@@ -1,0 +1,40 @@
+"""spacy-ray-tpu: a TPU-native distributed NLP pipeline training framework.
+
+Brand-new JAX/XLA/pallas implementation of the capability surface of
+explosion/spacy-ray (reference: /root/reference/spacy_ray): config-driven
+training of full NLP pipelines (tagger, transition-based parser/NER, textcat,
+spancat, shared CNN tok2vec and transformer backbones) scaled across
+accelerators from one CLI command.
+
+Where the reference implements distribution as asynchronous peer-to-peer
+parameter ownership over Ray actors (reference proxies.py:9-133,
+worker.py:23-262), this framework compiles the whole training step — forward,
+backward, gradient all-reduce over ICI, and (optionally ZeRO-1-sharded)
+optimizer update — into a single XLA program under `jax.jit` over a
+`jax.sharding.Mesh`.
+"""
+
+__version__ = "0.1.0"
+
+from .registry import registry  # noqa: F401
+from .config import Config, load_config  # noqa: F401
+
+# Importing these packages runs all registry registrations (architectures,
+# factories, optimizers, schedules, batchers, readers, loggers) — mirroring
+# the reference's entry-point-driven registration (setup.cfg:35-41).
+from . import models  # noqa: F401
+from .pipeline import components  # noqa: F401
+from . import training  # noqa: F401
+from .pipeline.language import Pipeline  # noqa: F401
+from .pipeline.doc import Doc, Example, Span  # noqa: F401
+
+__all__ = [
+    "registry",
+    "Config",
+    "load_config",
+    "Pipeline",
+    "Doc",
+    "Example",
+    "Span",
+    "__version__",
+]

@@ -1,0 +1,163 @@
+"""CLI: ``python -m spacy_ray_tpu train config.cfg [overrides]``.
+
+Capability parity with the reference CLI (reference train_cli.py:23-53:
+``spacy ray train <config> --n-workers --address --gpu-id --code --output
+--verbose`` + dotted config overrides). Mapping:
+
+* ``--n-workers N`` -> mesh data-axis size (actor count at reference
+  train_cli.py:72-82);
+* ``--address`` -> ``--coordinator`` (jax.distributed coordinator address;
+  Ray cluster address at train_cli.py:28);
+* ``--gpu-id`` -> ``--device`` (tpu/cpu; reference train_cli.py:29 + GPU
+  setup at :43);
+* ``--code`` -> same semantics: imported before config resolution in every
+  process (reference train_cli.py:30, worker.py:87);
+* ``--output`` -> WIRED to best/last checkpoints (the reference accepts and
+  drops it, TODO at train_cli.py:41 — SURVEY.md §2.4);
+* ``--verbose`` -> log level (train_cli.py:42).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+logger = logging.getLogger("spacy_ray_tpu")
+
+
+def _setup_device(device: str) -> None:
+    """Select the compute platform (the reference's setup_gpu/--gpu-id path,
+    train_cli.py:29,43).
+
+    Uses jax.config.update, not env vars: images whose sitecustomize imports
+    jax at interpreter boot have already locked in the env-var value by the
+    time the CLI runs.
+    """
+    if device == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    # tpu: default jax platform selection
+
+
+def _init_distributed(coordinator: Optional[str], num_processes: Optional[int], process_id: Optional[int]) -> None:
+    """Multi-host init (the reference's ray.init(address=...) equivalent,
+    train_cli.py:66-71): jax.distributed over ICI/DCN (SURVEY.md §5.8)."""
+    if coordinator:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+
+def train_command(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="spacy_ray_tpu train", description="Train a pipeline from a config."
+    )
+    parser.add_argument("config_path", type=Path)
+    parser.add_argument("--n-workers", type=int, default=None, dest="n_workers")
+    parser.add_argument("--coordinator", type=str, default=None,
+                        help="jax.distributed coordinator address (multi-host)")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--device", type=str, default="tpu", choices=["tpu", "cpu"])
+    parser.add_argument("--code", type=Path, default=None)
+    parser.add_argument("--output", "-o", type=Path, default=None)
+    parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--verbose", "-V", action="store_true")
+    args, extra = parser.parse_known_args(argv)
+
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.ERROR)
+    _setup_device(args.device)
+    _init_distributed(args.coordinator, args.num_processes, args.process_id)
+
+    from .config import load_config, parse_cli_overrides
+    from .registry import import_code
+
+    import_code(str(args.code) if args.code else None)
+    overrides = parse_cli_overrides(extra)
+    config = load_config(args.config_path, overrides, interpolate=False)
+
+    from .training.loop import train
+
+    nlp, result = train(
+        config,
+        output_path=args.output,
+        n_workers=args.n_workers,
+        resume=args.resume,
+    )
+    print(
+        f"Done. steps={result.final_step} best_score={result.best_score:.4f} "
+        f"(step {result.best_step}) words/sec={result.wps:,.0f}"
+    )
+    return 0
+
+
+def evaluate_command(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu evaluate")
+    parser.add_argument("model_path", type=Path)
+    parser.add_argument("data_path", type=Path)
+    parser.add_argument("--device", type=str, default="tpu", choices=["tpu", "cpu"])
+    args = parser.parse_args(argv)
+    _setup_device(args.device)
+
+    from .pipeline.language import Pipeline
+    from .training.corpus import Corpus
+
+    nlp = Pipeline.from_disk(args.model_path)
+    examples = list(Corpus(args.data_path)())
+    scores = nlp.evaluate(examples)
+    for key, value in sorted(scores.items()):
+        print(f"{key:24s} {value:.4f}")
+    return 0
+
+
+def convert_command(argv: List[str]) -> int:
+    """Convert jsonl/conllu corpora into the binary corpus format (the
+    reference's data path runs `spacy convert`, bin/get-data.sh:8-12)."""
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu convert")
+    parser.add_argument("input_path", type=Path)
+    parser.add_argument("output_path", type=Path)
+    args = parser.parse_args(argv)
+
+    from .training.corpus import DocBin, read_conllu_docs, read_jsonl_docs
+
+    if args.input_path.suffix == ".jsonl":
+        docs = list(read_jsonl_docs(args.input_path))
+    elif args.input_path.suffix == ".conllu":
+        docs = list(read_conllu_docs(args.input_path))
+    else:
+        print(f"Unsupported input: {args.input_path}", file=sys.stderr)
+        return 1
+    DocBin(docs).to_disk(args.output_path)
+    print(f"Wrote {len(docs)} docs to {args.output_path}")
+    return 0
+
+
+COMMANDS = {
+    "train": train_command,
+    "evaluate": evaluate_command,
+    "convert": convert_command,
+}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("Usage: python -m spacy_ray_tpu {train,evaluate,convert} ...")
+        return 0
+    command = argv[0]
+    if command not in COMMANDS:
+        print(f"Unknown command {command!r}. Available: {', '.join(COMMANDS)}", file=sys.stderr)
+        return 1
+    return COMMANDS[command](argv[1:])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

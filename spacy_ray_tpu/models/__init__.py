@@ -1,0 +1,6 @@
+"""Model architectures (registered in the ``architectures`` registry)."""
+
+from .core import Model, Context, chain, residual, clone, count_params, param_paths  # noqa: F401
+from . import layers  # noqa: F401
+from . import tok2vec  # noqa: F401  (registers spacy.HashEmbedCNN.v2 etc.)
+from . import heads  # noqa: F401  (registers spacy.Tagger.v2 etc.)

@@ -1,0 +1,133 @@
+"""Per-component head architectures: tagger, textcat, morphologizer-style.
+
+Registered under the canonical ``spacy.*`` architecture names used by the
+configs the reference trains (reference worker.py:91 resolves these via
+spacy's registry; SURVEY.md §5.6). Heads consume the tok2vec output
+(:class:`Padded`) either from an inline tok2vec sublayer or from the shared
+upstream component via ``spacy.Tok2VecListener.v1`` (the listener/upstream
+sharing pattern — SURVEY.md §7 "Transformer sharing across components").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..registry import registry
+from ..ops import ops as O
+from ..types import Padded, TokenBatch
+from .core import Context, Model, chain, glorot_uniform
+from .layers import Linear
+
+
+@registry.architectures("spacy.Tok2VecListener.v1")
+def Tok2VecListener(width: int, upstream: str = "*") -> Model:
+    """Placeholder layer standing in for the shared tok2vec component.
+
+    The pipeline feeds the upstream component's Padded output directly into
+    any head whose model tree contains a listener (pipeline/language.py wires
+    this; gradient flows back into the shared trunk because the whole
+    pipeline loss is one jitted function — the functional equivalent of
+    spaCy's listener backprop relay).
+    """
+
+    def init_fn(rng):
+        return {}
+
+    def apply_fn(params, x: Padded, ctx: Context) -> Padded:
+        if not isinstance(x, Padded):
+            raise TypeError(
+                "Tok2VecListener expected the upstream tok2vec output (Padded); "
+                "did the pipeline forget to run the shared tok2vec?"
+            )
+        return x
+
+    return Model(
+        "tok2vec_listener",
+        init_fn,
+        apply_fn,
+        dims={"nO": width},
+        meta={"listener": True, "upstream": upstream},
+    )
+
+
+def _has_listener(model: Model) -> bool:
+    return any(m.meta.get("listener") for m in model.walk())
+
+
+@registry.architectures("spacy.Tagger.v2")
+def Tagger(tok2vec: Model, nO: Optional[int] = None, normalize: bool = False) -> Model:
+    """Softmax tagger head: tok2vec → linear(nO). Loss/decode live in the
+    component (pipeline/components/tagger.py)."""
+    width = tok2vec.dims.get("nO")
+    if nO is None:
+        # Resolution happens again at Pipeline.initialize() with label count
+        # injected; constructing with nO=1 placeholder is never trained.
+        nO = 1
+    head = chain(tok2vec, Linear(width, nO, name="output"), name="tagger_model")
+    head.dims.update({"nO": nO, "width": width})
+    head.meta["has_listener"] = _has_listener(tok2vec)
+    return head
+
+
+@registry.architectures("spacy.TextCatReduce.v1")
+def TextCatReduce(
+    tok2vec: Model,
+    nO: Optional[int] = None,
+    exclusive_classes: bool = False,
+    use_reduce_first: bool = False,
+    use_reduce_last: bool = False,
+    use_reduce_max: bool = True,
+    use_reduce_mean: bool = True,
+) -> Model:
+    """Doc classifier: tok2vec → masked pooling (mean/max/first/last concat)
+    → linear(nO). Sigmoid vs softmax is applied by the component depending on
+    ``exclusive_classes``."""
+    width = tok2vec.dims.get("nO")
+    n_pools = sum([use_reduce_first, use_reduce_last, use_reduce_max, use_reduce_mean])
+    if n_pools == 0:
+        raise ValueError("TextCatReduce: enable at least one reduction")
+    if nO is None:
+        nO = 1
+
+    def init_fn(rng):
+        import jax
+
+        r1, r2 = jax.random.split(rng)
+        return {
+            "tok2vec": tok2vec.init(r1),
+            "W": glorot_uniform(r2, (width * n_pools, nO)),
+            "b": jnp.zeros((nO,)),
+        }
+
+    def apply_fn(params, x: Any, ctx: Context) -> jnp.ndarray:
+        h: Padded = tok2vec.apply(params["tok2vec"], x, ctx)
+        pools = []
+        mask = h.mask
+        if use_reduce_first:
+            first = h.X[:, 0, :]
+            pools.append(first)
+        if use_reduce_last:
+            lengths = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
+            last = jnp.take_along_axis(h.X, lengths[:, None, None], axis=1)[:, 0, :]
+            pools.append(last)
+        if use_reduce_max:
+            pools.append(O.max_pool(h.X, mask))
+        if use_reduce_mean:
+            pools.append(O.mean_pool(h.X, mask))
+        feats = jnp.concatenate(pools, axis=-1)
+        return feats @ params["W"] + params["b"]
+
+    m = Model(
+        "textcat_model",
+        init_fn,
+        apply_fn,
+        dims={"nO": nO, "width": width},
+        layers=[tok2vec],
+        meta={
+            "has_listener": _has_listener(tok2vec),
+            "exclusive_classes": exclusive_classes,
+        },
+    )
+    return m

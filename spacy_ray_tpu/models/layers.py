@@ -1,0 +1,127 @@
+"""Primitive layers over :class:`~spacy_ray_tpu.types.Padded` sequences.
+
+These are the building blocks the architecture registry composes (the role
+thinc's Linear/Maxout/LayerNorm/HashEmbed play for the reference's models —
+supplied there by native NumpyOps/CupyOps kernels, SURVEY.md §2.3; here by
+XLA on the MXU).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import ops as O
+from ..ops import hashing
+from ..types import Padded, TokenBatch
+from .core import Context, Model, glorot_uniform, normal_init
+
+
+def Linear(nI: int, nO: int, name: str = "linear") -> Model:
+    def init_fn(rng):
+        return {"W": glorot_uniform(rng, (nI, nO)), "b": jnp.zeros((nO,))}
+
+    def apply_fn(params, x: Padded, ctx: Context) -> Padded:
+        X = jnp.einsum("...i,io->...o", x.X, params["W"]) + params["b"]
+        return Padded(X=X, mask=x.mask)
+
+    return Model(name, init_fn, apply_fn, dims={"nI": nI, "nO": nO})
+
+
+def Maxout(nI: int, nO: int, nP: int = 3, name: str = "maxout") -> Model:
+    def init_fn(rng):
+        return {
+            "W": glorot_uniform(rng, (nI, nO * nP)),
+            "b": jnp.zeros((nO, nP)),
+        }
+
+    def apply_fn(params, x: Padded, ctx: Context) -> Padded:
+        X = O.maxout(x.X, params["W"], params["b"])
+        return Padded(X=X, mask=x.mask)
+
+    return Model(name, init_fn, apply_fn, dims={"nI": nI, "nO": nO, "nP": nP})
+
+
+def LayerNorm(nO: int, name: str = "norm") -> Model:
+    def init_fn(rng):
+        return {"g": jnp.ones((nO,)), "b": jnp.zeros((nO,))}
+
+    def apply_fn(params, x: Padded, ctx: Context) -> Padded:
+        return Padded(X=O.layer_norm(x.X, params["g"], params["b"]), mask=x.mask)
+
+    return Model(name, init_fn, apply_fn, dims={"nI": nO, "nO": nO})
+
+
+def Dropout(rate: float, name: str = "dropout") -> Model:
+    def init_fn(rng):
+        return {}
+
+    def apply_fn(params, x: Padded, ctx: Context) -> Padded:
+        if ctx.train and ctx.rng is not None and rate > 0:
+            return Padded(X=O.dropout(ctx.rng, x.X, rate, True), mask=x.mask)
+        return x
+
+    return Model(name, init_fn, apply_fn)
+
+
+def Seq2Col(window: int, nI: int, name: str = "seq2col") -> Model:
+    def init_fn(rng):
+        return {}
+
+    def apply_fn(params, x: Padded, ctx: Context) -> Padded:
+        return Padded(X=O.seq2col(x.X, window, x.mask), mask=x.mask)
+
+    nO = nI * (2 * window + 1)
+    return Model(name, init_fn, apply_fn, dims={"nI": nI, "nO": nO})
+
+
+def HashEmbed(
+    width: int,
+    rows: int,
+    seed: int,
+    attr_index: int,
+    name: str = "hash_embed",
+) -> Model:
+    """Feature-hashing embedding table: 4 murmur hashes per key, rows summed.
+
+    The XLA-native equivalent of thinc HashEmbed (native murmurhash dep of
+    the reference, SURVEY.md §2.3): gathers 4 rows per token from a
+    [rows, width] table using in-kernel murmur3 x86_128 of the 64-bit
+    attribute key.
+    """
+
+    def init_fn(rng):
+        return {"E": normal_init(rng, (rows, width), stddev=width ** -0.5)}
+
+    def apply_fn(params, batch: TokenBatch, ctx: Context) -> Padded:
+        keys = batch.attr_keys[..., attr_index, :]  # [B, T, 2]
+        ids = hashing.hash_embed_ids(keys, seed, rows)  # [B, T, 4]
+        vecs = jnp.take(params["E"], ids, axis=0)  # [B, T, 4, width]
+        X = jnp.sum(vecs, axis=-2)
+        mask_f = batch.mask[..., None].astype(X.dtype)
+        return Padded(X=X * mask_f, mask=batch.mask)
+
+    return Model(name, init_fn, apply_fn, dims={"nO": width, "rows": rows})
+
+
+def ConcatPadded(*layers: Model, name: str = "concat") -> Model:
+    """Apply layers to the same input, concat features."""
+
+    def init_fn(rng):
+        rngs = jax.random.split(rng, len(layers))
+        return {f"{i}_{l.name}": l.init(rngs[i]) for i, l in enumerate(layers)}
+
+    def apply_fn(params, x, ctx: Context):
+        outs = []
+        mask = None
+        for i, l in enumerate(layers):
+            ctx, sub = ctx.split()
+            out = l.apply(params.get(f"{i}_{l.name}", {}), x, sub)
+            outs.append(out.X)
+            mask = out.mask
+        return Padded(X=jnp.concatenate(outs, axis=-1), mask=mask)
+
+    nO = sum(l.dims.get("nO", 0) for l in layers)
+    return Model(name, init_fn, apply_fn, dims={"nO": nO}, layers=list(layers))

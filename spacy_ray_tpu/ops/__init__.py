@@ -1,0 +1,21 @@
+"""JaxOps: XLA/pallas kernel layer (the NumpyOps/CupyOps equivalent, SURVEY.md §2.3)."""
+
+from .ops import (  # noqa: F401
+    seq2col,
+    maxout,
+    layer_norm,
+    mish,
+    gelu,
+    dropout,
+    masked_softmax_cross_entropy,
+    masked_sigmoid_bce,
+    masked_accuracy,
+    mean_pool,
+    max_pool,
+)
+from .hashing import (  # noqa: F401
+    murmur3_x86_128_u64,
+    hash_embed_ids,
+    hash_string_u64,
+    split_u64,
+)

@@ -1,0 +1,277 @@
+"""Hashing kernels for hash-embedding tables, in pure jnp (TPU-friendly).
+
+Capability parity: the reference's models embed tokens via thinc's
+``HashEmbed`` layers, whose row lookup is murmurhash-based feature hashing
+supplied by the native murmurhash C dependency (reference setup.cfg:31-33
+transitively; SURVEY.md §2.3). Here the same capability is an in-kernel
+MurmurHash3 x86_128 implemented with 32-bit integer ops only, so it runs on
+the TPU VPU (no 64-bit int support needed) and fuses into the embedding
+gather under XLA.
+
+The x86_128 variant is used (not x64_128) because it needs only 32-bit
+multiplies and rotates. Keys are 64-bit token ids passed as two uint32 halves.
+Each key yields four 32-bit hashes; HashEmbed gathers and sums the four rows
+(collision mitigation, same scheme thinc uses).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+_C1 = np.uint32(0x239B961B)
+_C2 = np.uint32(0xAB0E9789)
+_C3 = np.uint32(0x38B34AE5)
+_C4 = np.uint32(0xA1E38B93)
+
+
+def _rotl32(x, r: int):
+    return (x << r) | (x >> (32 - r))
+
+
+def _fmix32(h):
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def murmur3_x86_128_u64(key_lo, key_hi, seed: int):
+    """MurmurHash3 x86_128 of an 8-byte key given as two uint32 words.
+
+    Args:
+      key_lo, key_hi: uint32 arrays (low/high 32 bits of the 64-bit key).
+      seed: python int seed.
+    Returns:
+      tuple of four uint32 arrays (h1, h2, h3, h4), same shape as inputs.
+    """
+    key_lo = key_lo.astype(jnp.uint32)
+    key_hi = key_hi.astype(jnp.uint32)
+    seed_u = jnp.uint32(seed & 0xFFFFFFFF)
+    h1 = h2 = h3 = h4 = jnp.broadcast_to(seed_u, key_lo.shape)
+
+    # tail processing for len=8: k1 = block0 (lo), k2 = block1 (hi), k3=k4=0
+    k1 = key_lo * jnp.uint32(_C1)
+    k1 = _rotl32(k1, 15)
+    k1 = k1 * jnp.uint32(_C2)
+    h1 = h1 ^ k1
+
+    k2 = key_hi * jnp.uint32(_C2)
+    k2 = _rotl32(k2, 16)
+    k2 = k2 * jnp.uint32(_C3)
+    h2 = h2 ^ k2
+
+    # finalization, length = 8 bytes
+    length = jnp.uint32(8)
+    h1 = h1 ^ length
+    h2 = h2 ^ length
+    h3 = h3 ^ length
+    h4 = h4 ^ length
+
+    h1 = h1 + h2 + h3 + h4
+    h2 = h2 + h1
+    h3 = h3 + h1
+    h4 = h4 + h1
+
+    h1 = _fmix32(h1)
+    h2 = _fmix32(h2)
+    h3 = _fmix32(h3)
+    h4 = _fmix32(h4)
+
+    h1 = h1 + h2 + h3 + h4
+    h2 = h2 + h1
+    h3 = h3 + h1
+    h4 = h4 + h1
+    return h1, h2, h3, h4
+
+
+def hash_embed_ids(keys_u64_2x32, seed: int, n_rows: int):
+    """Map 64-bit keys to 4 row indices each, for HashEmbed gather-sum.
+
+    Args:
+      keys_u64_2x32: uint32 array of shape [..., 2] — (lo, hi) halves.
+      seed: table seed.
+      n_rows: number of rows in the embedding table.
+    Returns:
+      uint32 array of shape [..., 4] of row indices in [0, n_rows).
+    """
+    lo = keys_u64_2x32[..., 0]
+    hi = keys_u64_2x32[..., 1]
+    h1, h2, h3, h4 = murmur3_x86_128_u64(lo, hi, seed)
+    ids = jnp.stack([h1, h2, h3, h4], axis=-1)
+    return (ids % jnp.uint32(n_rows)).astype(jnp.int32)
+
+
+# ----------------------------------------------------------------------
+# Host-side reference implementation (numpy) — the oracle for tests, and
+# the string->u64 key hash used by the Vocab when the C++ extension is
+# unavailable.
+# ----------------------------------------------------------------------
+
+
+def murmur3_x86_128_u64_np(key_lo: np.ndarray, key_hi: np.ndarray, seed: int):
+    with np.errstate(over="ignore"):
+        key_lo = key_lo.astype(np.uint32)
+        key_hi = key_hi.astype(np.uint32)
+
+        def rotl(x, r):
+            return ((x << np.uint32(r)) | (x >> np.uint32(32 - r))).astype(np.uint32)
+
+        def fmix(h):
+            h = h ^ (h >> np.uint32(16))
+            h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
+            h = h ^ (h >> np.uint32(13))
+            h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
+            h = h ^ (h >> np.uint32(16))
+            return h
+
+        seed_u = np.uint32(seed & 0xFFFFFFFF)
+        h1 = np.full(key_lo.shape, seed_u, dtype=np.uint32)
+        h2 = h1.copy()
+        h3 = h1.copy()
+        h4 = h1.copy()
+
+        k1 = (key_lo * _C1).astype(np.uint32)
+        k1 = rotl(k1, 15)
+        k1 = (k1 * _C2).astype(np.uint32)
+        h1 = h1 ^ k1
+
+        k2 = (key_hi * _C2).astype(np.uint32)
+        k2 = rotl(k2, 16)
+        k2 = (k2 * _C3).astype(np.uint32)
+        h2 = h2 ^ k2
+
+        length = np.uint32(8)
+        h1 ^= length
+        h2 ^= length
+        h3 ^= length
+        h4 ^= length
+        h1 = (h1 + h2 + h3 + h4).astype(np.uint32)
+        h2 = (h2 + h1).astype(np.uint32)
+        h3 = (h3 + h1).astype(np.uint32)
+        h4 = (h4 + h1).astype(np.uint32)
+        h1 = fmix(h1)
+        h2 = fmix(h2)
+        h3 = fmix(h3)
+        h4 = fmix(h4)
+        h1 = (h1 + h2 + h3 + h4).astype(np.uint32)
+        h2 = (h2 + h1).astype(np.uint32)
+        h3 = (h3 + h1).astype(np.uint32)
+        h4 = (h4 + h1).astype(np.uint32)
+        return h1, h2, h3, h4
+
+
+def hash_string_u64(s: str, seed: int = 0) -> int:
+    """Stable 64-bit hash of a string (host side), for Vocab key assignment.
+
+    Pure-python MurmurHash3 x86_128 over the utf-8 bytes, truncated to 64
+    bits. Replaced by the C++ extension when available (see native/).
+    Stable across processes — fixes the fragile per-process ``(node_id,
+    name)`` key identity the reference relies on (reference util.py:6,53-54;
+    SURVEY.md §2.4).
+    """
+    data = s.encode("utf8")
+    h = _murmur3_x86_128_bytes(data, seed)
+    return h & 0xFFFFFFFFFFFFFFFF
+
+
+def _murmur3_x86_128_bytes(data: bytes, seed: int) -> int:
+    def rotl(x, r):
+        return ((x << r) | (x >> (32 - r))) & 0xFFFFFFFF
+
+    def fmix(h):
+        h ^= h >> 16
+        h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+        h ^= h >> 13
+        h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+        h ^= h >> 16
+        return h
+
+    c1, c2, c3, c4 = 0x239B961B, 0xAB0E9789, 0x38B34AE5, 0xA1E38B93
+    h1 = h2 = h3 = h4 = seed & 0xFFFFFFFF
+    length = len(data)
+    nblocks = length // 16
+    for i in range(nblocks):
+        block = data[i * 16 : (i + 1) * 16]
+        k1 = int.from_bytes(block[0:4], "little")
+        k2 = int.from_bytes(block[4:8], "little")
+        k3 = int.from_bytes(block[8:12], "little")
+        k4 = int.from_bytes(block[12:16], "little")
+        k1 = rotl((k1 * c1) & 0xFFFFFFFF, 15)
+        k1 = (k1 * c2) & 0xFFFFFFFF
+        h1 ^= k1
+        h1 = rotl(h1, 19)
+        h1 = (h1 + h2) & 0xFFFFFFFF
+        h1 = (h1 * 5 + 0x561CCD1B) & 0xFFFFFFFF
+        k2 = rotl((k2 * c2) & 0xFFFFFFFF, 16)
+        k2 = (k2 * c3) & 0xFFFFFFFF
+        h2 ^= k2
+        h2 = rotl(h2, 17)
+        h2 = (h2 + h3) & 0xFFFFFFFF
+        h2 = (h2 * 5 + 0x0BCAA747) & 0xFFFFFFFF
+        k3 = rotl((k3 * c3) & 0xFFFFFFFF, 17)
+        k3 = (k3 * c4) & 0xFFFFFFFF
+        h3 ^= k3
+        h3 = rotl(h3, 15)
+        h3 = (h3 + h4) & 0xFFFFFFFF
+        h3 = (h3 * 5 + 0x96CD1C35) & 0xFFFFFFFF
+        k4 = rotl((k4 * c4) & 0xFFFFFFFF, 18)
+        k4 = (k4 * c1) & 0xFFFFFFFF
+        h4 ^= k4
+        h4 = rotl(h4, 13)
+        h4 = (h4 + h1) & 0xFFFFFFFF
+        h4 = (h4 * 5 + 0x32AC3B17) & 0xFFFFFFFF
+
+    tail = data[nblocks * 16 :]
+    k1 = k2 = k3 = k4 = 0
+    t = len(tail)
+    if t >= 13:
+        k4 = int.from_bytes(tail[12:t].ljust(4, b"\0"), "little")
+    if t >= 9:
+        k3 = int.from_bytes(tail[8:min(t, 12)].ljust(4, b"\0"), "little")
+    if t >= 5:
+        k2 = int.from_bytes(tail[4:min(t, 8)].ljust(4, b"\0"), "little")
+    if t >= 1:
+        k1 = int.from_bytes(tail[0:min(t, 4)].ljust(4, b"\0"), "little")
+    if k4:
+        k4 = rotl((k4 * c4) & 0xFFFFFFFF, 18)
+        k4 = (k4 * c1) & 0xFFFFFFFF
+        h4 ^= k4
+    if k3:
+        k3 = rotl((k3 * c3) & 0xFFFFFFFF, 17)
+        k3 = (k3 * c4) & 0xFFFFFFFF
+        h3 ^= k3
+    if k2:
+        k2 = rotl((k2 * c2) & 0xFFFFFFFF, 16)
+        k2 = (k2 * c3) & 0xFFFFFFFF
+        h2 ^= k2
+    if k1:
+        k1 = rotl((k1 * c1) & 0xFFFFFFFF, 15)
+        k1 = (k1 * c2) & 0xFFFFFFFF
+        h1 ^= k1
+
+    h1 ^= length
+    h2 ^= length
+    h3 ^= length
+    h4 ^= length
+    h1 = (h1 + h2 + h3 + h4) & 0xFFFFFFFF
+    h2 = (h2 + h1) & 0xFFFFFFFF
+    h3 = (h3 + h1) & 0xFFFFFFFF
+    h4 = (h4 + h1) & 0xFFFFFFFF
+    h1 = fmix(h1)
+    h2 = fmix(h2)
+    h3 = fmix(h3)
+    h4 = fmix(h4)
+    h1 = (h1 + h2 + h3 + h4) & 0xFFFFFFFF
+    h2 = (h2 + h1) & 0xFFFFFFFF
+    return (h2 << 32) | h1
+
+
+def split_u64(keys: np.ndarray) -> np.ndarray:
+    """uint64 array -> [..., 2] uint32 (lo, hi) for device-side hashing."""
+    keys = keys.astype(np.uint64)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    return np.stack([lo, hi], axis=-1)

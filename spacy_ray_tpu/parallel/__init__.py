@@ -1,0 +1,1 @@
+"""Distribution layer: mesh, sharded train step, collectives (SURVEY.md §5.8)."""

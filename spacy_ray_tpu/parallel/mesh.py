@@ -1,0 +1,80 @@
+"""Device mesh construction: the TPU-native replacement for the Ray cluster.
+
+Capability parity with the reference's worker topology (reference
+train_cli.py:66-82: ``ray.init`` + N actor spawn; SURVEY.md §5.8): here
+"workers" are mesh positions. Axes:
+
+* ``data`` — batch sharding + gradient all-reduce over ICI (replaces the
+  RayPeerProxy grad push/param broadcast protocol, reference
+  proxies.py:71-109);
+* ``model`` — tensor parallelism for large trunks (transformer);
+* ``context`` — sequence/context parallelism (ring attention).
+
+``--n-workers N`` from the CLI (reference train_cli.py:27) maps to the data
+axis size.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("data", "model", "context")
+
+
+def build_mesh(
+    n_data: Optional[int] = None,
+    n_model: int = 1,
+    n_context: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    n_total = len(devices)
+    if n_data is None:
+        n_data = n_total // (n_model * n_context)
+    want = n_data * n_model * n_context
+    if want > n_total:
+        raise ValueError(
+            f"Mesh {n_data}x{n_model}x{n_context} needs {want} devices, have {n_total}"
+        )
+    dev_array = np.array(devices[:want]).reshape(n_data, n_model, n_context)
+    return Mesh(dev_array, AXES)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh, rank: int = 0) -> NamedSharding:
+    """Shard dim `rank` over the data axis."""
+    spec = [None] * (rank + 1)
+    spec[rank] = "data"
+    return NamedSharding(mesh, P(*spec))
+
+
+def batch_spec(accumulate: bool = False):
+    """PartitionSpec for a batch leaf: [B, ...] or [A, B, ...] with accum."""
+    return P(None, "data") if accumulate else P("data")
+
+
+def zero1_spec(leaf: "jax.Array", mesh: Mesh) -> NamedSharding:
+    """ZeRO-1 sharding for one optimizer-state leaf: shard the first axis
+    divisible by the data-axis size; replicate otherwise.
+
+    The GSPMD version of the reference's parameter-ownership split
+    (reference util.py:57-75 ``divide_params`` + owner-applied updates at
+    proxies.py:111-133): ownership becomes a sharding annotation and the
+    update math is compiled with its collectives (SURVEY.md §2.2 row
+    "Optimizer/param-state sharding").
+    """
+    n_data = mesh.shape["data"]
+    shape = getattr(leaf, "shape", ())
+    for axis, dim in enumerate(shape):
+        if dim % n_data == 0 and dim >= n_data:
+            spec = [None] * len(shape)
+            spec[axis] = "data"
+            return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P())

@@ -1,0 +1,6 @@
+"""Pipeline components (registered in the ``factories`` registry)."""
+
+from .base import Component  # noqa: F401
+from . import tok2vec  # noqa: F401
+from . import tagger  # noqa: F401
+from . import textcat  # noqa: F401

@@ -1,0 +1,74 @@
+"""Tagger component: per-token softmax classification (POS tags).
+
+The first BASELINE.json config's head ("tagger-only CNN tok2vec"). Gold tags
+come from Doc.tags; scoring is token accuracy (``tag_acc``), matching the
+scorer key spaCy reports for parity checks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...registry import registry
+from ...models.core import Context, Params
+from ...ops import ops as O
+from ...pipeline.doc import Doc, Example
+from .base import Component
+
+
+class TaggerComponent(Component):
+    def add_labels_from(self, examples) -> None:
+        labels = set(self.labels)
+        for eg in examples:
+            if eg.reference.tags:
+                labels.update(t for t in eg.reference.tags if t)
+        self.labels = list(labels)
+
+    def make_targets(self, examples: List[Example], B: int, T: int) -> Dict[str, np.ndarray]:
+        label_ids = {label: i for i, label in enumerate(self.labels)}
+        tags = np.zeros((B, T), dtype=np.int32)
+        mask = np.zeros((B, T), dtype=bool)
+        for i, eg in enumerate(examples):
+            ref = eg.reference
+            if not ref.tags:
+                continue
+            for j, tag in enumerate(ref.tags[:T]):
+                if tag in label_ids:
+                    tags[i, j] = label_ids[tag]
+                    mask[i, j] = True
+        return {"tags": tags, "tag_mask": mask}
+
+    def loss(self, params: Params, inputs: Any, targets: Dict[str, Any], ctx: Context):
+        logits = self.model.apply(params, inputs, ctx).X
+        loss = O.masked_softmax_cross_entropy(
+            logits, targets["tags"], targets["tag_mask"]
+        )
+        acc = O.masked_accuracy(logits, targets["tags"], targets["tag_mask"])
+        return loss, {"tag_acc_batch": acc}
+
+    def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
+        pred = np.asarray(jnp.argmax(outputs.X, axis=-1))
+        for i, doc in enumerate(docs):
+            n = lengths[i]
+            doc.tags = [self.labels[t] for t in pred[i, :n]]
+
+    def score(self, examples: List[Example]) -> Dict[str, float]:
+        correct = 0
+        total = 0
+        for eg in examples:
+            gold = eg.reference.tags or []
+            pred = eg.predicted.tags or []
+            for g, p in zip(gold, pred):
+                if not g:
+                    continue
+                total += 1
+                correct += int(g == p)
+        return {"tag_acc": (correct / total) if total else 0.0}
+
+
+@registry.factories("tagger")
+def make_tagger(name: str, model: Dict[str, Any]) -> TaggerComponent:
+    return TaggerComponent(name, model)

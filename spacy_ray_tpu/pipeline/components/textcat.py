@@ -1,0 +1,124 @@
+"""Text classification components: ``textcat`` (exclusive) and
+``textcat_multilabel`` (BASELINE.json config #5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...registry import registry
+from ...models.core import Context, Params
+from ...ops import ops as O
+from ...pipeline.doc import Doc, Example
+from .base import Component
+
+
+class TextCatComponent(Component):
+    def __init__(self, name: str, model_cfg: Dict[str, Any], exclusive: bool, threshold: float = 0.5):
+        super().__init__(name, model_cfg)
+        self.exclusive = exclusive
+        self.threshold = threshold
+
+    def add_labels_from(self, examples) -> None:
+        labels = set(self.labels)
+        for eg in examples:
+            labels.update(eg.reference.cats.keys())
+        self.labels = list(labels)
+
+    def make_targets(self, examples: List[Example], B: int, T: int) -> Dict[str, np.ndarray]:
+        label_ids = {label: i for i, label in enumerate(self.labels)}
+        cats = np.zeros((B, len(self.labels)), dtype=np.float32)
+        mask = np.zeros((B,), dtype=bool)
+        for i, eg in enumerate(examples):
+            if eg.reference.cats:
+                mask[i] = True
+                for label, value in eg.reference.cats.items():
+                    if label in label_ids:
+                        cats[i, label_ids[label]] = float(value)
+        return {"cats": cats, "cats_mask": mask}
+
+    def loss(self, params: Params, inputs: Any, targets: Dict[str, Any], ctx: Context):
+        logits = self.model.apply(params, inputs, ctx)  # [B, C]
+        cats = targets["cats"]
+        mask = targets["cats_mask"].astype(jnp.float32)
+        if self.exclusive:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            per = -jnp.sum(cats * logp, axis=-1)
+            loss = jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            loss = O.masked_sigmoid_bce(logits, cats, targets["cats_mask"])
+        return loss, {}
+
+    def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
+        logits = np.asarray(outputs, dtype=np.float32)
+        if self.exclusive:
+            probs = np.exp(logits - logits.max(-1, keepdims=True))
+            probs = probs / probs.sum(-1, keepdims=True)
+        else:
+            probs = 1.0 / (1.0 + np.exp(-logits))
+        for i, doc in enumerate(docs):
+            doc.cats = {label: float(probs[i, j]) for j, label in enumerate(self.labels)}
+
+    def score(self, examples: List[Example]) -> Dict[str, float]:
+        # micro-F over label decisions at threshold; accuracy for exclusive
+        tp = fp = fn = 0
+        correct = total = 0
+        per_label_tp = {l: 0 for l in self.labels}
+        per_label_fp = {l: 0 for l in self.labels}
+        per_label_fn = {l: 0 for l in self.labels}
+        for eg in examples:
+            gold = eg.reference.cats
+            pred = eg.predicted.cats
+            if not gold:
+                continue
+            if self.exclusive:
+                total += 1
+                g = max(gold, key=gold.get)
+                p = max(pred, key=pred.get) if pred else None
+                correct += int(g == p)
+            for label in self.labels:
+                gv = gold.get(label, 0.0) >= 0.5
+                pv = pred.get(label, 0.0) >= self.threshold
+                if pv and gv:
+                    tp += 1
+                    per_label_tp[label] += 1
+                elif pv:
+                    fp += 1
+                    per_label_fp[label] += 1
+                elif gv:
+                    fn += 1
+                    per_label_fn[label] += 1
+        micro_p = tp / (tp + fp) if tp + fp else 0.0
+        micro_r = tp / (tp + fn) if tp + fn else 0.0
+        micro_f = 2 * micro_p * micro_r / (micro_p + micro_r) if micro_p + micro_r else 0.0
+        macro_fs = []
+        for label in self.labels:
+            ltp, lfp, lfn = per_label_tp[label], per_label_fp[label], per_label_fn[label]
+            p = ltp / (ltp + lfp) if ltp + lfp else 0.0
+            r = ltp / (ltp + lfn) if ltp + lfn else 0.0
+            macro_fs.append(2 * p * r / (p + r) if p + r else 0.0)
+        out = {
+            "cats_micro_f": micro_f,
+            "cats_macro_f": float(np.mean(macro_fs)) if macro_fs else 0.0,
+            "cats_score": micro_f,
+        }
+        if self.exclusive and total:
+            out["cats_acc"] = correct / total
+            out["cats_score"] = out["cats_acc"]
+        return out
+
+
+@registry.factories("textcat")
+def make_textcat(name: str, model: Dict[str, Any], threshold: float = 0.5) -> TextCatComponent:
+    return TextCatComponent(name, model, exclusive=True, threshold=threshold)
+
+
+@registry.factories("textcat_multilabel")
+def make_textcat_multilabel(
+    name: str, model: Dict[str, Any], threshold: float = 0.5
+) -> TextCatComponent:
+    return TextCatComponent(name, model, exclusive=False, threshold=threshold)

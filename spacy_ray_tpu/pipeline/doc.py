@@ -1,0 +1,115 @@
+"""Doc / Span / Example: host-side annotation containers.
+
+Capability parity with the spaCy ``Doc``/``Example`` objects that flow
+through the reference's training loop (reference worker.py:8-16 imports;
+SURVEY.md §2.3 row "spaCy core" — Doc/Vocab are native Cython there, and
+explicitly host-side I/O-bound structures in the TPU design). These are
+plain Python containers: the device never sees them — the batcher lowers
+them to padded arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class Span:
+    """A labeled token-slice [start, end) of a doc."""
+
+    start: int
+    end: int
+    label: str
+
+    def __iter__(self):
+        yield from (self.start, self.end, self.label)
+
+
+@dataclass
+class Doc:
+    """A tokenized text with optional gold/predicted annotations."""
+
+    words: List[str]
+    spaces: Optional[List[bool]] = None
+    # token-level
+    tags: Optional[List[str]] = None  # fine-grained POS
+    pos: Optional[List[str]] = None  # coarse UPOS
+    heads: Optional[List[int]] = None  # dependency head index per token
+    deps: Optional[List[str]] = None  # dependency label per token
+    lemmas: Optional[List[str]] = None
+    sent_starts: Optional[List[int]] = None  # 1/-1/0 per token
+    # span-level
+    ents: List[Span] = field(default_factory=list)  # named entities
+    spans: Dict[str, List[Span]] = field(default_factory=dict)  # spancat groups
+    # doc-level
+    cats: Dict[str, float] = field(default_factory=dict)
+
+    def __len__(self) -> int:
+        return len(self.words)
+
+    @property
+    def text(self) -> str:
+        if self.spaces is None:
+            return " ".join(self.words)
+        return "".join(
+            w + (" " if sp else "") for w, sp in zip(self.words, self.spaces)
+        )
+
+    def ents_biluo(self) -> List[str]:
+        """Render entity spans as per-token BILUO tags (O outside)."""
+        tags = ["O"] * len(self.words)
+        for span in self.ents:
+            if span.end <= span.start:
+                continue
+            if span.end - span.start == 1:
+                tags[span.start] = f"U-{span.label}"
+            else:
+                tags[span.start] = f"B-{span.label}"
+                for i in range(span.start + 1, span.end - 1):
+                    tags[i] = f"I-{span.label}"
+                tags[span.end - 1] = f"L-{span.label}"
+        return tags
+
+    @staticmethod
+    def spans_from_biluo(tags: List[str]) -> List[Span]:
+        spans: List[Span] = []
+        start, label = None, None
+        for i, tag in enumerate(tags):
+            if tag == "O" or tag == "-":
+                start, label = None, None
+                continue
+            prefix, _, lab = tag.partition("-")
+            if prefix == "U":
+                spans.append(Span(i, i + 1, lab))
+                start, label = None, None
+            elif prefix == "B":
+                start, label = i, lab
+            elif prefix == "I":
+                if start is None or lab != label:
+                    start, label = None, None  # malformed; drop
+            elif prefix == "L":
+                if start is not None and lab == label:
+                    spans.append(Span(start, i + 1, lab))
+                start, label = None, None
+        return spans
+
+    def copy_shell(self) -> "Doc":
+        """A prediction shell: same tokens, no annotations."""
+        return Doc(words=list(self.words), spaces=list(self.spaces) if self.spaces else None)
+
+
+@dataclass
+class Example:
+    """Paired (predicted, reference) docs, mirroring spacy's Example
+    (consumed by the loop at reference worker.py:176-189)."""
+
+    predicted: Doc
+    reference: Doc
+
+    @classmethod
+    def from_gold(cls, gold: Doc) -> "Example":
+        return cls(predicted=gold.copy_shell(), reference=gold)
+
+    def __len__(self) -> int:
+        return len(self.reference)

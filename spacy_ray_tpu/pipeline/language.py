@@ -1,0 +1,315 @@
+"""Pipeline: the ``nlp`` object — config-built component container.
+
+Capability parity with the spaCy ``Language`` object the reference replicates
+per worker (reference worker.py:91 ``init_nlp``; nlp.update inside
+``train_while_improving`` worker.py:176-189; serialization worker.py:219-222).
+TPU-first differences:
+
+* The whole multi-component forward+loss is ONE pure function
+  (``make_loss_fn``) so jit compiles tok2vec trunk + every head + their
+  gradient sum into a single XLA program — the listener gradient relay and
+  "summed gradients into shared trunk" fall out of autodiff for free.
+* Collation lowers ragged Example batches into bucketed, statically-shaped
+  padded arrays (SURVEY.md §7 "Ragged/variable-length batching").
+* Frozen components (reference worker.py:186-187 semantics) are excluded via
+  ``stop_gradient`` on their param subtree.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..models.core import Context, Params
+from ..registry import registry
+from ..training.batcher import bucket_batch_size, bucket_length, DEFAULT_LENGTH_BUCKETS
+from ..types import TokenBatch
+from .components.base import Component
+from .components.tok2vec import Tok2VecComponent
+from .doc import Doc, Example
+from .tokenizer import Tokenizer
+from .vocab import Vocab
+
+
+class Pipeline:
+    def __init__(
+        self,
+        lang: str = "en",
+        components: Optional[Dict[str, Component]] = None,
+        pipe_names: Optional[List[str]] = None,
+        config: Optional[Config] = None,
+    ):
+        self.lang = lang
+        self.vocab = Vocab()
+        self.tokenizer = Tokenizer()
+        self.components: Dict[str, Component] = components or {}
+        self.pipe_names: List[str] = pipe_names or list(self.components)
+        self.config: Config = config or Config()
+        self.params: Optional[Params] = None
+        self.frozen_components: List[str] = []
+        self.annotating_components: List[str] = []
+        self.length_buckets: Sequence[int] = DEFAULT_LENGTH_BUCKETS
+        self._jit_forward = None  # cached compiled forward (predict path)
+
+    # ------------------------------------------------------------------
+    # Construction
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_config(cls, config: Config) -> "Pipeline":
+        """Build the pipeline skeleton from an interpolated config."""
+        nlp_cfg = config.get("nlp", {})
+        lang = nlp_cfg.get("lang", "en")
+        pipe_names = list(nlp_cfg.get("pipeline", []))
+        comp_cfgs = config.get("components", {})
+        components: Dict[str, Component] = {}
+        for name in pipe_names:
+            if name not in comp_cfgs:
+                raise ValueError(f"Pipeline names component {name!r} but no [components.{name}]")
+            block = dict(comp_cfgs[name])
+            factory_name = block.pop("factory", None)
+            if factory_name is None:
+                raise ValueError(f"[components.{name}] missing 'factory'")
+            factory = registry.get("factories", factory_name)
+            model_cfg = block.pop("model", None)
+            if model_cfg is None:
+                raise ValueError(f"[components.{name}] missing model block")
+            components[name] = factory(name=name, model=model_cfg, **block)
+        nlp = cls(lang=lang, components=components, pipe_names=pipe_names, config=config)
+        training = config.get("training", {})
+        nlp.frozen_components = list(training.get("frozen_components", []) or [])
+        nlp.annotating_components = list(training.get("annotating_components", []) or [])
+        return nlp
+
+    @property
+    def tok2vec_name(self) -> Optional[str]:
+        for name in self.pipe_names:
+            if isinstance(self.components[name], Tok2VecComponent):
+                return name
+        return None
+
+    def head_names(self) -> List[str]:
+        t2v = self.tok2vec_name
+        return [n for n in self.pipe_names if n != t2v]
+
+    # ------------------------------------------------------------------
+    # Initialization
+    # ------------------------------------------------------------------
+    def initialize(
+        self,
+        get_examples: Optional[Callable[[], Iterable[Example]]] = None,
+        *,
+        seed: int = 0,
+        label_sample_limit: int = 10000,
+    ) -> Params:
+        """Collect labels from gold data, build models, init params.
+
+        The equivalent of spacy's ``init_nlp`` run per-worker at reference
+        worker.py:91 (here it runs once; params are replicated by sharding).
+        """
+        if get_examples is not None:
+            sample: List[Example] = []
+            for i, eg in enumerate(get_examples()):
+                if i >= label_sample_limit:
+                    break
+                sample.append(eg)
+            for name in self.pipe_names:
+                comp = self.components[name]
+                comp.add_labels_from(sample)
+                comp.finish_labels()
+        rng = jax.random.PRNGKey(seed)
+        params: Dict[str, Any] = {}
+        for name in self.pipe_names:
+            comp = self.components[name]
+            comp.build_model()
+            rng, sub = jax.random.split(rng)
+            params[name] = comp.init_params(sub)
+        self.params = params
+        self._jit_forward = None  # models rebuilt -> stale closure
+        return params
+
+    # ------------------------------------------------------------------
+    # Collation: List[Example] -> statically-shaped device batch
+    # ------------------------------------------------------------------
+    def collate(
+        self,
+        examples: List[Example],
+        *,
+        with_targets: bool = True,
+        pad_batch_to: Optional[int] = None,
+        pad_len_to: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        lengths = [len(eg) for eg in examples]
+        max_len = max(lengths) if lengths else 1
+        T = pad_len_to or bucket_length(max_len, self.length_buckets)
+        B = pad_batch_to or bucket_batch_size(len(examples))
+        n_attrs = 4
+        attr_keys = np.zeros((B, T, n_attrs, 2), dtype=np.uint32)
+        mask = np.zeros((B, T), dtype=bool)
+        for i, eg in enumerate(examples):
+            words = eg.reference.words[:T]
+            feats = self.vocab.featurize(words)
+            attr_keys[i, : len(words)] = feats
+            mask[i, : len(words)] = True
+        batch: Dict[str, Any] = {
+            "tokens": TokenBatch(attr_keys=jnp.asarray(attr_keys), mask=jnp.asarray(mask)),
+            "n_words": int(sum(min(l, T) for l in lengths)),
+            "lengths": lengths,
+        }
+        if with_targets:
+            targets: Dict[str, Any] = {}
+            for name in self.head_names():
+                comp = self.components[name]
+                t = comp.make_targets(examples, B, T)
+                if t:
+                    targets[name] = {k: jnp.asarray(v) for k, v in t.items()}
+            batch["targets"] = targets
+        return batch
+
+    # ------------------------------------------------------------------
+    # Pure loss (jit-traceable)
+    # ------------------------------------------------------------------
+    def make_loss_fn(self) -> Callable:
+        """Returns loss_fn(params, tokens, targets, rng) -> (loss, metrics)."""
+        t2v_name = self.tok2vec_name
+        head_names = self.head_names()
+        components = self.components
+        frozen = set(self.frozen_components)
+
+        def loss_fn(params: Params, tokens: TokenBatch, targets: Dict[str, Any], rng):
+            metrics: Dict[str, Any] = {}
+            total = jnp.float32(0.0)
+            t2v_out = None
+            if t2v_name is not None:
+                t2v_params = params[t2v_name]
+                if t2v_name in frozen:
+                    t2v_params = jax.lax.stop_gradient(t2v_params)
+                rng, sub = jax.random.split(rng)
+                t2v_out = components[t2v_name].forward(
+                    t2v_params, tokens, Context(train=True, rng=sub)
+                )
+            for name in head_names:
+                comp = components[name]
+                if not comp.trainable or name not in targets:
+                    continue
+                comp_params = params[name]
+                if name in frozen:
+                    comp_params = jax.lax.stop_gradient(comp_params)
+                inputs = t2v_out if comp.listens else tokens
+                rng, sub = jax.random.split(rng)
+                loss, comp_metrics = comp.loss(
+                    comp_params, inputs, targets[name], Context(train=True, rng=sub)
+                )
+                metrics[f"loss_{name}"] = loss
+                metrics.update(comp_metrics)
+                total = total + loss
+            return total, metrics
+
+        return loss_fn
+
+    def make_forward_fn(self) -> Callable:
+        """Returns forward(params, tokens) -> {component: output} (eval mode)."""
+        t2v_name = self.tok2vec_name
+        head_names = self.head_names()
+        components = self.components
+
+        def forward(params: Params, tokens: TokenBatch):
+            outputs: Dict[str, Any] = {}
+            t2v_out = None
+            if t2v_name is not None:
+                t2v_out = components[t2v_name].forward(
+                    params[t2v_name], tokens, Context(train=False)
+                )
+                outputs[t2v_name] = t2v_out
+            for name in head_names:
+                comp = components[name]
+                inputs = t2v_out if comp.listens else tokens
+                outputs[name] = comp.forward(params[name], inputs, Context(train=False))
+            return outputs
+
+        return forward
+
+    # ------------------------------------------------------------------
+    # Prediction / evaluation (host orchestration)
+    # ------------------------------------------------------------------
+    def predict_docs(
+        self, docs: List[Doc], params: Optional[Params] = None, batch_size: int = 128
+    ) -> List[Doc]:
+        params = params if params is not None else self.params
+        assert params is not None, "Pipeline not initialized"
+        if self._jit_forward is None:
+            # cache so repeated evaluate() calls hit jit's compile cache
+            self._jit_forward = jax.jit(self.make_forward_fn())
+        forward = self._jit_forward
+        for start in range(0, len(docs), batch_size):
+            chunk = docs[start : start + batch_size]
+            examples = [Example.from_gold(d) for d in chunk]
+            batch = self.collate(examples, with_targets=False)
+            outputs = forward(params, batch["tokens"])
+            lengths = [min(len(d), batch["tokens"].seq_len) for d in chunk]
+            for name in self.head_names():
+                self.components[name].set_annotations(chunk, outputs[name], lengths)
+        return docs
+
+    def __call__(self, text: str) -> Doc:
+        doc = self.tokenizer(text)
+        self.predict_docs([doc])
+        return doc
+
+    def evaluate(
+        self, examples: List[Example], params: Optional[Params] = None, batch_size: int = 128
+    ) -> Dict[str, float]:
+        """Predict over dev data and score — the per-worker evaluation the
+        reference runs via ``create_evaluation_callback`` (reference
+        worker.py:209-217)."""
+        params = params if params is not None else self.params
+        docs = [eg.reference.copy_shell() for eg in examples]
+        self.predict_docs(docs, params, batch_size=batch_size)
+        for eg, doc in zip(examples, docs):
+            eg.predicted = doc
+        scores: Dict[str, float] = {}
+        for name in self.head_names():
+            scores.update(self.components[name].score(examples))
+        return scores
+
+    # ------------------------------------------------------------------
+    # Serialization (the nlp.to_disk path, reference worker.py:219-222)
+    # ------------------------------------------------------------------
+    def meta(self) -> Dict[str, Any]:
+        return {
+            "lang": self.lang,
+            "pipeline": self.pipe_names,
+            "labels": {name: self.components[name].labels for name in self.pipe_names},
+        }
+
+    def to_disk(self, path) -> None:
+        from ..training import checkpoint
+
+        path = Path(path)
+        path.mkdir(parents=True, exist_ok=True)
+        (path / "config.cfg").write_text(self.config.to_str(), encoding="utf8")
+        (path / "meta.json").write_text(json.dumps(self.meta(), indent=2), encoding="utf8")
+        assert self.params is not None
+        checkpoint.save_params(path / "params.npz", self.params)
+
+    @classmethod
+    def from_disk(cls, path) -> "Pipeline":
+        from ..training import checkpoint
+
+        path = Path(path)
+        config = Config.from_str((path / "config.cfg").read_text(encoding="utf8"))
+        config = config.interpolate()
+        nlp = cls.from_config(config)
+        meta = json.loads((path / "meta.json").read_text(encoding="utf8"))
+        for name, labels in meta.get("labels", {}).items():
+            if name in nlp.components:
+                nlp.components[name].labels = labels
+        for name in nlp.pipe_names:
+            nlp.components[name].build_model()
+        nlp.params = checkpoint.load_params(path / "params.npz")
+        return nlp

@@ -1,0 +1,61 @@
+"""Rule-based word tokenizer (host side).
+
+Capability parity with spaCy's native tokenizer (Cython, SURVEY.md §2.3 row
+"spaCy core"): splits raw text into Doc tokens. Training corpora are usually
+pre-tokenized (the reference's data flow converts jsonl with `spacy convert`,
+reference bin/get-data.sh:1-13), so this is the inference-path entry point.
+Registered in the ``tokenizers`` registry so configs can swap it.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..registry import registry
+from .doc import Doc
+
+# token = word chars (incl. unicode letters/digits/apostrophes-in-word) | single punct
+_TOKEN_RE = re.compile(
+    r"""
+    \d+(?:[.,]\d+)*          # numbers, incl. 1,000.5
+  | \w+(?:[''’]\w+)*         # words with internal apostrophes
+  | [^\w\s]                  # any single punctuation mark
+    """,
+    re.VERBOSE | re.UNICODE,
+)
+
+_SUFFIXES = ("'s", "'S", "’s", "’S", "n't", "N'T", "'ll", "'re", "'ve", "'m", "'d")
+
+
+class Tokenizer:
+    def __init__(self):
+        pass
+
+    def __call__(self, text: str) -> Doc:
+        words: List[str] = []
+        spaces: List[bool] = []
+        for m in _TOKEN_RE.finditer(text):
+            token = m.group(0)
+            end = m.end()
+            # split common English clitics off word tokens
+            pieces = self._split_clitics(token)
+            for i, piece in enumerate(pieces):
+                words.append(piece)
+                if i < len(pieces) - 1:
+                    spaces.append(False)
+                else:
+                    spaces.append(end < len(text) and text[end : end + 1].isspace())
+        return Doc(words=words, spaces=spaces)
+
+    @staticmethod
+    def _split_clitics(token: str) -> List[str]:
+        for suf in _SUFFIXES:
+            if len(token) > len(suf) and token.endswith(suf):
+                return [token[: -len(suf)], token[-len(suf) :]]
+        return [token]
+
+
+@registry.tokenizers("spacy.Tokenizer.v1")
+def create_tokenizer() -> Tokenizer:
+    return Tokenizer()

@@ -1,0 +1,125 @@
+"""Vocab + lexical featurization: strings → stable 64-bit hash keys.
+
+Capability parity with spaCy's Vocab/StringStore (native murmurhash/preshed
+C deps of the reference, SURVEY.md §2.3 rows "spaCy core" / "murmurhash").
+Host-side: each token is mapped to its lexical attribute strings
+(NORM, PREFIX, SUFFIX, SHAPE — the HashEmbed feature set), each attribute
+string is murmur-hashed to a stable uint64 key, and the keys ship to device
+as [T, n_attrs, 2]-uint32 arrays (device re-hashes per table:
+ops/hashing.py). Uses the C++ native extension when built (native/), with a
+pure-Python fallback.
+
+Hash keys are content-derived and therefore identical on every host —
+replacing the reference's per-process node-id param keys (reference
+util.py:6,53-54) and its reliance on identical construction order
+(SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import re
+from functools import lru_cache
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..models.tok2vec import ATTRS
+from ..ops.hashing import hash_string_u64
+
+_DIGIT_RE = re.compile(r"\d")
+
+
+def norm_of(word: str) -> str:
+    return word.lower()
+
+
+def prefix_of(word: str, n: int = 1) -> str:
+    return word[:n]
+
+
+def suffix_of(word: str, n: int = 3) -> str:
+    return word[-n:]
+
+
+@lru_cache(maxsize=2 ** 17)
+def shape_of(word: str) -> str:
+    """Word shape: 'Xxxx', 'dd', 'xx-xx' — capped run-length like spaCy."""
+    out = []
+    last = ""
+    run = 0
+    for ch in word:
+        if ch.isalpha():
+            sym = "X" if ch.isupper() else "x"
+        elif ch.isdigit():
+            sym = "d"
+        else:
+            sym = ch
+        if sym == last:
+            run += 1
+            if run < 4:
+                out.append(sym)
+        else:
+            out.append(sym)
+            last = sym
+            run = 1
+    return "".join(out)
+
+
+class StringStore:
+    """Bidirectional string <-> uint64 hash map (host side)."""
+
+    def __init__(self):
+        self._map: Dict[int, str] = {}
+
+    def add(self, s: str) -> int:
+        key = hash_string_u64(s)
+        self._map[key] = s
+        return key
+
+    def __getitem__(self, key: int) -> str:
+        return self._map[key]
+
+    def __contains__(self, key: int) -> bool:
+        return key in self._map
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+class Vocab:
+    """Featurizer with a per-token LRU cache.
+
+    ``featurize(words) -> uint32 [T, n_attrs, 2]`` (lo, hi halves of the
+    uint64 attribute-hash keys).
+    """
+
+    def __init__(self):
+        self.strings = StringStore()
+        self._cache: Dict[str, np.ndarray] = {}
+
+    def token_features(self, word: str) -> np.ndarray:
+        feats = self._cache.get(word)
+        if feats is None:
+            attrs = self._attr_strings(word)
+            keys = np.array([hash_string_u64(a) for a in attrs], dtype=np.uint64)
+            lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            hi = (keys >> np.uint64(32)).astype(np.uint32)
+            feats = np.stack([lo, hi], axis=-1)  # [n_attrs, 2]
+            if len(self._cache) < 2 ** 20:
+                self._cache[word] = feats
+        return feats
+
+    @staticmethod
+    def _attr_strings(word: str) -> List[str]:
+        # Order must match models.tok2vec.ATTRS
+        return [
+            "norm=" + norm_of(word),
+            "pre=" + prefix_of(word),
+            "suf=" + suffix_of(word),
+            "shape=" + shape_of(word),
+        ]
+
+    def featurize(self, words: Sequence[str]) -> np.ndarray:
+        if not words:
+            return np.zeros((0, len(ATTRS), 2), dtype=np.uint32)
+        return np.stack([self.token_features(w) for w in words])

@@ -1,0 +1,215 @@
+"""Function registry: the plugin system behind ``@architectures = "..."`` config
+references.
+
+Capability parity with the registry surface the reference programs against
+(reference train_cli.py:44-46 ``load_config`` + ``registry.resolve``;
+worker.py:93 ``registry.resolve(config["training"], schema=...)``;
+loggers.py:8 ``@registry.loggers("spacy-ray.ConsoleLogger.v1")``). The
+reference delegates to thinc/spacy's catalogue-based registry; this is a
+self-contained reimplementation with the same user-facing model:
+
+* named registries (architectures, optimizers, schedules, loggers, readers,
+  batchers, scorers, tokenizers, misc, callbacks),
+* ``@registry.architectures("name.v1")`` decorator registration,
+* resolution of config blocks whose ``@<registry>`` key names a registered
+  factory, with nested blocks resolved bottom-up,
+* user-code injection (``--code`` flag) simply imports a module that runs
+  decorators at import time (reference worker.py:87 ``import_code``).
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import inspect
+import sys
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterable, Optional
+
+
+class RegistryError(ValueError):
+    pass
+
+
+class _SubRegistry:
+    """One named function table, e.g. ``registry.architectures``."""
+
+    def __init__(self, namespace: str):
+        self.namespace = namespace
+        self._table: Dict[str, Callable] = {}
+
+    def __call__(self, name: str, func: Optional[Callable] = None):
+        """Decorator form: ``@registry.architectures("Foo.v1")``."""
+        if func is not None:
+            self.register(name, func)
+            return func
+
+        def decorator(f: Callable) -> Callable:
+            self.register(name, f)
+            return f
+
+        return decorator
+
+    def register(self, name: str, func: Callable) -> None:
+        self._table[name] = func
+
+    def get(self, name: str) -> Callable:
+        if name not in self._table:
+            available = ", ".join(sorted(self._table)) or "<empty>"
+            raise RegistryError(
+                f"Can't find '{name}' in registry {self.namespace}. "
+                f"Available: {available}"
+            )
+        return self._table[name]
+
+    def has(self, name: str) -> bool:
+        return name in self._table
+
+    def get_all(self) -> Dict[str, Callable]:
+        return dict(self._table)
+
+    def names(self) -> Iterable[str]:
+        return sorted(self._table)
+
+
+class Registry:
+    """Top-level registry of registries.
+
+    Namespaces mirror the slots the reference's config files address
+    (``[training.logger]`` -> loggers, ``[training.optimizer]`` -> optimizers,
+    ``@architectures`` in ``[components.*.model]`` blocks, corpus
+    ``@readers``, ``[training.batcher]`` -> batchers).
+    """
+
+    NAMESPACES = (
+        "architectures",
+        "optimizers",
+        "schedules",
+        "loggers",
+        "readers",
+        "batchers",
+        "scorers",
+        "tokenizers",
+        "factories",  # pipeline component factories ([components.X] factory = "...")
+        "augmenters",
+        "callbacks",
+        "initializers",
+        "misc",
+    )
+
+    def __init__(self):
+        for ns in self.NAMESPACES:
+            setattr(self, ns, _SubRegistry(ns))
+
+    def get(self, namespace: str, name: str) -> Callable:
+        return self._ns(namespace).get(name)
+
+    def has(self, namespace: str, name: str) -> bool:
+        if not hasattr(self, namespace):
+            return False
+        return self._ns(namespace).has(name)
+
+    def _ns(self, namespace: str) -> _SubRegistry:
+        sub = getattr(self, namespace, None)
+        if not isinstance(sub, _SubRegistry):
+            raise RegistryError(
+                f"Unknown registry namespace '{namespace}'. "
+                f"Available: {', '.join(self.NAMESPACES)}"
+            )
+        return sub
+
+    # ------------------------------------------------------------------
+    # Config-block resolution
+    # ------------------------------------------------------------------
+    def resolve(self, block: Any, *, validate: bool = True) -> Any:
+        """Recursively resolve a config mapping.
+
+        A dict containing a ``@<namespace>`` key is replaced by the result of
+        calling the registered factory with the remaining keys as kwargs
+        (nested dicts resolved first, bottom-up). Mirrors the semantics the
+        reference relies on in spacy's ``registry.resolve``
+        (reference worker.py:93-95).
+        """
+        return self._resolve_value(block, validate=validate)
+
+    def _resolve_value(self, value: Any, *, validate: bool) -> Any:
+        if isinstance(value, dict):
+            ref_keys = [k for k in value if isinstance(k, str) and k.startswith("@")]
+            resolved = {
+                k: self._resolve_value(v, validate=validate)
+                for k, v in value.items()
+                if not (isinstance(k, str) and k.startswith("@"))
+            }
+            if not ref_keys:
+                return resolved
+            if len(ref_keys) > 1:
+                raise RegistryError(
+                    f"Config block has multiple registry references: {ref_keys}"
+                )
+            ref_key = ref_keys[0]
+            namespace = ref_key[1:]
+            name = value[ref_key]
+            func = self.get(namespace, name)
+            if validate:
+                self._validate_args(func, resolved, namespace, name)
+            return func(**resolved)
+        if isinstance(value, list):
+            return [self._resolve_value(v, validate=validate) for v in value]
+        return value
+
+    @staticmethod
+    def _validate_args(func: Callable, kwargs: Dict[str, Any], namespace: str, name: str) -> None:
+        try:
+            sig = inspect.signature(func)
+        except (TypeError, ValueError):  # builtins without signatures
+            return
+        has_var_kw = any(
+            p.kind == inspect.Parameter.VAR_KEYWORD for p in sig.parameters.values()
+        )
+        if not has_var_kw:
+            unknown = set(kwargs) - set(sig.parameters)
+            if unknown:
+                raise RegistryError(
+                    f"Invalid argument(s) {sorted(unknown)} for "
+                    f"@{namespace} = \"{name}\" "
+                    f"(accepts: {sorted(sig.parameters)})"
+                )
+        missing = [
+            p.name
+            for p in sig.parameters.values()
+            if p.default is inspect.Parameter.empty
+            and p.kind
+            in (
+                inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                inspect.Parameter.KEYWORD_ONLY,
+            )
+            and p.name not in kwargs
+        ]
+        if missing:
+            raise RegistryError(
+                f"Missing required argument(s) {missing} for "
+                f"@{namespace} = \"{name}\""
+            )
+
+
+registry = Registry()
+
+
+def import_code(code_path: Optional[str]) -> None:
+    """Import a user python file so its registry decorators run.
+
+    Equivalent of the ``--code`` plumbing at reference train_cli.py:30 /
+    worker.py:87 (``import_code`` from spacy.cli._util). Must run in every
+    process that resolves configs.
+    """
+    if code_path is None:
+        return
+    path = Path(code_path)
+    if not path.exists():
+        raise FileNotFoundError(f"--code path not found: {code_path}")
+    module_name = f"_user_code_{path.stem}"
+    spec = importlib.util.spec_from_file_location(module_name, str(path))
+    assert spec is not None and spec.loader is not None
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[module_name] = module
+    spec.loader.exec_module(module)

@@ -1,0 +1,6 @@
+"""Training subsystem: loop, batching, corpora, optimizers, checkpointing."""
+
+from . import corpus  # noqa: F401  (registers readers)
+from . import batcher  # noqa: F401  (registers batchers/schedules)
+from . import optimizers  # noqa: F401  (registers optimizers/schedules)
+from . import loggers  # noqa: F401  (registers loggers)

@@ -1,0 +1,139 @@
+"""Checkpoint / resume: params + optimizer state + loop position.
+
+The reference defines a save path but never wires it (reference
+worker.py:219-222 ``save_checkpoint``; ``--output`` dropped with a TODO at
+train_cli.py:41 — SURVEY.md §2.4 "Checkpointing unreachable"), and has no
+resume at all (SURVEY.md §5.4). Here both are first-class:
+
+* ``save_params`` / ``load_params``: portable .npz of the flattened params
+  pytree ('/'-joined stable path keys) — the exported-model format.
+* ``TrainCheckpoint``: full training state (params, optax opt_state, step,
+  epoch, rng, best score/step, data position) for exact resume.
+
+Arrays are gathered to host before writing; restore re-shards by whatever
+shardings the caller puts them under.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def gather_to_host(tree: Any) -> Any:
+    """Fetch a (possibly cross-host-sharded) pytree to host numpy.
+
+    ZeRO-1 opt state is sharded over the data axis; on multi-host meshes its
+    shards span non-addressable devices, where a bare device_get raises —
+    gather via multihost_utils first.
+    """
+    def fetch(x):
+        if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree_util.tree_map(fetch, tree)
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            sub = f"{prefix}/{k}" if prefix else str(k)
+            out.update(_flatten(tree[k], sub))
+    else:
+        out[prefix] = np.asarray(jax.device_get(tree))
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    root: Dict[str, Any] = {}
+    for path, arr in flat.items():
+        parts = path.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return root
+
+
+def save_params(path, params: Any) -> None:
+    flat = _flatten(params)
+    np.savez(str(path), **flat)
+
+
+def load_params(path) -> Dict[str, Any]:
+    with np.load(str(path)) as data:
+        flat = {k: data[k] for k in data.files}
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.asarray, _unflatten(flat))
+
+
+class TrainCheckpoint:
+    """Full training-state checkpoint directory.
+
+    Layout: state.pkl (opt_state pytree via pickle of host numpy),
+    params.npz, meta.json. The opt_state is pickled because optax states are
+    nested namedtuples whose structure the restore side reconstructs anyway;
+    arrays inside are converted to numpy first.
+    """
+
+    @staticmethod
+    def save(
+        path,
+        *,
+        params: Any,
+        opt_state: Any,
+        step: int,
+        epoch: int,
+        rng: Any,
+        best_score: float,
+        best_step: int,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        path = Path(path)
+        path.mkdir(parents=True, exist_ok=True)
+        save_params(path / "params.npz", params)
+        host_opt = gather_to_host(opt_state)
+        with open(path / "opt_state.pkl", "wb") as f:
+            pickle.dump(host_opt, f)
+        meta = {
+            "step": int(step),
+            "epoch": int(epoch),
+            "rng": np.asarray(jax.device_get(rng)).tolist(),
+            "best_score": float(best_score),
+            "best_step": int(best_step),
+            "extra": extra or {},
+        }
+        (path / "train_meta.json").write_text(json.dumps(meta, indent=2), encoding="utf8")
+
+    @staticmethod
+    def load(path) -> Optional[Dict[str, Any]]:
+        path = Path(path)
+        if not (path / "train_meta.json").exists():
+            return None
+        import jax.numpy as jnp
+
+        meta = json.loads((path / "train_meta.json").read_text(encoding="utf8"))
+        params = load_params(path / "params.npz")
+        with open(path / "opt_state.pkl", "rb") as f:
+            opt_state = pickle.load(f)
+        opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "step": meta["step"],
+            "epoch": meta["epoch"],
+            "rng": jnp.asarray(np.array(meta["rng"], dtype=np.uint32)),
+            "best_score": meta["best_score"],
+            "best_step": meta["best_step"],
+            "extra": meta.get("extra", {}),
+        }

@@ -1,0 +1,265 @@
+"""Corpus readers: registered ``@readers`` factories resolving to callables
+that yield :class:`Example` streams.
+
+Capability parity with the reference's corpus plumbing: dot-name-resolved
+train/dev corpora (reference worker.py:94-95 ``resolve_dot_names``), the
+``spacy convert``-produced binary corpus (reference bin/get-data.sh:8-12),
+and jsonl sources. Formats:
+
+* ``.jsonl``: one doc per line: {"tokens": [...], "tags": [...], "heads":
+  [...], "deps": [...], "ents": [[start, end, label], ...], "spans":
+  {"group": [[s, e, label], ...]}, "cats": {...}, "text": ...}
+* ``.conllu``: Universal Dependencies format (UPOS/XPOS/head/deprel)
+* ``.msgdoc``: this framework's binary DocBin equivalent (msgpack-free:
+  JSON-lines inside gzip — portable, no native dep)
+
+Rank-sharding lives in the batcher/loop, not here, so every process can
+construct the same reader from the same config (per-host sharding fixes the
+reference's duplicated-data gotcha, SURVEY.md §2.4 "No data sharding").
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import random
+from pathlib import Path
+from typing import Callable, Iterable, Iterator, List, Optional, Union
+
+from ..registry import registry
+from ..pipeline.doc import Doc, Example, Span
+
+CorpusReader = Callable[[], Iterator[Example]]
+
+
+def _doc_from_json(obj: dict) -> Doc:
+    words = obj.get("tokens") or obj.get("words")
+    if words is None:
+        raise ValueError(f"Corpus line missing 'tokens': keys={list(obj)}")
+    doc = Doc(
+        words=list(words),
+        spaces=obj.get("spaces"),
+        tags=obj.get("tags"),
+        pos=obj.get("pos"),
+        heads=obj.get("heads"),
+        deps=obj.get("deps"),
+        lemmas=obj.get("lemmas"),
+        sent_starts=obj.get("sent_starts"),
+        cats=dict(obj.get("cats") or {}),
+    )
+    for s, e, label in obj.get("ents") or []:
+        doc.ents.append(Span(int(s), int(e), str(label)))
+    for group, spans in (obj.get("spans") or {}).items():
+        doc.spans[group] = [Span(int(s), int(e), str(label)) for s, e, label in spans]
+    return doc
+
+
+def _doc_to_json(doc: Doc) -> dict:
+    out: dict = {"tokens": doc.words}
+    if doc.spaces is not None:
+        out["spaces"] = doc.spaces
+    for attr in ("tags", "pos", "heads", "deps", "lemmas", "sent_starts"):
+        val = getattr(doc, attr)
+        if val is not None:
+            out[attr] = val
+    if doc.ents:
+        out["ents"] = [[s.start, s.end, s.label] for s in doc.ents]
+    if doc.spans:
+        out["spans"] = {
+            g: [[s.start, s.end, s.label] for s in spans] for g, spans in doc.spans.items()
+        }
+    if doc.cats:
+        out["cats"] = doc.cats
+    return out
+
+
+def read_jsonl_docs(path: Union[str, Path]) -> Iterator[Doc]:
+    with open(path, "r", encoding="utf8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                yield _doc_from_json(json.loads(line))
+
+
+def read_conllu_docs(path: Union[str, Path]) -> Iterator[Doc]:
+    words: List[str] = []
+    tags: List[str] = []
+    pos: List[str] = []
+    heads: List[int] = []
+    deps: List[str] = []
+
+    def flush() -> Optional[Doc]:
+        nonlocal words, tags, pos, heads, deps
+        if not words:
+            return None
+        doc = Doc(words=words, tags=tags, pos=pos, heads=heads, deps=deps)
+        words, tags, pos, heads, deps = [], [], [], [], []
+        return doc
+
+    with open(path, "r", encoding="utf8") as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                doc = flush()
+                if doc:
+                    yield doc
+                continue
+            if line.startswith("#"):
+                continue
+            cols = line.split("\t")
+            if "-" in cols[0] or "." in cols[0]:
+                continue  # skip MWT / empty nodes
+            idx = int(cols[0]) - 1
+            words.append(cols[1])
+            pos.append(cols[3])
+            tags.append(cols[4] if cols[4] != "_" else cols[3])
+            head = int(cols[6]) if cols[6] != "_" else 0
+            heads.append(head - 1 if head > 0 else idx)  # root points to itself
+            deps.append(cols[7] if cols[7] != "_" else "dep")
+    doc = flush()
+    if doc:
+        yield doc
+
+
+class DocBin:
+    """Serializable collection of docs (the .spacy-DocBin equivalent)."""
+
+    def __init__(self, docs: Optional[Iterable[Doc]] = None):
+        self.docs: List[Doc] = list(docs) if docs else []
+
+    def add(self, doc: Doc) -> None:
+        self.docs.append(doc)
+
+    def to_disk(self, path: Union[str, Path]) -> None:
+        with gzip.open(path, "wt", encoding="utf8") as f:
+            for doc in self.docs:
+                f.write(json.dumps(_doc_to_json(doc)) + "\n")
+
+    @classmethod
+    def from_disk(cls, path: Union[str, Path]) -> "DocBin":
+        docs = []
+        with gzip.open(path, "rt", encoding="utf8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    docs.append(_doc_from_json(json.loads(line)))
+        return cls(docs)
+
+
+def _iter_path(path: Path) -> Iterator[Doc]:
+    if path.is_dir():
+        for sub in sorted(path.iterdir()):
+            if sub.suffix in (".jsonl", ".conllu", ".msgdoc", ".spacy"):
+                yield from _iter_path(sub)
+        return
+    suffix = path.suffix
+    if suffix == ".jsonl":
+        yield from read_jsonl_docs(path)
+    elif suffix == ".conllu":
+        yield from read_conllu_docs(path)
+    elif suffix in (".msgdoc", ".spacy"):
+        yield from DocBin.from_disk(path).docs
+    else:
+        raise ValueError(f"Unsupported corpus format: {path}")
+
+
+class Corpus:
+    """Config-constructed corpus: callable yielding fresh Example iterators.
+
+    max_length splits long docs on sentence boundaries (or hard-truncates)
+    — the mechanism by which the reference ecosystem bounds sequence length
+    (SURVEY.md §5.7: document segmentation, not attention sharding).
+    """
+
+    def __init__(
+        self,
+        path: Union[str, Path],
+        *,
+        max_length: int = 0,
+        limit: int = 0,
+        shuffle: bool = False,
+        seed: int = 0,
+    ):
+        self.path = Path(path)
+        self.max_length = max_length
+        self.limit = limit
+        self.shuffle = shuffle
+        self.seed = seed
+
+    def _split(self, doc: Doc) -> Iterator[Doc]:
+        if self.max_length <= 0 or len(doc) <= self.max_length:
+            yield doc
+            return
+        # split on sentence starts when available, else hard chunks
+        bounds: List[int] = [0]
+        if doc.sent_starts:
+            for i, s in enumerate(doc.sent_starts):
+                if s == 1 and i > 0:
+                    bounds.append(i)
+        else:
+            bounds.extend(range(self.max_length, len(doc), self.max_length))
+        bounds.append(len(doc))
+        for a, b in zip(bounds, bounds[1:]):
+            if b <= a:
+                continue
+            piece = Doc(
+                words=doc.words[a:b],
+                spaces=doc.spaces[a:b] if doc.spaces else None,
+                tags=doc.tags[a:b] if doc.tags else None,
+                pos=doc.pos[a:b] if doc.pos else None,
+                heads=[min(max(h - a, 0), b - a - 1) for h in doc.heads[a:b]]
+                if doc.heads
+                else None,
+                deps=doc.deps[a:b] if doc.deps else None,
+                cats=dict(doc.cats),
+            )
+            for span in doc.ents:
+                if span.start >= a and span.end <= b:
+                    piece.ents.append(Span(span.start - a, span.end - a, span.label))
+            for g, spans in doc.spans.items():
+                kept = [
+                    Span(s.start - a, s.end - a, s.label)
+                    for s in spans
+                    if s.start >= a and s.end <= b
+                ]
+                if kept:
+                    piece.spans[g] = kept
+            yield piece
+
+    def __call__(self) -> Iterator[Example]:
+        docs = _iter_path(self.path)
+        if self.shuffle:
+            docs_list = list(docs)
+            random.Random(self.seed).shuffle(docs_list)
+            docs = iter(docs_list)
+        n = 0
+        for doc in docs:
+            for piece in self._split(doc):
+                if len(piece) == 0:
+                    continue
+                yield Example.from_gold(piece)
+                n += 1
+                if self.limit and n >= self.limit:
+                    return
+
+
+@registry.readers("spacy.Corpus.v1")
+def create_corpus(
+    path: Optional[str] = None,
+    max_length: int = 0,
+    gold_preproc: bool = False,
+    limit: int = 0,
+    augmenter: Optional[Callable] = None,
+) -> Corpus:
+    if path is None:
+        raise ValueError("Corpus path is required (set [paths.train]/[paths.dev])")
+    return Corpus(path, max_length=max_length, limit=limit)
+
+
+@registry.readers("spacy.JsonlCorpus.v1")
+def create_jsonl_corpus(
+    path: Optional[str] = None, min_length: int = 0, max_length: int = 0, limit: int = 0
+) -> Corpus:
+    if path is None:
+        raise ValueError("JsonlCorpus path is required")
+    return Corpus(path, max_length=max_length, limit=limit)

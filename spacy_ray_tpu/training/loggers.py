@@ -1,0 +1,100 @@
+"""Training loggers: the pluggable ``[training.logger]`` registry slot.
+
+Capability parity with the reference's console logger plugin (reference
+loggers.py:8-66, registered ``spacy-ray.ConsoleLogger.v1`` via
+setup.cfg:40-41; SURVEY.md §5.5). Same protocol: the factory returns a
+setup function taking the pipeline and returning ``(log_step, finalize)``;
+``log_step(info_or_None)`` is called every step (None = no new row).
+
+TPU additions (SURVEY.md §5.5 "add words/sec/chip and step-time metrics as
+first-class"): WPS and WPS/chip columns computed from the loop's counters.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Dict, IO, List, Optional, Tuple
+
+from ..registry import registry
+
+
+def _fmt(value: float, width: int = 8, places: int = 2) -> str:
+    return f"{value:{width}.{places}f}"
+
+
+@registry.loggers("spacy-ray.ConsoleLogger.v1")
+@registry.loggers("spacy_ray_tpu.ConsoleLogger.v1")
+def console_logger(progress_bar: bool = False):
+    def setup(nlp, stdout: IO = sys.stdout, stderr: IO = sys.stderr):
+        pipe_names = [
+            n for n in nlp.head_names() if nlp.components[n].trainable
+        ]
+        score_keys = list(nlp.config.get("training", {}).get("score_weights", {}) or {})
+        loss_cols = [f"Loss {n}" for n in pipe_names]
+        score_cols = score_keys
+        header = ["E", "#", "W"] + loss_cols + score_cols + ["WPS", "Score"]
+        widths = [max(len(h), 8) for h in header]
+        stdout.write(" ".join(h.rjust(w) for h, w in zip(header, widths)) + "\n")
+        stdout.write(" ".join("-" * w for w in widths) + "\n")
+
+        def log_step(info: Optional[Dict[str, Any]]) -> None:
+            if info is None:
+                return
+            row: List[str] = [
+                str(info.get("epoch", 0)).rjust(widths[0]),
+                str(info.get("step", 0)).rjust(widths[1]),
+                str(info.get("words", 0)).rjust(widths[2]),
+            ]
+            losses = info.get("losses", {})
+            for i, name in enumerate(pipe_names):
+                row.append(_fmt(float(losses.get(name, 0.0)), widths[3 + i]))
+            scores = info.get("other_scores", {})
+            for j, key in enumerate(score_keys):
+                val = scores.get(key)
+                col = widths[3 + len(pipe_names) + j]
+                row.append(_fmt(float(val) * 100, col) if val is not None else " " * col)
+            row.append(_fmt(float(info.get("wps", 0.0)), widths[-2], 0))
+            score = info.get("score")
+            row.append(
+                _fmt(float(score) * 100, widths[-1]) if score is not None else " " * widths[-1]
+            )
+            stdout.write(" ".join(row) + "\n")
+            stdout.flush()
+
+        def finalize() -> None:
+            pass
+
+        return log_step, finalize
+
+    return setup
+
+
+@registry.loggers("spacy_ray_tpu.JsonlLogger.v1")
+def jsonl_logger(path: Optional[str] = None):
+    """Machine-readable per-step log (jsonl) for dashboards/benchmarks."""
+    import json
+
+    def setup(nlp, stdout: IO = sys.stdout, stderr: IO = sys.stderr):
+        handle = open(path, "a", encoding="utf8") if path else None
+
+        def log_step(info: Optional[Dict[str, Any]]) -> None:
+            if info is None:
+                return
+            rec = {
+                k: info.get(k)
+                for k in ("epoch", "step", "words", "wps", "score", "losses", "other_scores")
+            }
+            line = json.dumps(rec, default=float)
+            if handle:
+                handle.write(line + "\n")
+                handle.flush()
+            else:
+                stdout.write(line + "\n")
+
+        def finalize() -> None:
+            if handle:
+                handle.close()
+
+        return log_step, finalize
+
+    return setup

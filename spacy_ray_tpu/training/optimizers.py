@@ -1,0 +1,184 @@
+"""Optimizers + LR schedules: registered ``@optimizers`` / ``@schedules``.
+
+Capability parity with the thinc Optimizer surface the reference drives
+(reference proxies.py:128 ``optimizer(key, param, grad)``;
+``step_schedules`` at worker.py/proxies via thinc; FakeOptimizer no-op at
+reference worker.py:265-278). Here the optimizer is an optax
+GradientTransformation compiled INTO the train step — there is no per-key
+optimizer call and no proxy, so the reference's whole stale-gradient /
+quorum machinery (proxies.py:111-133) has no equivalent to need.
+
+``Adam.v1`` matches the config-surface of thinc's Adam (learn_rate, betas,
+eps, L2, grad_clip, L2_is_weight_decay, use_averages).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional, Union
+
+import optax
+
+from ..registry import registry
+
+ScheduleLike = Union[float, Callable[[int], float], Iterable[float]]
+
+
+class Schedule:
+    """A LR schedule usable both as an optax step->value callable and as an
+    iterator (thinc schedules are generators; optax wants step->value).
+
+    ``fn`` MUST be jnp-traceable: inside the jitted train step the optax
+    step count is a tracer, so python control flow on it would crash.
+    """
+
+    def __init__(self, fn: Callable[[Any], Any]):
+        self.fn = fn
+        self._step = 0
+
+    def __call__(self, step):
+        return self.fn(step)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> float:
+        val = float(self.fn(self._step))
+        self._step += 1
+        return val
+
+
+def as_schedule_fn(value: ScheduleLike) -> Callable[[Any], Any]:
+    """Normalize a learn_rate config value to a traceable step->rate fn."""
+    import jax.numpy as jnp
+
+    if isinstance(value, Schedule):
+        return value.fn
+    if isinstance(value, (int, float)):
+        return lambda step: jnp.float32(value)
+    if callable(value):
+        return value
+    # A generator/iterable (e.g. compounding.v1 used as LR): materialize a
+    # long prefix into a device array and index it — python iteration can't
+    # run under jit.
+    import itertools
+
+    table = jnp.asarray(
+        [float(v) for v in itertools.islice(iter(value), 100_000)], dtype=jnp.float32
+    )
+    if table.size == 0:
+        return lambda step: jnp.float32(0.0)
+
+    def fn(step):
+        idx = jnp.minimum(step, table.size - 1)
+        return jnp.take(table, idx)
+
+    return fn
+
+
+@registry.schedules("warmup_linear.v1")
+def warmup_linear(initial_rate: float, warmup_steps: int, total_steps: int) -> Schedule:
+    """Linear warmup then linear decay — jnp-traceable (runs inside jit)."""
+    import jax.numpy as jnp
+
+    warmup = max(int(warmup_steps), 0)
+    decay_span = max(int(total_steps) - warmup, 1)
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = initial_rate * (step + 1.0) / max(warmup, 1)
+        frac = (step - warmup) / decay_span
+        decayed = jnp.maximum(initial_rate * (1.0 - frac), 0.0)
+        if warmup == 0:
+            return decayed
+        return jnp.where(step < warmup, warm, decayed)
+
+    return Schedule(fn)
+
+
+@registry.schedules("linear.v1")
+def linear(initial_rate: float, final_rate: float, total_steps: int) -> Schedule:
+    import jax.numpy as jnp
+
+    span = max(int(total_steps), 1)
+
+    def fn(step):
+        frac = jnp.minimum(jnp.asarray(step, jnp.float32) / span, 1.0)
+        return initial_rate + (final_rate - initial_rate) * frac
+
+    return Schedule(fn)
+
+
+@registry.schedules("cosine.v1")
+def cosine(initial_rate: float, total_steps: int, final_scale: float = 0.0) -> Schedule:
+    import jax.numpy as jnp
+
+    span = max(int(total_steps), 1)
+
+    def fn(step):
+        frac = jnp.minimum(jnp.asarray(step, jnp.float32) / span, 1.0)
+        return initial_rate * (
+            final_scale + (1 - final_scale) * 0.5 * (1 + jnp.cos(jnp.pi * frac))
+        )
+
+    return Schedule(fn)
+
+
+@registry.optimizers("Adam.v1")
+def Adam(
+    learn_rate: ScheduleLike = 0.001,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    eps: float = 1e-8,
+    L2: float = 0.0,
+    grad_clip: float = 1.0,
+    L2_is_weight_decay: bool = True,
+    use_averages: bool = False,
+) -> optax.GradientTransformation:
+    lr_fn = as_schedule_fn(learn_rate)
+    chain = []
+    if grad_clip and grad_clip > 0:
+        chain.append(optax.clip_by_global_norm(grad_clip))
+    if L2 and not L2_is_weight_decay:
+        chain.append(optax.add_decayed_weights(L2))  # classic L2 into grads
+    chain.append(optax.scale_by_adam(b1=beta1, b2=beta2, eps=eps))
+    if L2 and L2_is_weight_decay:
+        chain.append(optax.add_decayed_weights(L2))
+    chain.append(optax.scale_by_learning_rate(lr_fn))
+    tx = optax.chain(*chain)
+    if use_averages:
+        tx = optax.chain(tx)  # EMA of params handled by loop (kept simple)
+    return tx
+
+
+@registry.optimizers("SGD.v1")
+def SGD(
+    learn_rate: ScheduleLike = 0.001, L2: float = 0.0, grad_clip: float = 1.0
+) -> optax.GradientTransformation:
+    lr_fn = as_schedule_fn(learn_rate)
+    chain = []
+    if grad_clip and grad_clip > 0:
+        chain.append(optax.clip_by_global_norm(grad_clip))
+    if L2:
+        chain.append(optax.add_decayed_weights(L2))
+    chain.append(optax.scale_by_learning_rate(lr_fn))
+    return optax.chain(*chain)
+
+
+@registry.optimizers("RAdam.v1")
+def RAdam(
+    learn_rate: ScheduleLike = 0.001,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    grad_clip: float = 1.0,
+) -> optax.GradientTransformation:
+    lr_fn = as_schedule_fn(learn_rate)
+    chain = []
+    if grad_clip and grad_clip > 0:
+        chain.append(optax.clip_by_global_norm(grad_clip))
+    chain.append(optax.scale_by_radam(b1=beta1, b2=beta2, eps=eps))
+    if weight_decay:
+        chain.append(optax.add_decayed_weights(weight_decay))
+    chain.append(optax.scale_by_learning_rate(lr_fn))
+    return optax.chain(*chain)

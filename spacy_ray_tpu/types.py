@@ -1,0 +1,47 @@
+"""Shared array-container types crossing the host/device boundary.
+
+All containers are pytrees (chex dataclasses) of statically-shaped padded
+arrays — the jit-friendly replacement for the ragged ``List[Doc]`` batches
+that flow through the reference's training loop (reference worker.py:170-189
+via spacy's ``create_train_batches``).
+"""
+
+from __future__ import annotations
+
+import chex
+import jax.numpy as jnp
+
+
+@chex.dataclass
+class Padded:
+    """A padded batch of token vectors: X [B, T, D], mask [B, T] bool."""
+
+    X: jnp.ndarray
+    mask: jnp.ndarray
+
+    @property
+    def width(self) -> int:
+        return self.X.shape[-1]
+
+
+@chex.dataclass
+class TokenBatch:
+    """Device-side featurized token batch.
+
+    attr_keys: [B, T, n_attrs, 2] uint32 — 64-bit lexical-attribute hash keys
+      (NORM/PREFIX/SUFFIX/SHAPE...) split into (lo, hi) uint32 halves, hashed
+      host-side by the Vocab (see pipeline/vocab.py), re-hashed on device per
+      embedding table (ops/hashing.py).
+    mask: [B, T] bool — True on real tokens.
+    """
+
+    attr_keys: jnp.ndarray
+    mask: jnp.ndarray
+
+    @property
+    def batch_size(self) -> int:
+        return self.attr_keys.shape[0]
+
+    @property
+    def seq_len(self) -> int:
+        return self.attr_keys.shape[1]

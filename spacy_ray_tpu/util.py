@@ -1,0 +1,151 @@
+"""Utilities: timers + synthetic data generation.
+
+Timer/ManyTimer mirror the reference's instrumentation scaffolding
+(reference util.py:9-38) but are actually wired: the loop and bench use them.
+The synthetic corpus generator backs tests and bench.py (the reference pulls
+a fashion-brands NER corpus in bin/get-data.sh; tests here must run
+hermetically with zero egress).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator, List, Optional
+
+from .pipeline.doc import Doc, Example, Span
+
+
+class Timer:
+    """Accumulating context-manager timer (reference util.py:9-29)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.total = 0.0
+        self.n = 0
+        self._start: Optional[float] = None
+
+    def __enter__(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        assert self._start is not None
+        self.total += time.perf_counter() - self._start
+        self.n += 1
+        self._start = None
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.n if self.n else 0.0
+
+
+class ManyTimer:
+    """Keyed timer registry (reference util.py:32-38)."""
+
+    def __init__(self):
+        self.timers: Dict[str, Timer] = {}
+
+    def __call__(self, name: str) -> Timer:
+        if name not in self.timers:
+            self.timers[name] = Timer(name)
+        return self.timers[name]
+
+    def report(self) -> str:
+        return "; ".join(
+            f"{t.name}: total={t.total:.3f}s mean={t.mean*1000:.1f}ms n={t.n}"
+            for t in self.timers.values()
+        )
+
+
+# ----------------------------------------------------------------------
+# Synthetic corpora
+# ----------------------------------------------------------------------
+
+_POS_VOCAB = {
+    "DET": ["the", "a", "an", "this", "that"],
+    "NOUN": ["cat", "dog", "tree", "market", "chip", "tensor", "mesh", "house"],
+    "VERB": ["runs", "jumps", "compiles", "shards", "eats", "sees", "builds"],
+    "ADJ": ["green", "fast", "large", "tiny", "sharded", "parallel"],
+    "ADV": ["quickly", "slowly", "very", "almost"],
+    "PROPN": ["Alice", "Bob", "Jax", "Pallas", "Austin", "Tokyo"],
+    "ADP": ["in", "on", "under", "over", "with"],
+    "PRON": ["he", "she", "it", "they", "we"],
+}
+
+_ENT_LABELS = {
+    "PERSON": ["Alice Smith", "Bob Jones", "Carol White"],
+    "ORG": ["Acme Corp", "Globex Inc", "Initech LLC"],
+    "GPE": ["Austin", "Tokyo", "Berlin", "Paris"],
+}
+
+
+def synth_tagged_doc(rng: random.Random, min_len: int = 4, max_len: int = 24) -> Doc:
+    """A doc whose tags are recoverable from word identity (learnable)."""
+    n = rng.randint(min_len, max_len)
+    words: List[str] = []
+    tags: List[str] = []
+    pos_names = list(_POS_VOCAB)
+    for _ in range(n):
+        pos = rng.choice(pos_names)
+        words.append(rng.choice(_POS_VOCAB[pos]))
+        tags.append(pos)
+    return Doc(words=words, tags=tags, pos=list(tags))
+
+
+def synth_ner_doc(rng: random.Random, min_len: int = 5, max_len: int = 24) -> Doc:
+    words: List[str] = []
+    ents: List[Span] = []
+    n_chunks = rng.randint(2, 6)
+    for _ in range(n_chunks):
+        if rng.random() < 0.4:
+            label = rng.choice(list(_ENT_LABELS))
+            ent_words = rng.choice(_ENT_LABELS[label]).split()
+            start = len(words)
+            words.extend(ent_words)
+            ents.append(Span(start, len(words), label))
+        else:
+            for _ in range(rng.randint(1, 4)):
+                pos = rng.choice(list(_POS_VOCAB))
+                words.append(rng.choice(_POS_VOCAB[pos]))
+    doc = Doc(words=words)
+    doc.ents = ents
+    return doc
+
+
+def synth_textcat_doc(rng: random.Random) -> Doc:
+    label = rng.choice(["SPORTS", "TECH", "FOOD"])
+    topical = {
+        "SPORTS": ["game", "team", "score", "win", "league", "ball"],
+        "TECH": ["chip", "tensor", "compile", "code", "mesh", "kernel"],
+        "FOOD": ["eat", "ham", "eggs", "bake", "sauce", "dish"],
+    }
+    words = [rng.choice(topical[label]) for _ in range(rng.randint(5, 15))]
+    rng.shuffle(words)
+    doc = Doc(words=words)
+    doc.cats = {k: (1.0 if k == label else 0.0) for k in topical}
+    return doc
+
+
+def synth_corpus(
+    n_docs: int, kind: str = "tagger", seed: int = 0
+) -> List[Example]:
+    rng = random.Random(seed)
+    makers = {
+        "tagger": synth_tagged_doc,
+        "ner": synth_ner_doc,
+        "textcat": synth_textcat_doc,
+    }
+    maker = makers[kind]
+    return [Example.from_gold(maker(rng)) for _ in range(n_docs)]
+
+
+def write_synth_jsonl(path, n_docs: int, kind: str = "tagger", seed: int = 0) -> None:
+    import json
+
+    from .training.corpus import _doc_to_json
+
+    with open(path, "w", encoding="utf8") as f:
+        for eg in synth_corpus(n_docs, kind, seed):
+            f.write(json.dumps(_doc_to_json(eg.reference)) + "\n")

@@ -1,0 +1,81 @@
+"""Optimizer/schedule tests — notably that schedules are jit-traceable
+(the optax step count is a tracer inside the compiled train step)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from spacy_ray_tpu.registry import registry
+from spacy_ray_tpu.training.optimizers import as_schedule_fn
+from spacy_ray_tpu.training.batcher import compounding
+
+
+def _jit_rates(sched_fn, steps):
+    f = jax.jit(lambda s: sched_fn(s))
+    return [float(f(jnp.int32(s))) for s in steps]
+
+
+def test_warmup_linear_traceable():
+    sched = registry.get("schedules", "warmup_linear.v1")(
+        initial_rate=0.1, warmup_steps=10, total_steps=110
+    )
+    rates = _jit_rates(sched.fn, [0, 9, 10, 60, 110, 200])
+    assert rates[0] == pytest.approx(0.01)  # (0+1)/10 * 0.1
+    assert rates[1] == pytest.approx(0.1)
+    assert rates[2] == pytest.approx(0.1)
+    assert rates[3] == pytest.approx(0.05)
+    assert rates[4] == pytest.approx(0.0, abs=1e-7)
+    assert rates[5] == pytest.approx(0.0, abs=1e-7)  # clamped, not negative
+
+
+def test_cosine_linear_traceable():
+    cos = registry.get("schedules", "cosine.v1")(initial_rate=1.0, total_steps=100)
+    lin = registry.get("schedules", "linear.v1")(
+        initial_rate=1.0, final_rate=0.0, total_steps=100
+    )
+    c = _jit_rates(cos.fn, [0, 50, 100])
+    l = _jit_rates(lin.fn, [0, 50, 100])
+    assert c[0] == pytest.approx(1.0)
+    assert c[1] == pytest.approx(0.5, abs=1e-6)
+    assert c[2] == pytest.approx(0.0, abs=1e-6)
+    assert l == [pytest.approx(1.0), pytest.approx(0.5), pytest.approx(0.0)]
+
+
+def test_generator_schedule_as_lr_traceable():
+    fn = as_schedule_fn(compounding(1.0, 32.0, 1.5))
+    rates = _jit_rates(fn, [0, 1, 2])
+    assert rates[0] == pytest.approx(1.0)
+    assert rates[1] == pytest.approx(1.5)
+    assert rates[2] == pytest.approx(2.25)
+
+
+def test_adam_with_schedule_trains_under_jit():
+    """Regression: Adam with a warmup_linear learn_rate must run inside jit."""
+    sched = registry.get("schedules", "warmup_linear.v1")(
+        initial_rate=0.1, warmup_steps=2, total_steps=100
+    )
+    tx = registry.get("optimizers", "Adam.v1")(learn_rate=sched)
+    params = {"w": jnp.ones((4,))}
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        grads = {"w": jnp.ones((4,))}
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    for _ in range(3):
+        params, opt_state = step(params, opt_state)
+    assert np.isfinite(np.asarray(params["w"])).all()
+    assert float(params["w"][0]) < 1.0
+
+
+def test_schedule_iterator_protocol():
+    sched = registry.get("schedules", "warmup_linear.v1")(
+        initial_rate=0.1, warmup_steps=2, total_steps=10
+    )
+    vals = [next(sched) for _ in range(3)]
+    assert vals[0] == pytest.approx(0.05)
+    assert vals[1] == pytest.approx(0.1)

@@ -1,0 +1,105 @@
+"""End-to-end training tests: the minimum slice (SURVEY.md §7 layer 3) —
+config → pipeline → loop → improving scores → checkpoint/resume."""
+
+import numpy as np
+import pytest
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.pipeline.language import Pipeline
+from spacy_ray_tpu.training.loop import train, weighted_score
+from spacy_ray_tpu.util import synth_corpus, write_synth_jsonl
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("data")
+    write_synth_jsonl(d / "train.jsonl", 200, kind="tagger", seed=0)
+    write_synth_jsonl(d / "dev.jsonl", 40, kind="tagger", seed=1)
+    return d
+
+
+def _config(tagger_config_text, data_dir, **over):
+    cfg = Config.from_str(tagger_config_text)
+    cfg = cfg.apply_overrides(
+        {
+            "paths.train": str(data_dir / "train.jsonl"),
+            "paths.dev": str(data_dir / "dev.jsonl"),
+            **over,
+        }
+    )
+    return cfg
+
+
+def test_train_tagger_learns(tagger_config_text, data_dir, tmp_path):
+    cfg = _config(tagger_config_text, data_dir)
+    nlp, result = train(cfg, output_path=tmp_path / "out", n_workers=1, stdout_log=False)
+    assert result.final_step == 60
+    # synthetic tags are word-recoverable: accuracy should be high
+    assert result.best_score > 0.8, f"tagger failed to learn: {result.best_score}"
+    assert (tmp_path / "out" / "best-model" / "params.npz").exists()
+    assert (tmp_path / "out" / "last-model" / "train_meta.json").exists()
+
+
+def test_model_roundtrip_and_predict(tagger_config_text, data_dir, tmp_path):
+    cfg = _config(tagger_config_text, data_dir, **{"training.max_steps": 20})
+    nlp, _ = train(cfg, output_path=tmp_path / "out", n_workers=1, stdout_log=False)
+    reloaded = Pipeline.from_disk(tmp_path / "out" / "last-model")
+    dev = synth_corpus(20, "tagger", seed=2)
+    s1 = nlp.evaluate(dev)
+    s2 = reloaded.evaluate(dev)
+    assert s1["tag_acc"] == pytest.approx(s2["tag_acc"], abs=1e-6)
+    doc = reloaded("the cat runs quickly")
+    assert doc.tags is not None and len(doc.tags) == 4
+
+
+def test_resume_continues_from_checkpoint(tagger_config_text, data_dir, tmp_path):
+    cfg = _config(tagger_config_text, data_dir, **{"training.max_steps": 20})
+    _, r1 = train(cfg, output_path=tmp_path / "out", n_workers=1, stdout_log=False)
+    assert r1.final_step == 20
+    cfg2 = _config(tagger_config_text, data_dir, **{"training.max_steps": 40})
+    _, r2 = train(cfg2, output_path=tmp_path / "out", n_workers=1, resume=True, stdout_log=False)
+    # resumed from step 20, so only 20 more steps were run
+    assert r2.final_step == 40
+
+
+def test_gradient_accumulation_runs(tagger_config_text, data_dir, tmp_path):
+    cfg = _config(
+        tagger_config_text,
+        data_dir,
+        **{"training.max_steps": 10, "training.accumulate_gradient": 2},
+    )
+    _, result = train(cfg, n_workers=1, stdout_log=False)
+    assert result.final_step == 10
+
+
+def test_weighted_score():
+    assert weighted_score({"a": 0.5, "b": 1.0}, {"a": 0.6, "b": 0.4}) == pytest.approx(0.7)
+    assert weighted_score({"a": 0.5}, {}) == pytest.approx(0.5)
+    assert weighted_score({"a": 0.5, "b": 0.9}, {"a": 1.0, "b": None}) == pytest.approx(0.5)
+
+
+def test_frozen_component_not_updated(tagger_config_text, data_dir):
+    cfg = _config(
+        tagger_config_text,
+        data_dir,
+        **{"training.max_steps": 5, "training.frozen_components": ["tok2vec"]},
+    )
+    from spacy_ray_tpu.training.loop import train as train_fn
+
+    nlp, _ = train_fn(cfg, n_workers=1, stdout_log=False)
+    # train again without freezing; compare tok2vec params drift
+    import jax
+
+    cfg2 = _config(tagger_config_text, data_dir, **{"training.max_steps": 5})
+    nlp2, _ = train_fn(cfg2, n_workers=1, stdout_log=False)
+
+    def leaves(params):
+        return jax.tree_util.tree_leaves(params)
+
+    # frozen run: tok2vec params identical to a fresh init with same seed
+    fresh = Pipeline.from_config(cfg.interpolate())
+    fresh.initialize(lambda: iter(synth_corpus(50, "tagger", 0)), seed=0)
+    frozen_leaves = leaves(nlp.params["tok2vec"])
+    fresh_leaves = leaves(fresh.params["tok2vec"])
+    for a, b in zip(frozen_leaves, fresh_leaves):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
