@@ -1,0 +1,121 @@
+"""Benchmark: training words/sec/chip on the flagship CNN-tagger pipeline.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md: "None"), so the baseline is
+the driver-defined nominal in BASELINE.md ("self-measured baseline, then
+scale"): NOMINAL_BASELINE_WPS below is the single-device spaCy-class CNN
+tagger trainer throughput the north star compares against;
+vs_baseline = measured / nominal.
+
+Workload: BASELINE.json config #1 shape — tagger + HashEmbedCNN tok2vec
+(width 96, depth 4, embed 2000), synthetic corpus, fixed (B, T) so one
+compiled step is reused; full train step (fwd+bwd+Adam) per iteration.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+NOMINAL_BASELINE_WPS = 20_000.0  # single-device spaCy-class CNN tagger trainer
+
+B, T = 256, 64
+WIDTH, DEPTH, EMBED = 96, 4, 2000
+WARMUP_STEPS = 3
+BENCH_STEPS = 30
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.pipeline.language import Pipeline
+    from spacy_ray_tpu.parallel.mesh import build_mesh
+    from spacy_ray_tpu.parallel.step import (
+        make_train_step,
+        place_batch,
+        place_replicated,
+        shard_opt_state,
+    )
+    from spacy_ray_tpu.registry import registry
+    from spacy_ray_tpu.util import synth_corpus
+
+    cfg = Config.from_str(
+        f"""
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = {WIDTH}
+depth = {DEPTH}
+embed_size = {EMBED}
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = {WIDTH}
+"""
+    )
+    nlp = Pipeline.from_config(cfg)
+    examples = synth_corpus(2048, "tagger", seed=0)
+    nlp.initialize(lambda: iter(examples), seed=0)
+
+    n_chips = len(jax.devices())
+    mesh = build_mesh(n_data=n_chips)
+    tx = registry.get("optimizers", "Adam.v1")(learn_rate=0.001)
+    params = place_replicated(nlp.params, mesh)
+    opt_state = shard_opt_state(tx.init(params), mesh, zero1=False)
+    update = make_train_step(
+        nlp.make_loss_fn(), tx, mesh, opt_state_template=opt_state
+    )
+
+    # one fixed-shape batch, reused (isolates step time from host collation)
+    chunk = examples[:B]
+    batch = nlp.collate(chunk, pad_batch_to=B, pad_len_to=T)
+    tokens = place_batch(batch["tokens"], mesh)
+    targets = place_batch(batch["targets"], mesh)
+    n_words = int(batch["n_words"])
+
+    rng = jax.random.PRNGKey(0)
+    for _ in range(WARMUP_STEPS):
+        rng, sub = jax.random.split(rng)
+        params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(BENCH_STEPS):
+        rng, sub = jax.random.split(rng)
+        params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    wps = n_words * BENCH_STEPS / dt
+    wps_chip = wps / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "train_words_per_sec_per_chip (CNN tok2vec tagger, fwd+bwd+Adam)",
+                "value": round(wps_chip, 1),
+                "unit": "words/s/chip",
+                "vs_baseline": round(wps_chip / NOMINAL_BASELINE_WPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

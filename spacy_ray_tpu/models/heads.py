@@ -102,7 +102,8 @@ def TextCatReduce(
         }
 
     def apply_fn(params, x: Any, ctx: Context) -> jnp.ndarray:
-        h: Padded = tok2vec.apply(params["tok2vec"], x, ctx)
+        # .get: a listener tok2vec has no params and is pruned from the tree
+        h: Padded = tok2vec.apply(params.get("tok2vec", {}), x, ctx)
         pools = []
         mask = h.mask
         if use_reduce_first:

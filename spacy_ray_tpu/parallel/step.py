@@ -97,21 +97,22 @@ def make_train_step(
         metrics["grad_norm"] = grad_norm
         return new_params, new_opt_state, loss, metrics
 
-    # Sharding layout: params replicated; batch sharded over `data`;
-    # opt state replicated or ZeRO-1.
+    # Sharding layout, DECLARED to jit (not left to placement inference):
+    # params replicated; batch sharded over `data`; opt state replicated or
+    # ZeRO-1 per-leaf. out_shardings pin the updated opt state to the same
+    # layout so a ZeRO-1 state stays sharded across steps instead of being
+    # replicated back by GSPMD.
     repl = replicated(mesh)
     batch_shard = NamedSharding(mesh, P(None, "data") if accum > 1 else P("data"))
-
-    def batch_sharding_tree(tree):
-        return jax.tree_util.tree_map(lambda _: batch_shard, tree)
-
     if opt_state_template is not None:
-        opt_shardings = opt_state_shardings(opt_state_template, mesh, zero1)
+        opt_sh: Any = opt_state_shardings(opt_state_template, mesh, zero1)
     else:
-        opt_shardings = None
+        opt_sh = repl  # prefix: whole subtree replicated
 
-    params_sh = None  # inferred (replicated) from input placement
-    jit_kwargs: Dict[str, Any] = {}
+    jit_kwargs: Dict[str, Any] = {
+        "in_shardings": (repl, opt_sh, batch_shard, batch_shard, repl),
+        "out_shardings": (repl, opt_sh, repl, repl),
+    }
     if donate:
         jit_kwargs["donate_argnums"] = (0, 1)
 
@@ -123,7 +124,7 @@ def make_train_step(
     run.mesh = mesh
     run.batch_shard = batch_shard
     run.replicated = repl
-    run.opt_shardings = opt_shardings
+    run.opt_shardings = opt_sh
     return run
 
 

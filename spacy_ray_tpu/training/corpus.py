@@ -185,6 +185,7 @@ class Corpus:
         self.limit = limit
         self.shuffle = shuffle
         self.seed = seed
+        self._epoch = 0  # bumps per __call__ so each epoch reshuffles
 
     def _split(self, doc: Doc) -> Iterator[Doc]:
         if self.max_length <= 0 or len(doc) <= self.max_length:
@@ -230,7 +231,8 @@ class Corpus:
         docs = _iter_path(self.path)
         if self.shuffle:
             docs_list = list(docs)
-            random.Random(self.seed).shuffle(docs_list)
+            random.Random(self.seed + self._epoch).shuffle(docs_list)
+            self._epoch += 1
             docs = iter(docs_list)
         n = 0
         for doc in docs:
@@ -250,16 +252,23 @@ def create_corpus(
     gold_preproc: bool = False,
     limit: int = 0,
     augmenter: Optional[Callable] = None,
+    shuffle: bool = False,
+    seed: int = 0,
 ) -> Corpus:
     if path is None:
         raise ValueError("Corpus path is required (set [paths.train]/[paths.dev])")
-    return Corpus(path, max_length=max_length, limit=limit)
+    return Corpus(path, max_length=max_length, limit=limit, shuffle=shuffle, seed=seed)
 
 
 @registry.readers("spacy.JsonlCorpus.v1")
 def create_jsonl_corpus(
-    path: Optional[str] = None, min_length: int = 0, max_length: int = 0, limit: int = 0
+    path: Optional[str] = None,
+    min_length: int = 0,
+    max_length: int = 0,
+    limit: int = 0,
+    shuffle: bool = False,
+    seed: int = 0,
 ) -> Corpus:
     if path is None:
         raise ValueError("JsonlCorpus path is required")
-    return Corpus(path, max_length=max_length, limit=limit)
+    return Corpus(path, max_length=max_length, limit=limit, shuffle=shuffle, seed=seed)

@@ -37,6 +37,7 @@ from ..registry import registry
 from ..parallel.mesh import build_mesh
 from ..parallel.step import make_train_step, place_batch, place_replicated, shard_opt_state
 from .batcher import bucket_batch_size, bucket_length, shard_stream
+from . import checkpoint as checkpoint_mod
 from .checkpoint import TrainCheckpoint
 from . import corpus as _corpus  # noqa: F401  (registers readers)
 from . import optimizers as _optimizers  # noqa: F401  (registers optimizers)
@@ -174,6 +175,17 @@ def train(
         opt_state_template=opt_state,
     )
 
+    # Parameter averaging (thinc Adam use_averages semantics): running mean
+    # of params, used for eval + best-model checkpoints.
+    use_averages = bool(getattr(tx, "use_averages", False))
+    avg_params = params if use_averages else None
+    avg_count = 0
+
+    @jax.jit
+    def _avg_step(avg, params, t):
+        t = jnp.float32(t)
+        return jax.tree_util.tree_map(lambda a, p: a + (p - a) / t, avg, params)
+
     # ---- logger ----
     logger_cfg = T.get("logger") or {"@loggers": "spacy_ray_tpu.ConsoleLogger.v1"}
     logger_setup = registry.resolve(logger_cfg)
@@ -227,8 +239,9 @@ def train(
                 cur_epoch, b = next(batch_iter)
                 raw_batches.append(b)
         except StopIteration:
-            if not raw_batches:
-                break
+            # end of data: an incomplete accumulation group would underscale
+            # the mean gradient (scan still divides by `accum`) — drop it
+            break
         # collate to the same (B, T) bucket so stacking works
         max_len = max(max(len(eg) for eg in b) for b in raw_batches)
         max_b = max(len(b) for b in raw_batches)
@@ -236,6 +249,16 @@ def train(
         # B must divide evenly over the mesh data axis for P("data") sharding
         B_pad = max(bucket_batch_size(max_b), n_data)
         B_pad = ((B_pad + n_data - 1) // n_data) * n_data
+        if process_count > 1:
+            # multi-controller SPMD: every host must launch the same program
+            # — sync padded shapes to the all-host max
+            from jax.experimental import multihost_utils
+
+            dims = multihost_utils.process_allgather(
+                np.array([T_pad, B_pad], np.int32)
+            ).reshape(-1, 2)
+            T_pad = int(dims[:, 0].max())
+            B_pad = int(dims[:, 1].max())
         collated = [
             nlp.collate(b, pad_batch_to=B_pad, pad_len_to=T_pad) for b in raw_batches
         ]
@@ -254,6 +277,9 @@ def train(
         rng, sub = jax.random.split(rng)
         params, opt_state, loss, metrics = update(params, opt_state, tokens, targets, sub)
         step += 1
+        if use_averages:
+            avg_count += 1
+            avg_params = _avg_step(avg_params, params, avg_count)
         result.words_seen += n_words
         words_since_log += n_words
 
@@ -263,7 +289,17 @@ def train(
 
         info: Optional[Dict[str, Any]] = None
         if step % eval_frequency == 0:
-            host_params = jax.device_get(params)
+            # eval (and best-model save) uses averaged params when enabled
+            eval_src = avg_params if use_averages else params
+            host_params = jax.device_get(eval_src)
+            # gather_to_host on the (possibly cross-host-sharded) opt state is
+            # a COLLECTIVE on multi-host — must run on every process, not just
+            # rank 0, or the pod deadlocks
+            host_opt = (
+                checkpoint_mod.gather_to_host(opt_state)
+                if output_path is not None
+                else None
+            )
             scores = nlp.evaluate(dev_examples, host_params)
             score = weighted_score(scores, T.get("score_weights") or {})
             now = time.perf_counter()
@@ -290,8 +326,8 @@ def train(
             if output_path is not None and jax.process_index() == 0:
                 TrainCheckpoint.save(
                     Path(output_path) / "last-model",
-                    params=host_params,
-                    opt_state=opt_state,
+                    params=jax.device_get(params),  # raw (not averaged): resume state
+                    opt_state=host_opt,
                     step=step,
                     epoch=cur_epoch,
                     rng=sub,

@@ -123,6 +123,26 @@ def cosine(initial_rate: float, total_steps: int, final_scale: float = 0.0) -> S
     return Schedule(fn)
 
 
+class OptimizerWrapper:
+    """optax transformation + framework metadata.
+
+    ``use_averages`` signals the loop to keep a running mean of params and
+    evaluate/checkpoint with it (thinc Adam's averages semantics — the
+    reference's optimizer is constructed from config with use_averages and
+    spacy evaluates under ``use_params(optimizer.averages)``).
+    """
+
+    def __init__(self, tx: optax.GradientTransformation, use_averages: bool = False):
+        self.tx = tx
+        self.use_averages = use_averages
+
+    def init(self, params):
+        return self.tx.init(params)
+
+    def update(self, grads, state, params=None):
+        return self.tx.update(grads, state, params)
+
+
 @registry.optimizers("Adam.v1")
 def Adam(
     learn_rate: ScheduleLike = 0.001,
@@ -133,7 +153,7 @@ def Adam(
     grad_clip: float = 1.0,
     L2_is_weight_decay: bool = True,
     use_averages: bool = False,
-) -> optax.GradientTransformation:
+) -> OptimizerWrapper:
     lr_fn = as_schedule_fn(learn_rate)
     chain = []
     if grad_clip and grad_clip > 0:
@@ -144,10 +164,7 @@ def Adam(
     if L2 and L2_is_weight_decay:
         chain.append(optax.add_decayed_weights(L2))
     chain.append(optax.scale_by_learning_rate(lr_fn))
-    tx = optax.chain(*chain)
-    if use_averages:
-        tx = optax.chain(tx)  # EMA of params handled by loop (kept simple)
-    return tx
+    return OptimizerWrapper(optax.chain(*chain), use_averages=use_averages)
 
 
 @registry.optimizers("SGD.v1")
