@@ -1,0 +1,387 @@
+"""TransitionBasedParser architecture: state2vec MLP + on-device greedy decode.
+
+Capability parity with spaCy's ``TransitionBasedParser.v2`` architecture
+(the model of the reference's parser/NER pipes, trained via reference
+worker.py:91/176-189; native Cython ``nn_parser.pyx`` machinery per
+SURVEY.md §2.3). TPU-first design per SURVEY.md §7 option (a):
+
+* TRAINING: zero dynamic control flow. The host precomputes teacher-forced
+  state features (pipeline/transition.py); the model is
+  ``gather token vectors at [B, S, F] indices → maxout hidden → linear
+  actions`` — two large batched MXU matmuls over the whole doc×step grid.
+* DECODE (parser): fixed-length ``lax.scan`` arc-eager state machine with
+  masked-action argmax — stacks/buffers/heads as dense int arrays, jnp ops
+  only, vectorized over the batch.
+* DECODE (NER): BILUO logits are position-only, so they're one batched
+  matmul; the scan only walks the constraint automaton (open-entity state)
+  over precomputed logits.
+
+Action encodings follow pipeline/transition.py (parser) and
+pipeline/components/ner.py (BILUO).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import registry
+from ..pipeline import transition as T
+from ..types import Padded
+from .core import Context, Model, glorot_uniform
+from ..ops import ops as O
+
+PARSER_N_FEATURES = T.N_FEATURES
+NER_N_FEATURES = 5  # token window [t-2, t-1, t, t+1, t+2]
+
+
+def ner_window_features(Tlen: int, lengths: jnp.ndarray) -> jnp.ndarray:
+    """[B, T, 5] window indices [t-2 .. t+2], -1 outside [0, length).
+
+    Single source of truth for the NER feature layout — used by both the
+    training targets (host) and the jit decode path.
+    """
+    grid = (
+        jnp.arange(Tlen)[None, :, None]
+        + jnp.array([-2, -1, 0, 1, 2])[None, None, :]
+    )
+    lengths = jnp.asarray(lengths)
+    return jnp.where(
+        (grid >= 0) & (grid < lengths[:, None, None]), grid, -1
+    ).astype(jnp.int32)
+
+
+def _gather(X: jnp.ndarray, feats: jnp.ndarray) -> jnp.ndarray:
+    """X [B, T, D], feats [B, S, F] -> [B, S, F, D], -1 slots zeroed."""
+    Tlen = X.shape[1]
+    safe = jnp.clip(feats, 0, Tlen - 1).astype(jnp.int32)
+
+    def per_row(Xrow, frow):  # [T, D], [S, F]
+        return Xrow[frow]  # [S, F, D]
+
+    out = jax.vmap(per_row)(X, safe)
+    mask = (feats >= 0)[..., None].astype(X.dtype)
+    return out * mask
+
+
+class ParserModelFns:
+    """Pure functions bound to static dims; stored in Model.meta."""
+
+    def __init__(self, n_feats: int, width: int, hidden: int, pieces: int, n_actions: int):
+        self.n_feats = n_feats
+        self.width = width
+        self.hidden = hidden
+        self.pieces = pieces
+        self.n_actions = n_actions
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        r1, r2, r3 = jax.random.split(rng, 3)
+        return {
+            "hidden_W": glorot_uniform(r1, (self.n_feats * self.width, self.hidden * self.pieces)),
+            "hidden_b": jnp.zeros((self.hidden, self.pieces)),
+            "out_W": glorot_uniform(r2, (self.hidden, self.n_actions)),
+            "out_b": jnp.zeros((self.n_actions,)),
+        }
+
+    def logits(self, params: Dict[str, Any], state_vecs: jnp.ndarray) -> jnp.ndarray:
+        """state_vecs [..., F*D] -> [..., n_actions]."""
+        h = O.maxout(state_vecs, params["hidden_W"], params["hidden_b"])
+        return h @ params["out_W"] + params["out_b"]
+
+    def step_logits(self, params, X, feats):
+        """X [B,T,D], feats [B,S,F] -> [B,S,nA] (training path, fully batched)."""
+        vecs = _gather(X, feats)  # [B, S, F, D]
+        B, S = vecs.shape[:2]
+        flat = vecs.reshape(B, S, self.n_feats * self.width)
+        return self.logits(params, flat)
+
+
+@registry.architectures("spacy.TransitionBasedParser.v2")
+def TransitionBasedParser(
+    tok2vec: Model,
+    state_type: str = "parser",
+    extra_state_tokens: bool = False,
+    hidden_width: int = 64,
+    maxout_pieces: int = 2,
+    use_upper: bool = True,
+    nO: Optional[int] = None,
+) -> Model:
+    """nO = number of actions (injected at Pipeline.initialize from labels)."""
+    width = tok2vec.dims.get("nO")
+    n_feats = PARSER_N_FEATURES if state_type == "parser" else NER_N_FEATURES
+    n_act = nO if nO else 3
+    fns = ParserModelFns(n_feats, width, hidden_width, maxout_pieces, n_act)
+
+    def init_fn(rng):
+        r1, r2 = jax.random.split(rng)
+        return {"tok2vec": tok2vec.init(r1), "upper": fns.init(r2)}
+
+    def apply_fn(params, x, ctx: Context):
+        """x = (inputs_for_tok2vec, feats [B,S,F]) -> [B,S,nA] logits."""
+        inputs, feats = x
+        t2v: Padded = tok2vec.apply(params.get("tok2vec", {}), inputs, ctx)
+        return fns.step_logits(params["upper"], t2v.X, feats)
+
+    has_listener = any(m.meta.get("listener") for m in tok2vec.walk())
+    m = Model(
+        f"transition_model_{state_type}",
+        init_fn,
+        apply_fn,
+        dims={"nO": n_act, "width": width, "hidden": hidden_width, "n_feats": n_feats},
+        layers=[tok2vec],
+        meta={
+            "has_listener": has_listener,
+            "state_type": state_type,
+            "fns": fns,
+        },
+    )
+    return m
+
+
+# ----------------------------------------------------------------------
+# Device decode: arc-eager greedy under lax.scan
+# ----------------------------------------------------------------------
+
+
+def decode_parser(
+    fns: ParserModelFns,
+    upper_params: Dict[str, Any],
+    X: jnp.ndarray,
+    lengths: jnp.ndarray,
+    n_labels: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy arc-eager decode on device.
+
+    X [B, T, D] tok2vec output; lengths [B] true lengths.
+    Returns (heads [B, T] int32 with ROOT as self-index, labels [B, T]).
+    """
+    B, Tlen, D = X.shape
+    n_act = fns.n_actions
+    NEG = jnp.float32(-1e9)
+
+    def init_state():
+        return {
+            "stack": jnp.full((B, Tlen + 1), -1, jnp.int32),
+            "sp": jnp.zeros((B,), jnp.int32),
+            "buf": jnp.zeros((B,), jnp.int32),
+            "heads": jnp.full((B, Tlen), -2, jnp.int32),
+            "labels": jnp.zeros((B, Tlen), jnp.int32),
+            "lc0": jnp.full((B, Tlen), -1, jnp.int32),
+            "lc1": jnp.full((B, Tlen), -1, jnp.int32),
+            "rc0": jnp.full((B, Tlen), -1, jnp.int32),
+            "rc1": jnp.full((B, Tlen), -1, jnp.int32),
+        }
+
+    bidx = jnp.arange(B)
+
+    def peek(st, depth):
+        idx = st["sp"] - depth
+        ok = idx >= 1
+        return jnp.where(ok, st["stack"][bidx, jnp.clip(idx - 1, 0, Tlen)], -1)
+
+    def features(st):
+        s0 = peek(st, 0)
+        s1 = peek(st, 1)
+        s2 = peek(st, 2)
+        b = st["buf"]
+        b0 = jnp.where(b < lengths, b, -1)
+        b1 = jnp.where(b + 1 < lengths, b + 1, -1)
+        b2 = jnp.where(b + 2 < lengths, b + 2, -1)
+        s0c = jnp.clip(s0, 0, Tlen - 1)
+        s1c = jnp.clip(s1, 0, Tlen - 1)
+        s0l = jnp.where(s0 >= 0, st["lc0"][bidx, s0c], -1)
+        s0r = jnp.where(s0 >= 0, st["rc0"][bidx, s0c], -1)
+        s1l = jnp.where(s1 >= 0, st["lc0"][bidx, s1c], -1)
+        s1r = jnp.where(s1 >= 0, st["rc0"][bidx, s1c], -1)
+        s0l2 = jnp.where(s0 >= 0, st["lc1"][bidx, s0c], -1)
+        s0r2 = jnp.where(s0 >= 0, st["rc1"][bidx, s0c], -1)
+        return jnp.stack(
+            [s0, s1, s2, b0, b1, b2, s0l, s0r, s1l, s1r, s0l2, s0r2], axis=1
+        )  # [B, 12]
+
+    def valid_mask(st):
+        has_b0 = st["buf"] < lengths
+        has_s0 = st["sp"] >= 1
+        s0 = peek(st, 0)
+        s0c = jnp.clip(s0, 0, Tlen - 1)
+        s0_has_head = has_s0 & (st["heads"][bidx, s0c] != -2)
+        shift_ok = has_b0
+        # cleanup: when buffer is empty, REDUCE pops anything (ROOT-escape)
+        reduce_ok = (has_s0 & s0_has_head) | (has_s0 & ~has_b0)
+        la_ok = has_s0 & has_b0 & ~s0_has_head
+        ra_ok = has_s0 & has_b0
+        mask = jnp.zeros((B, n_act), bool)
+        mask = mask.at[:, T.SHIFT].set(shift_ok)
+        mask = mask.at[:, T.REDUCE].set(reduce_ok)
+        la_cols = 2 + 2 * jnp.arange(n_labels)
+        ra_cols = 3 + 2 * jnp.arange(n_labels)
+        mask = mask.at[:, la_cols].set(la_ok[:, None])
+        mask = mask.at[:, ra_cols].set(ra_ok[:, None])
+        return mask
+
+    def apply_action(st, action, active):
+        is_shift = (action == T.SHIFT) & active
+        is_reduce = (action == T.REDUCE) & active
+        arc = action >= 2
+        is_la = arc & ((action - 2) % 2 == 0) & active
+        is_ra = arc & ((action - 2) % 2 == 1) & active
+        label = jnp.where(arc, (action - 2) // 2, 0).astype(jnp.int32)
+        s0 = peek(st, 0)
+        s0c = jnp.clip(s0, 0, Tlen - 1)
+        b0 = st["buf"]
+        b0c = jnp.clip(b0, 0, Tlen - 1)
+
+        push = is_shift | is_ra
+        pop = is_reduce | is_la
+
+        # ROOT-escape on REDUCE of a headless token
+        s0_headless = st["heads"][bidx, s0c] == -2
+        heads = st["heads"]
+        heads = heads.at[bidx, s0c].set(
+            jnp.where(
+                is_reduce & s0_headless & (s0 >= 0), -1, heads[bidx, s0c]
+            )
+        )
+        # LEFT-ARC: head(s0) = b0
+        heads = heads.at[bidx, s0c].set(
+            jnp.where(is_la & (s0 >= 0), b0, heads[bidx, s0c])
+        )
+        labels_arr = st["labels"]
+        labels_arr = labels_arr.at[bidx, s0c].set(
+            jnp.where(is_la & (s0 >= 0), label, labels_arr[bidx, s0c])
+        )
+        # RIGHT-ARC: head(b0) = s0 (or ROOT if stack empty — masked anyway)
+        ra_head = jnp.where(st["sp"] >= 1, s0, -1)
+        heads = heads.at[bidx, b0c].set(
+            jnp.where(is_ra, ra_head, heads[bidx, b0c])
+        )
+        labels_arr = labels_arr.at[bidx, b0c].set(
+            jnp.where(is_ra, label, labels_arr[bidx, b0c])
+        )
+
+        # child bookkeeping (dep < head -> left chain, else right chain)
+        def upd_children(lc0, lc1, rc0, rc1, head, dep, on):
+            hc = jnp.clip(head, 0, Tlen - 1)
+            left = dep < head
+            old_l0 = lc0[bidx, hc]
+            new_l0 = jnp.where(on & left & ((old_l0 == -1) | (dep < old_l0)), dep, old_l0)
+            new_l1 = jnp.where(
+                on & left & ((old_l0 == -1) | (dep < old_l0)), old_l0, lc1[bidx, hc]
+            )
+            new_l1 = jnp.where(
+                on & left & ~((old_l0 == -1) | (dep < old_l0))
+                & ((lc1[bidx, hc] == -1) | (dep < lc1[bidx, hc])),
+                dep,
+                new_l1,
+            )
+            old_r0 = rc0[bidx, hc]
+            new_r0 = jnp.where(on & ~left & ((old_r0 == -1) | (dep > old_r0)), dep, old_r0)
+            new_r1 = jnp.where(
+                on & ~left & ((old_r0 == -1) | (dep > old_r0)), old_r0, rc1[bidx, hc]
+            )
+            new_r1 = jnp.where(
+                on & ~left & ~((old_r0 == -1) | (dep > old_r0))
+                & ((rc1[bidx, hc] == -1) | (dep > rc1[bidx, hc])),
+                dep,
+                new_r1,
+            )
+            on_h = on & (head >= 0)
+            lc0 = lc0.at[bidx, hc].set(jnp.where(on_h, new_l0, lc0[bidx, hc]))
+            lc1 = lc1.at[bidx, hc].set(jnp.where(on_h, new_l1, lc1[bidx, hc]))
+            rc0 = rc0.at[bidx, hc].set(jnp.where(on_h, new_r0, rc0[bidx, hc]))
+            rc1 = rc1.at[bidx, hc].set(jnp.where(on_h, new_r1, rc1[bidx, hc]))
+            return lc0, lc1, rc0, rc1
+
+        lc0, lc1, rc0, rc1 = st["lc0"], st["lc1"], st["rc0"], st["rc1"]
+        lc0, lc1, rc0, rc1 = upd_children(lc0, lc1, rc0, rc1, b0, s0, is_la & (s0 >= 0))
+        lc0, lc1, rc0, rc1 = upd_children(lc0, lc1, rc0, rc1, ra_head, b0, is_ra)
+
+        sp = st["sp"]
+        stack = st["stack"]
+        # pop then (maybe) push
+        sp_after_pop = jnp.where(pop, sp - 1, sp)
+        stack = stack.at[bidx, jnp.clip(sp_after_pop, 0, Tlen)].set(
+            jnp.where(push, b0, stack[bidx, jnp.clip(sp_after_pop, 0, Tlen)])
+        )
+        sp_new = jnp.where(push, sp_after_pop + 1, sp_after_pop)
+        buf_new = jnp.where(is_shift | is_ra, st["buf"] + 1, st["buf"])
+        return {
+            "stack": stack,
+            "sp": sp_new,
+            "buf": buf_new,
+            "heads": heads,
+            "labels": labels_arr,
+            "lc0": lc0,
+            "lc1": lc1,
+            "rc0": rc0,
+            "rc1": rc1,
+        }
+
+    def body(st, _):
+        done = (st["buf"] >= lengths) & (st["sp"] == 0)
+        feats = features(st)  # [B, 12]
+        vecs = _gather(X, feats[:, None, :])  # [B, 1, F, D]
+        flat = vecs.reshape(B, fns.n_feats * fns.width)
+        logits = fns.logits(upper_params, flat)  # [B, nA]
+        mask = valid_mask(st)
+        masked = jnp.where(mask, logits, NEG)
+        action = jnp.argmax(masked, axis=-1).astype(jnp.int32)
+        st = apply_action(st, action, ~done)
+        return st, None
+
+    n_steps = 2 * Tlen + 2
+    final, _ = jax.lax.scan(body, init_state(), None, length=n_steps)
+    heads = final["heads"]
+    # ROOT (-1) and never-attached (-2) -> self (Doc convention)
+    self_idx = jnp.arange(Tlen)[None, :].repeat(B, axis=0)
+    heads = jnp.where(heads < 0, self_idx, heads)
+    return heads, final["labels"]
+
+
+def decode_biluo(
+    logits: jnp.ndarray, lengths: jnp.ndarray, n_labels: int
+) -> jnp.ndarray:
+    """Constrained greedy BILUO decode over precomputed logits.
+
+    logits [B, T, nA] with action encoding O=0, B=1+4i, I=2+4i, L=3+4i,
+    U=4+4i. Returns action ids [B, T]. The scan carries only the
+    open-entity automaton state (-1 = outside).
+    """
+    B, Tlen, nA = logits.shape
+    if n_labels == 0:  # no entity labels seen in training data: all-O
+        return jnp.zeros((B, Tlen), jnp.int32)
+    NEG = jnp.float32(-1e9)
+    lab = jnp.arange(n_labels)
+    B_cols = 1 + 4 * lab
+    I_cols = 2 + 4 * lab
+    L_cols = 3 + 4 * lab
+    U_cols = 4 + 4 * lab
+
+    bidx = jnp.arange(B)
+
+    def body(open_lab, t):
+        lg = logits[:, t, :]  # [B, nA]
+        outside = open_lab < 0
+        inside = ~outside
+        is_last = (t + 1) >= lengths
+        mask = jnp.zeros((B, nA), bool)
+        # outside: O, U-i always; B-i only if not last token (needs an L)
+        mask = mask.at[:, 0].set(outside)
+        mask = mask.at[:, U_cols].set(outside[:, None])
+        mask = mask.at[:, B_cols].set((outside & ~is_last)[:, None])
+        # inside open label k: only I-k (if not last) or L-k
+        open_c = jnp.clip(open_lab, 0, n_labels - 1)
+        mask = mask.at[bidx, I_cols[open_c]].max(inside & ~is_last)
+        mask = mask.at[bidx, L_cols[open_c]].max(inside)
+        masked = jnp.where(mask, lg, NEG)
+        act = jnp.argmax(masked, axis=-1).astype(jnp.int32)
+        # new automaton state
+        opens = (act >= 1) & ((act - 1) % 4 == 0)  # B-i
+        conts = (act >= 2) & ((act - 2) % 4 == 0)  # I-i
+        new_open = jnp.where(opens, (act - 1) // 4, jnp.where(conts, open_lab, -1))
+        return new_open, act
+
+    _, actions = jax.lax.scan(body, jnp.full((B,), -1, jnp.int32), jnp.arange(Tlen))
+    return actions.T  # [B, T]

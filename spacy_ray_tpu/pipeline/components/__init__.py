@@ -4,3 +4,5 @@ from .base import Component  # noqa: F401
 from . import tok2vec  # noqa: F401
 from . import tagger  # noqa: F401
 from . import textcat  # noqa: F401
+from . import parser  # noqa: F401
+from . import ner  # noqa: F401

@@ -1,0 +1,152 @@
+"""Named entity recognizer: BILUO transition system (push-down automaton).
+
+Capability parity with spaCy's ``ner`` pipe (BiluoPushDown transition
+system over the same nn_parser machinery, SURVEY.md §2.3) as trained by the
+reference. TPU-first: the BILUO action at each token depends only on the
+token position and the open-entity automaton state, so
+
+* training is one batched window-feature classification over [B, T]
+  (teacher-forced gold actions = the BILUO tags — no scan);
+* decode precomputes all logits in one matmul and runs only the constraint
+  automaton under ``lax.scan`` (models/parser.py ``decode_biluo``).
+
+Action encoding: O=0, B-i=1+4i, I-i=2+4i, L-i=3+4i, U-i=4+4i.
+Scores: ``ents_p``/``ents_r``/``ents_f`` (exact-span match, spaCy scorer
+semantics) + per-type F.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...registry import registry
+from ...models.core import Context, Params
+from ...models.parser import NER_N_FEATURES, decode_biluo, ner_window_features
+from ...ops import ops as O
+from ...pipeline.doc import Doc, Example, Span
+from ...types import Padded
+from .base import Component
+
+
+def n_ner_actions(n_labels: int) -> int:
+    return 1 + 4 * n_labels
+
+
+def biluo_action_id(tag: str, label_ids: Dict[str, int]) -> int:
+    if tag == "O" or tag == "-":
+        return 0
+    prefix, _, label = tag.partition("-")
+    i = label_ids.get(label)
+    if i is None:  # label outside the initialize()-sampled set: treat as O
+        return 0
+    return {"B": 1, "I": 2, "L": 3, "U": 4}[prefix] + 4 * i
+
+
+def action_to_biluo(action: int, labels: List[str]) -> str:
+    if action == 0:
+        return "O"
+    prefix = ["B", "I", "L", "U"][(action - 1) % 4]
+    return f"{prefix}-{labels[(action - 1) // 4]}"
+
+
+
+
+class NERComponent(Component):
+    def add_labels_from(self, examples) -> None:
+        labels = set(self.labels)
+        for eg in examples:
+            for span in eg.reference.ents:
+                labels.add(span.label)
+        self.labels = list(labels)
+
+    def build_model(self):
+        cfg = dict(self.model_cfg)
+        cfg["nO"] = n_ner_actions(len(self.labels))
+        model = registry.resolve(cfg)
+        self.model = model
+        self.listens = bool(model.meta.get("has_listener"))
+        return model
+
+    def make_targets(self, examples: List[Example], B: int, Tlen: int) -> Dict[str, np.ndarray]:
+        label_ids = {label: i for i, label in enumerate(self.labels)}
+        actions = np.zeros((B, Tlen), dtype=np.int32)
+        mask = np.zeros((B, Tlen), dtype=bool)
+        lengths = []
+        for i, eg in enumerate(examples):
+            ref = eg.reference
+            n = min(len(ref), Tlen)
+            lengths.append(n)
+            tags = ref.ents_biluo()
+            for t in range(n):
+                actions[i, t] = biluo_action_id(tags[t], label_ids)
+                mask[i, t] = True
+        while len(lengths) < B:
+            lengths.append(0)
+        feats = np.asarray(ner_window_features(Tlen, np.asarray(lengths)))
+        return {"actions": actions, "feats": feats, "ner_mask": mask}
+
+    def loss(self, params: Params, inputs: Any, targets: Dict[str, Any], ctx: Context):
+        logits = self.model.apply(params, (inputs, targets["feats"]), ctx)
+        loss = O.masked_softmax_cross_entropy(
+            logits, targets["actions"], targets["ner_mask"]
+        )
+        acc = O.masked_accuracy(logits, targets["actions"], targets["ner_mask"])
+        return loss, {"ner_action_acc": acc}
+
+    def forward(self, params: Params, inputs: Any, ctx: Context):
+        if isinstance(inputs, Padded):
+            t2v = inputs
+        else:
+            tok2vec = self.model.layers[0]
+            t2v = tok2vec.apply(params.get("tok2vec", {}), inputs, ctx)
+        B, Tlen, _ = t2v.X.shape
+        lengths_arr = jnp.sum(t2v.mask.astype(jnp.int32), axis=1)
+        feats = ner_window_features(Tlen, lengths_arr)
+        fns = self.model.meta["fns"]
+        logits = fns.step_logits(params["upper"], t2v.X, feats)
+        actions = decode_biluo(logits, lengths_arr, len(self.labels))
+        return {"actions": actions}
+
+    def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
+        actions = np.asarray(outputs["actions"])
+        for i, doc in enumerate(docs):
+            n = lengths[i]
+            tags = [action_to_biluo(int(a), self.labels) for a in actions[i, :n]]
+            doc.ents = Doc.spans_from_biluo(tags)
+
+    def score(self, examples: List[Example]) -> Dict[str, float]:
+        tp = fp = fn = 0
+        per_type: Dict[str, List[int]] = {l: [0, 0, 0] for l in self.labels}
+        for eg in examples:
+            gold = {(s.start, s.end, s.label) for s in eg.reference.ents}
+            pred = {(s.start, s.end, s.label) for s in eg.predicted.ents}
+            for p in pred:
+                if p in gold:
+                    tp += 1
+                    if p[2] in per_type:
+                        per_type[p[2]][0] += 1
+                else:
+                    fp += 1
+                    if p[2] in per_type:
+                        per_type[p[2]][1] += 1
+            for g in gold - pred:
+                fn += 1
+                if g[2] in per_type:
+                    per_type[g[2]][2] += 1
+        p = tp / (tp + fp) if tp + fp else 0.0
+        r = tp / (tp + fn) if tp + fn else 0.0
+        f = 2 * p * r / (p + r) if p + r else 0.0
+        scores = {"ents_p": p, "ents_r": r, "ents_f": f}
+        for label, (ltp, lfp, lfn) in per_type.items():
+            lp = ltp / (ltp + lfp) if ltp + lfp else 0.0
+            lr = ltp / (ltp + lfn) if ltp + lfn else 0.0
+            scores[f"ents_f_{label}"] = 2 * lp * lr / (lp + lr) if lp + lr else 0.0
+        return scores
+
+
+@registry.factories("ner")
+def make_ner(name: str, model: Dict[str, Any]) -> NERComponent:
+    return NERComponent(name, model)

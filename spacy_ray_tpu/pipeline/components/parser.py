@@ -1,0 +1,149 @@
+"""Dependency parser component (arc-eager, teacher-forced training).
+
+Capability parity with spaCy's ``parser`` pipe trained by the reference
+(reference worker.py:91/176-189; SURVEY.md §2.3 "spaCy core", §7 hard part
+#1). Training lowers each gold tree to a precomputed (actions, state
+features, valid masks) grid host-side (pipeline/transition.py) — the device
+loss is one batched classification over the doc×step grid. Decode runs the
+arc-eager machine under ``lax.scan`` on device (models/parser.py).
+
+Scores: UAS/LAS (``dep_uas``/``dep_las``), matching spaCy's scorer keys for
+the parity targets in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...registry import registry
+from ...models.core import Context, Params
+from ...models.parser import decode_parser
+from ...pipeline import transition as T
+from ...pipeline.doc import Doc, Example
+from ...types import Padded, TokenBatch
+from .base import Component
+
+
+class ParserComponent(Component):
+    def add_labels_from(self, examples) -> None:
+        labels = set(self.labels)
+        for eg in examples:
+            if eg.reference.deps:
+                labels.update(d for d in eg.reference.deps if d)
+        self.labels = list(labels)
+
+    def build_model(self):
+        cfg = dict(self.model_cfg)
+        cfg["nO"] = T.n_actions(len(self.labels))
+        model = registry.resolve(cfg)
+        self.model = model
+        self.listens = bool(model.meta.get("has_listener"))
+        return model
+
+    # ------------------------------------------------------------------
+    def make_targets(self, examples: List[Example], B: int, Tlen: int) -> Dict[str, np.ndarray]:
+        label_ids = {label: i for i, label in enumerate(self.labels)}
+        n_act = T.n_actions(len(self.labels))
+        S = 2 * Tlen + 2
+        actions = np.zeros((B, S), dtype=np.int32)
+        feats = np.full((B, S, T.N_FEATURES), -1, dtype=np.int32)
+        valid = np.zeros((B, S, n_act), dtype=bool)
+        step_mask = np.zeros((B, S), dtype=bool)
+        for i, eg in enumerate(examples):
+            ref = eg.reference
+            if not ref.heads or not ref.deps or len(ref) > Tlen:
+                continue
+            ids = [label_ids.get(d, 0) for d in ref.deps]
+            out = T.gold_oracle(ref.heads, ids, len(self.labels))
+            if out is None:  # non-projective or oracle-unreachable: skip doc
+                continue
+            acts, f, v = out
+            s = min(len(acts), S)
+            actions[i, :s] = acts[:s]
+            feats[i, :s] = f[:s]
+            valid[i, :s] = v[:s]
+            step_mask[i, :s] = True
+        return {
+            "actions": actions,
+            "feats": feats,
+            "valid": valid,
+            "step_mask": step_mask,
+        }
+
+    # ------------------------------------------------------------------
+    def loss(self, params: Params, inputs: Any, targets: Dict[str, Any], ctx: Context):
+        logits = self.model.apply(params, (inputs, targets["feats"]), ctx)
+        NEG = jnp.float32(-1e9)
+        masked_logits = jnp.where(targets["valid"], logits, NEG)
+        logp = jax.nn.log_softmax(masked_logits.astype(jnp.float32), axis=-1)
+        gold = jax.nn.one_hot(targets["actions"], logits.shape[-1], dtype=jnp.float32)
+        ce = -jnp.sum(gold * logp, axis=-1)
+        mask_f = targets["step_mask"].astype(jnp.float32)
+        loss = jnp.sum(ce * mask_f) / jnp.maximum(jnp.sum(mask_f), 1.0)
+        pred = jnp.argmax(masked_logits, axis=-1)
+        acc = jnp.sum((pred == targets["actions"]) * mask_f) / jnp.maximum(
+            jnp.sum(mask_f), 1.0
+        )
+        return loss, {"parse_action_acc": acc}
+
+    # ------------------------------------------------------------------
+    def forward(self, params: Params, inputs: Any, ctx: Context):
+        fns = self.model.meta["fns"]
+        if isinstance(inputs, Padded):
+            t2v = inputs
+            if not self.listens:
+                raise TypeError("parser got Padded input but has its own tok2vec")
+        else:
+            tok2vec = self.model.layers[0]
+            t2v = tok2vec.apply(params.get("tok2vec", {}), inputs, ctx)
+        lengths = jnp.sum(t2v.mask.astype(jnp.int32), axis=1)
+        heads, labels = decode_parser(
+            fns, params["upper"], t2v.X, lengths, len(self.labels)
+        )
+        return {"heads": heads, "labels": labels}
+
+    def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
+        heads = np.asarray(outputs["heads"])
+        labels = np.asarray(outputs["labels"])
+        for i, doc in enumerate(docs):
+            n = lengths[i]
+            doc.heads = [int(h) for h in heads[i, :n]]
+            doc.deps = [
+                self.labels[l] if self.labels else "dep" for l in labels[i, :n]
+            ]
+            # ROOT-attached tokens (head == self) get the root label
+            for j in range(n):
+                if doc.heads[j] == j:
+                    doc.deps[j] = "ROOT"
+
+    def score(self, examples: List[Example]) -> Dict[str, float]:
+        correct_u = correct_l = total = 0
+        for eg in examples:
+            gold_heads = eg.reference.heads
+            gold_deps = eg.reference.deps
+            pred_heads = eg.predicted.heads
+            pred_deps = eg.predicted.deps
+            if not gold_heads or not pred_heads:
+                continue
+            for j in range(min(len(gold_heads), len(pred_heads))):
+                total += 1
+                if gold_heads[j] == pred_heads[j]:
+                    correct_u += 1
+                    gd = gold_deps[j] if gold_deps else None
+                    pd = pred_deps[j] if pred_deps else None
+                    if gd is not None and (
+                        gd == pd or (gold_heads[j] == j and pd == "ROOT")
+                    ):
+                        correct_l += 1
+        uas = correct_u / total if total else 0.0
+        las = correct_l / total if total else 0.0
+        return {"dep_uas": uas, "dep_las": las}
+
+
+@registry.factories("parser")
+def make_parser(name: str, model: Dict[str, Any]) -> ParserComponent:
+    return ParserComponent(name, model)

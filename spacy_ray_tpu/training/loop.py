@@ -178,7 +178,11 @@ def train(
     # Parameter averaging (thinc Adam use_averages semantics): running mean
     # of params, used for eval + best-model checkpoints.
     use_averages = bool(getattr(tx, "use_averages", False))
-    avg_params = params if use_averages else None
+    # copy: params buffers are donated to the jitted update, so an alias
+    # would dereference deleted buffers at the first _avg_step on TPU
+    avg_params = (
+        jax.tree_util.tree_map(jnp.copy, params) if use_averages else None
+    )
     avg_count = 0
 
     @jax.jit
@@ -238,9 +242,23 @@ def train(
             for _ in range(accum):
                 cur_epoch, b = next(batch_iter)
                 raw_batches.append(b)
+            have_group = True
         except StopIteration:
             # end of data: an incomplete accumulation group would underscale
             # the mean gradient (scan still divides by `accum`) — drop it
+            have_group = False
+        if process_count > 1:
+            # loop termination must be COLLECTIVE: if any host ran out of
+            # data, all hosts stop this step, else the continuing hosts
+            # enter the update collectives alone and deadlock
+            from jax.experimental import multihost_utils
+
+            flags = multihost_utils.process_allgather(
+                np.array([1 if have_group else 0], np.int32)
+            )
+            if int(np.min(flags)) == 0:
+                break
+        elif not have_group:
             break
         # collate to the same (B, T) bucket so stacking works
         max_len = max(max(len(eg) for eg in b) for b in raw_batches)

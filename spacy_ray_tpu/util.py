@@ -114,6 +114,58 @@ def synth_ner_doc(rng: random.Random, min_len: int = 5, max_len: int = 24) -> Do
     return doc
 
 
+def synth_parsed_doc(rng: random.Random) -> Doc:
+    """Template-grammar sentence with a gold projective dependency tree.
+
+    S -> NP VP [PUNCT]; NP -> DET ADJ* NOUN; VP -> VERB [NP] [ADV].
+    Heads: DET/ADJ->NOUN, subj NOUN->VERB, obj NOUN->VERB, ADV->VERB,
+    VERB=root. Always projective; structure recoverable from word identity.
+    """
+
+    words: List[str] = []
+    tags: List[str] = []
+    heads: List[int] = []
+    deps: List[str] = []
+
+    def emit(pos: str, dep: str, head: int = -100) -> int:
+        words.append(rng.choice(_POS_VOCAB[pos]))
+        tags.append(pos)
+        heads.append(head)
+        deps.append(dep)
+        return len(words) - 1
+
+    def np_() -> int:
+        """Append an NP; returns noun index; dependents head to the noun."""
+        start = len(words)
+        if rng.random() < 0.7:
+            emit("DET", "det")
+        for _ in range(rng.randint(0, 2)):
+            emit("ADJ", "amod")
+        noun_i = emit("NOUN", "dep", -200)
+        for k in range(start, noun_i):
+            heads[k] = noun_i
+        return noun_i
+
+    subj = np_()
+    verb_i = emit("VERB", "ROOT")
+    heads[verb_i] = verb_i  # root: head = self
+    heads[subj] = verb_i
+    deps[subj] = "nsubj"
+    if rng.random() < 0.7:
+        obj = np_()
+        heads[obj] = verb_i
+        deps[obj] = "obj"
+    if rng.random() < 0.5:
+        i = emit("ADV", "advmod")
+        heads[i] = verb_i
+    if rng.random() < 0.6:
+        words.append(".")
+        tags.append("PUNCT")
+        heads.append(verb_i)
+        deps.append("punct")
+    return Doc(words=words, tags=tags, heads=heads, deps=deps)
+
+
 def synth_textcat_doc(rng: random.Random) -> Doc:
     label = rng.choice(["SPORTS", "TECH", "FOOD"])
     topical = {
@@ -136,6 +188,7 @@ def synth_corpus(
         "tagger": synth_tagged_doc,
         "ner": synth_ner_doc,
         "textcat": synth_textcat_doc,
+        "parser": synth_parsed_doc,
     }
     maker = makers[kind]
     return [Example.from_gold(maker(rng)) for _ in range(n_docs)]

@@ -1,0 +1,130 @@
+"""End-to-end multi-component pipeline through the training loop —
+the en_core_web_sm shape (BASELINE.json config #2): tagger + parser + NER
+over one shared CNN tok2vec, multi-task gradients summed into the trunk."""
+
+import json
+
+import pytest
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.training.loop import train
+from spacy_ray_tpu.training.corpus import _doc_to_json
+from spacy_ray_tpu.util import synth_corpus
+
+FULL_CFG = """
+[paths]
+train = null
+dev = null
+
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger","parser","ner"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 64
+depth = 2
+embed_size = 512
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 64
+
+[components.parser]
+factory = "parser"
+
+[components.parser.model]
+@architectures = "spacy.TransitionBasedParser.v2"
+state_type = "parser"
+hidden_width = 64
+maxout_pieces = 2
+
+[components.parser.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 64
+
+[components.ner]
+factory = "ner"
+
+[components.ner.model]
+@architectures = "spacy.TransitionBasedParser.v2"
+state_type = "ner"
+hidden_width = 64
+maxout_pieces = 2
+
+[components.ner.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 64
+
+[corpora.train]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${paths.train}
+shuffle = true
+
+[corpora.dev]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${paths.dev}
+
+[training]
+seed = 0
+max_steps = 120
+eval_frequency = 40
+patience = 0
+
+[training.optimizer]
+@optimizers = "Adam.v1"
+learn_rate = 0.005
+
+[training.batcher]
+@batchers = "spacy.batch_by_words.v1"
+size = 800
+
+[training.score_weights]
+tag_acc = 0.34
+dep_las = 0.33
+ents_f = 0.33
+"""
+
+
+def _write_mixed(path, n, seed):
+    """Mixed corpus: parsed docs (tags+heads+deps) + NER docs (ents).
+    Each component learns from the docs carrying its annotation."""
+    egs = synth_corpus(n // 2, "parser", seed=seed) + synth_corpus(
+        n // 2, "ner", seed=seed + 1
+    )
+    with open(path, "w", encoding="utf8") as f:
+        for eg in egs:
+            f.write(json.dumps(_doc_to_json(eg.reference)) + "\n")
+
+
+def test_full_pipeline_multitask(tmp_path):
+    _write_mixed(tmp_path / "train.jsonl", 400, seed=0)
+    _write_mixed(tmp_path / "dev.jsonl", 80, seed=7)
+    cfg = Config.from_str(FULL_CFG).apply_overrides(
+        {
+            "paths.train": str(tmp_path / "train.jsonl"),
+            "paths.dev": str(tmp_path / "dev.jsonl"),
+        }
+    )
+    nlp, result = train(cfg, output_path=tmp_path / "out", n_workers=2, stdout_log=False)
+    assert result.final_step == 120
+    last = result.history[-1]["other_scores"]
+    assert last["tag_acc"] > 0.85, last
+    assert last["dep_uas"] > 0.6, last
+    assert last["ents_f"] > 0.5, last
+    # model roundtrip with all components
+    from spacy_ray_tpu.pipeline.language import Pipeline
+
+    reloaded = Pipeline.from_disk(tmp_path / "out" / "best-model")
+    doc = reloaded("Alice Smith sees the green tree")
+    assert doc.tags and len(doc.tags) == len(doc.words)
+    assert doc.heads and len(doc.heads) == len(doc.words)
