@@ -30,6 +30,12 @@ BENCH_STEPS = 30
 
 def main() -> None:
     import jax
+
+    try:  # probe the default platform; fall back to CPU if TPU is unreachable
+        jax.devices()
+    except RuntimeError as e:
+        print(f"# TPU backend unavailable ({e}); falling back to CPU", flush=True)
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from spacy_ray_tpu.config import Config
@@ -44,31 +50,10 @@ def main() -> None:
     from spacy_ray_tpu.registry import registry
     from spacy_ray_tpu.util import synth_corpus
 
+    from spacy_ray_tpu.presets import CNN_TAGGER_CFG
+
     cfg = Config.from_str(
-        f"""
-[nlp]
-lang = "en"
-pipeline = ["tok2vec","tagger"]
-
-[components.tok2vec]
-factory = "tok2vec"
-
-[components.tok2vec.model]
-@architectures = "spacy.HashEmbedCNN.v2"
-width = {WIDTH}
-depth = {DEPTH}
-embed_size = {EMBED}
-
-[components.tagger]
-factory = "tagger"
-
-[components.tagger.model]
-@architectures = "spacy.Tagger.v2"
-
-[components.tagger.model.tok2vec]
-@architectures = "spacy.Tok2VecListener.v1"
-width = {WIDTH}
-"""
+        CNN_TAGGER_CFG.format(width=WIDTH, depth=DEPTH, embed_size=EMBED)
     )
     nlp = Pipeline.from_config(cfg)
     examples = synth_corpus(2048, "tagger", seed=0)

@@ -4,3 +4,5 @@ from .core import Model, Context, chain, residual, clone, count_params, param_pa
 from . import layers  # noqa: F401
 from . import tok2vec  # noqa: F401  (registers spacy.HashEmbedCNN.v2 etc.)
 from . import heads  # noqa: F401  (registers spacy.Tagger.v2 etc.)
+from . import parser  # noqa: F401  (registers spacy.TransitionBasedParser.v2)
+from . import transformer  # noqa: F401  (registers spacy_ray_tpu.TransformerEncoder.v1)

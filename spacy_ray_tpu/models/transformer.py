@@ -1,0 +1,232 @@
+"""Transformer trunk: RoBERTa-base-shape encoder, TPU-first.
+
+Capability parity with the reference ecosystem's shared transformer backbone
+(en_core_web_trf: RoBERTa-base feeding tagger/parser/NER via listeners —
+BASELINE.json config #4; the reference trains it through the same loop,
+worker.py:91/176-189). Differences, deliberate and TPU-native:
+
+* Pretrained HF checkpoint loading is gated (zero-egress environment);
+  the trunk trains from scratch. Sub-word information comes from the
+  MultiHashEmbed featurizer (NORM/PREFIX/SUFFIX/SHAPE) instead of BPE
+  wordpieces, so there is no wordpiece↔token alignment problem at all —
+  one vector per token throughout.
+* bfloat16 matmuls on the MXU, fp32 layernorm/softmax accumulation,
+  fp32 params.
+* Attention uses ``jax.nn.dot_product_attention`` (XLA flash-attention
+  path) on a single chip; with a ``context`` mesh axis the same layer
+  switches to ring attention over ICI (parallel/ring_attention.py,
+  SURVEY.md §5.7 — first-class here although the reference has none).
+* Tensor parallelism: head and FFN dims carry sharding constraints over
+  the ``model`` mesh axis when TP is enabled (parallel/context.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..registry import registry
+from ..ops import ops as O
+from ..types import Padded, TokenBatch
+from ..parallel import context as pctx
+from .core import Context, Model, glorot_uniform, normal_init
+from .tok2vec import MultiHashEmbed, ATTRS
+
+
+def _maybe_shard(x: jnp.ndarray, spec: P) -> jnp.ndarray:
+    """Apply a sharding constraint when a mesh is active (no-op otherwise;
+    axes of size 1 in the mesh make the constraint a no-op too)."""
+    mesh = pctx.current_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, spec)
+    )
+
+
+def transformer_layer_params(rng, width: int, ffn: int):
+    r = jax.random.split(rng, 6)
+    scale = 0.02
+    return {
+        "qkv_W": normal_init(r[0], (width, 3 * width), scale),
+        "qkv_b": jnp.zeros((3 * width,)),
+        "o_W": normal_init(r[1], (width, width), scale),
+        "o_b": jnp.zeros((width,)),
+        "ln1_g": jnp.ones((width,)),
+        "ln1_b": jnp.zeros((width,)),
+        "ffn_W1": normal_init(r[2], (width, ffn), scale),
+        "ffn_b1": jnp.zeros((ffn,)),
+        "ffn_W2": normal_init(r[3], (ffn, width), scale),
+        "ffn_b2": jnp.zeros((width,)),
+        "ln2_g": jnp.ones((width,)),
+        "ln2_b": jnp.zeros((width,)),
+    }
+
+
+def apply_transformer_layer(
+    p,
+    X: jnp.ndarray,
+    mask: jnp.ndarray,
+    rng: Optional[jax.Array],
+    *,
+    n_heads: int,
+    dropout: float,
+    train: bool,
+    compute_dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    """Pre-LN encoder layer. X [B, T, D] fp32, mask [B, T] bool.
+
+    Keyword args are static (bound with functools.partial before
+    jax.checkpoint, so the checkpointed callable takes only pytrees).
+    """
+    B, T, D = X.shape
+    H = n_heads
+    Dh = D // H
+    use_dropout = train and rng is not None and dropout > 0
+    if use_dropout:
+        rng1, rng2 = jax.random.split(rng)
+
+    # ---- attention ----
+    h = O.layer_norm(X, p["ln1_g"], p["ln1_b"])
+    h16 = h.astype(compute_dtype)
+    qkv = h16 @ p["qkv_W"].astype(compute_dtype) + p["qkv_b"].astype(compute_dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(x):
+        return x.reshape(B, T, H, Dh)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    # full-layout constraints (batch over data, seq over context, heads over
+    # model) — partial specs make the partitioner re-materialize
+    qkv_spec = P("data", "context", "model", None)
+    q = _maybe_shard(q, qkv_spec)
+    k = _maybe_shard(k, qkv_spec)
+    v = _maybe_shard(v, qkv_spec)
+
+    if pctx.context_parallel_active():
+        from ..parallel.ring_attention import ring_attention
+
+        attn = ring_attention(q, k, v, mask)
+    else:
+        attn = jax.nn.dot_product_attention(
+            q, k, v,
+            mask=mask[:, None, None, :],  # [B, 1, 1, T] broadcast over heads+query
+        )
+    attn = attn.reshape(B, T, D)
+    out = attn @ p["o_W"].astype(compute_dtype) + p["o_b"].astype(compute_dtype)
+    out = out.astype(jnp.float32)
+    if use_dropout:
+        out = O.dropout(rng1, out, dropout, True)
+    X = X + out
+
+    # ---- ffn ----
+    h = O.layer_norm(X, p["ln2_g"], p["ln2_b"])
+    h16 = h.astype(compute_dtype)
+    inner = h16 @ p["ffn_W1"].astype(compute_dtype) + p["ffn_b1"].astype(compute_dtype)
+    inner = _maybe_shard(inner, P("data", "context", "model"))
+    inner = O.gelu(inner)
+    out = inner @ p["ffn_W2"].astype(compute_dtype) + p["ffn_b2"].astype(compute_dtype)
+    out = out.astype(jnp.float32)
+    if use_dropout:
+        out = O.dropout(rng2, out, dropout, True)
+    return X + out
+
+
+@registry.architectures("spacy_ray_tpu.TransformerEncoder.v1")
+def TransformerEncoder(
+    width: int = 768,
+    depth: int = 12,
+    n_heads: int = 12,
+    ffn_mult: int = 4,
+    dropout: float = 0.1,
+    max_len: int = 512,
+    embed_size: int = 10000,
+    remat: bool = True,
+) -> Model:
+    """Hash-embed featurized transformer trunk (tok2vec-compatible output).
+
+    ``remat=True`` wraps each layer in jax.checkpoint — rematerialize
+    activations in backward to trade FLOPs for HBM (the standard TPU
+    memory/bandwidth tradeoff for deep trunks).
+    """
+    if width % n_heads != 0:
+        raise ValueError(f"width {width} not divisible by n_heads {n_heads}")
+    ffn = width * ffn_mult
+    embed = MultiHashEmbed(width=width, attrs=list(ATTRS),
+                           rows=[embed_size] + [embed_size // 2] * 3)
+
+    def init_fn(rng):
+        rngs = jax.random.split(rng, depth + 2)
+        params = {
+            "embed": embed.init(rngs[0]),
+            "pos": normal_init(rngs[1], (max_len, width), 0.02),
+            "ln_f_g": jnp.ones((width,)),
+            "ln_f_b": jnp.zeros((width,)),
+        }
+        for i in range(depth):
+            params[f"layer_{i}"] = transformer_layer_params(rngs[i + 2], width, ffn)
+        return params
+
+    def apply_fn(params, batch: TokenBatch, ctx: Context) -> Padded:
+        emb: Padded = embed.apply(params["embed"], batch, ctx)
+        T = emb.X.shape[1]
+        if T > max_len:
+            import warnings
+
+            warnings.warn(
+                f"sequence length {T} exceeds transformer max_len {max_len}; "
+                "positions beyond max_len reuse the last positional embedding "
+                "(set a larger max_len or bound doc length via corpus "
+                "max_length)",
+                stacklevel=2,
+            )
+        pos_idx = jnp.minimum(jnp.arange(T), params["pos"].shape[0] - 1)
+        X = emb.X + params["pos"][pos_idx][None, :, :]
+        mask = emb.mask
+        if pctx.context_parallel_active():
+            # sequence-parallel layout: T sharded over the context axis
+            X = _maybe_shard(X, P("data", "context", None))
+            mask = _maybe_shard(mask, P("data", "context"))
+
+        from functools import partial as _partial
+
+        layer_fn = _partial(
+            apply_transformer_layer,
+            n_heads=n_heads,
+            dropout=dropout,
+            train=ctx.train,
+        )
+        if remat:
+            # checkpointed callable takes only pytree args (p, X, mask, rng)
+            layer_fn = jax.checkpoint(layer_fn)
+        for i in range(depth):
+            ctx, sub = ctx.split()
+            X = layer_fn(params[f"layer_{i}"], X, mask, sub.rng)
+        X = O.layer_norm(X, params["ln_f_g"], params["ln_f_b"])
+        return Padded(X=X * mask[..., None].astype(X.dtype), mask=mask)
+
+    return Model(
+        "transformer_encoder",
+        init_fn,
+        apply_fn,
+        dims={"nO": width, "depth": depth, "n_heads": n_heads},
+        layers=[embed],
+    )
+
+
+@registry.architectures("spacy-transformers.TransformerModel.v3")
+def HFTransformerModel(
+    name: str = "roberta-base",
+    get_spans=None,
+    tokenizer_config: Optional[dict] = None,
+    transformer_config: Optional[dict] = None,
+) -> Model:
+    raise NotImplementedError(
+        "Pretrained HuggingFace checkpoints are not loadable in this "
+        "zero-egress environment. Use @architectures "
+        '"spacy_ray_tpu.TransformerEncoder.v1" — the same RoBERTa-base '
+        "shape trained from scratch (width=768, depth=12, n_heads=12)."
+    )

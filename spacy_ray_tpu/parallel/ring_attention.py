@@ -1,0 +1,98 @@
+"""Ring attention: exact attention over sequences sharded on the ``context``
+mesh axis.
+
+Long-context sequence parallelism — absent from the reference (SURVEY.md
+§5.7: sequence scaling there is document segmentation only) but first-class
+here: each device holds a [B, T/n] slice of the sequence; key/value blocks
+rotate around the ring via ``lax.ppermute`` over ICI while queries stay
+put, with an online-softmax accumulator so the result is EXACT attention
+(numerically identical to the dense computation), memory O(T/n) per device,
+and communication overlapped block-by-block.
+
+Implemented with ``shard_map`` over the mesh (per-device code + explicit
+collectives), the idiomatic JAX pattern for collective-permute pipelines.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map  # jax >= 0.7 (replication check kwarg: check_vma)
+    _CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+    _CHECK_KW = "check_rep"
+from jax.sharding import PartitionSpec as P
+
+from . import context as pctx
+
+AXIS = "context"
+
+
+def _ring_body(carry, _, *, q, scale, axis_name, n_shards):
+    k, v, kmask, m, num, den = carry
+    # scores over the current key block: [B, H, Tq, Tk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    neg = jnp.float32(-1e30)
+    scores = jnp.where(kmask[:, None, None, :], scores, neg)
+    block_max = jnp.max(scores, axis=-1)  # [B, H, Tq]
+    new_m = jnp.maximum(m, block_max)
+    correction = jnp.exp(m - new_m)
+    p = jnp.exp(scores - new_m[..., None])  # [B, H, Tq, Tk]
+    p = jnp.where(kmask[:, None, None, :], p, 0.0)
+    corr_q = correction.transpose(0, 2, 1)[..., None]  # [B, Tq, H, 1]
+    num = num * corr_q + jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    den = den * correction + jnp.sum(p, axis=-1)
+    # rotate k/v/mask to the next ring position
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    k = jax.lax.ppermute(k, axis_name, perm)
+    v = jax.lax.ppermute(v, axis_name, perm)
+    kmask = jax.lax.ppermute(kmask, axis_name, perm)
+    return (k, v, kmask, new_m, num, den), None
+
+
+def ring_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    """q/k/v [B, T, H, Dh] (T logically sharded over 'context'), mask [B, T].
+
+    Returns [B, T, H, Dh] in q.dtype. Must be called under jit with the
+    active mesh (parallel/context.py) carrying a 'context' axis.
+    """
+    mesh = pctx.current_mesh()
+    assert mesh is not None and AXIS in mesh.shape, "ring_attention needs a context axis"
+    n_shards = int(mesh.shape[AXIS])
+    Dh = q.shape[-1]
+    scale = 1.0 / (Dh ** 0.5)
+    out_dtype = q.dtype
+
+    data = "data" if "data" in mesh.shape else None
+    model = "model" if "model" in mesh.shape and mesh.shape["model"] > 1 else None
+    qkv_spec = P(data, AXIS, model, None)
+    mask_spec = P(data, AXIS)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        **{_CHECK_KW: False},
+    )
+    def inner(q, k, v, kmask):
+        B, Tq, H, _ = q.shape
+        m = jnp.full((B, H, Tq), -1e30, jnp.float32)
+        num = jnp.zeros((B, Tq, H, Dh), jnp.float32)
+        den = jnp.zeros((B, H, Tq), jnp.float32)
+        body = partial(
+            _ring_body, q=q, scale=scale, axis_name=AXIS, n_shards=n_shards
+        )
+        (k, v, kmask, m, num, den), _ = jax.lax.scan(
+            body, (k, v, kmask, m, num, den), None, length=n_shards
+        )
+        den_t = den.transpose(0, 2, 1)[..., None]  # [B, Tq, H, 1]
+        return (num / jnp.maximum(den_t, 1e-9)).astype(out_dtype)
+
+    return inner(q, k, v, mask)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import context as pctx
 from .mesh import replicated, zero1_spec
 
 
@@ -119,7 +120,10 @@ def make_train_step(
     jitted = jax.jit(update, **jit_kwargs)
 
     def run(params, opt_state, tokens, targets, rng):
-        return jitted(params, opt_state, tokens, targets, rng)
+        # install the mesh so model code (transformer TP/CP constraints,
+        # ring attention) can consult it at trace time
+        with pctx.use_mesh(mesh):
+            return jitted(params, opt_state, tokens, targets, rng)
 
     run.mesh = mesh
     run.batch_shard = batch_shard

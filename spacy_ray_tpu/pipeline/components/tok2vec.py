@@ -31,3 +31,13 @@ class Tok2VecComponent(Component):
 @registry.factories("tok2vec")
 def make_tok2vec(name: str, model: Dict[str, Any]) -> Tok2VecComponent:
     return Tok2VecComponent(name, model)
+
+
+@registry.factories("transformer")
+def make_transformer(
+    name: str, model: Dict[str, Any], max_batch_items: int = 4096
+) -> Tok2VecComponent:
+    """The shared transformer trunk is a tok2vec-protocol component: heads
+    listen to it exactly like the CNN trunk (spacy's `transformer` pipe +
+    TransformerListener collapse to the same listener wiring here)."""
+    return Tok2VecComponent(name, model)

@@ -1,0 +1,58 @@
+"""Preset config strings: canonical pipeline shapes used by bench,
+__graft_entry__, tests, and as user starting points (the role of
+``spacy init config`` templates in the reference ecosystem)."""
+
+CNN_TAGGER_CFG = """
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = {width}
+depth = {depth}
+embed_size = {embed_size}
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = {width}
+"""
+
+TINY_TRF_TAGGER_CFG = """
+[nlp]
+lang = "en"
+pipeline = ["transformer","tagger"]
+
+[components.transformer]
+factory = "transformer"
+
+[components.transformer.model]
+@architectures = "spacy_ray_tpu.TransformerEncoder.v1"
+width = 32
+depth = 2
+n_heads = 4
+ffn_mult = 2
+dropout = 0.1
+max_len = 64
+embed_size = 256
+remat = false
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 32
+"""
