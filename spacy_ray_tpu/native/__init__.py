@@ -1,0 +1,103 @@
+"""Native extension loader: builds murmur.cpp with g++ on first import.
+
+Binding is ctypes (no pybind11 in the image); a pure-Python fallback keeps
+every feature working when no compiler is available. The .so is cached next
+to the source and rebuilt when the source is newer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_HERE = Path(__file__).parent
+_SRC = _HERE / "murmur.cpp"
+_SO = _HERE / "libsrt_native.so"
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", str(_SO), str(_SRC)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Return the native lib, building it if needed; None if unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            needs_build = (not _SO.exists()) or (
+                _SRC.stat().st_mtime > _SO.stat().st_mtime
+            )
+            if needs_build and not _build():
+                return None
+            lib = ctypes.CDLL(str(_SO))
+            lib.murmur3_u64.restype = ctypes.c_uint64
+            lib.murmur3_u64.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int64,
+                ctypes.c_uint32,
+            ]
+            lib.murmur3_u64_batch.restype = None
+            lib.murmur3_u64_batch.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            _LIB = lib
+        except Exception:
+            _LIB = None
+        return _LIB
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def hash_strings_u64(strings: Sequence[str], seed: int = 0) -> np.ndarray:
+    """Batch 64-bit murmur of utf-8 strings. Native when possible."""
+    lib = load()
+    if lib is None:
+        from ..ops.hashing import hash_string_u64
+
+        return np.array(
+            [hash_string_u64(s, seed) for s in strings], dtype=np.uint64
+        )
+    encoded = [s.encode("utf8") for s in strings]
+    n = len(encoded)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    for i, b in enumerate(encoded):
+        offsets[i + 1] = offsets[i] + len(b)
+    blob = b"".join(encoded)
+    out = np.zeros(n, dtype=np.uint64)
+    lib.murmur3_u64_batch(
+        blob,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        seed & 0xFFFFFFFF,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return out

@@ -25,10 +25,6 @@ _MESH: "contextvars.ContextVar[Optional[Mesh]]" = contextvars.ContextVar(
 )
 
 
-def set_mesh(mesh: Optional[Mesh]) -> None:
-    _MESH.set(mesh)
-
-
 def current_mesh() -> Optional[Mesh]:
     return _MESH.get()
 

@@ -6,3 +6,4 @@ from . import tagger  # noqa: F401
 from . import textcat  # noqa: F401
 from . import parser  # noqa: F401
 from . import ner  # noqa: F401
+from . import spancat  # noqa: F401

