@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Produce a training corpus (the reference's bin/get-data.sh role,
+reference bin/get-data.sh:1-13: download NER jsonl + `spacy convert`).
+
+This environment is zero-egress, so instead of downloading, this generates
+the synthetic corpora used by tests/bench, or converts a local jsonl/conllu
+file into the binary corpus format.
+
+Usage:
+  python bin/get-data.py synth <out_dir> [--kind tagger|parser|ner|textcat|spancat] [--n 1000]
+  python bin/get-data.py convert <in.jsonl|in.conllu> <out.msgdoc>
+"""
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_synth = sub.add_parser("synth")
+    p_synth.add_argument("out_dir", type=Path)
+    p_synth.add_argument("--kind", default="tagger")
+    p_synth.add_argument("--n", type=int, default=1000)
+    p_conv = sub.add_parser("convert")
+    p_conv.add_argument("input_path", type=Path)
+    p_conv.add_argument("output_path", type=Path)
+    args = parser.parse_args()
+
+    if args.cmd == "synth":
+        from spacy_ray_tpu.util import write_synth_jsonl
+
+        args.out_dir.mkdir(parents=True, exist_ok=True)
+        write_synth_jsonl(args.out_dir / "train.jsonl", args.n, kind=args.kind, seed=0)
+        write_synth_jsonl(args.out_dir / "dev.jsonl", max(args.n // 5, 20), kind=args.kind, seed=1)
+        print(f"Wrote {args.kind} corpus to {args.out_dir}/(train|dev).jsonl")
+    else:
+        from spacy_ray_tpu.cli import convert_command
+
+        return convert_command([str(args.input_path), str(args.output_path)])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

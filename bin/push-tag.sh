@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Tag a release from the version in pyproject.toml and push the tag
+# (the reference's bin/push-tag.sh:1-14 role, reading setup.cfg there).
+set -euo pipefail
+version=$(grep -m1 '^version' pyproject.toml | sed 's/.*"\(.*\)"/\1/')
+git tag "v${version}"
+git push origin "v${version}"
+echo "pushed tag v${version}"

@@ -70,6 +70,8 @@ def train_command(argv: List[str]) -> int:
     parser.add_argument("--code", type=Path, default=None)
     parser.add_argument("--output", "-o", type=Path, default=None)
     parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--profile", type=Path, default=None,
+                        help="write a jax.profiler trace of steps 5-15 here")
     parser.add_argument("--verbose", "-V", action="store_true")
     args, extra = parser.parse_known_args(argv)
 
@@ -91,6 +93,7 @@ def train_command(argv: List[str]) -> int:
         output_path=args.output,
         n_workers=args.n_workers,
         resume=args.resume,
+        profile_dir=args.profile,
     )
     print(
         f"Done. steps={result.final_step} best_score={result.best_score:.4f} "
@@ -140,22 +143,62 @@ def convert_command(argv: List[str]) -> int:
     return 0
 
 
+def init_config_command(argv: List[str]) -> int:
+    """Write a ready-to-train preset config (spacy's `init config` role)."""
+    from .presets import INIT_PRESETS
+
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu init-config")
+    parser.add_argument("output_path", type=Path)
+    parser.add_argument(
+        "--preset",
+        default="cnn",
+        choices=sorted(INIT_PRESETS),
+        help="cnn: tagger-only CNN tok2vec; sm: tagger+parser+ner shared CNN; "
+        "trf: RoBERTa-base-shape transformer pipeline; spancat: spancat+textcat",
+    )
+    args = parser.parse_args(argv)
+    from .config import Config
+
+    cfg = Config.from_str(INIT_PRESETS[args.preset])  # parse = validate
+    args.output_path.write_text(cfg.to_str(), encoding="utf8")
+    print(f"Wrote {args.preset!r} preset to {args.output_path}")
+    return 0
+
+
+def _load_plugins() -> None:
+    """Import packages registered under the `spacy_ray_tpu_plugins` entry
+    point so their @registry decorators run (the reference's setuptools
+    plugin mechanism, setup.cfg:35-41)."""
+    try:
+        from importlib.metadata import entry_points
+
+        for ep in entry_points(group="spacy_ray_tpu_plugins"):
+            try:
+                ep.load()
+            except Exception as e:  # a broken plugin must not kill the CLI
+                print(f"warning: plugin {ep.name!r} failed to load: {e}", file=sys.stderr)
+    except Exception:
+        pass
+
+
 COMMANDS = {
     "train": train_command,
     "evaluate": evaluate_command,
     "convert": convert_command,
+    "init-config": init_config_command,
 }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("Usage: python -m spacy_ray_tpu {train,evaluate,convert} ...")
+        print("Usage: python -m spacy_ray_tpu {train,evaluate,convert,init-config} ...")
         return 0
     command = argv[0]
     if command not in COMMANDS:
         print(f"Unknown command {command!r}. Available: {', '.join(COMMANDS)}", file=sys.stderr)
         return 1
+    _load_plugins()
     return COMMANDS[command](argv[1:])
 
 

@@ -7,3 +7,4 @@ from . import textcat  # noqa: F401
 from . import parser  # noqa: F401
 from . import ner  # noqa: F401
 from . import spancat  # noqa: F401
+from . import token_classifiers  # noqa: F401

@@ -1,0 +1,157 @@
+"""Additional per-token classifier components: morphologizer + senter.
+
+Capability parity with spaCy's ``morphologizer`` and ``senter`` pipes (part
+of the pipeline family the reference trains through its config-driven loop;
+both are per-token classification heads over the shared tok2vec, like the
+tagger). They reuse the tagger machinery with different gold attributes:
+
+* morphologizer: label = "POS|FEATS" combination string (spaCy semantics);
+  sets doc.pos and doc.morphs. Score: ``pos_acc``, ``morph_acc``.
+* senter: binary sentence-start decisions; sets doc.sent_starts.
+  Score: ``sents_f`` (boundary P/R/F over start positions, excluding
+  token 0 which is trivially a start).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...registry import registry
+from ...pipeline.doc import Doc, Example
+from .base import Component
+from .tagger import TaggerComponent
+
+
+class MorphologizerComponent(TaggerComponent):
+    @staticmethod
+    def _gold_label(doc: Doc, i: int) -> str:
+        pos = doc.pos[i] if doc.pos else ""
+        morph = doc.morphs[i] if doc.morphs else ""
+        if not pos and not morph:
+            return ""
+        return f"{pos}|{morph}" if morph else pos
+
+    def add_labels_from(self, examples) -> None:
+        labels = set(self.labels)
+        for eg in examples:
+            ref = eg.reference
+            if ref.pos or ref.morphs:
+                for i in range(len(ref)):
+                    label = self._gold_label(ref, i)
+                    if label:
+                        labels.add(label)
+        self.labels = list(labels)
+
+    def make_targets(self, examples: List[Example], B: int, T: int) -> Dict[str, np.ndarray]:
+        label_ids = {label: i for i, label in enumerate(self.labels)}
+        tags = np.zeros((B, T), dtype=np.int32)
+        mask = np.zeros((B, T), dtype=bool)
+        for i, eg in enumerate(examples):
+            ref = eg.reference
+            if not (ref.pos or ref.morphs):
+                continue
+            for j in range(min(len(ref), T)):
+                label = self._gold_label(ref, j)
+                if label in label_ids:
+                    tags[i, j] = label_ids[label]
+                    mask[i, j] = True
+        return {"tags": tags, "tag_mask": mask}
+
+    def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
+        pred = np.asarray(jnp.argmax(outputs.X, axis=-1))
+        for i, doc in enumerate(docs):
+            n = lengths[i]
+            pos, morphs = [], []
+            for t in pred[i, :n]:
+                label = self.labels[t] if self.labels else ""
+                p, _, m = label.partition("|")
+                pos.append(p)
+                morphs.append(m)
+            doc.pos = pos
+            doc.morphs = morphs
+
+    def score(self, examples: List[Example]) -> Dict[str, float]:
+        pos_correct = morph_correct = total = 0
+        for eg in examples:
+            ref, pred = eg.reference, eg.predicted
+            if not (ref.pos or ref.morphs):
+                continue
+            n = min(len(ref), len(pred.pos or []))
+            for i in range(n):
+                gold = self._gold_label(ref, i)
+                if not gold:
+                    continue
+                total += 1
+                gp, _, gm = gold.partition("|")
+                if pred.pos and pred.pos[i] == gp:
+                    pos_correct += 1
+                pm = pred.morphs[i] if pred.morphs else ""
+                if pm == gm:
+                    morph_correct += 1
+        return {
+            "pos_acc": pos_correct / total if total else 0.0,
+            "morph_acc": morph_correct / total if total else 0.0,
+        }
+
+
+class SenterComponent(TaggerComponent):
+    """Binary sentence-start classifier. Labels fixed: ["I", "S"]."""
+
+    def add_labels_from(self, examples) -> None:
+        self.labels = ["I", "S"]
+
+    def finish_labels(self) -> None:
+        self.labels = ["I", "S"]
+
+    def make_targets(self, examples: List[Example], B: int, T: int) -> Dict[str, np.ndarray]:
+        tags = np.zeros((B, T), dtype=np.int32)
+        mask = np.zeros((B, T), dtype=bool)
+        for i, eg in enumerate(examples):
+            ref = eg.reference
+            if not ref.sent_starts:
+                continue
+            for j, s in enumerate(ref.sent_starts[:T]):
+                tags[i, j] = 1 if s == 1 else 0
+                mask[i, j] = s != 0  # 0 = unannotated
+        return {"tags": tags, "tag_mask": mask}
+
+    def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
+        pred = np.asarray(jnp.argmax(outputs.X, axis=-1))
+        for i, doc in enumerate(docs):
+            n = lengths[i]
+            starts = [1 if t == 1 else -1 for t in pred[i, :n]]
+            if starts:
+                starts[0] = 1  # first token always starts a sentence
+            doc.sent_starts = starts
+
+    def score(self, examples: List[Example]) -> Dict[str, float]:
+        tp = fp = fn = 0
+        for eg in examples:
+            gold = eg.reference.sent_starts
+            pred = eg.predicted.sent_starts
+            if not gold or not pred:
+                continue
+            n = min(len(gold), len(pred))
+            # skip position 0: trivially a start
+            g = {i for i in range(1, n) if gold[i] == 1}
+            p = {i for i in range(1, n) if pred[i] == 1}
+            tp += len(g & p)
+            fp += len(p - g)
+            fn += len(g - p)
+        prec = tp / (tp + fp) if tp + fp else 0.0
+        rec = tp / (tp + fn) if tp + fn else 0.0
+        f = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+        return {"sents_p": prec, "sents_r": rec, "sents_f": f}
+
+
+@registry.factories("morphologizer")
+def make_morphologizer(name: str, model: Dict[str, Any]) -> MorphologizerComponent:
+    return MorphologizerComponent(name, model)
+
+
+@registry.factories("senter")
+def make_senter(name: str, model: Dict[str, Any]) -> SenterComponent:
+    return SenterComponent(name, model)

@@ -38,6 +38,7 @@ class Doc:
     heads: Optional[List[int]] = None  # dependency head index per token
     deps: Optional[List[str]] = None  # dependency label per token
     lemmas: Optional[List[str]] = None
+    morphs: Optional[List[str]] = None  # UD FEATS string per token
     sent_starts: Optional[List[int]] = None  # 1/-1/0 per token
     # span-level
     ents: List[Span] = field(default_factory=list)  # named entities

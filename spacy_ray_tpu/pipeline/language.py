@@ -206,7 +206,9 @@ class Pipeline:
                     comp_params, inputs, targets[name], Context(train=True, rng=sub)
                 )
                 metrics[f"loss_{name}"] = loss
-                metrics.update(comp_metrics)
+                # namespace per component: shared base classes emit the same
+                # metric keys (e.g. tag_acc_batch) and would clobber
+                metrics.update({f"{name}_{k}": v for k, v in comp_metrics.items()})
                 total = total + loss
             return total, metrics
 

@@ -1,6 +1,57 @@
 """Preset config strings: canonical pipeline shapes used by bench,
-__graft_entry__, tests, and as user starting points (the role of
+__graft_entry__, tests, and the ``init-config`` CLI command (the role of
 ``spacy init config`` templates in the reference ecosystem)."""
+
+# standard [paths]/[corpora]/[training] tail shared by init-config presets
+_TRAINING_TAIL = """
+[paths]
+train = null
+dev = null
+
+[corpora.train]
+@readers = "spacy.Corpus.v1"
+path = ${{paths.train}}
+shuffle = true
+
+[corpora.dev]
+@readers = "spacy.Corpus.v1"
+path = ${{paths.dev}}
+
+[training]
+seed = 0
+dropout = 0.1
+accumulate_gradient = {accumulate_gradient}
+patience = 1600
+max_epochs = 0
+max_steps = 20000
+eval_frequency = 200
+zero1 = {zero1}
+
+[training.optimizer]
+@optimizers = "Adam.v1"
+learn_rate = 0.001
+beta1 = 0.9
+beta2 = 0.999
+grad_clip = 1.0
+use_averages = false
+
+[training.batcher]
+@batchers = "spacy.batch_by_words.v1"
+size = 2000
+tolerance = 0.2
+
+[training.score_weights]
+{score_weights}
+"""
+
+
+def _full(components: str, score_weights: str, accumulate_gradient: int = 1,
+          zero1: bool = False) -> str:
+    return components + _TRAINING_TAIL.format(
+        accumulate_gradient=accumulate_gradient,
+        zero1="true" if zero1 else "false",
+        score_weights=score_weights,
+    )
 
 CNN_TAGGER_CFG = """
 [nlp]
@@ -26,6 +77,180 @@ factory = "tagger"
 @architectures = "spacy.Tok2VecListener.v1"
 width = {width}
 """
+
+# ---------------------------------------------------------------------------
+# init-config presets (full trainable configs, BASELINE.json config shapes)
+# ---------------------------------------------------------------------------
+
+_SM_COMPONENTS = """
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger","parser","ner"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 96
+depth = 4
+embed_size = 2000
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 96
+
+[components.parser]
+factory = "parser"
+
+[components.parser.model]
+@architectures = "spacy.TransitionBasedParser.v2"
+state_type = "parser"
+hidden_width = 128
+maxout_pieces = 2
+
+[components.parser.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 96
+
+[components.ner]
+factory = "ner"
+
+[components.ner.model]
+@architectures = "spacy.TransitionBasedParser.v2"
+state_type = "ner"
+hidden_width = 128
+maxout_pieces = 2
+
+[components.ner.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 96
+"""
+
+_TRF_COMPONENTS = """
+[nlp]
+lang = "en"
+pipeline = ["transformer","tagger","parser","ner"]
+
+[components.transformer]
+factory = "transformer"
+
+[components.transformer.model]
+@architectures = "spacy_ray_tpu.TransformerEncoder.v1"
+width = 768
+depth = 12
+n_heads = 12
+ffn_mult = 4
+dropout = 0.1
+max_len = 512
+embed_size = 20000
+remat = true
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 768
+
+[components.parser]
+factory = "parser"
+
+[components.parser.model]
+@architectures = "spacy.TransitionBasedParser.v2"
+state_type = "parser"
+hidden_width = 128
+maxout_pieces = 2
+
+[components.parser.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 768
+
+[components.ner]
+factory = "ner"
+
+[components.ner.model]
+@architectures = "spacy.TransitionBasedParser.v2"
+state_type = "ner"
+hidden_width = 128
+maxout_pieces = 2
+
+[components.ner.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 768
+"""
+
+_SPANCAT_COMPONENTS = """
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","spancat","textcat_multilabel"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 96
+depth = 4
+embed_size = 2000
+
+[components.spancat]
+factory = "spancat"
+spans_key = "sc"
+threshold = 0.5
+
+[components.spancat.suggester]
+@misc = "spacy.ngram_suggester.v1"
+sizes = [1,2,3]
+
+[components.spancat.model]
+@architectures = "spacy.SpanCategorizer.v1"
+hidden_size = 128
+
+[components.spancat.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 96
+
+[components.textcat_multilabel]
+factory = "textcat_multilabel"
+
+[components.textcat_multilabel.model]
+@architectures = "spacy.TextCatReduce.v1"
+
+[components.textcat_multilabel.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 96
+"""
+
+INIT_PRESETS = {
+    "cnn": _full(
+        CNN_TAGGER_CFG.format(width=96, depth=4, embed_size=2000),
+        "tag_acc = 1.0",
+    ),
+    "sm": _full(
+        _SM_COMPONENTS,
+        "tag_acc = 0.33\ndep_las = 0.33\nents_f = 0.34",
+    ),
+    "trf": _full(
+        _TRF_COMPONENTS,
+        "tag_acc = 0.33\ndep_las = 0.33\nents_f = 0.34",
+        accumulate_gradient=3,
+        zero1=True,
+    ),
+    "spancat": _full(
+        _SPANCAT_COMPONENTS,
+        "spans_sc_f = 0.7\ncats_micro_f = 0.3",
+    ),
+}
 
 TINY_TRF_TAGGER_CFG = """
 [nlp]

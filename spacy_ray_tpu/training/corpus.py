@@ -44,6 +44,7 @@ def _doc_from_json(obj: dict) -> Doc:
         heads=obj.get("heads"),
         deps=obj.get("deps"),
         lemmas=obj.get("lemmas"),
+        morphs=obj.get("morphs"),
         sent_starts=obj.get("sent_starts"),
         cats=dict(obj.get("cats") or {}),
     )
@@ -58,7 +59,7 @@ def _doc_to_json(doc: Doc) -> dict:
     out: dict = {"tokens": doc.words}
     if doc.spaces is not None:
         out["spaces"] = doc.spaces
-    for attr in ("tags", "pos", "heads", "deps", "lemmas", "sent_starts"):
+    for attr in ("tags", "pos", "heads", "deps", "lemmas", "morphs", "sent_starts"):
         val = getattr(doc, attr)
         if val is not None:
             out[attr] = val
@@ -87,13 +88,14 @@ def read_conllu_docs(path: Union[str, Path]) -> Iterator[Doc]:
     pos: List[str] = []
     heads: List[int] = []
     deps: List[str] = []
+    morphs: List[str] = []
 
     def flush() -> Optional[Doc]:
-        nonlocal words, tags, pos, heads, deps
+        nonlocal words, tags, pos, heads, deps, morphs
         if not words:
             return None
-        doc = Doc(words=words, tags=tags, pos=pos, heads=heads, deps=deps)
-        words, tags, pos, heads, deps = [], [], [], [], []
+        doc = Doc(words=words, tags=tags, pos=pos, heads=heads, deps=deps, morphs=morphs)
+        words, tags, pos, heads, deps, morphs = [], [], [], [], [], []
         return doc
 
     with open(path, "r", encoding="utf8") as f:
@@ -113,6 +115,7 @@ def read_conllu_docs(path: Union[str, Path]) -> Iterator[Doc]:
             words.append(cols[1])
             pos.append(cols[3])
             tags.append(cols[4] if cols[4] != "_" else cols[3])
+            morphs.append(cols[5] if cols[5] != "_" else "")
             head = int(cols[6]) if cols[6] != "_" else 0
             heads.append(head - 1 if head > 0 else idx)  # root points to itself
             deps.append(cols[7] if cols[7] != "_" else "dep")
@@ -203,6 +206,7 @@ class Corpus:
         for a, b in zip(bounds, bounds[1:]):
             if b <= a:
                 continue
+            # slice every token-aligned list attribute (heads re-based)
             piece = Doc(
                 words=doc.words[a:b],
                 spaces=doc.spaces[a:b] if doc.spaces else None,
@@ -212,6 +216,9 @@ class Corpus:
                 if doc.heads
                 else None,
                 deps=doc.deps[a:b] if doc.deps else None,
+                lemmas=doc.lemmas[a:b] if doc.lemmas else None,
+                morphs=doc.morphs[a:b] if doc.morphs else None,
+                sent_starts=doc.sent_starts[a:b] if doc.sent_starts else None,
                 cats=dict(doc.cats),
             )
             for span in doc.ents:

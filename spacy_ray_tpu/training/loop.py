@@ -114,11 +114,15 @@ def train(
     resume: bool = False,
     max_steps_override: Optional[int] = None,
     stdout_log: bool = True,
+    profile_dir: Optional[Path] = None,
 ) -> Tuple[Pipeline, TrainResult]:
     """Run config-driven training. Returns (pipeline, result).
 
     ``n_workers`` maps to the mesh's data-axis size (the reference's
     ``--n-workers`` actor count, train_cli.py:27); default = all devices.
+
+    ``profile_dir``: capture a jax.profiler trace of steps 5-15 (first-class
+    tracing — the reference's Timer scaffolding is unwired, SURVEY.md §5.1).
     """
     config = config.interpolate()
     T = resolve_training(config)
@@ -229,9 +233,21 @@ def train(
 
     start_time = time.perf_counter()
     loss_accum: Dict[str, float] = {}
+    pending_metrics: List[Dict[str, Any]] = []
     words_since_log = 0
     last_log_time = start_time
     stop = False
+    steps_run = 0  # steps executed THIS run (profiling window is resume-safe)
+    profile_active = False
+
+    def drain_metrics() -> None:
+        """Materialize queued device metrics into loss_accum (sync point)."""
+        for m in pending_metrics:
+            host = jax.device_get(m)
+            for key, value in host.items():
+                if key.startswith("loss_"):
+                    loss_accum[key[5:]] = loss_accum.get(key[5:], 0.0) + float(value)
+        pending_metrics.clear()
 
     batch_iter = batches_forever()
     while not stop:
@@ -292,21 +308,31 @@ def train(
             )
         tokens = place_batch(tokens, mesh, accum=accum > 1)
         targets = place_batch(targets, mesh, accum=accum > 1)
+        if profile_dir is not None and not profile_active and steps_run == 5:
+            jax.profiler.start_trace(str(profile_dir))
+            profile_active = True
         rng, sub = jax.random.split(rng)
         params, opt_state, loss, metrics = update(params, opt_state, tokens, targets, sub)
         step += 1
+        steps_run += 1
+        if profile_active and steps_run >= 15:
+            jax.block_until_ready(loss)
+            jax.profiler.stop_trace()
+            profile_active = False
         if use_averages:
             avg_count += 1
             avg_params = _avg_step(avg_params, params, avg_count)
         result.words_seen += n_words
         words_since_log += n_words
 
-        for key, value in metrics.items():
-            if key.startswith("loss_"):
-                loss_accum[key[5:]] = loss_accum.get(key[5:], 0.0) + float(value)
+        # keep metrics as device arrays — float() here would synchronize the
+        # host with the device EVERY step and kill host/device overlap; the
+        # accumulated scalars are only materialized at eval/log time
+        pending_metrics.append(metrics)
 
         info: Optional[Dict[str, Any]] = None
         if step % eval_frequency == 0:
+            drain_metrics()
             # eval (and best-model save) uses averaged params when enabled
             eval_src = avg_params if use_averages else params
             host_params = jax.device_get(eval_src)
@@ -359,6 +385,9 @@ def train(
         if patience and best_step >= 0 and (step - best_step) >= patience:
             stop = True
 
+    if profile_active:  # loop ended inside the window: still write the trace
+        jax.profiler.stop_trace()
+        profile_active = False
     result.seconds = time.perf_counter() - start_time
     result.best_score = best_score
     result.best_step = best_step

@@ -163,7 +163,12 @@ def synth_parsed_doc(rng: random.Random) -> Doc:
         tags.append("PUNCT")
         heads.append(verb_i)
         deps.append("punct")
-    return Doc(words=words, tags=tags, heads=heads, deps=deps)
+    morphs = [f"Cat={t.title()}" for t in tags]
+    sent_starts = [1 if i == 0 else -1 for i in range(len(words))]
+    return Doc(
+        words=words, tags=tags, pos=list(tags), heads=heads, deps=deps,
+        morphs=morphs, sent_starts=sent_starts,
+    )
 
 
 def synth_textcat_doc(rng: random.Random) -> Doc:

@@ -1,0 +1,88 @@
+"""morphologizer + senter component tests."""
+
+import random
+
+import jax
+import optax
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.pipeline.doc import Doc, Example
+from spacy_ray_tpu.pipeline.language import Pipeline
+from spacy_ray_tpu.util import synth_corpus, synth_parsed_doc
+
+CFG = """
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","morphologizer","senter"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 64
+depth = 2
+embed_size = 512
+
+[components.morphologizer]
+factory = "morphologizer"
+
+[components.morphologizer.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.morphologizer.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 64
+
+[components.senter]
+factory = "senter"
+
+[components.senter.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.senter.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 64
+"""
+
+
+def _multi_sentence_doc(rng):
+    """Concatenate 2-3 single-sentence parsed docs into one."""
+    parts = [synth_parsed_doc(rng) for _ in range(rng.randint(2, 3))]
+    words, tags, morphs, sent_starts = [], [], [], []
+    for d in parts:
+        words.extend(d.words)
+        tags.extend(d.tags)
+        morphs.extend(d.morphs)
+        sent_starts.extend(d.sent_starts)
+    return Doc(words=words, tags=tags, pos=tags, morphs=morphs, sent_starts=sent_starts)
+
+
+def test_morphologizer_and_senter_learn():
+    rng = random.Random(0)
+    examples = [Example.from_gold(_multi_sentence_doc(rng)) for _ in range(200)]
+    nlp = Pipeline.from_config(Config.from_str(CFG))
+    nlp.initialize(lambda: iter(examples), seed=0)
+    grad_loss = jax.jit(
+        jax.value_and_grad(lambda p, t, g, r: nlp.make_loss_fn()(p, t, g, r)[0])
+    )
+    tx = optax.adam(3e-3)
+    params = nlp.params
+    opt = tx.init(params)
+    key = jax.random.PRNGKey(0)
+    for step in range(50):
+        batch = nlp.collate(examples[(step * 32) % 160 : (step * 32) % 160 + 32])
+        key, sub = jax.random.split(key)
+        loss, grads = grad_loss(params, batch["tokens"], batch["targets"], sub)
+        updates, opt = tx.update(grads, opt)
+        params = optax.apply_updates(params, updates)
+    nlp.params = params
+    dev_rng = random.Random(99)
+    dev = [Example.from_gold(_multi_sentence_doc(dev_rng)) for _ in range(30)]
+    scores = nlp.evaluate(dev)
+    assert scores["pos_acc"] > 0.85, scores
+    assert scores["morph_acc"] > 0.85, scores
+    assert scores["sents_f"] > 0.6, scores
+    # annotations present
+    assert dev[0].predicted.pos and dev[0].predicted.morphs
+    assert dev[0].predicted.sent_starts[0] == 1
