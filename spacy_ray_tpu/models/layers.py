@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from ..ops import ops as O
 from ..ops import hashing
+from ..ops.pallas_kernels import hash_embed_lookup
 from ..types import Padded, TokenBatch
 from .core import Context, Model, glorot_uniform, normal_init
 
@@ -98,8 +99,7 @@ def HashEmbed(
     def apply_fn(params, batch: TokenBatch, ctx: Context) -> Padded:
         keys = batch.attr_keys[..., attr_index, :]  # [B, T, 2]
         ids = hashing.hash_embed_ids(keys, seed, rows)  # [B, T, 4]
-        vecs = jnp.take(params["E"], ids, axis=0)  # [B, T, 4, width]
-        X = jnp.sum(vecs, axis=-2)
+        X = hash_embed_lookup(params["E"], ids)  # pallas on TPU, jnp elsewhere
         mask_f = batch.mask[..., None].astype(X.dtype)
         return Padded(X=X * mask_f, mask=batch.mask)
 

@@ -1,0 +1,169 @@
+"""Pallas TPU kernels for ops XLA fuses poorly (SURVEY.md §7.1: "pallas only
+where profiling shows XLA fusion fails (likely: ragged gather for hash
+embeds)").
+
+``hash_embed_lookup``: the HashEmbed inner op — gather 4 rows per token from
+the embedding table and sum them. The XLA lowering materializes a
+[tokens, 4, width] gather intermediate in HBM; this kernel keeps the table
+resident in VMEM (typical tables: 2000 x 96 fp32 = 768KB, well under the
+~16MB budget), streams id blocks through SMEM (bounded at TOKEN_BLOCK*16B
+regardless of batch shape), and accumulates rows in-register.
+
+Differentiation: pallas_call has no automatic VJP, so the kernel carries a
+``jax.custom_vjp`` whose backward is the standard scatter-add of the output
+cotangent into the table rows (a jnp ``.at[ids].add`` — XLA lowers this
+well); the probe validates BOTH forward and gradient numerics before
+enabling.
+
+Safety: enabled only by a one-time startup probe (compile + numeric check on
+the current backend), silently falling back to the jnp path otherwise.
+Force with SRT_PALLAS=1/0.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+TOKEN_BLOCK = 256
+VMEM_TABLE_BUDGET = 8 * 1024 * 1024  # bytes of VMEM we allow the table
+
+
+def _reference_lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """jnp fallback: [rows, D], [N, 4] -> [N, D]."""
+    return jnp.sum(jnp.take(table, ids, axis=0), axis=-2)
+
+
+def _table_grad(ids: jnp.ndarray, ct: jnp.ndarray, rows: int) -> jnp.ndarray:
+    """Backward of the gather-sum: scatter-add cotangent into table rows.
+
+    ids [N, 4], ct [N, D] -> [rows, D].
+    """
+    updates = jnp.broadcast_to(ct[:, None, :], (ct.shape[0], 4, ct.shape[1]))
+    zeros = jnp.zeros((rows, ct.shape[1]), ct.dtype)
+    return zeros.at[ids].add(updates)
+
+
+try:  # pallas imports can fail on exotic builds; treat as "unavailable"
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_IMPORTED = True
+except Exception:  # pragma: no cover
+    _PALLAS_IMPORTED = False
+
+
+def _kernel(ids_ref, table_ref, out_ref):
+    """One grid step: TOKEN_BLOCK tokens; ids block lives in SMEM."""
+    import jax.lax as lax
+
+    def body(t, _):
+        r0 = ids_ref[t, 0]
+        r1 = ids_ref[t, 1]
+        r2 = ids_ref[t, 2]
+        r3 = ids_ref[t, 3]
+        out_ref[t, :] = (
+            table_ref[r0, :] + table_ref[r1, :] + table_ref[r2, :] + table_ref[r3, :]
+        )
+        return 0
+
+    lax.fori_loop(0, TOKEN_BLOCK, body, 0)
+
+
+def _pallas_lookup_raw(
+    table: jnp.ndarray, ids: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """[rows, D] fp32, [N, 4] int32 -> [N, D]. N must be a TOKEN_BLOCK multiple."""
+    n = ids.shape[0]
+    D = table.shape[1]
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((n, D), table.dtype),
+        grid=(n // TOKEN_BLOCK,),
+        in_specs=[
+            # per-step id block in SMEM: bounded regardless of batch shape
+            pl.BlockSpec((TOKEN_BLOCK, 4), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # whole table resident
+        ],
+        out_specs=pl.BlockSpec(
+            (TOKEN_BLOCK, D), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(ids, table)
+
+
+@jax.custom_vjp
+def _pallas_lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    return _pallas_lookup_raw(table, ids)
+
+
+def _pallas_lookup_fwd(table, ids):
+    return _pallas_lookup_raw(table, ids), (ids, table.shape[0])
+
+
+def _pallas_lookup_bwd(res, ct):
+    ids, rows = res
+    return _table_grad(ids, ct, rows), None
+
+
+_pallas_lookup.defvjp(_pallas_lookup_fwd, _pallas_lookup_bwd)
+
+
+_PROBED: Optional[bool] = None
+
+
+def pallas_enabled() -> bool:
+    """One-time probe: compile + numerically validate forward AND gradient
+    on the default backend; cache the verdict."""
+    global _PROBED
+    if _PROBED is not None:
+        return _PROBED
+    env = os.environ.get("SRT_PALLAS")
+    if env == "0" or not _PALLAS_IMPORTED:
+        _PROBED = False
+        return False
+    if env != "1" and jax.default_backend() != "tpu":
+        _PROBED = False  # default: only auto-enable on real TPU
+        return False
+    try:
+        table = jax.random.normal(jax.random.PRNGKey(0), (64, 96), jnp.float32)
+        ids = jax.random.randint(
+            jax.random.PRNGKey(1), (2 * TOKEN_BLOCK, 4), 0, 64
+        ).astype(jnp.int32)
+        got = jax.jit(_pallas_lookup)(table, ids)
+        want = _reference_lookup(table, ids)
+        fwd_ok = bool(jnp.allclose(got, want, atol=1e-5))
+        g_got = jax.grad(lambda t: jnp.sum(jnp.sin(_pallas_lookup(t, ids))))(table)
+        g_want = jax.grad(lambda t: jnp.sum(jnp.sin(_reference_lookup(t, ids))))(table)
+        grad_ok = bool(jnp.allclose(g_got, g_want, atol=1e-4))
+        _PROBED = fwd_ok and grad_ok
+    except Exception:
+        _PROBED = False
+    return _PROBED
+
+
+def hash_embed_lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """Gather-sum 4 rows per key: table [rows, D], ids [..., 4] -> [..., D].
+
+    Uses the pallas kernel when the startup probe enabled it and the table
+    fits the VMEM budget; jnp gather otherwise.
+    """
+    lead_shape = ids.shape[:-1]
+    if (
+        pallas_enabled()
+        and table.dtype == jnp.float32
+        and table.nbytes <= VMEM_TABLE_BUDGET
+    ):
+        flat_ids = ids.reshape(-1, 4).astype(jnp.int32)
+        n = flat_ids.shape[0]
+        pad = (-n) % TOKEN_BLOCK
+        if pad:
+            flat_ids = jnp.pad(flat_ids, ((0, pad), (0, 0)))
+        out = _pallas_lookup(table, flat_ids)
+        if pad:
+            out = out[:n]
+        return out.reshape(*lead_shape, table.shape[1])
+    return _reference_lookup(table, ids.astype(jnp.int32))
