@@ -165,6 +165,78 @@ def init_config_command(argv: List[str]) -> int:
     return 0
 
 
+def debug_data_command(argv: List[str]) -> int:
+    """Corpus sanity report (spaCy's `debug data` role): doc/token counts,
+    annotation coverage, label distributions, length histogram, and
+    parser-specific warnings (non-projective trees are skipped by the
+    arc-eager oracle)."""
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu debug-data")
+    parser.add_argument("data_path", type=Path)
+    parser.add_argument("--limit", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    from collections import Counter
+
+    from .pipeline.transition import is_projective
+    from .training.corpus import Corpus
+
+    examples = list(Corpus(args.data_path, limit=args.limit)())
+    n_docs = len(examples)
+    n_tokens = sum(len(eg) for eg in examples)
+    lengths = sorted(len(eg) for eg in examples)
+    have = Counter()
+    tag_labels, dep_labels, ent_labels, cat_labels = Counter(), Counter(), Counter(), Counter()
+    nonproj = 0
+    for eg in examples:
+        ref = eg.reference
+        if ref.tags:
+            have["tags"] += 1
+            tag_labels.update(t for t in ref.tags if t)
+        if ref.heads and ref.deps:
+            have["deps"] += 1
+            dep_labels.update(d for d in ref.deps if d)
+            if not is_projective(ref.heads):
+                nonproj += 1
+        if ref.ents:
+            have["ents"] += 1
+            ent_labels.update(s.label for s in ref.ents)
+        if ref.cats:
+            have["cats"] += 1
+            cat_labels.update(ref.cats)
+        if ref.spans:
+            have["spans"] += 1
+        if ref.sent_starts:
+            have["sent_starts"] += 1
+        if ref.morphs:
+            have["morphs"] += 1
+
+    def pct(n):
+        return f"{100 * n / n_docs:.1f}%" if n_docs else "0%"
+
+    print(f"docs: {n_docs}   tokens: {n_tokens}")
+    if lengths:
+        print(
+            f"doc length: min={lengths[0]} p50={lengths[len(lengths) // 2]} "
+            f"p95={lengths[int(len(lengths) * 0.95)]} max={lengths[-1]}"
+        )
+    print("annotation coverage:", {k: pct(v) for k, v in sorted(have.items())})
+    for name, counter in [
+        ("tags", tag_labels), ("deps", dep_labels), ("ents", ent_labels), ("cats", cat_labels)
+    ]:
+        if counter:
+            top = ", ".join(f"{l}({c})" for l, c in counter.most_common(12))
+            print(f"{name} labels ({len(counter)}): {top}")
+    if nonproj:
+        print(
+            f"WARNING: {nonproj}/{have['deps']} parsed docs are non-projective "
+            "— the arc-eager parser skips them for training"
+        )
+    if n_docs == 0:
+        print("WARNING: corpus is empty")
+        return 1
+    return 0
+
+
 def _load_plugins() -> None:
     """Import packages registered under the `spacy_ray_tpu_plugins` entry
     point so their @registry decorators run (the reference's setuptools
@@ -186,13 +258,14 @@ COMMANDS = {
     "evaluate": evaluate_command,
     "convert": convert_command,
     "init-config": init_config_command,
+    "debug-data": debug_data_command,
 }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("Usage: python -m spacy_ray_tpu {train,evaluate,convert,init-config} ...")
+        print("Usage: python -m spacy_ray_tpu {train,evaluate,convert,init-config,debug-data} ...")
         return 0
     command = argv[0]
     if command not in COMMANDS:

@@ -54,6 +54,7 @@ class Pipeline:
         self.params: Optional[Params] = None
         self.frozen_components: List[str] = []
         self.annotating_components: List[str] = []
+        self.sourced_components: Dict[str, str] = {}
         self.length_buckets: Sequence[int] = DEFAULT_LENGTH_BUCKETS
         self._jit_forward = None  # cached compiled forward (predict path)
 
@@ -68,10 +69,42 @@ class Pipeline:
         pipe_names = list(nlp_cfg.get("pipeline", []))
         comp_cfgs = config.get("components", {})
         components: Dict[str, Component] = {}
+        sourced: Dict[str, str] = {}
+        src_cache: Dict[str, "Pipeline"] = {}  # one load per source dir
         for name in pipe_names:
             if name not in comp_cfgs:
                 raise ValueError(f"Pipeline names component {name!r} but no [components.{name}]")
             block = dict(comp_cfgs[name])
+            source = block.pop("source", None)
+            if source is not None:
+                # spaCy's `source = "model_dir"`: reuse a trained component
+                # (config + labels + params) from a saved pipeline
+                if block:
+                    raise ValueError(
+                        f"[components.{name}] mixes source = {source!r} with other "
+                        f"keys {sorted(block)} — a sourced component can't be "
+                        "overridden; drop `source` or the extra keys"
+                    )
+                if source not in src_cache:
+                    src_cache[source] = cls.from_disk(source)
+                src_nlp = src_cache[source]
+                if name not in src_nlp.components:
+                    raise ValueError(
+                        f"[components.{name}] source {source!r} has no component "
+                        f"{name!r} (has: {src_nlp.pipe_names})"
+                    )
+                components[name] = src_nlp.components[name]
+                sourced[name] = source
+                components[name]._sourced_params = src_nlp.params[name]
+                # Rewrite the config block to the source's CONCRETE block so
+                # the saved combined model reloads without the source dir
+                # (its params travel in our params.npz anyway).
+                import copy as _copy
+
+                src_block = src_nlp.config.get("components", {}).get(name)
+                if src_block:
+                    config["components"][name] = _copy.deepcopy(src_block)
+                continue
             factory_name = block.pop("factory", None)
             if factory_name is None:
                 raise ValueError(f"[components.{name}] missing 'factory'")
@@ -81,6 +114,7 @@ class Pipeline:
                 raise ValueError(f"[components.{name}] missing model block")
             components[name] = factory(name=name, model=model_cfg, **block)
         nlp = cls(lang=lang, components=components, pipe_names=pipe_names, config=config)
+        nlp.sourced_components = sourced
         training = config.get("training", {})
         nlp.frozen_components = list(training.get("frozen_components", []) or [])
         nlp.annotating_components = list(training.get("annotating_components", []) or [])
@@ -119,6 +153,8 @@ class Pipeline:
                     break
                 sample.append(eg)
             for name in self.pipe_names:
+                if name in self.sourced_components:
+                    continue  # sourced: labels came with the saved component
                 comp = self.components[name]
                 comp.add_labels_from(sample)
                 comp.finish_labels()
@@ -126,9 +162,29 @@ class Pipeline:
         params: Dict[str, Any] = {}
         for name in self.pipe_names:
             comp = self.components[name]
+            if name in self.sourced_components:
+                # model already built by from_disk; reuse trained params
+                params[name] = comp._sourced_params
+                continue
             comp.build_model()
             rng, sub = jax.random.split(rng)
             params[name] = comp.init_params(sub)
+        # Width compatibility: a (possibly sourced) listening head must match
+        # the trunk width, or jit fails later with an opaque shape error.
+        t2v = self.tok2vec_name
+        if t2v is not None:
+            trunk_w = self.components[t2v].model.dims.get("nO")
+            for name in self.head_names():
+                comp = self.components[name]
+                head_w = (comp.model.dims or {}).get("width")
+                if comp.listens and trunk_w and head_w and head_w != trunk_w:
+                    src = self.sourced_components.get(name)
+                    hint = f" (sourced from {src!r})" if src else ""
+                    raise ValueError(
+                        f"Component {name!r}{hint} expects tok2vec width "
+                        f"{head_w} but the pipeline trunk {t2v!r} produces "
+                        f"{trunk_w}"
+                    )
         self.params = params
         self._jit_forward = None  # models rebuilt -> stale closure
         return params
@@ -262,6 +318,17 @@ class Pipeline:
         doc = self.tokenizer(text)
         self.predict_docs([doc])
         return doc
+
+    def pipe(self, texts: Iterable[str], batch_size: int = 128) -> Iterable[Doc]:
+        """Bulk inference over raw texts (spaCy's nlp.pipe surface)."""
+        chunk: List[Doc] = []
+        for text in texts:
+            chunk.append(self.tokenizer(text))
+            if len(chunk) >= batch_size:
+                yield from self.predict_docs(chunk, batch_size=batch_size)
+                chunk = []
+        if chunk:
+            yield from self.predict_docs(chunk, batch_size=batch_size)
 
     def evaluate(
         self, examples: List[Example], params: Optional[Params] = None, batch_size: int = 128

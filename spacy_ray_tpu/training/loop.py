@@ -140,6 +140,28 @@ def train(
     nlp = Pipeline.from_config(config)
     nlp.initialize(train_corpus, seed=seed)
 
+    # Multi-host startup assertion: every host must have built the IDENTICAL
+    # param tree (same paths, same label sets) — the SPMD-era replacement for
+    # the reference's unchecked reliance on identical model construction
+    # order (SURVEY.md §2.4 "Key identity is fragile", §5.2 race detection).
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        from ..models.core import param_paths
+        from ..ops.hashing import hash_string_u64
+
+        signature = "|".join(param_paths(nlp.params)) + "||" + "|".join(
+            f"{n}:{','.join(nlp.components[n].labels)}" for n in nlp.pipe_names
+        )
+        digest = np.array([hash_string_u64(signature) % (2 ** 31)], np.int32)
+        digests = multihost_utils.process_allgather(digest)
+        if int(np.min(digests)) != int(np.max(digests)):
+            raise RuntimeError(
+                "Parameter-tree/label mismatch across hosts: all processes "
+                "must resolve the same config over the same training data "
+                f"(digests: {digests.tolist()})"
+            )
+
     # ---- mesh / optimizer / step ----
     mesh = build_mesh(n_data=n_workers)
     n_data = mesh.shape["data"]

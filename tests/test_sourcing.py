@@ -1,0 +1,163 @@
+"""Sourced components (`source = "model_dir"`) + frozen-component reuse +
+nlp.pipe bulk inference."""
+
+import pytest
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.pipeline.language import Pipeline
+from spacy_ray_tpu.training.loop import train
+from spacy_ray_tpu.util import synth_corpus, write_synth_jsonl
+
+
+def _train_tagger(tmp_path, tagger_config_text):
+    write_synth_jsonl(tmp_path / "train.jsonl", 200, kind="tagger", seed=0)
+    write_synth_jsonl(tmp_path / "dev.jsonl", 40, kind="tagger", seed=1)
+    cfg = Config.from_str(tagger_config_text).apply_overrides(
+        {
+            "paths.train": str(tmp_path / "train.jsonl"),
+            "paths.dev": str(tmp_path / "dev.jsonl"),
+            "training.max_steps": 40,
+            "training.eval_frequency": 20,
+        }
+    )
+    nlp, result = train(cfg, output_path=tmp_path / "out", n_workers=1, stdout_log=False)
+    assert result.best_score > 0.8
+    return tmp_path / "out" / "best-model"
+
+
+SOURCED_CFG = """
+[paths]
+train = null
+dev = null
+
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger","ner"]
+
+[components.tok2vec]
+source = "{model_dir}"
+
+[components.tagger]
+source = "{model_dir}"
+
+[components.ner]
+factory = "ner"
+
+[components.ner.model]
+@architectures = "spacy.TransitionBasedParser.v2"
+state_type = "ner"
+hidden_width = 64
+maxout_pieces = 2
+
+[components.ner.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 64
+
+[corpora.train]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${{paths.train}}
+
+[corpora.dev]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${{paths.dev}}
+
+[training]
+max_steps = 30
+eval_frequency = 15
+patience = 0
+frozen_components = ["tok2vec","tagger"]
+
+[training.optimizer]
+@optimizers = "Adam.v1"
+learn_rate = 0.003
+
+[training.batcher]
+@batchers = "spacy.batch_by_words.v1"
+size = 600
+
+[training.score_weights]
+ents_f = 1.0
+"""
+
+
+def test_sourced_components_reused_and_frozen(tmp_path, tagger_config_text):
+    import numpy as np
+    import jax
+
+    model_dir = _train_tagger(tmp_path, tagger_config_text)
+    write_synth_jsonl(tmp_path / "ner_train.jsonl", 200, kind="ner", seed=2)
+    write_synth_jsonl(tmp_path / "ner_dev.jsonl", 40, kind="ner", seed=3)
+    cfg = Config.from_str(SOURCED_CFG.format(model_dir=model_dir)).apply_overrides(
+        {
+            "paths.train": str(tmp_path / "ner_train.jsonl"),
+            "paths.dev": str(tmp_path / "ner_dev.jsonl"),
+        }
+    )
+    nlp, result = train(cfg, n_workers=1, stdout_log=False)
+    assert result.final_step == 30
+    # sourced tagger kept its trained labels and (frozen) its params
+    src = Pipeline.from_disk(model_dir)
+    assert nlp.components["tagger"].labels == src.components["tagger"].labels
+    for a, b in zip(
+        jax.tree_util.tree_leaves(nlp.params["tagger"]),
+        jax.tree_util.tree_leaves(src.params["tagger"]),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+    # the sourced tagger still works inside the new pipeline
+    doc = nlp("the cat runs")
+    assert doc.tags == ["DET", "NOUN", "VERB"]
+
+
+def test_pipe_bulk_inference(tmp_path, tagger_config_text):
+    model_dir = _train_tagger(tmp_path, tagger_config_text)
+    nlp = Pipeline.from_disk(model_dir)
+    texts = ["the cat runs", "a dog sees the tree", "she jumps quickly"]
+    docs = list(nlp.pipe(texts, batch_size=2))
+    assert len(docs) == 3
+    assert all(d.tags and len(d.tags) == len(d.words) for d in docs)
+
+
+def test_sourced_model_reloads_without_source_dir(tmp_path, tagger_config_text):
+    """The saved combined model must be self-contained: the config's source=
+    blocks are rewritten to concrete factory blocks at load time."""
+    import shutil
+
+    model_dir = _train_tagger(tmp_path, tagger_config_text)
+    write_synth_jsonl(tmp_path / "n_train.jsonl", 80, kind="ner", seed=2)
+    write_synth_jsonl(tmp_path / "n_dev.jsonl", 20, kind="ner", seed=3)
+    cfg = Config.from_str(SOURCED_CFG.format(model_dir=model_dir)).apply_overrides(
+        {
+            "paths.train": str(tmp_path / "n_train.jsonl"),
+            "paths.dev": str(tmp_path / "n_dev.jsonl"),
+            "training.max_steps": 10,
+            "training.eval_frequency": 5,
+        }
+    )
+    nlp, _ = train(cfg, output_path=tmp_path / "combined", n_workers=1, stdout_log=False)
+    shutil.rmtree(model_dir)  # source gone
+    reloaded = Pipeline.from_disk(tmp_path / "combined" / "best-model")
+    doc = reloaded("the cat runs")
+    assert doc.tags == ["DET", "NOUN", "VERB"]
+
+
+def test_sourced_width_mismatch_fails_fast(tmp_path, tagger_config_text):
+    model_dir = _train_tagger(tmp_path, tagger_config_text)
+    bad = SOURCED_CFG.format(model_dir=model_dir).replace("width = 64", "width = 128")
+    # tok2vec sourced at width 64; ner head declares listener width 128
+    cfg = Config.from_str(bad).apply_overrides(
+        {"paths.train": "x", "paths.dev": "y"}
+    ).interpolate()
+    nlp = Pipeline.from_config(cfg)
+    with pytest.raises(ValueError, match="width"):
+        nlp.initialize(lambda: iter(synth_corpus(10, "ner", 0)), seed=0)
+
+
+def test_sourced_block_with_extra_keys_rejected(tmp_path, tagger_config_text):
+    model_dir = _train_tagger(tmp_path, tagger_config_text)
+    text = SOURCED_CFG.format(model_dir=model_dir).replace(
+        '[components.tagger]\nsource = "' + str(model_dir) + '"',
+        '[components.tagger]\nsource = "' + str(model_dir) + '"\nfactory = "tagger"',
+    )
+    cfg = Config.from_str(text).apply_overrides({"paths.train": "x", "paths.dev": "y"})
+    with pytest.raises(ValueError, match="mixes source"):
+        Pipeline.from_config(cfg.interpolate())
