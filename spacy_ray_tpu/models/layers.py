@@ -106,6 +106,50 @@ def HashEmbed(
     return Model(name, init_fn, apply_fn, dims={"nO": width, "rows": rows})
 
 
+def StaticVectors(width: int, name: str = "static_vectors") -> Model:
+    """Frozen pretrained vectors -> trainable linear projection to `width`.
+
+    The table comes from the active vectors context (pipeline/vectors.py)
+    and is stored as a stop_gradient\'d parameter: frozen in training, but a
+    real array argument to the compiled step (a traced-in constant would be
+    re-embedded into every shape-bucket executable).
+    """
+    from ..pipeline.vectors import current_vectors
+
+    vectors = current_vectors()
+    if vectors is None:
+        raise ValueError(
+            "include_static_vectors=true but no vectors are loaded — set "
+            "[initialize] vectors = \"path.npz\" (or Pipeline.load_vectors)"
+        )
+    host_table = vectors.table  # numpy; becomes a frozen param at init
+
+    def init_fn(rng):
+        # the table lives in params (stop_gradient\'d in apply) rather than
+        # being closure-captured: a traced-in constant would be duplicated
+        # into every compiled executable (one per shape bucket)
+        return {
+            "table": jnp.asarray(host_table),
+            "W": glorot_uniform(rng, (host_table.shape[1], width)),
+        }
+
+    def apply_fn(params, batch: TokenBatch, ctx: Context) -> Padded:
+        rows = batch.vector_rows
+        if rows is None:
+            raise ValueError(
+                "TokenBatch has no vector_rows — the pipeline that collated "
+                "this batch has no vectors loaded"
+            )
+        table = jax.lax.stop_gradient(params["table"])  # frozen by definition
+        safe = jnp.clip(rows, 0, table.shape[0] - 1)
+        vecs = jnp.take(table, safe, axis=0)  # [B, T, Dv]
+        vecs = vecs * (rows >= 0)[..., None].astype(vecs.dtype)  # OOV -> 0
+        X = vecs @ params["W"]
+        return Padded(X=X, mask=batch.mask)
+
+    return Model(name, init_fn, apply_fn, dims={"nO": width, "nV": len(vectors)})
+
+
 def ConcatPadded(*layers: Model, name: str = "concat") -> Model:
     """Apply layers to the same input, concat features."""
 

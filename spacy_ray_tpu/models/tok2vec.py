@@ -59,8 +59,6 @@ def MultiHashEmbed(
         rows = [5000] + [2500] * (len(attrs) - 1)
     if len(rows) != len(attrs):
         raise ValueError(f"len(rows) != len(attrs): {rows} vs {attrs}")
-    if include_static_vectors:
-        raise NotImplementedError("static vectors: planned (requires .vectors asset)")
     embeds = [
         HashEmbed(
             width,
@@ -71,10 +69,16 @@ def MultiHashEmbed(
         )
         for i, (a, r) in enumerate(zip(attrs, rows))
     ]
+    n_inputs = len(attrs)
+    if include_static_vectors:
+        from .layers import StaticVectors
+
+        embeds.append(StaticVectors(width))
+        n_inputs += 1
     concat = ConcatPadded(*embeds, name="embeds")
     mix = chain(
         concat,
-        Maxout(width * len(attrs), width, nP=3, name="mix"),
+        Maxout(width * n_inputs, width, nP=3, name="mix"),
         LayerNorm(width),
         name="multi_hash_embed",
     )
@@ -135,11 +139,12 @@ def HashEmbedCNN(
     dropout: Optional[float] = None,
 ) -> Model:
     """The standard CNN tok2vec (BASELINE.json config #1's backbone)."""
-    if pretrained_vectors:
-        raise NotImplementedError("pretrained static vectors: planned")
     attrs = list(ATTRS) if subword_features else ["NORM"]
     rows = [embed_size] + [embed_size // 2] * (len(attrs) - 1)
-    embed = MultiHashEmbed(width=width, attrs=attrs, rows=rows)
+    embed = MultiHashEmbed(
+        width=width, attrs=attrs, rows=rows,
+        include_static_vectors=bool(pretrained_vectors),
+    )
     layers = [embed]
     if dropout:
         layers.append(Dropout(dropout))

@@ -8,3 +8,4 @@ from . import parser  # noqa: F401
 from . import ner  # noqa: F401
 from . import spancat  # noqa: F401
 from . import token_classifiers  # noqa: F401
+from . import lemmatizer  # noqa: F401

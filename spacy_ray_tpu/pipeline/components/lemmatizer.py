@@ -1,0 +1,121 @@
+"""Lookup lemmatizer: host-side, trained from gold lemma counts.
+
+Capability parity with spaCy's lookup-mode ``lemmatizer`` pipe (rule/lookup
+host-side preprocessing — SURVEY.md §2.3 places Doc-level string work on the
+host). No device compute: at initialize it builds (word, pos) -> lemma and
+word -> lemma tables from the gold corpus by majority count; prediction is a
+dictionary lookup with suffix-strip fallbacks. Score: ``lemma_acc``.
+"""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...registry import registry
+from ...pipeline.doc import Doc, Example
+from .base import Component
+
+_SUFFIX_RULES = [
+    ("ies", "y"),
+    ("sses", "ss"),
+    ("ing", ""),
+    ("ed", ""),
+    ("s", ""),
+]
+
+
+class LemmatizerComponent(Component):
+    trainable = False
+    listens = False
+
+    def __init__(self, name: str, model_cfg: Optional[Dict[str, Any]] = None, mode: str = "lookup"):
+        super().__init__(name, model_cfg or {})
+        self.mode = mode
+        self.table: Dict[Tuple[str, str], str] = {}
+        self.word_table: Dict[str, str] = {}
+
+    # host-only: no model/params
+    def build_model(self):
+        self.model = None
+        return None
+
+    def init_params(self, rng):
+        return {}
+
+    def add_labels_from(self, examples) -> None:
+        counts: Dict[Tuple[str, str], Counter] = defaultdict(Counter)
+        word_counts: Dict[str, Counter] = defaultdict(Counter)
+        for eg in examples:
+            ref = eg.reference
+            if not ref.lemmas:
+                continue
+            for i, lemma in enumerate(ref.lemmas):
+                if not lemma:
+                    continue
+                word = ref.words[i].lower()
+                pos = ref.pos[i] if ref.pos else ""
+                counts[(word, pos)][lemma] += 1
+                word_counts[word][lemma] += 1
+        self.table = {k: c.most_common(1)[0][0] for k, c in counts.items()}
+        self.word_table = {w: c.most_common(1)[0][0] for w, c in word_counts.items()}
+
+    def finish_labels(self) -> None:
+        pass
+
+    def lemmatize(self, word: str, pos: str = "") -> str:
+        low = word.lower()
+        hit = self.table.get((low, pos)) or self.word_table.get(low)
+        if hit:
+            return hit
+        for suffix, repl in _SUFFIX_RULES:
+            if low.endswith(suffix) and len(low) > len(suffix) + 2:
+                return low[: -len(suffix)] + repl
+        return low
+
+    # annotate directly (no device output)
+    def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
+        for doc in docs:
+            pos_list = doc.pos or [""] * len(doc)
+            doc.lemmas = [
+                self.lemmatize(w, pos_list[i] if i < len(pos_list) else "")
+                for i, w in enumerate(doc.words)
+            ]
+
+    def forward(self, params, inputs, ctx):
+        return None  # host-side only
+
+    def score(self, examples: List[Example]) -> Dict[str, float]:
+        correct = total = 0
+        for eg in examples:
+            gold = eg.reference.lemmas
+            pred = eg.predicted.lemmas
+            if not gold or not pred:
+                continue
+            for g, p in zip(gold, pred):
+                if not g:
+                    continue
+                total += 1
+                correct += int(g.lower() == p.lower())
+        return {"lemma_acc": correct / total if total else 0.0}
+
+    # ------------------------------------------------------------------
+    # serialization: the tables must survive to_disk/from_disk
+    def table_data(self) -> Dict[str, Any]:
+        return {
+            "mode": self.mode,
+            "table": [[w, p, l] for (w, p), l in self.table.items()],
+            "word_table": self.word_table,
+        }
+
+    def load_table_data(self, data: Dict[str, Any]) -> None:
+        self.mode = data.get("mode", "lookup")
+        self.table = {(w, p): l for w, p, l in data.get("table", [])}
+        self.word_table = dict(data.get("word_table", {}))
+
+
+@registry.factories("lemmatizer")
+def make_lemmatizer(
+    name: str, model: Optional[Dict[str, Any]] = None, mode: str = "lookup"
+) -> LemmatizerComponent:
+    return LemmatizerComponent(name, model, mode=mode)

@@ -34,6 +34,7 @@ from .components.base import Component
 from .components.tok2vec import Tok2VecComponent
 from .doc import Doc, Example
 from .tokenizer import Tokenizer
+from .vectors import Vectors, use_vectors
 from .vocab import Vocab
 
 
@@ -55,6 +56,7 @@ class Pipeline:
         self.frozen_components: List[str] = []
         self.annotating_components: List[str] = []
         self.sourced_components: Dict[str, str] = {}
+        self.vectors: Optional[Vectors] = None
         self.length_buckets: Sequence[int] = DEFAULT_LENGTH_BUCKETS
         self._jit_forward = None  # cached compiled forward (predict path)
 
@@ -70,6 +72,7 @@ class Pipeline:
         comp_cfgs = config.get("components", {})
         components: Dict[str, Component] = {}
         sourced: Dict[str, str] = {}
+        sourced_vectors = None  # adopted from the first vector-ful source
         src_cache: Dict[str, "Pipeline"] = {}  # one load per source dir
         for name in pipe_names:
             if name not in comp_cfgs:
@@ -95,7 +98,10 @@ class Pipeline:
                     )
                 components[name] = src_nlp.components[name]
                 sourced[name] = source
-                components[name]._sourced_params = src_nlp.params[name]
+                # host-side components (lemmatizer) may have no params entry
+                components[name]._sourced_params = (src_nlp.params or {}).get(name, {})
+                if src_nlp.vectors is not None:
+                    sourced_vectors = src_nlp.vectors
                 # Rewrite the config block to the source's CONCRETE block so
                 # the saved combined model reloads without the source dir
                 # (its params travel in our params.npz anyway).
@@ -111,10 +117,20 @@ class Pipeline:
             factory = registry.get("factories", factory_name)
             model_cfg = block.pop("model", None)
             if model_cfg is None:
-                raise ValueError(f"[components.{name}] missing model block")
-            components[name] = factory(name=name, model=model_cfg, **block)
+                import inspect
+
+                sig = inspect.signature(factory)
+                model_param = sig.parameters.get("model")
+                if model_param is None or model_param.default is inspect.Parameter.empty:
+                    raise ValueError(f"[components.{name}] missing model block")
+                # model-less (host-side) components like the lemmatizer
+                components[name] = factory(name=name, **block)
+            else:
+                components[name] = factory(name=name, model=model_cfg, **block)
         nlp = cls(lang=lang, components=components, pipe_names=pipe_names, config=config)
         nlp.sourced_components = sourced
+        if sourced_vectors is not None:
+            nlp.vectors = sourced_vectors
         training = config.get("training", {})
         nlp.frozen_components = list(training.get("frozen_components", []) or [])
         nlp.annotating_components = list(training.get("annotating_components", []) or [])
@@ -158,17 +174,26 @@ class Pipeline:
                 comp = self.components[name]
                 comp.add_labels_from(sample)
                 comp.finish_labels()
+        # vectors asset ([initialize] vectors = "path.npz", spaCy semantics)
+        init_cfg = self.config.get("initialize", {}) if self.config else {}
+        vectors_path = init_cfg.get("vectors")
+        if vectors_path and self.vectors is None:
+            self.vectors = Vectors.from_disk(vectors_path)
         rng = jax.random.PRNGKey(seed)
         params: Dict[str, Any] = {}
-        for name in self.pipe_names:
-            comp = self.components[name]
-            if name in self.sourced_components:
-                # model already built by from_disk; reuse trained params
-                params[name] = comp._sourced_params
-                continue
-            comp.build_model()
-            rng, sub = jax.random.split(rng)
-            params[name] = comp.init_params(sub)
+        with use_vectors(self.vectors):
+            for name in self.pipe_names:
+                comp = self.components[name]
+                if name in self.sourced_components:
+                    # model already built by from_disk; reuse trained params
+                    if comp._sourced_params:
+                        params[name] = comp._sourced_params
+                    continue
+                comp.build_model()
+                rng, sub = jax.random.split(rng)
+                comp_params = comp.init_params(sub)
+                if comp_params:  # host-only components have no params; empty
+                    params[name] = comp_params  # dicts break pytree matching
         # Width compatibility: a (possibly sourced) listening head must match
         # the trunk width, or jit fails later with an opaque shape error.
         t2v = self.tok2vec_name
@@ -176,6 +201,8 @@ class Pipeline:
             trunk_w = self.components[t2v].model.dims.get("nO")
             for name in self.head_names():
                 comp = self.components[name]
+                if comp.model is None:
+                    continue
                 head_w = (comp.model.dims or {}).get("width")
                 if comp.listens and trunk_w and head_w and head_w != trunk_w:
                     src = self.sourced_components.get(name)
@@ -207,13 +234,22 @@ class Pipeline:
         n_attrs = 4
         attr_keys = np.zeros((B, T, n_attrs, 2), dtype=np.uint32)
         mask = np.zeros((B, T), dtype=bool)
+        vec_rows = (
+            np.full((B, T), -1, dtype=np.int32) if self.vectors is not None else None
+        )
         for i, eg in enumerate(examples):
             words = eg.reference.words[:T]
             feats = self.vocab.featurize(words)
             attr_keys[i, : len(words)] = feats
             mask[i, : len(words)] = True
+            if vec_rows is not None:
+                vec_rows[i, : len(words)] = self.vectors.rows_of(words)
         batch: Dict[str, Any] = {
-            "tokens": TokenBatch(attr_keys=jnp.asarray(attr_keys), mask=jnp.asarray(mask)),
+            "tokens": TokenBatch(
+                attr_keys=jnp.asarray(attr_keys),
+                mask=jnp.asarray(mask),
+                vector_rows=jnp.asarray(vec_rows) if vec_rows is not None else None,
+            ),
             "n_words": int(sum(min(l, T) for l in lengths)),
             "lengths": lengths,
         }
@@ -286,6 +322,8 @@ class Pipeline:
                 outputs[t2v_name] = t2v_out
             for name in head_names:
                 comp = components[name]
+                if comp.model is None:
+                    continue  # host-side components have no device forward
                 inputs = t2v_out if comp.listens else tokens
                 outputs[name] = comp.forward(params[name], inputs, Context(train=False))
             return outputs
@@ -311,7 +349,9 @@ class Pipeline:
             outputs = forward(params, batch["tokens"])
             lengths = [min(len(d), batch["tokens"].seq_len) for d in chunk]
             for name in self.head_names():
-                self.components[name].set_annotations(chunk, outputs[name], lengths)
+                self.components[name].set_annotations(
+                    chunk, outputs.get(name), lengths
+                )
         return docs
 
     def __call__(self, text: str) -> Doc:
@@ -356,6 +396,15 @@ class Pipeline:
             "labels": {name: self.components[name].labels for name in self.pipe_names},
         }
 
+    def component_data(self) -> Dict[str, Any]:
+        """Host-side component state (e.g. lemmatizer lookup tables) —
+        saved as its own artifact so meta.json stays small."""
+        return {
+            name: comp.table_data()
+            for name, comp in self.components.items()
+            if hasattr(comp, "table_data")
+        }
+
     def to_disk(self, path) -> None:
         from ..training import checkpoint
 
@@ -363,6 +412,13 @@ class Pipeline:
         path.mkdir(parents=True, exist_ok=True)
         (path / "config.cfg").write_text(self.config.to_str(), encoding="utf8")
         (path / "meta.json").write_text(json.dumps(self.meta(), indent=2), encoding="utf8")
+        extras = self.component_data()
+        if extras:
+            (path / "components.json").write_text(
+                json.dumps(extras), encoding="utf8"
+            )
+        if self.vectors is not None:
+            self.vectors.to_disk(path / "vectors.npz")
         assert self.params is not None
         checkpoint.save_params(path / "params.npz", self.params)
 
@@ -378,7 +434,18 @@ class Pipeline:
         for name, labels in meta.get("labels", {}).items():
             if name in nlp.components:
                 nlp.components[name].labels = labels
-        for name in nlp.pipe_names:
-            nlp.components[name].build_model()
+        comp_data_path = path / "components.json"
+        if comp_data_path.exists():
+            for name, data in json.loads(
+                comp_data_path.read_text(encoding="utf8")
+            ).items():
+                comp = nlp.components.get(name)
+                if comp is not None and hasattr(comp, "load_table_data"):
+                    comp.load_table_data(data)
+        if (path / "vectors.npz").exists():
+            nlp.vectors = Vectors.from_disk(path / "vectors.npz")
+        with use_vectors(nlp.vectors):
+            for name in nlp.pipe_names:
+                nlp.components[name].build_model()
         nlp.params = checkpoint.load_params(path / "params.npz")
         return nlp

@@ -1,0 +1,91 @@
+"""Static word vectors: pretrained embedding table support.
+
+Capability parity with spaCy's vectors asset (``include_static_vectors`` in
+MultiHashEmbed; vectors live on the Vocab there). Format: an .npz with
+``words`` (unicode array) and ``vectors`` [N, D] float32 — zero-egress
+environments generate their own (e.g. from a local embedding dump).
+
+Device side: the table is closure-captured into the embedding layer as an
+XLA constant (NOT a parameter: static vectors are frozen by definition, and
+keeping them out of the params pytree keeps checkpoints and optimizer state
+small). A trainable linear projection maps vector dim -> model width.
+
+The active table is installed in a context (like parallel/context.py's mesh)
+so architecture factories can reach it during config resolution, where no
+vocab handle exists.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Dict, Iterator, Optional, Sequence, Union
+
+import numpy as np
+
+
+class Vectors:
+    def __init__(self, words: Sequence[str], table: np.ndarray):
+        if len(words) != table.shape[0]:
+            raise ValueError(f"{len(words)} words vs {table.shape[0]} vector rows")
+        table = np.asarray(table, dtype=np.float32)
+        # dedupe (keep first occurrence) so save/load roundtrips: a dict of
+        # N-1 unique words over an N-row table would crash on reload
+        seen: Dict[str, int] = {}
+        keep: list = []
+        for i, w in enumerate(words):
+            if w not in seen:
+                seen[w] = len(keep)
+                keep.append(i)
+        if len(keep) != len(words):
+            table = table[np.asarray(keep)]
+        self.table = table
+        self.key_to_row: Dict[str, int] = seen
+
+    @property
+    def width(self) -> int:
+        return int(self.table.shape[1])
+
+    def __len__(self) -> int:
+        return self.table.shape[0]
+
+    def row_of(self, word: str) -> int:
+        """Row index or -1 (OOV -> zero vector)."""
+        r = self.key_to_row.get(word)
+        if r is None:
+            r = self.key_to_row.get(word.lower(), -1)
+        return r
+
+    def rows_of(self, words: Sequence[str]) -> np.ndarray:
+        return np.array([self.row_of(w) for w in words], dtype=np.int32)
+
+    @classmethod
+    def from_disk(cls, path: Union[str, Path]) -> "Vectors":
+        with np.load(str(path), allow_pickle=False) as data:
+            words = [str(w) for w in data["words"]]
+            table = data["vectors"]
+        return cls(words, table)
+
+    def to_disk(self, path: Union[str, Path]) -> None:
+        words = np.array(list(self.key_to_row), dtype=np.str_)
+        order = np.argsort([self.key_to_row[w] for w in words])
+        np.savez(str(path), words=words[order], vectors=self.table)
+
+
+_ACTIVE: "contextvars.ContextVar[Optional[Vectors]]" = contextvars.ContextVar(
+    "spacy_ray_tpu_vectors", default=None
+)
+
+
+def current_vectors() -> Optional[Vectors]:
+    return _ACTIVE.get()
+
+
+@contextmanager
+def use_vectors(vectors: Optional[Vectors]) -> Iterator[None]:
+    token = _ACTIVE.set(vectors)
+    try:
+        yield
+    finally:
+        _ACTIVE.reset(token)

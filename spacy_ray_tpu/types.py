@@ -8,6 +8,8 @@ via spacy's ``create_train_batches``).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import chex
 import jax.numpy as jnp
 
@@ -37,6 +39,9 @@ class TokenBatch:
 
     attr_keys: jnp.ndarray
     mask: jnp.ndarray
+    #: [B, T] int32 static-vector rows (-1 = OOV); None when the pipeline
+    #: has no vectors asset loaded
+    vector_rows: Optional[jnp.ndarray] = None
 
     @property
     def batch_size(self) -> int:

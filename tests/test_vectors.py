@@ -1,0 +1,124 @@
+"""Static vectors: asset loading, include_static_vectors training path,
+serialization roundtrip."""
+
+import numpy as np
+import pytest
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.pipeline.language import Pipeline
+from spacy_ray_tpu.pipeline.vectors import Vectors
+from spacy_ray_tpu.util import synth_corpus, write_synth_jsonl
+
+VEC_CFG = """
+[paths]
+train = null
+dev = null
+vectors = null
+
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.MultiHashEmbed.v2"
+width = 32
+rows = [500,250,250,250]
+include_static_vectors = true
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 32
+
+[corpora.train]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${paths.train}
+
+[corpora.dev]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${paths.dev}
+
+[initialize]
+vectors = ${paths.vectors}
+
+[training]
+max_steps = 30
+eval_frequency = 15
+patience = 0
+
+[training.optimizer]
+@optimizers = "Adam.v1"
+learn_rate = 0.01
+
+[training.batcher]
+@batchers = "spacy.batch_by_words.v1"
+size = 600
+
+[training.score_weights]
+tag_acc = 1.0
+"""
+
+
+@pytest.fixture(scope="module")
+def vectors_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("vec")
+    rng = np.random.default_rng(0)
+    # vectors for the synthetic vocabulary
+    from spacy_ray_tpu.util import _POS_VOCAB
+
+    words = sorted({w for ws in _POS_VOCAB.values() for w in ws})
+    Vectors(words, rng.normal(size=(len(words), 24)).astype(np.float32)).to_disk(
+        d / "vectors.npz"
+    )
+    return d / "vectors.npz"
+
+
+def test_vectors_roundtrip(tmp_path, vectors_file):
+    v = Vectors.from_disk(vectors_file)
+    assert v.width == 24
+    assert v.row_of("cat") >= 0
+    assert v.row_of("zzz-not-here") == -1
+    v.to_disk(tmp_path / "v2.npz")
+    v2 = Vectors.from_disk(tmp_path / "v2.npz")
+    assert v2.row_of("cat") == v.row_of("cat")
+    np.testing.assert_array_equal(v2.table, v.table)
+
+
+def test_static_vectors_pipeline_trains_and_reloads(tmp_path, vectors_file):
+    from spacy_ray_tpu.training.loop import train
+
+    write_synth_jsonl(tmp_path / "train.jsonl", 200, kind="tagger", seed=0)
+    write_synth_jsonl(tmp_path / "dev.jsonl", 40, kind="tagger", seed=1)
+    cfg = Config.from_str(VEC_CFG).apply_overrides(
+        {
+            "paths.train": str(tmp_path / "train.jsonl"),
+            "paths.dev": str(tmp_path / "dev.jsonl"),
+            "paths.vectors": str(vectors_file),
+        }
+    )
+    nlp, result = train(cfg, output_path=tmp_path / "out", n_workers=1, stdout_log=False)
+    assert result.best_score > 0.8, result.best_score
+    # vectors travel with the model
+    reloaded = Pipeline.from_disk(tmp_path / "out" / "best-model")
+    assert reloaded.vectors is not None and reloaded.vectors.width == 24
+    doc = reloaded("the cat runs")
+    assert doc.tags == ["DET", "NOUN", "VERB"]
+
+
+def test_missing_vectors_fails_actionably():
+    cfg = Config.from_str(VEC_CFG).apply_overrides(
+        {"paths.train": "x", "paths.dev": "y", "paths.vectors": None}
+    )
+    # no [initialize] vectors value -> StaticVectors must raise helpfully
+    cfg = cfg.apply_overrides({"initialize.vectors": None})
+    nlp = Pipeline.from_config(cfg.interpolate())
+    with pytest.raises(ValueError, match="no vectors are loaded"):
+        nlp.initialize(lambda: iter(synth_corpus(10, "tagger", 0)), seed=0)
