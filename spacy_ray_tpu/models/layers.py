@@ -125,11 +125,13 @@ def StaticVectors(width: int, name: str = "static_vectors") -> Model:
     host_table = vectors.table  # numpy; becomes a frozen param at init
 
     def init_fn(rng):
-        # the table lives in params (stop_gradient\'d in apply) rather than
-        # being closure-captured: a traced-in constant would be duplicated
-        # into every compiled executable (one per shape bucket)
+        # The table lives in params rather than being closure-captured (a
+        # traced-in constant would be duplicated into every compiled
+        # executable). The "frozen_" key prefix is the framework convention
+        # marking leaves the optimizer must skip entirely (optax.masked in
+        # the loop: no updates, no decay, no Adam moments).
         return {
-            "table": jnp.asarray(host_table),
+            "frozen_table": jnp.asarray(host_table),
             "W": glorot_uniform(rng, (host_table.shape[1], width)),
         }
 
@@ -140,7 +142,7 @@ def StaticVectors(width: int, name: str = "static_vectors") -> Model:
                 "TokenBatch has no vector_rows — the pipeline that collated "
                 "this batch has no vectors loaded"
             )
-        table = jax.lax.stop_gradient(params["table"])  # frozen by definition
+        table = jax.lax.stop_gradient(params["frozen_table"])
         safe = jnp.clip(rows, 0, table.shape[0] - 1)
         vecs = jnp.take(table, safe, axis=0)  # [B, T, Dv]
         vecs = vecs * (rows >= 0)[..., None].astype(vecs.dtype)  # OOV -> 0

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from ...registry import registry
 from ...models.core import Context, Params
-from ...models.parser import NER_N_FEATURES, decode_biluo, ner_window_features
+from ...models.parser import NER_N_FEATURES, decode_biluo, decode_biluo_viterbi, ner_window_features
 from ...ops import ops as O
 from ...pipeline.doc import Doc, Example, Span
 from ...types import Padded
@@ -55,6 +55,12 @@ def action_to_biluo(action: int, labels: List[str]) -> str:
 
 
 class NERComponent(Component):
+    def __init__(self, name, model_cfg, decode: str = "viterbi"):
+        super().__init__(name, model_cfg)
+        if decode not in ("viterbi", "greedy"):
+            raise ValueError(f"ner decode must be viterbi|greedy, got {decode!r}")
+        self.decode = decode
+
     def add_labels_from(self, examples) -> None:
         labels = set(self.labels)
         for eg in examples:
@@ -107,7 +113,10 @@ class NERComponent(Component):
         feats = ner_window_features(Tlen, lengths_arr)
         fns = self.model.meta["fns"]
         logits = fns.step_logits(params["upper"], t2v.X, feats)
-        actions = decode_biluo(logits, lengths_arr, len(self.labels))
+        decode_fn = (
+            decode_biluo_viterbi if self.decode == "viterbi" else decode_biluo
+        )
+        actions = decode_fn(logits, lengths_arr, len(self.labels))
         return {"actions": actions}
 
     def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
@@ -148,5 +157,5 @@ class NERComponent(Component):
 
 
 @registry.factories("ner")
-def make_ner(name: str, model: Dict[str, Any]) -> NERComponent:
-    return NERComponent(name, model)
+def make_ner(name: str, model: Dict[str, Any], decode: str = "viterbi") -> NERComponent:
+    return NERComponent(name, model, decode=decode)

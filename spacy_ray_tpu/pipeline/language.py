@@ -101,7 +101,19 @@ class Pipeline:
                 # host-side components (lemmatizer) may have no params entry
                 components[name]._sourced_params = (src_nlp.params or {}).get(name, {})
                 if src_nlp.vectors is not None:
-                    sourced_vectors = src_nlp.vectors
+                    if sourced_vectors is None:
+                        sourced_vectors = src_nlp.vectors
+                    elif sourced_vectors is not src_nlp.vectors and (
+                        sourced_vectors.table.shape != src_nlp.vectors.table.shape
+                        or not np.array_equal(
+                            sourced_vectors.table, src_nlp.vectors.table
+                        )
+                    ):
+                        raise ValueError(
+                            f"[components.{name}] source {source!r} carries a "
+                            "different vectors table than an earlier source — "
+                            "sourced components must share one vectors asset"
+                        )
                 # Rewrite the config block to the source's CONCRETE block so
                 # the saved combined model reloads without the source dir
                 # (its params travel in our params.npz anyway).
@@ -174,10 +186,11 @@ class Pipeline:
                 comp = self.components[name]
                 comp.add_labels_from(sample)
                 comp.finish_labels()
-        # vectors asset ([initialize] vectors = "path.npz", spaCy semantics)
+        # vectors asset ([initialize] vectors = "path.npz", spaCy semantics);
+        # an explicit config path WINS over vectors adopted from a source
         init_cfg = self.config.get("initialize", {}) if self.config else {}
         vectors_path = init_cfg.get("vectors")
-        if vectors_path and self.vectors is None:
+        if vectors_path:
             self.vectors = Vectors.from_disk(vectors_path)
         rng = jax.random.PRNGKey(seed)
         params: Dict[str, Any] = {}
@@ -237,13 +250,20 @@ class Pipeline:
         vec_rows = (
             np.full((B, T), -1, dtype=np.int32) if self.vectors is not None else None
         )
-        for i, eg in enumerate(examples):
-            words = eg.reference.words[:T]
-            feats = self.vocab.featurize(words)
-            attr_keys[i, : len(words)] = feats
-            mask[i, : len(words)] = True
-            if vec_rows is not None:
-                vec_rows[i, : len(words)] = self.vectors.rows_of(words)
+        # featurize the whole batch in ONE call (one native hash batch + one
+        # stack) instead of per-doc — the dominant host cost at high WPS
+        doc_words = [eg.reference.words[:T] for eg in examples]
+        flat_words = [w for words in doc_words for w in words]
+        if flat_words:
+            flat_feats = self.vocab.featurize(flat_words)
+            offset = 0
+            for i, words in enumerate(doc_words):
+                n = len(words)
+                attr_keys[i, :n] = flat_feats[offset : offset + n]
+                mask[i, :n] = True
+                if vec_rows is not None:
+                    vec_rows[i, :n] = self.vectors.rows_of(words)
+                offset += n
         batch: Dict[str, Any] = {
             "tokens": TokenBatch(
                 attr_keys=jnp.asarray(attr_keys),

@@ -166,6 +166,7 @@ def train(
     mesh = build_mesh(n_data=n_workers)
     n_data = mesh.shape["data"]
     tx = registry.resolve(T.get("optimizer") or {"@optimizers": "Adam.v1"})
+    tx = _optimizers.mask_frozen(tx, nlp.params)  # skip frozen_ leaves entirely
     batcher = registry.resolve(
         T.get("batcher")
         or {"@batchers": "spacy.batch_by_words.v1", "size": 1000, "tolerance": 0.2}

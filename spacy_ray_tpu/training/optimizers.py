@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Iterable, Optional, Union
 
+import jax
 import optax
 
 from ..registry import registry
@@ -141,6 +142,32 @@ class OptimizerWrapper:
 
     def update(self, grads, state, params=None):
         return self.tx.update(grads, state, params)
+
+
+def mask_frozen(tx, params):
+    """Wrap a transformation with optax.masked so leaves under a dict key
+    starting with "frozen_" (e.g. static-vector tables) get NO updates, NO
+    weight decay, and NO optimizer-state moments."""
+
+    def trainable_tree(tree):
+        def rec(node, frozen):
+            if isinstance(node, dict):
+                return {
+                    k: rec(v, frozen or str(k).startswith("frozen_"))
+                    for k, v in node.items()
+                }
+            return not frozen
+
+        return rec(tree, False)
+
+    mask = trainable_tree(params)
+    if all(jax.tree_util.tree_leaves(mask)):
+        return tx  # nothing frozen: keep the plain transformation
+    inner = tx.tx if isinstance(tx, OptimizerWrapper) else tx
+    masked = optax.masked(inner, mask)
+    if isinstance(tx, OptimizerWrapper):
+        return OptimizerWrapper(masked, use_averages=tx.use_averages)
+    return masked
 
 
 @registry.optimizers("Adam.v1")
