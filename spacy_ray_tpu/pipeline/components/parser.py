@@ -53,12 +53,26 @@ class ParserComponent(Component):
         feats = np.full((B, S, T.N_FEATURES), -1, dtype=np.int32)
         valid = np.zeros((B, S, n_act), dtype=bool)
         step_mask = np.zeros((B, S), dtype=bool)
+        labels_sig = tuple(self.labels)
         for i, eg in enumerate(examples):
             ref = eg.reference
             if not ref.heads or not ref.deps or len(ref) > Tlen:
                 continue
-            ids = [label_ids.get(d, 0) for d in ref.deps]
-            out = T.gold_oracle(ref.heads, ids, len(self.labels))
+            # oracle simulation is the collation hot path: memoize per
+            # Example (the corpus reuses Example objects across epochs).
+            # The key hashes the gold annotations so an augmenter mutating
+            # reference heads/deps in place can never serve a stale oracle.
+            memo_key = (labels_sig, hash((tuple(ref.heads), tuple(ref.deps))))
+            cached = getattr(eg, "_oracle_cache", None)
+            if cached is not None and cached[0] == memo_key:
+                out = cached[1]
+            else:
+                ids = [label_ids.get(d, 0) for d in ref.deps]
+                out = T.gold_oracle(ref.heads, ids, len(self.labels))
+                try:
+                    eg._oracle_cache = (memo_key, out)
+                except AttributeError:
+                    pass
             if out is None:  # non-projective or oracle-unreachable: skip doc
                 continue
             acts, f, v = out

@@ -167,11 +167,17 @@ def _iter_path(path: Path) -> Iterator[Doc]:
 
 
 class Corpus:
-    """Config-constructed corpus: callable yielding fresh Example iterators.
+    """Config-constructed corpus: callable yielding Example iterators.
 
     max_length splits long docs on sentence boundaries (or hard-truncates)
     — the mechanism by which the reference ecosystem bounds sequence length
     (SURVEY.md §5.7: document segmentation, not attention sharding).
+
+    ``cache`` (DEFAULT TRUE) materializes the whole corpus in host RAM on
+    first use and reuses the same Example objects every epoch — this powers
+    the parser's per-Example oracle memo and skips re-parsing files each
+    epoch. For larger-than-RAM corpora set ``cache = false`` in the reader
+    block to stream from disk per epoch.
     """
 
     def __init__(
@@ -182,13 +188,17 @@ class Corpus:
         limit: int = 0,
         shuffle: bool = False,
         seed: int = 0,
+        cache: bool = True,
     ):
         self.path = Path(path)
         self.max_length = max_length
         self.limit = limit
         self.shuffle = shuffle
         self.seed = seed
-        self._epoch = 0  # bumps per __call__ so each epoch reshuffles
+        self.cache = cache  # materialize once; reuse Example objects across
+        self._examples: Optional[List[Example]] = None  # epochs (enables the
+        self._epoch = 0  # parser's per-Example oracle memo); cache=false
+        # streams from disk every epoch for larger-than-RAM corpora
 
     def _split(self, doc: Doc) -> Iterator[Doc]:
         if self.max_length <= 0 or len(doc) <= self.max_length:
@@ -234,22 +244,39 @@ class Corpus:
                     piece.spans[g] = kept
             yield piece
 
-    def __call__(self) -> Iterator[Example]:
-        docs = _iter_path(self.path)
-        if self.shuffle:
-            docs_list = list(docs)
-            random.Random(self.seed + self._epoch).shuffle(docs_list)
-            self._epoch += 1
-            docs = iter(docs_list)
-        n = 0
-        for doc in docs:
+    def _read_examples(self) -> Iterator[Example]:
+        for doc in _iter_path(self.path):
             for piece in self._split(doc):
                 if len(piece) == 0:
                     continue
                 yield Example.from_gold(piece)
+
+    def __call__(self) -> Iterator[Example]:
+        # limit applies AFTER shuffling: with shuffle=True each epoch yields
+        # a fresh random subset, not a fixed file-order prefix
+        if not self.cache and not self.shuffle:
+            # pure streaming path (larger-than-RAM corpora)
+            n = 0
+            for eg in self._read_examples():
+                yield eg
                 n += 1
                 if self.limit and n >= self.limit:
                     return
+            return
+        if self.cache:
+            if self._examples is None:
+                self._examples = list(self._read_examples())
+            examples: List[Example] = self._examples
+        else:  # shuffle without cache: must materialize this epoch anyway
+            examples = list(self._read_examples())
+        if self.shuffle:
+            order = list(range(len(examples)))
+            random.Random(self.seed + self._epoch).shuffle(order)
+            self._epoch += 1
+            examples = [examples[i] for i in order]
+        if self.limit:
+            examples = examples[: self.limit]
+        yield from examples
 
 
 @registry.readers("spacy.Corpus.v1")
@@ -261,10 +288,13 @@ def create_corpus(
     augmenter: Optional[Callable] = None,
     shuffle: bool = False,
     seed: int = 0,
+    cache: bool = True,
 ) -> Corpus:
     if path is None:
         raise ValueError("Corpus path is required (set [paths.train]/[paths.dev])")
-    return Corpus(path, max_length=max_length, limit=limit, shuffle=shuffle, seed=seed)
+    return Corpus(
+        path, max_length=max_length, limit=limit, shuffle=shuffle, seed=seed, cache=cache
+    )
 
 
 @registry.readers("spacy.JsonlCorpus.v1")
@@ -275,7 +305,10 @@ def create_jsonl_corpus(
     limit: int = 0,
     shuffle: bool = False,
     seed: int = 0,
+    cache: bool = True,
 ) -> Corpus:
     if path is None:
         raise ValueError("JsonlCorpus path is required")
-    return Corpus(path, max_length=max_length, limit=limit, shuffle=shuffle, seed=seed)
+    return Corpus(
+        path, max_length=max_length, limit=limit, shuffle=shuffle, seed=seed, cache=cache
+    )
