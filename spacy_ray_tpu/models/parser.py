@@ -145,74 +145,63 @@ def TransitionBasedParser(
 # ----------------------------------------------------------------------
 
 
-def decode_parser(
-    fns: ParserModelFns,
-    upper_params: Dict[str, Any],
-    X: jnp.ndarray,
-    lengths: jnp.ndarray,
-    n_labels: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Greedy arc-eager decode on device.
-
-    X [B, T, D] tok2vec output; lengths [B] true lengths.
-    Returns (heads [B, T] int32 with ROOT as self-index, labels [B, T]).
-    """
-    B, Tlen, D = X.shape
-    n_act = fns.n_actions
-    NEG = jnp.float32(-1e9)
+def _arc_eager_machine(Tlen: int, lengths_n: jnp.ndarray, n_labels: int, n_act: int):
+    """Vectorized arc-eager state machine over a leading dim N (= batch for
+    greedy decode, batch*beam for beam decode). Returns the state ops as a
+    dict of pure functions."""
+    N = lengths_n.shape[0]
+    nidx = jnp.arange(N)
 
     def init_state():
         return {
-            "stack": jnp.full((B, Tlen + 1), -1, jnp.int32),
-            "sp": jnp.zeros((B,), jnp.int32),
-            "buf": jnp.zeros((B,), jnp.int32),
-            "heads": jnp.full((B, Tlen), -2, jnp.int32),
-            "labels": jnp.zeros((B, Tlen), jnp.int32),
-            "lc0": jnp.full((B, Tlen), -1, jnp.int32),
-            "lc1": jnp.full((B, Tlen), -1, jnp.int32),
-            "rc0": jnp.full((B, Tlen), -1, jnp.int32),
-            "rc1": jnp.full((B, Tlen), -1, jnp.int32),
+            "stack": jnp.full((N, Tlen + 1), -1, jnp.int32),
+            "sp": jnp.zeros((N,), jnp.int32),
+            "buf": jnp.zeros((N,), jnp.int32),
+            "heads": jnp.full((N, Tlen), -2, jnp.int32),
+            "labels": jnp.zeros((N, Tlen), jnp.int32),
+            "lc0": jnp.full((N, Tlen), -1, jnp.int32),
+            "lc1": jnp.full((N, Tlen), -1, jnp.int32),
+            "rc0": jnp.full((N, Tlen), -1, jnp.int32),
+            "rc1": jnp.full((N, Tlen), -1, jnp.int32),
         }
-
-    bidx = jnp.arange(B)
 
     def peek(st, depth):
         idx = st["sp"] - depth
         ok = idx >= 1
-        return jnp.where(ok, st["stack"][bidx, jnp.clip(idx - 1, 0, Tlen)], -1)
+        return jnp.where(ok, st["stack"][nidx, jnp.clip(idx - 1, 0, Tlen)], -1)
 
     def features(st):
         s0 = peek(st, 0)
         s1 = peek(st, 1)
         s2 = peek(st, 2)
         b = st["buf"]
-        b0 = jnp.where(b < lengths, b, -1)
-        b1 = jnp.where(b + 1 < lengths, b + 1, -1)
-        b2 = jnp.where(b + 2 < lengths, b + 2, -1)
+        b0 = jnp.where(b < lengths_n, b, -1)
+        b1 = jnp.where(b + 1 < lengths_n, b + 1, -1)
+        b2 = jnp.where(b + 2 < lengths_n, b + 2, -1)
         s0c = jnp.clip(s0, 0, Tlen - 1)
         s1c = jnp.clip(s1, 0, Tlen - 1)
-        s0l = jnp.where(s0 >= 0, st["lc0"][bidx, s0c], -1)
-        s0r = jnp.where(s0 >= 0, st["rc0"][bidx, s0c], -1)
-        s1l = jnp.where(s1 >= 0, st["lc0"][bidx, s1c], -1)
-        s1r = jnp.where(s1 >= 0, st["rc0"][bidx, s1c], -1)
-        s0l2 = jnp.where(s0 >= 0, st["lc1"][bidx, s0c], -1)
-        s0r2 = jnp.where(s0 >= 0, st["rc1"][bidx, s0c], -1)
+        s0l = jnp.where(s0 >= 0, st["lc0"][nidx, s0c], -1)
+        s0r = jnp.where(s0 >= 0, st["rc0"][nidx, s0c], -1)
+        s1l = jnp.where(s1 >= 0, st["lc0"][nidx, s1c], -1)
+        s1r = jnp.where(s1 >= 0, st["rc0"][nidx, s1c], -1)
+        s0l2 = jnp.where(s0 >= 0, st["lc1"][nidx, s0c], -1)
+        s0r2 = jnp.where(s0 >= 0, st["rc1"][nidx, s0c], -1)
         return jnp.stack(
             [s0, s1, s2, b0, b1, b2, s0l, s0r, s1l, s1r, s0l2, s0r2], axis=1
-        )  # [B, 12]
+        )  # [N, 12]
 
     def valid_mask(st):
-        has_b0 = st["buf"] < lengths
+        has_b0 = st["buf"] < lengths_n
         has_s0 = st["sp"] >= 1
         s0 = peek(st, 0)
         s0c = jnp.clip(s0, 0, Tlen - 1)
-        s0_has_head = has_s0 & (st["heads"][bidx, s0c] != -2)
+        s0_has_head = has_s0 & (st["heads"][nidx, s0c] != -2)
         shift_ok = has_b0
         # cleanup: when buffer is empty, REDUCE pops anything (ROOT-escape)
         reduce_ok = (has_s0 & s0_has_head) | (has_s0 & ~has_b0)
         la_ok = has_s0 & has_b0 & ~s0_has_head
         ra_ok = has_s0 & has_b0
-        mask = jnp.zeros((B, n_act), bool)
+        mask = jnp.zeros((N, n_act), bool)
         mask = mask.at[:, T.SHIFT].set(shift_ok)
         mask = mask.at[:, T.REDUCE].set(reduce_ok)
         la_cols = 2 + 2 * jnp.arange(n_labels)
@@ -237,61 +226,61 @@ def decode_parser(
         pop = is_reduce | is_la
 
         # ROOT-escape on REDUCE of a headless token
-        s0_headless = st["heads"][bidx, s0c] == -2
+        s0_headless = st["heads"][nidx, s0c] == -2
         heads = st["heads"]
-        heads = heads.at[bidx, s0c].set(
+        heads = heads.at[nidx, s0c].set(
             jnp.where(
-                is_reduce & s0_headless & (s0 >= 0), -1, heads[bidx, s0c]
+                is_reduce & s0_headless & (s0 >= 0), -1, heads[nidx, s0c]
             )
         )
         # LEFT-ARC: head(s0) = b0
-        heads = heads.at[bidx, s0c].set(
-            jnp.where(is_la & (s0 >= 0), b0, heads[bidx, s0c])
+        heads = heads.at[nidx, s0c].set(
+            jnp.where(is_la & (s0 >= 0), b0, heads[nidx, s0c])
         )
         labels_arr = st["labels"]
-        labels_arr = labels_arr.at[bidx, s0c].set(
-            jnp.where(is_la & (s0 >= 0), label, labels_arr[bidx, s0c])
+        labels_arr = labels_arr.at[nidx, s0c].set(
+            jnp.where(is_la & (s0 >= 0), label, labels_arr[nidx, s0c])
         )
         # RIGHT-ARC: head(b0) = s0 (or ROOT if stack empty — masked anyway)
         ra_head = jnp.where(st["sp"] >= 1, s0, -1)
-        heads = heads.at[bidx, b0c].set(
-            jnp.where(is_ra, ra_head, heads[bidx, b0c])
+        heads = heads.at[nidx, b0c].set(
+            jnp.where(is_ra, ra_head, heads[nidx, b0c])
         )
-        labels_arr = labels_arr.at[bidx, b0c].set(
-            jnp.where(is_ra, label, labels_arr[bidx, b0c])
+        labels_arr = labels_arr.at[nidx, b0c].set(
+            jnp.where(is_ra, label, labels_arr[nidx, b0c])
         )
 
         # child bookkeeping (dep < head -> left chain, else right chain)
         def upd_children(lc0, lc1, rc0, rc1, head, dep, on):
             hc = jnp.clip(head, 0, Tlen - 1)
             left = dep < head
-            old_l0 = lc0[bidx, hc]
+            old_l0 = lc0[nidx, hc]
             new_l0 = jnp.where(on & left & ((old_l0 == -1) | (dep < old_l0)), dep, old_l0)
             new_l1 = jnp.where(
-                on & left & ((old_l0 == -1) | (dep < old_l0)), old_l0, lc1[bidx, hc]
+                on & left & ((old_l0 == -1) | (dep < old_l0)), old_l0, lc1[nidx, hc]
             )
             new_l1 = jnp.where(
                 on & left & ~((old_l0 == -1) | (dep < old_l0))
-                & ((lc1[bidx, hc] == -1) | (dep < lc1[bidx, hc])),
+                & ((lc1[nidx, hc] == -1) | (dep < lc1[nidx, hc])),
                 dep,
                 new_l1,
             )
-            old_r0 = rc0[bidx, hc]
+            old_r0 = rc0[nidx, hc]
             new_r0 = jnp.where(on & ~left & ((old_r0 == -1) | (dep > old_r0)), dep, old_r0)
             new_r1 = jnp.where(
-                on & ~left & ((old_r0 == -1) | (dep > old_r0)), old_r0, rc1[bidx, hc]
+                on & ~left & ((old_r0 == -1) | (dep > old_r0)), old_r0, rc1[nidx, hc]
             )
             new_r1 = jnp.where(
                 on & ~left & ~((old_r0 == -1) | (dep > old_r0))
-                & ((rc1[bidx, hc] == -1) | (dep > rc1[bidx, hc])),
+                & ((rc1[nidx, hc] == -1) | (dep > rc1[nidx, hc])),
                 dep,
                 new_r1,
             )
             on_h = on & (head >= 0)
-            lc0 = lc0.at[bidx, hc].set(jnp.where(on_h, new_l0, lc0[bidx, hc]))
-            lc1 = lc1.at[bidx, hc].set(jnp.where(on_h, new_l1, lc1[bidx, hc]))
-            rc0 = rc0.at[bidx, hc].set(jnp.where(on_h, new_r0, rc0[bidx, hc]))
-            rc1 = rc1.at[bidx, hc].set(jnp.where(on_h, new_r1, rc1[bidx, hc]))
+            lc0 = lc0.at[nidx, hc].set(jnp.where(on_h, new_l0, lc0[nidx, hc]))
+            lc1 = lc1.at[nidx, hc].set(jnp.where(on_h, new_l1, lc1[nidx, hc]))
+            rc0 = rc0.at[nidx, hc].set(jnp.where(on_h, new_r0, rc0[nidx, hc]))
+            rc1 = rc1.at[nidx, hc].set(jnp.where(on_h, new_r1, rc1[nidx, hc]))
             return lc0, lc1, rc0, rc1
 
         lc0, lc1, rc0, rc1 = st["lc0"], st["lc1"], st["rc0"], st["rc1"]
@@ -302,8 +291,8 @@ def decode_parser(
         stack = st["stack"]
         # pop then (maybe) push
         sp_after_pop = jnp.where(pop, sp - 1, sp)
-        stack = stack.at[bidx, jnp.clip(sp_after_pop, 0, Tlen)].set(
-            jnp.where(push, b0, stack[bidx, jnp.clip(sp_after_pop, 0, Tlen)])
+        stack = stack.at[nidx, jnp.clip(sp_after_pop, 0, Tlen)].set(
+            jnp.where(push, b0, stack[nidx, jnp.clip(sp_after_pop, 0, Tlen)])
         )
         sp_new = jnp.where(push, sp_after_pop + 1, sp_after_pop)
         buf_new = jnp.where(is_shift | is_ra, st["buf"] + 1, st["buf"])
@@ -319,25 +308,124 @@ def decode_parser(
             "rc1": rc1,
         }
 
+    return {
+        "init": init_state,
+        "features": features,
+        "valid_mask": valid_mask,
+        "apply_action": apply_action,
+    }
+
+
+def decode_parser(
+    fns: ParserModelFns,
+    upper_params: Dict[str, Any],
+    X: jnp.ndarray,
+    lengths: jnp.ndarray,
+    n_labels: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy arc-eager decode on device.
+
+    X [B, T, D] tok2vec output; lengths [B] true lengths.
+    Returns (heads [B, T] int32 with ROOT as self-index, labels [B, T]).
+    """
+    B, Tlen, D = X.shape
+    n_act = fns.n_actions
+    NEG = jnp.float32(-1e9)
+    m = _arc_eager_machine(Tlen, lengths, n_labels, n_act)
+
     def body(st, _):
         done = (st["buf"] >= lengths) & (st["sp"] == 0)
-        feats = features(st)  # [B, 12]
+        feats = m["features"](st)  # [B, 12]
         vecs = _gather(X, feats[:, None, :])  # [B, 1, F, D]
         flat = vecs.reshape(B, fns.n_feats * fns.width)
         logits = fns.logits(upper_params, flat)  # [B, nA]
-        mask = valid_mask(st)
+        mask = m["valid_mask"](st)
         masked = jnp.where(mask, logits, NEG)
         action = jnp.argmax(masked, axis=-1).astype(jnp.int32)
-        st = apply_action(st, action, ~done)
+        st = m["apply_action"](st, action, ~done)
         return st, None
 
     n_steps = 2 * Tlen + 2
-    final, _ = jax.lax.scan(body, init_state(), None, length=n_steps)
+    final, _ = jax.lax.scan(body, m["init"](), None, length=n_steps)
     heads = final["heads"]
     # ROOT (-1) and never-attached (-2) -> self (Doc convention)
     self_idx = jnp.arange(Tlen)[None, :].repeat(B, axis=0)
     heads = jnp.where(heads < 0, self_idx, heads)
     return heads, final["labels"]
+
+
+def decode_parser_beam(
+    fns: ParserModelFns,
+    upper_params: Dict[str, Any],
+    X: jnp.ndarray,
+    lengths: jnp.ndarray,
+    n_labels: int,
+    beam_width: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Beam-search arc-eager decode (scored by summed action log-probs).
+
+    The reference ecosystem's parser offers beam alongside greedy; here the
+    beam lives as an extra leading dim on the same vectorized state machine
+    — states flattened to [B*K], top-k re-selection per step, all under one
+    ``lax.scan``.
+    """
+    K = int(beam_width)
+    if K <= 1:
+        return decode_parser(fns, upper_params, X, lengths, n_labels)
+    B, Tlen, D = X.shape
+    n_act = fns.n_actions
+    NEG = jnp.float32(-1e9)
+    lengths_n = jnp.repeat(lengths, K)  # [B*K]
+    m = _arc_eager_machine(Tlen, lengths_n, n_labels, n_act)
+    bidx = jnp.arange(B)
+
+    def gather_beams(st, beam_idx):
+        """beam_idx [B, K] source-beam per new slot -> reindexed state."""
+        flat_src = (bidx[:, None] * K + beam_idx).reshape(-1)  # [B*K]
+
+        return jax.tree_util.tree_map(lambda a: a[flat_src], st)
+
+    def body(carry, _):
+        st, scores = carry  # scores [B, K]
+        done = ((st["buf"] >= lengths_n) & (st["sp"] == 0)).reshape(B, K)
+        feats = m["features"](st)  # [B*K, F]
+        # gather against the UN-replicated X: beams of one sentence share it,
+        # so fold the beam dim into the feature dim instead of copying X K
+        # times ([B, K*F] gather -> [B*K, F, D])
+        vecs = _gather(X, feats.reshape(B, K * fns.n_feats))
+        flat = vecs.reshape(B * K, fns.n_feats * fns.width)
+        logits = fns.logits(upper_params, flat)
+        mask = m["valid_mask"](st)
+        masked = jnp.where(mask, logits.astype(jnp.float32), NEG)
+        logp = jax.nn.log_softmax(masked, axis=-1).reshape(B, K, n_act)
+        logp = jnp.where(mask.reshape(B, K, n_act), logp, NEG)
+        cand = scores[:, :, None] + logp  # [B, K, nA]
+        # finished beams contribute exactly ONE candidate (no-op, action 0)
+        # carrying their score forward
+        noop = jnp.full((B, K, n_act), NEG)
+        noop = noop.at[:, :, 0].set(scores)
+        cand = jnp.where(done[:, :, None], noop, cand)
+        flat_cand = cand.reshape(B, K * n_act)
+        new_scores, top = jax.lax.top_k(flat_cand, K)  # [B, K]
+        src_beam = (top // n_act).astype(jnp.int32)
+        action = (top % n_act).astype(jnp.int32)
+        st = gather_beams(st, src_beam)
+        done_sel = jnp.take_along_axis(done, src_beam, axis=1).reshape(-1)
+        st = m["apply_action"](st, action.reshape(-1), ~done_sel)
+        return (st, new_scores), None
+
+    init_scores = jnp.full((B, K), NEG).at[:, 0].set(0.0)  # identical-beam fix
+    n_steps = 2 * Tlen + 2
+    (final, scores), _ = jax.lax.scan(
+        body, (m["init"](), init_scores), None, length=n_steps
+    )
+    best = jnp.argmax(scores, axis=1)  # [B]
+    flat_best = bidx * K + best
+    heads = final["heads"][flat_best]
+    labels = final["labels"][flat_best]
+    self_idx = jnp.arange(Tlen)[None, :].repeat(B, axis=0)
+    heads = jnp.where(heads < 0, self_idx, heads)
+    return heads, labels
 
 
 def decode_biluo_viterbi(

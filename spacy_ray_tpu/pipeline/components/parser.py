@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from ...registry import registry
 from ...models.core import Context, Params
-from ...models.parser import decode_parser
+from ...models.parser import decode_parser, decode_parser_beam
 from ...pipeline import transition as T
 from ...pipeline.doc import Doc, Example
 from ...types import Padded, TokenBatch
@@ -29,6 +29,10 @@ from .base import Component
 
 
 class ParserComponent(Component):
+    def __init__(self, name, model_cfg, beam_width: int = 1):
+        super().__init__(name, model_cfg)
+        self.beam_width = int(beam_width)
+
     def add_labels_from(self, examples) -> None:
         labels = set(self.labels)
         for eg in examples:
@@ -115,9 +119,15 @@ class ParserComponent(Component):
             tok2vec = self.model.layers[0]
             t2v = tok2vec.apply(params.get("tok2vec", {}), inputs, ctx)
         lengths = jnp.sum(t2v.mask.astype(jnp.int32), axis=1)
-        heads, labels = decode_parser(
-            fns, params["upper"], t2v.X, lengths, len(self.labels)
-        )
+        if self.beam_width > 1:
+            heads, labels = decode_parser_beam(
+                fns, params["upper"], t2v.X, lengths, len(self.labels),
+                self.beam_width,
+            )
+        else:
+            heads, labels = decode_parser(
+                fns, params["upper"], t2v.X, lengths, len(self.labels)
+            )
         return {"heads": heads, "labels": labels}
 
     def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
@@ -159,5 +169,7 @@ class ParserComponent(Component):
 
 
 @registry.factories("parser")
-def make_parser(name: str, model: Dict[str, Any]) -> ParserComponent:
-    return ParserComponent(name, model)
+def make_parser(
+    name: str, model: Dict[str, Any], beam_width: int = 1
+) -> ParserComponent:
+    return ParserComponent(name, model, beam_width=beam_width)

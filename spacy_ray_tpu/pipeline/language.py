@@ -358,10 +358,16 @@ class Pipeline:
     ) -> List[Doc]:
         params = params if params is not None else self.params
         assert params is not None, "Pipeline not initialized"
-        if self._jit_forward is None:
-            # cache so repeated evaluate() calls hit jit's compile cache
-            self._jit_forward = jax.jit(self.make_forward_fn())
-        forward = self._jit_forward
+        # cache keyed on decode-affecting component settings, so e.g.
+        # changing parser.beam_width or ner.decode takes effect immediately
+        decode_sig = tuple(
+            (name, getattr(self.components[name], "beam_width", None),
+             getattr(self.components[name], "decode", None))
+            for name in self.pipe_names
+        )
+        if self._jit_forward is None or self._jit_forward[0] != decode_sig:
+            self._jit_forward = (decode_sig, jax.jit(self.make_forward_fn()))
+        forward = self._jit_forward[1]
         for start in range(0, len(docs), batch_size):
             chunk = docs[start : start + batch_size]
             examples = [Example.from_gold(d) for d in chunk]

@@ -1,0 +1,91 @@
+"""Beam-search parser decode tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.models.parser import decode_parser, decode_parser_beam
+from spacy_ray_tpu.pipeline.language import Pipeline
+from spacy_ray_tpu.util import synth_corpus
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    import optax
+
+    from pathlib import Path
+    import re
+
+    cfg_text = (Path(__file__).parent / "test_parser.py").read_text()
+
+    cfg = Config.from_str(re.search(r'PARSER_CFG = """(.*?)"""', cfg_text, re.S).group(1))
+    nlp = Pipeline.from_config(cfg)
+    examples = synth_corpus(300, "parser", seed=0)
+    nlp.initialize(lambda: iter(examples), seed=0)
+    grad_loss = jax.jit(
+        jax.value_and_grad(lambda p, t, g, r: nlp.make_loss_fn()(p, t, g, r)[0])
+    )
+    tx = optax.adam(2e-3)
+    params = nlp.params
+    opt = tx.init(params)
+    rng = jax.random.PRNGKey(0)
+    for step in range(40):
+        batch = nlp.collate(examples[(step * 32) % 256 : (step * 32) % 256 + 32])
+        rng, sub = jax.random.split(rng)
+        loss, grads = grad_loss(params, batch["tokens"], batch["targets"], sub)
+        updates, opt = tx.update(grads, opt)
+        params = optax.apply_updates(params, updates)
+    nlp.params = params
+    return nlp
+
+
+def _decode_both(nlp, dev, beam_width):
+    comp = nlp.components["parser"]
+    comp.beam_width = beam_width
+    nlp._jit_forward = None
+    return nlp.evaluate(dev)
+
+
+def test_beam_width_1_equals_greedy(trained):
+    nlp = trained
+    comp = nlp.components["parser"]
+    fns = comp.model.meta["fns"]
+    batch = nlp.collate(synth_corpus(8, "parser", seed=9)[:8], with_targets=False)
+    t2v = nlp.components["tok2vec"].forward(
+        nlp.params["tok2vec"], batch["tokens"], None
+    )
+    lengths = jnp.sum(t2v.mask.astype(jnp.int32), axis=1)
+    h1, l1 = decode_parser(fns, nlp.params["parser"]["upper"], t2v.X, lengths, len(comp.labels))
+    h2, l2 = decode_parser_beam(
+        fns, nlp.params["parser"]["upper"], t2v.X, lengths, len(comp.labels), 1
+    )
+    np.testing.assert_array_equal(np.asarray(h1), np.asarray(h2))
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+def test_beam_width_change_invalidates_forward_cache(trained):
+    """Changing beam_width between evaluates must take effect without
+    touching private pipeline state."""
+    nlp = trained
+    dev = synth_corpus(6, "parser", seed=13)
+    nlp.components["parser"].beam_width = 1
+    nlp.evaluate(dev)
+    sig_before = nlp._jit_forward[0]
+    nlp.components["parser"].beam_width = 4
+    nlp.evaluate(synth_corpus(6, "parser", seed=13))
+    assert nlp._jit_forward[0] != sig_before
+
+
+def test_beam_4_structurally_valid_and_not_worse(trained):
+    nlp = trained
+    dev = synth_corpus(40, "parser", seed=11)
+    s_greedy = _decode_both(nlp, dev, 1)
+    dev2 = synth_corpus(40, "parser", seed=11)
+    s_beam = _decode_both(nlp, dev2, 4)
+    # beam explores strictly more; on a well-trained model allow tiny slack
+    assert s_beam["dep_uas"] >= s_greedy["dep_uas"] - 0.02, (s_beam, s_greedy)
+    for eg in dev2:
+        n = len(eg.predicted)
+        assert all(0 <= h < n for h in eg.predicted.heads)
