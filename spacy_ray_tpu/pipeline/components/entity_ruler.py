@@ -1,0 +1,195 @@
+"""Entity ruler: pattern-based entity annotation (host side).
+
+Capability parity with spaCy's ``entity_ruler`` pipe (rule engine; pure
+host-side preprocessing per the SURVEY.md §2.3 host/device split). Patterns:
+
+* phrase patterns: ``{"label": "ORG", "pattern": "Acme Corp"}`` — the phrase
+  is run through the pipeline tokenizer and matched case-SENSITIVELY on the
+  token sequence (use a token pattern with ``LOWER`` for case-insensitive)
+* token patterns: ``{"label": "CITY", "pattern": [{"LOWER": "new"},
+  {"LOWER": "york"}]}`` — each dict constrains one token: TEXT, LOWER,
+  IS_DIGIT, IS_ALPHA, SHAPE, and OP ("?", "*", "+") for optional/repeats
+
+Longest match wins; overlapping matches resolved left-to-right longest-first.
+``overwrite_ents`` controls whether rule matches replace model entities or
+only fill unclaimed tokens. Patterns serialize with the pipeline
+(components.json).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ...registry import registry
+from ...pipeline.doc import Doc, Example, Span
+from ...pipeline.tokenizer import Tokenizer
+from ...pipeline.vocab import shape_of
+from .base import Component
+
+_PATTERN_TOKENIZER = Tokenizer()  # stateless; shared for phrase patterns
+
+
+def _token_matches(constraint: Dict[str, Any], word: str) -> bool:
+    for key, want in constraint.items():
+        if key == "OP":
+            continue
+        if key == "TEXT":
+            ok = word == want
+        elif key == "LOWER":
+            ok = word.lower() == want
+        elif key == "IS_DIGIT":
+            ok = word.isdigit() == bool(want)
+        elif key == "IS_ALPHA":
+            ok = word.isalpha() == bool(want)
+        elif key == "IS_TITLE":
+            ok = word.istitle() == bool(want)
+        elif key == "SHAPE":
+            ok = shape_of(word) == want
+        else:
+            raise ValueError(f"Unsupported token-pattern key {key!r}")
+        if not ok:
+            return False
+    return True
+
+
+def _match_token_pattern(
+    pattern: List[Dict[str, Any]], words: List[str], start: int
+) -> Optional[int]:
+    """Match `pattern` at `start`; returns end index (exclusive) of the
+    LONGEST match or None. Supports OP: "?", "*", "+" per token constraint."""
+
+    def rec(pi: int, wi: int) -> Optional[int]:
+        if pi == len(pattern):
+            return wi
+        tok = pattern[pi]
+        op = tok.get("OP", "1")
+        if op == "1":
+            if wi < len(words) and _token_matches(tok, words[wi]):
+                return rec(pi + 1, wi + 1)
+            return None
+        if op == "?":
+            if wi < len(words) and _token_matches(tok, words[wi]):
+                longer = rec(pi + 1, wi + 1)
+                if longer is not None:
+                    return longer
+            return rec(pi + 1, wi)
+        if op in ("*", "+"):
+            # greedy: consume as many as possible, then backtrack
+            max_wi = wi
+            while max_wi < len(words) and _token_matches(tok, words[max_wi]):
+                max_wi += 1
+            min_needed = wi + 1 if op == "+" else wi
+            for end in range(max_wi, min_needed - 1, -1):
+                if op == "+" and end == wi:
+                    break
+                got = rec(pi + 1, end)
+                if got is not None:
+                    return got
+            return None
+        raise ValueError(f"Unsupported OP {op!r}")
+
+    return rec(0, start)
+
+
+class EntityRulerComponent(Component):
+    trainable = False
+    listens = False
+
+    def __init__(
+        self,
+        name: str,
+        model_cfg: Optional[Dict[str, Any]] = None,
+        patterns: Optional[List[Dict[str, Any]]] = None,
+        overwrite_ents: bool = False,
+    ):
+        super().__init__(name, model_cfg or {})
+        self.patterns: List[Dict[str, Any]] = list(patterns or [])
+        self.overwrite_ents = overwrite_ents
+
+    def add_patterns(self, patterns: Iterable[Dict[str, Any]]) -> None:
+        self.patterns.extend(patterns)
+        self.finish_labels()
+
+    # host-only
+    def build_model(self):
+        self.model = None
+        return None
+
+    def init_params(self, rng):
+        return {}
+
+    def add_labels_from(self, examples) -> None:
+        pass
+
+    def finish_labels(self) -> None:
+        self.labels = sorted({p["label"] for p in self.patterns})
+
+    def _find_matches(self, words: List[str]) -> List[Span]:
+        matches: List[Tuple[int, int, str]] = []
+        for pat in self.patterns:
+            label = pat["label"]
+            pattern = pat["pattern"]
+            if isinstance(pattern, str):
+                # tokenize the phrase the same way docs are tokenized, so
+                # phrases with punctuation ("U.S.", "Coca-Cola") can match
+                pattern = [
+                    {"TEXT": w} for w in _PATTERN_TOKENIZER(pattern).words
+                ]
+            for start in range(len(words)):
+                end = _match_token_pattern(pattern, words, start)
+                if end is not None and end > start:
+                    matches.append((start, end, label))
+        # longest-first, then leftmost; drop overlaps
+        matches.sort(key=lambda m: (-(m[1] - m[0]), m[0]))
+        taken = [False] * len(words)
+        out: List[Span] = []
+        for start, end, label in matches:
+            if any(taken[start:end]):
+                continue
+            for i in range(start, end):
+                taken[i] = True
+            out.append(Span(start, end, label))
+        out.sort(key=lambda s: s.start)
+        return out
+
+    def forward(self, params, inputs, ctx):
+        return None  # host-side only
+
+    def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
+        for doc in docs:
+            matches = self._find_matches(doc.words)
+            if self.overwrite_ents:
+                primary, secondary = matches, doc.ents  # rules win
+            else:
+                primary, secondary = doc.ents, matches  # model ents win
+            claimed = {i for e in primary for i in range(e.start, e.end)}
+            merged = list(primary) + [
+                m
+                for m in secondary
+                if not (set(range(m.start, m.end)) & claimed)
+            ]
+            doc.ents = sorted(merged, key=lambda s: s.start)
+
+    def score(self, examples: List[Example]) -> Dict[str, float]:
+        return {}
+
+    # serialization (components.json)
+    def table_data(self) -> Dict[str, Any]:
+        return {"patterns": self.patterns, "overwrite_ents": self.overwrite_ents}
+
+    def load_table_data(self, data: Dict[str, Any]) -> None:
+        self.patterns = list(data.get("patterns", []))
+        self.overwrite_ents = bool(data.get("overwrite_ents", False))
+        self.finish_labels()
+
+
+@registry.factories("entity_ruler")
+def make_entity_ruler(
+    name: str,
+    model: Optional[Dict[str, Any]] = None,
+    patterns: Optional[List[Dict[str, Any]]] = None,
+    overwrite_ents: bool = False,
+) -> EntityRulerComponent:
+    return EntityRulerComponent(
+        name, model, patterns=patterns, overwrite_ents=overwrite_ents
+    )

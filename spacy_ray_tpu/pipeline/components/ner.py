@@ -124,7 +124,20 @@ class NERComponent(Component):
         for i, doc in enumerate(docs):
             n = lengths[i]
             tags = [action_to_biluo(int(a), self.labels) for a in actions[i, :n]]
-            doc.ents = Doc.spans_from_biluo(tags)
+            model_ents = Doc.spans_from_biluo(tags)
+            if doc.ents:
+                # respect entities preset by earlier components (e.g. an
+                # entity_ruler placed before ner, spaCy semantics): keep
+                # them and add only non-overlapping model entities
+                claimed = {j for e in doc.ents for j in range(e.start, e.end)}
+                model_ents = [
+                    m
+                    for m in model_ents
+                    if not (set(range(m.start, m.end)) & claimed)
+                ]
+                doc.ents = sorted(doc.ents + model_ents, key=lambda s: s.start)
+            else:
+                doc.ents = model_ents
 
     def score(self, examples: List[Example]) -> Dict[str, float]:
         tp = fp = fn = 0

@@ -1,0 +1,136 @@
+"""Entity ruler: phrase/token patterns, OP quantifiers, model-ent merging."""
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.pipeline.components.entity_ruler import (
+    EntityRulerComponent,
+    _match_token_pattern,
+)
+from spacy_ray_tpu.pipeline.doc import Doc, Span
+from spacy_ray_tpu.pipeline.language import Pipeline
+
+
+def _ruler(patterns, **kw):
+    return EntityRulerComponent("entity_ruler", None, patterns=patterns, **kw)
+
+
+def test_phrase_pattern():
+    r = _ruler([{"label": "ORG", "pattern": "Acme Corp"}])
+    doc = Doc(words=["I", "work", "at", "Acme", "Corp", "now"])
+    r.set_annotations([doc], None, [6])
+    assert [(s.start, s.end, s.label) for s in doc.ents] == [(3, 5, "ORG")]
+
+
+def test_token_pattern_with_ops():
+    pat = [{"LOWER": "new"}, {"LOWER": "york"}, {"LOWER": "city", "OP": "?"}]
+    assert _match_token_pattern(pat, ["New", "York", "City"], 0) == 3  # longest
+    assert _match_token_pattern(pat, ["new", "york", "state"], 0) == 2
+    assert _match_token_pattern(pat, ["old", "york"], 0) is None
+    plus = [{"IS_DIGIT": True, "OP": "+"}]
+    assert _match_token_pattern(plus, ["12", "34", "x"], 0) == 2
+    assert _match_token_pattern(plus, ["x"], 0) is None
+
+
+def test_longest_match_wins_and_no_overlap():
+    r = _ruler(
+        [
+            {"label": "SHORT", "pattern": "New York"},
+            {"label": "LONG", "pattern": [{"LOWER": "new"}, {"LOWER": "york"}, {"LOWER": "city"}]},
+        ]
+    )
+    doc = Doc(words=["New", "York", "City"])
+    r.set_annotations([doc], None, [3])
+    assert [(s.start, s.end, s.label) for s in doc.ents] == [(0, 3, "LONG")]
+
+
+def test_merge_with_model_ents():
+    r = _ruler([{"label": "ORG", "pattern": "Acme Corp"}])
+    doc = Doc(words=["Acme", "Corp", "hired", "Alice"])
+    doc.ents = [Span(0, 1, "PERSON"), Span(3, 4, "PERSON")]  # model output
+    r.set_annotations([doc], None, [4])
+    # model ents win by default: overlapping rule match dropped
+    assert [(s.start, s.end, s.label) for s in doc.ents] == [
+        (0, 1, "PERSON"),
+        (3, 4, "PERSON"),
+    ]
+    r2 = _ruler([{"label": "ORG", "pattern": "Acme Corp"}], overwrite_ents=True)
+    doc2 = Doc(words=["Acme", "Corp", "hired", "Alice"])
+    doc2.ents = [Span(0, 1, "PERSON"), Span(3, 4, "PERSON")]
+    r2.set_annotations([doc2], None, [4])
+    assert [(s.start, s.end, s.label) for s in doc2.ents] == [
+        (0, 2, "ORG"),
+        (3, 4, "PERSON"),
+    ]
+
+
+def test_in_pipeline_and_serializes(tmp_path):
+    cfg = Config.from_str(
+        """
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger","entity_ruler"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 32
+depth = 1
+embed_size = 128
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 32
+
+[components.entity_ruler]
+factory = "entity_ruler"
+patterns = [{"label": "ORG", "pattern": "Acme Corp"}]
+"""
+    )
+    nlp = Pipeline.from_config(cfg)
+    from spacy_ray_tpu.pipeline.doc import Example
+
+    gold = [Example.from_gold(Doc(words=["a", "b"], tags=["X", "Y"]))]
+    nlp.initialize(lambda: iter(gold), seed=0)
+    doc = nlp("we visited Acme Corp today")
+    assert [(s.start, s.end, s.label) for s in doc.ents] == [(2, 4, "ORG")]
+    nlp.to_disk(tmp_path / "m")
+    reloaded = Pipeline.from_disk(tmp_path / "m")
+    doc2 = reloaded("we visited Acme Corp today")
+    assert [(s.start, s.end, s.label) for s in doc2.ents] == [(2, 4, "ORG")]
+
+
+def test_phrase_with_punctuation_matches():
+    r = _ruler([{"label": "GPE", "pattern": "U.S."}])
+    # doc tokenized the same way the pattern is
+    from spacy_ray_tpu.pipeline.tokenizer import Tokenizer
+
+    doc = Tokenizer()("Made in the U.S. today")
+    r.set_annotations([doc], None, [len(doc)])
+    assert any(s.label == "GPE" for s in doc.ents), doc.ents
+
+
+def test_ner_respects_preset_entities():
+    """ruler-before-ner order: NER must not clobber preset entities."""
+    from spacy_ray_tpu.pipeline.components.ner import NERComponent
+
+    comp = NERComponent("ner", {"@architectures": "spacy.TransitionBasedParser.v2",
+                                 "state_type": "ner"})
+    comp.labels = ["ORG"]
+    doc = Doc(words=["Acme", "Corp", "hired", "Alice"])
+    doc.ents = [Span(0, 2, "PRODUCT")]  # preset by an earlier ruler
+    import numpy as np
+
+    # model predicts B-ORG L-ORG O U-ORG (overlapping + new)
+    actions = np.array([[1, 3, 0, 4]])
+    comp.set_annotations([doc], {"actions": actions}, [4])
+    assert [(s.start, s.end, s.label) for s in doc.ents] == [
+        (0, 2, "PRODUCT"),  # preset kept
+        (3, 4, "ORG"),  # non-overlapping model ent added
+    ]
