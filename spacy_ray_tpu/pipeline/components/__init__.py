@@ -10,3 +10,4 @@ from . import spancat  # noqa: F401
 from . import token_classifiers  # noqa: F401
 from . import lemmatizer  # noqa: F401
 from . import entity_ruler  # noqa: F401
+from . import attribute_ruler  # noqa: F401

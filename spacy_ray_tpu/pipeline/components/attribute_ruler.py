@@ -1,0 +1,135 @@
+"""Attribute ruler: pattern-triggered token attribute overrides (host side).
+
+Capability parity with spaCy's ``attribute_ruler`` pipe (rule engine for
+token-level exceptions — e.g. force TAG/POS/LEMMA/MORPH on specific
+constructions after the statistical components run). Pure host-side; shares
+the token-pattern matcher with the entity_ruler.
+
+Pattern entries: ``{"patterns": [[{"LOWER": "who"}], [{"LOWER": "whom"}]],
+"attrs": {"TAG": "PRON", "LEMMA": "who"}, "index": 0}`` — every match of any
+listed token pattern sets the attrs on the matched token at ``index``
+(supports negative indices into the match, spaCy semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+from ...registry import registry
+from ...pipeline.doc import Doc, Example
+from .base import Component
+from .entity_ruler import _match_token_pattern, validate_token_patterns
+
+_ATTR_FIELDS = {
+    "TAG": "tags",
+    "POS": "pos",
+    "LEMMA": "lemmas",
+    "MORPH": "morphs",
+}
+
+
+class AttributeRulerComponent(Component):
+    trainable = False
+    listens = False
+
+    def __init__(
+        self,
+        name: str,
+        model_cfg: Optional[Dict[str, Any]] = None,
+        patterns: Optional[List[Dict[str, Any]]] = None,
+    ):
+        super().__init__(name, model_cfg or {})
+        self.patterns: List[Dict[str, Any]] = []
+        if patterns:
+            self.add_patterns(patterns)
+
+    @staticmethod
+    def _validate(patterns: Iterable[Dict[str, Any]]) -> None:
+        """Fail at CONFIG time, not at the first matching token."""
+        for rule in patterns:
+            for attr in rule.get("attrs", {}):
+                if attr.upper() not in _ATTR_FIELDS:
+                    raise ValueError(
+                        f"Unsupported attribute {attr!r}; "
+                        f"supported: {sorted(_ATTR_FIELDS)}"
+                    )
+            validate_token_patterns(rule.get("patterns", []))
+
+    def add_patterns(self, patterns: Iterable[Dict[str, Any]]) -> None:
+        patterns = list(patterns)
+        self._validate(patterns)
+        self.patterns.extend(patterns)
+
+    # host-only
+    def build_model(self):
+        self.model = None
+        return None
+
+    def init_params(self, rng):
+        return {}
+
+    def add_labels_from(self, examples) -> None:
+        pass
+
+    def finish_labels(self) -> None:
+        self.labels = []
+
+    def forward(self, params, inputs, ctx):
+        return None
+
+    @staticmethod
+    def _ensure_field(doc: Doc, field: str) -> List[str]:
+        values = getattr(doc, field)
+        if values is None:
+            values = [""] * len(doc)
+            setattr(doc, field, values)
+        return values
+
+    def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
+        for doc in docs:
+            for rule in self.patterns:
+                # attrs pre-validated at config time: resolve fields once
+                field_values = [
+                    (_ATTR_FIELDS[attr.upper()], value)
+                    for attr, value in rule.get("attrs", {}).items()
+                ]
+                index = int(rule.get("index", 0))
+                for pattern in rule.get("patterns", []):
+                    for start in range(len(doc.words)):
+                        end = _match_token_pattern(pattern, doc.words, start)
+                        if end is None or end <= start:
+                            continue
+                        span_len = end - start
+                        ti = index if index >= 0 else span_len + index
+                        if not (0 <= ti < span_len):
+                            # spaCy raises for out-of-range index (E1001);
+                            # a silent skip would hide rule typos
+                            raise ValueError(
+                                f"attribute_ruler rule index {index} is out "
+                                f"of range for a {span_len}-token match at "
+                                f"tokens {start}:{end}"
+                            )
+                        tok = start + ti
+                        for field, value in field_values:
+                            self._ensure_field(doc, field)[tok] = value
+
+    def score(self, examples: List[Example]) -> Dict[str, float]:
+        return {}
+
+    # serialization (components.json)
+    def table_data(self) -> Dict[str, Any]:
+        return {"patterns": self.patterns}
+
+    def load_table_data(self, data: Dict[str, Any]) -> None:
+        patterns = list(data.get("patterns", []))
+        self._validate(patterns)
+        self.patterns = patterns
+
+
+@registry.factories("attribute_ruler")
+def make_attribute_ruler(
+    name: str,
+    model: Optional[Dict[str, Any]] = None,
+    patterns: Optional[List[Dict[str, Any]]] = None,
+) -> AttributeRulerComponent:
+    return AttributeRulerComponent(name, model, patterns=patterns)

@@ -28,6 +28,29 @@ from .base import Component
 
 _PATTERN_TOKENIZER = Tokenizer()  # stateless; shared for phrase patterns
 
+SUPPORTED_TOKEN_KEYS = ("TEXT", "LOWER", "IS_DIGIT", "IS_ALPHA", "IS_TITLE", "SHAPE", "OP")
+SUPPORTED_OPS = ("1", "?", "*", "+")
+
+
+def validate_token_patterns(patterns) -> None:
+    """Config-time validation of token-pattern lists (key + OP names);
+    shared by entity_ruler and attribute_ruler so misconfigured rules fail
+    before training/inference rather than at the first matching token."""
+    for pattern in patterns:
+        if isinstance(pattern, str):
+            continue
+        for tok in pattern:
+            for key in tok:
+                if key not in SUPPORTED_TOKEN_KEYS:
+                    raise ValueError(
+                        f"Unsupported token-pattern key {key!r}; "
+                        f"supported: {sorted(SUPPORTED_TOKEN_KEYS)}"
+                    )
+            if str(tok.get("OP", "1")) not in SUPPORTED_OPS:
+                raise ValueError(
+                    f"Unsupported OP {tok.get('OP')!r}; supported: {SUPPORTED_OPS}"
+                )
+
 
 def _token_matches(constraint: Dict[str, Any], word: str) -> bool:
     for key, want in constraint.items():
@@ -103,10 +126,14 @@ class EntityRulerComponent(Component):
         overwrite_ents: bool = False,
     ):
         super().__init__(name, model_cfg or {})
-        self.patterns: List[Dict[str, Any]] = list(patterns or [])
+        self.patterns: List[Dict[str, Any]] = []
         self.overwrite_ents = overwrite_ents
+        if patterns:
+            self.add_patterns(patterns)
 
     def add_patterns(self, patterns: Iterable[Dict[str, Any]]) -> None:
+        patterns = list(patterns)
+        validate_token_patterns(p["pattern"] for p in patterns)
         self.patterns.extend(patterns)
         self.finish_labels()
 

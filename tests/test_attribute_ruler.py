@@ -1,0 +1,80 @@
+"""Attribute ruler tests."""
+
+import pytest
+
+from spacy_ray_tpu.pipeline.components.attribute_ruler import AttributeRulerComponent
+from spacy_ray_tpu.pipeline.doc import Doc
+
+
+def test_sets_attrs_on_indexed_token():
+    r = AttributeRulerComponent(
+        "ar",
+        patterns=[
+            {
+                "patterns": [[{"LOWER": "who"}], [{"LOWER": "whom"}]],
+                "attrs": {"TAG": "PRON", "LEMMA": "who"},
+            },
+            {
+                "patterns": [[{"LOWER": "new"}, {"LOWER": "york"}]],
+                "attrs": {"TAG": "PROPN"},
+                "index": -1,  # last token of the match
+            },
+        ],
+    )
+    doc = Doc(words=["Whom", "did", "New", "York", "call"],
+              tags=["X", "VERB", "X", "X", "VERB"])
+    r.set_annotations([doc], None, [5])
+    assert doc.tags == ["PRON", "VERB", "X", "PROPN", "VERB"]
+    assert doc.lemmas[0] == "who"
+    assert doc.lemmas[1] == ""  # untouched fields stay empty
+
+
+def test_unsupported_attr_raises_at_construction():
+    with pytest.raises(ValueError, match="Unsupported attribute"):
+        AttributeRulerComponent(
+            "ar", patterns=[{"patterns": [[{"TEXT": "x"}]], "attrs": {"DEP": "nsubj"}}]
+        )
+
+
+def test_serialization_roundtrip(tmp_path):
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.pipeline.doc import Example
+    from spacy_ray_tpu.pipeline.language import Pipeline
+
+    cfg = Config.from_str(
+        """
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger","attribute_ruler"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 32
+depth = 1
+embed_size = 128
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 32
+
+[components.attribute_ruler]
+factory = "attribute_ruler"
+patterns = [{"patterns": [[{"LOWER": "xyzzy"}]], "attrs": {"TAG": "MAGIC"}}]
+"""
+    )
+    nlp = Pipeline.from_config(cfg)
+    gold = [Example.from_gold(Doc(words=["a", "b"], tags=["A", "B"]))]
+    nlp.initialize(lambda: iter(gold), seed=0)
+    nlp.to_disk(tmp_path / "m")
+    reloaded = Pipeline.from_disk(tmp_path / "m")
+    doc = reloaded("say xyzzy now")
+    assert doc.tags[1] == "MAGIC"
