@@ -1,0 +1,56 @@
+"""Platform selection helpers.
+
+The one safe way to get the CPU platform on this class of image is
+``jax.config.update("jax_platforms", "cpu")`` *before* the backend
+initializes: interpreter boot may import jax with a TPU-tunnel platform
+(e.g. ``JAX_PLATFORMS=axon``) already locked in from the environment, so
+mutating ``os.environ`` in-process is read too late, and a wedged tunnel
+makes backend init hang forever rather than error.
+
+This module is a leaf (no package-relative imports) so callers that must
+run before anything else — test conftests, the driver's multichip dryrun —
+can import it without pulling the full package.
+"""
+
+from __future__ import annotations
+
+
+def force_cpu(n_devices: int = 8) -> None:
+    """Select the CPU platform with at least ``n_devices`` virtual devices.
+
+    Safe to call multiple times and after another caller already forced CPU.
+    Raises (instead of silently proceeding on an accelerator backend) if the
+    jax backend was already initialized on a non-CPU platform — proceeding
+    there would mean hanging on a wedged relay or running a CPU-only check
+    on real hardware.
+
+    Mutates no environment variables, so nothing leaks into subprocesses
+    spawned later (a child that inherited ``JAX_PLATFORMS=cpu`` would
+    silently run its real-hardware work on CPU).
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized; verified below
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except Exception:
+        pass  # already initialized, or jax predates the key; verified below
+
+    backend = jax.default_backend()  # initializes the backend if needed
+    if backend != "cpu":
+        raise RuntimeError(
+            f"force_cpu(): backend is {backend!r}, not 'cpu' — the jax "
+            "backend was already initialized on another platform before "
+            "force_cpu() ran. Call it before any jax device use."
+        )
+    have = len(jax.devices())
+    if have < n_devices:
+        raise RuntimeError(
+            f"force_cpu(): need {n_devices} CPU devices, have {have}. "
+            "The device count was locked in before force_cpu() ran; start "
+            "a fresh process, or set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}."
+        )
