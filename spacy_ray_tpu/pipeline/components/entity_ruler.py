@@ -205,7 +205,11 @@ class EntityRulerComponent(Component):
         return {"patterns": self.patterns, "overwrite_ents": self.overwrite_ents}
 
     def load_table_data(self, data: Dict[str, Any]) -> None:
-        self.patterns = list(data.get("patterns", []))
+        patterns = list(data.get("patterns", []))
+        # a hand-edited/corrupted components.json must fail here, eagerly,
+        # like add_patterns does — not at the first matching token
+        validate_token_patterns(p["pattern"] for p in patterns)
+        self.patterns = patterns
         self.overwrite_ents = bool(data.get("overwrite_ents", False))
         self.finish_labels()
 

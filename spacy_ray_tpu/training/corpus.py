@@ -222,7 +222,13 @@ class Corpus:
                 spaces=doc.spaces[a:b] if doc.spaces else None,
                 tags=doc.tags[a:b] if doc.tags else None,
                 pos=doc.pos[a:b] if doc.pos else None,
-                heads=[min(max(h - a, 0), b - a - 1) for h in doc.heads[a:b]]
+                # a head outside the slice becomes a root (head == self) —
+                # clamping it to the slice edge would fabricate an arc to an
+                # unrelated token and corrupt the gold tree
+                heads=[
+                    h - a if a <= h < b else i
+                    for i, h in enumerate(doc.heads[a:b])
+                ]
                 if doc.heads
                 else None,
                 deps=doc.deps[a:b] if doc.deps else None,

@@ -185,6 +185,7 @@ def train(
     best_step = -1
 
     # ---- resume ----
+    resume_skip = 0  # batches already consumed in the checkpointed epoch
     if resume and output_path is not None:
         ckpt = TrainCheckpoint.load(Path(output_path) / "last-model")
         if ckpt is not None:
@@ -195,6 +196,18 @@ def train(
             rng = ckpt["rng"]
             best_score = ckpt["best_score"]
             best_step = ckpt["best_step"]
+            # exact data-position resume: reproduce the checkpointed epoch's
+            # shuffle order (restore the corpus's own epoch counter — it may
+            # be offset from the loop's epoch by initialize() passes), then
+            # fast-forward past the batches already consumed. On multi-host,
+            # rank 0's position is saved for everyone; per-host epoch
+            # boundaries can drift when shards are unequal, so cross-host
+            # resume is exact for rank 0 and off by at most one batch
+            # elsewhere.
+            resume_skip = int(ckpt["extra"].get("batches_in_epoch", 0))
+            corpus_epoch = ckpt["extra"].get("corpus_epoch")
+            if corpus_epoch is not None and hasattr(train_corpus, "_epoch"):
+                train_corpus._epoch = int(corpus_epoch)
 
     loss_fn = nlp.make_loss_fn()
     update = make_train_step(
@@ -238,19 +251,34 @@ def train(
     process_rank = jax.process_index()
     process_count = jax.process_count()
 
+    batches_in_epoch = 0  # data position within the current epoch
+    stream_corpus_epoch = 0  # corpus._epoch as of the current stream
+
     def batches_forever() -> Iterator[Tuple[int, List[Example]]]:
-        nonlocal epoch
+        nonlocal epoch, batches_in_epoch, stream_corpus_epoch
+        skip = resume_skip
         while True:
+            stream_corpus_epoch = getattr(train_corpus, "_epoch", 0)
             stream = train_corpus()
             if process_count > 1:
                 stream = shard_stream(stream, process_rank, process_count)
             got_any = False
             for b in batcher(stream):
                 got_any = True
+                # batches_in_epoch is the position from the EPOCH START, so
+                # fast-forwarded batches count too — otherwise a checkpoint
+                # written after a resume would record a position relative to
+                # the resume point and a second resume would be inexact
+                batches_in_epoch += 1
+                if skip > 0:  # resume fast-forward within the first epoch
+                    skip -= 1
+                    continue
                 yield epoch, b
             if not got_any:
                 raise ValueError("Training corpus is empty")
+            skip = 0
             epoch += 1
+            batches_in_epoch = 0
             if max_epochs and epoch >= max_epochs:
                 return
 
@@ -308,18 +336,24 @@ def train(
         B_pad = ((B_pad + n_data - 1) // n_data) * n_data
         if process_count > 1:
             # multi-controller SPMD: every host must launch the same program
-            # — sync padded shapes to the all-host max
+            # — sync padded shapes to the all-host max. The same allgather
+            # carries each host's word count: the global batch is the
+            # concatenation of all hosts' rows (place_batch), so the words
+            # consumed this step are the sum over hosts, not local × P.
             from jax.experimental import multihost_utils
 
+            local_words = sum(len(eg) for b in raw_batches for eg in b)
             dims = multihost_utils.process_allgather(
-                np.array([T_pad, B_pad], np.int32)
-            ).reshape(-1, 2)
+                np.array([T_pad, B_pad, local_words], np.int32)
+            ).reshape(-1, 3)
             T_pad = int(dims[:, 0].max())
             B_pad = int(dims[:, 1].max())
+            n_words = int(dims[:, 2].sum())
         collated = [
             nlp.collate(b, pad_batch_to=B_pad, pad_len_to=T_pad) for b in raw_batches
         ]
-        n_words = sum(c["n_words"] for c in collated)
+        if process_count == 1:
+            n_words = sum(c["n_words"] for c in collated)
         if accum == 1:
             tokens, targets = collated[0]["tokens"], collated[0]["targets"]
         else:
@@ -397,9 +431,16 @@ def train(
                     opt_state=host_opt,
                     step=step,
                     epoch=cur_epoch,
-                    rng=sub,
+                    # post-split rng, NOT this step's subkey: resume must
+                    # continue the exact rng chain the uninterrupted run
+                    # would have used
+                    rng=rng,
                     best_score=best_score,
                     best_step=best_step,
+                    extra={
+                        "batches_in_epoch": batches_in_epoch,
+                        "corpus_epoch": stream_corpus_epoch,
+                    },
                 )
         log_step(info)
 

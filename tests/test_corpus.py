@@ -1,0 +1,42 @@
+"""Corpus reader unit tests: doc splitting must preserve gold-tree validity."""
+
+from spacy_ray_tpu.pipeline.doc import Doc
+from spacy_ray_tpu.training.corpus import Corpus
+
+
+def _split_pieces(doc, max_length):
+    c = Corpus.__new__(Corpus)
+    c.max_length = max_length
+    return list(c._split(doc))
+
+
+def test_split_rebases_in_slice_heads_and_roots_cross_slice_arcs():
+    # two sentences; token 3 ("quickly") has its gold head in sentence 1 —
+    # after splitting, that arc leaves the slice and must become a root
+    # (head == self), NOT an arc to the slice's edge token
+    doc = Doc(
+        words=["dogs", "run", ".", "quickly", "they", "move"],
+        heads=[1, 1, 1, 1, 5, 5],  # "quickly" -> "run" (cross-sentence)
+        deps=["nsubj", "ROOT", "punct", "advmod", "nsubj", "ROOT"],
+        sent_starts=[1, 0, 0, 1, 0, 0],
+    )
+    pieces = _split_pieces(doc, max_length=3)
+    assert [p.words for p in pieces] == [["dogs", "run", "."], ["quickly", "they", "move"]]
+    assert pieces[0].heads == [1, 1, 1]
+    # pre-fix behavior clamped head of "quickly" to 0 (arc to itself is the
+    # fix; arc to slice-start was the bug only when the head was BEFORE the
+    # slice; a head AFTER the slice clamped to the last token)
+    assert pieces[1].heads == [0, 2, 2]
+
+
+def test_split_head_after_slice_becomes_root():
+    doc = Doc(
+        words=["a", "b", "c", "d"],
+        heads=[3, 0, 3, 3],  # "a" -> "d": leaves the first hard chunk
+        sent_starts=None,
+    )
+    pieces = _split_pieces(doc, max_length=2)
+    assert [p.words for p in pieces] == [["a", "b"], ["c", "d"]]
+    # "a"'s head (3) is outside slice [0,2) -> root at itself, not clamped to 1
+    assert pieces[0].heads == [0, 0]
+    assert pieces[1].heads == [1, 1]

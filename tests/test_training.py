@@ -103,3 +103,41 @@ def test_frozen_component_not_updated(tagger_config_text, data_dir):
     fresh_leaves = leaves(fresh.params["tok2vec"])
     for a, b in zip(frozen_leaves, fresh_leaves):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+
+
+def test_resume_is_exact(tagger_config_text, data_dir, tmp_path):
+    """Resume must continue the EXACT run: same shuffle order, same data
+    position within the epoch, same rng chain — so straight-through and
+    checkpoint+resume end with identical params (pre-fix, resume replayed
+    the stream from the epoch-0 start and diverged)."""
+    import jax
+
+    over = {
+        "training.eval_frequency": 10,
+        "corpora.train.shuffle": True,
+        "corpora.train.seed": 3,
+    }
+    cfg_a = _config(tagger_config_text, data_dir, **{"training.max_steps": 40, **over})
+    nlp_a, _ = train(cfg_a, output_path=tmp_path / "a", n_workers=1, stdout_log=False)
+
+    cfg_b1 = _config(tagger_config_text, data_dir, **{"training.max_steps": 20, **over})
+    _, rb1 = train(cfg_b1, output_path=tmp_path / "b", n_workers=1, stdout_log=False)
+    assert rb1.final_step == 20
+    cfg_b2 = _config(tagger_config_text, data_dir, **{"training.max_steps": 30, **over})
+    _, rb2 = train(
+        cfg_b2, output_path=tmp_path / "b", n_workers=1, resume=True, stdout_log=False
+    )
+    assert rb2.final_step == 30
+    # second resume: the mid-epoch position saved DURING a resumed run must
+    # be absolute from the epoch start, not relative to the resume point
+    cfg_b3 = _config(tagger_config_text, data_dir, **{"training.max_steps": 40, **over})
+    nlp_b, rb3 = train(
+        cfg_b3, output_path=tmp_path / "b", n_workers=1, resume=True, stdout_log=False
+    )
+    assert rb3.final_step == 40
+
+    la = jax.tree_util.tree_leaves(nlp_a.params)
+    lb = jax.tree_util.tree_leaves(nlp_b.params)
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
