@@ -133,13 +133,38 @@ def make_train_step(
 
 
 def place_batch(batch_tree: Any, mesh: Mesh, accum: bool = False) -> Any:
-    """Device-put batch leaves with the data axis sharded.
+    """Place batch leaves with the batch dim sharded over the ``data`` axis.
 
     Pads are already in the arrays; B must be divisible by the data-axis
     size (the batcher guarantees it via bucket_batch_size + mesh multiple).
+
+    Single-process: a plain sharded device_put (the local array IS the
+    global batch). Multi-process: every host collated a DIFFERENT local
+    batch (the stream is sharded by host in the loop), so device_put with a
+    global sharding would treat each host's array as the same global value
+    and silently drop every row outside that host's global shard slice —
+    most of the corpus. Instead the global batch is assembled with
+    ``jax.make_array_from_process_local_data``: global B = per-host B ×
+    process_count, each host contributing all of its local rows.
     """
+    import numpy as np
+
     sh = NamedSharding(mesh, P(None, "data") if accum else P("data"))
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch_tree)
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch_tree)
+
+    bdim = 1 if accum else 0
+
+    def make_global(x):
+        x = np.asarray(x)
+        global_shape = (
+            x.shape[:bdim]
+            + (x.shape[bdim] * jax.process_count(),)
+            + x.shape[bdim + 1 :]
+        )
+        return jax.make_array_from_process_local_data(sh, x, global_shape)
+
+    return jax.tree_util.tree_map(make_global, batch_tree)
 
 
 def place_replicated(tree: Any, mesh: Mesh) -> Any:

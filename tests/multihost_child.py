@@ -1,0 +1,162 @@
+"""Child process for the real 2-process multi-host test.
+
+Spawned (not imported) by tests/test_multihost.py: each instance is one
+"host" of a 2-process jax.distributed group with 4 local CPU devices
+(8 global). Exercises the multi-host-only paths of the training loop —
+the startup digest assertion, per-step shape sync, collective loop
+termination (training/loop.py) — and place_batch's global-batch assembly
+(parallel/step.py), none of which run under the single-process test
+harness. The reference shipped an untested sync protocol and a silent
+quorum bug with it (SURVEY.md §2.4, §4); this is the guard against
+repeating that one level up.
+
+Usage: python multihost_child.py <rank> <port> <data_dir>
+Prints "CHILD_OK rank=R words=W step=S score=F" on success.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    rank = int(sys.argv[1])
+    port = sys.argv[2]
+    data_dir = sys.argv[3]
+
+    import jax
+
+    # CPU platform must be selected before the backend initializes; env vars
+    # are read too late on this image (see spacy_ray_tpu/devices.py).
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.process_index() == rank
+    assert len(jax.devices()) == 8, len(jax.devices())
+    assert len(jax.local_devices()) == 4
+
+    import numpy as np
+
+    # --- place_batch: the global batch must contain EVERY host's rows, in
+    # host order — not each host's rows sliced at that host's global shard
+    # offsets (the device_put bug this guards against yields
+    # [0..3, 104..107] here instead of [0..3, 100..103]).
+    from spacy_ray_tpu.parallel.mesh import build_mesh
+    from spacy_ray_tpu.parallel.step import place_batch
+
+    mesh = build_mesh(n_data=8)
+    local = (np.arange(4, dtype=np.float32) + 100.0 * rank)[:, None] * np.ones(
+        (1, 3), np.float32
+    )
+    g = place_batch(local, mesh)
+    assert g.shape == (8, 3), g.shape
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    col = jax.jit(
+        lambda x: x[:, 0], out_shardings=NamedSharding(mesh, P())
+    )(g)
+    got = np.asarray(jax.device_get(col))
+    want = np.array([0, 1, 2, 3, 100, 101, 102, 103], np.float32)
+    assert np.array_equal(got, want), f"global batch rows wrong: {got}"
+
+    # --- end-to-end train() across 2 processes ---
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.training.loop import train
+
+    cfg_text = f"""
+[paths]
+train = "{data_dir}/train.jsonl"
+dev = "{data_dir}/dev.jsonl"
+
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger"]
+
+[components]
+[components.tok2vec]
+factory = "tok2vec"
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 32
+depth = 2
+embed_size = 256
+[components.tagger]
+factory = "tagger"
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 32
+
+[corpora]
+[corpora.train]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${{paths.train}}
+[corpora.dev]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${{paths.dev}}
+
+[training]
+seed = 0
+dropout = 0.1
+accumulate_gradient = 1
+patience = 0
+max_epochs = 2
+max_steps = 0
+eval_frequency = 5
+
+[training.optimizer]
+@optimizers = "Adam.v1"
+learn_rate = 0.01
+
+[training.batcher]
+@batchers = "spacy.batch_by_words.v1"
+size = 300
+tolerance = 0.2
+
+[training.score_weights]
+tag_acc = 1.0
+"""
+    nlp, result = train(Config.from_str(cfg_text), stdout_log=False)
+    assert result.final_step > 0
+
+    # SPMD symmetry: every process must have computed identical scores and
+    # word counts (words are a global sum now, not a local count).
+    from jax.experimental import multihost_utils
+
+    stats = multihost_utils.process_allgather(
+        np.array([result.best_score, float(result.words_seen)], np.float64)
+    ).reshape(-1, 2)
+    assert np.allclose(stats[0], stats[1]), f"rank-divergent results: {stats}"
+
+    # Global words/epoch must be ~ the FULL corpus, not the ~half this host
+    # saw locally (the pre-fix accounting), and not x2 (the reference's
+    # estimated scaling, worker.py:310). The last (incomplete) step group
+    # may be dropped at epoch end, hence >=90%.
+    import json
+
+    with open(f"{data_dir}/train.jsonl") as f:
+        corpus_words = sum(
+            len(json.loads(line)["tokens"]) for line in f if line.strip()
+        )
+    expect = 2 * corpus_words  # max_epochs=2
+    assert 0.9 * expect <= result.words_seen <= expect, (
+        f"words_seen={result.words_seen} expected ~{expect} "
+        f"(global sum over hosts, 2 epochs)"
+    )
+
+    print(
+        f"CHILD_OK rank={rank} words={result.words_seen} "
+        f"step={result.final_step} score={result.best_score:.4f}",
+        flush=True,
+    )
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
