@@ -1,42 +1,136 @@
-"""Benchmark: training words/sec/chip on the flagship CNN-tagger pipeline.
+"""Benchmark suite: training words/sec/chip across the BASELINE.json configs.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per benchmark:
+  {"metric", "value", "unit", "vs_baseline", "platform", "devices", "B", "T"}
 
-The reference publishes no numbers (BASELINE.md: "None"), so the baseline is
-the driver-defined nominal in BASELINE.md ("self-measured baseline, then
-scale"): NOMINAL_BASELINE_WPS below is the single-device spaCy-class CNN
-tagger trainer throughput the north star compares against;
-vs_baseline = measured / nominal.
+The reference publishes no numbers (BASELINE.md: "None"), so ``vs_baseline``
+compares against a MEASURED single-device baseline stored in
+``MEASURED_BASELINE.json`` (written by ``python bench.py --measure-baseline``
+on the CPU host; the TPU run then reads it). If no measured entry exists for
+a config, vs_baseline is null.
 
-Workload: BASELINE.json config #1 shape — tagger + HashEmbedCNN tok2vec
-(width 96, depth 4, embed 2000), synthetic corpus, fixed (B, T) so one
-compiled step is reused; full train step (fwd+bwd+Adam) per iteration.
+Benchmarks (BASELINE.json "configs"):
+  cnn_tagger      #1 tagger-only CNN tok2vec (flagship; first line printed)
+  cnn_tagger_e2e  #1 end-to-end variant: host collation + transfer included
+  sm_pipeline     #2 tagger+parser+NER over one shared CNN tok2vec
+  ner_dp          #3 NER, data-parallel over all available devices
+  trf             #4 RoBERTa-base-shape shared transformer + tagger/parser/NER
+  spancat_textcat #5 spancat + textcat_multilabel, large batch
+
+Each measures the full compiled train step (fwd+bwd+Adam, gradient psum over
+the data axis) on a fixed (B, T) bucket; the _e2e variant re-collates a real
+batch stream on the host every step, so it measures the pipeline rate, not
+just chip MFU. Workloads are synthetic (zero-egress image), sized per
+platform so the CPU baseline finishes in minutes while the TPU run uses
+hardware-appropriate batches.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-NOMINAL_BASELINE_WPS = 20_000.0  # single-device spaCy-class CNN tagger trainer
+BASELINE_FILE = Path(__file__).parent / "MEASURED_BASELINE.json"
 
-B, T = 256, 64
-WIDTH, DEPTH, EMBED = 96, 4, 2000
-WARMUP_STEPS = 3
-BENCH_STEPS = 30
+WARMUP = 3
 
 
-def main() -> None:
+def _corpus(kinds: List[str], n: int, seed: int = 0):
+    from spacy_ray_tpu.util import synth_corpus
+
+    per = n // len(kinds)
+    out = []
+    for i, kind in enumerate(kinds):
+        out.extend(synth_corpus(per, kind, seed=seed + i))
+    return out
+
+
+def _configs(platform: str) -> List[Dict[str, Any]]:
+    """Benchmark definitions. B/T are per-platform: the CPU host needs small
+    batches to finish in minutes; accelerators get hardware-sized ones."""
+    from spacy_ray_tpu.presets import (
+        CNN_TAGGER_CFG,
+        INIT_PRESETS,
+    )
+
+    cpu = platform == "cpu"
+    cnn = CNN_TAGGER_CFG.format(width=96, depth=4, embed_size=2000)
+    return [
+        dict(
+            name="cnn_tagger",
+            metric="train_words_per_sec_per_chip (CNN tok2vec tagger, fwd+bwd+Adam)",
+            cfg=cnn, kinds=["tagger"], B=256, T=64, steps=30,
+        ),
+        dict(
+            name="cnn_tagger_e2e",
+            metric="e2e_words_per_sec_per_chip (CNN tagger, host collation included)",
+            cfg=cnn, kinds=["tagger"], B=256, T=64, steps=20, e2e=True,
+        ),
+        dict(
+            name="sm_pipeline",
+            metric="train_words_per_sec_per_chip (sm: tagger+parser+NER, shared CNN)",
+            cfg=INIT_PRESETS["sm"], kinds=["parser", "ner"],
+            B=64 if cpu else 128, T=32, steps=10 if cpu else 20,
+        ),
+        dict(
+            name="ner_dp",
+            metric="train_words_per_sec_per_chip (NER, data-parallel all devices)",
+            cfg=NER_CFG, kinds=["ner"],
+            B=64 if cpu else 256, T=32 if cpu else 64, steps=10 if cpu else 20,
+        ),
+        dict(
+            name="trf",
+            metric="train_words_per_sec_per_chip (trf RoBERTa-base shape + tagger/parser/NER)",
+            cfg=INIT_PRESETS["trf"], kinds=["parser", "ner"],
+            B=4 if cpu else 16, T=32 if cpu else 128,
+            steps=3 if cpu else 10, warmup=1 if cpu else 3,
+        ),
+        dict(
+            name="spancat_textcat",
+            metric="train_words_per_sec_per_chip (spancat + textcat_multilabel, large batch)",
+            cfg=INIT_PRESETS["spancat"], kinds=["spancat", "textcat"],
+            B=64 if cpu else 512, T=32 if cpu else 64,
+            steps=5 if cpu else 15,
+        ),
+    ]
+
+
+NER_CFG = """
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","ner"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 96
+depth = 4
+embed_size = 2000
+
+[components.ner]
+factory = "ner"
+
+[components.ner.model]
+@architectures = "spacy.TransitionBasedParser.v2"
+state_type = "ner"
+hidden_width = 64
+maxout_pieces = 2
+
+[components.ner.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 96
+"""
+
+
+def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
     import jax
-
-    try:  # probe the default platform; fall back to CPU if TPU is unreachable
-        jax.devices()
-    except RuntimeError as e:
-        print(f"# TPU backend unavailable ({e}); falling back to CPU", flush=True)
-        jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
 
     from spacy_ray_tpu.config import Config
     from spacy_ray_tpu.pipeline.language import Pipeline
@@ -48,58 +142,176 @@ def main() -> None:
         shard_opt_state,
     )
     from spacy_ray_tpu.registry import registry
-    from spacy_ray_tpu.util import synth_corpus
 
-    from spacy_ray_tpu.presets import CNN_TAGGER_CFG
+    cfg_text = spec["cfg"]
+    n_chips = len(jax.devices())
+    B = int(spec["B"])
+    B = ((B + n_chips - 1) // n_chips) * n_chips
+    T = int(spec["T"])
+    steps = int(spec["steps"])
+    warmup = int(spec.get("warmup", WARMUP))
 
-    cfg = Config.from_str(
-        CNN_TAGGER_CFG.format(width=WIDTH, depth=DEPTH, embed_size=EMBED)
-    )
-    nlp = Pipeline.from_config(cfg)
-    examples = synth_corpus(2048, "tagger", seed=0)
+    nlp = Pipeline.from_config(Config.from_str(cfg_text))
+    examples = _corpus(spec["kinds"], max(2 * B, 512))
     nlp.initialize(lambda: iter(examples), seed=0)
 
-    n_chips = len(jax.devices())
     mesh = build_mesh(n_data=n_chips)
     tx = registry.get("optimizers", "Adam.v1")(learn_rate=0.001)
     params = place_replicated(nlp.params, mesh)
     opt_state = shard_opt_state(tx.init(params), mesh, zero1=False)
-    update = make_train_step(
-        nlp.make_loss_fn(), tx, mesh, opt_state_template=opt_state
-    )
-
-    # one fixed-shape batch, reused (isolates step time from host collation)
-    chunk = examples[:B]
-    batch = nlp.collate(chunk, pad_batch_to=B, pad_len_to=T)
-    tokens = place_batch(batch["tokens"], mesh)
-    targets = place_batch(batch["targets"], mesh)
-    n_words = int(batch["n_words"])
+    update = make_train_step(nlp.make_loss_fn(), tx, mesh, opt_state_template=opt_state)
 
     rng = jax.random.PRNGKey(0)
-    for _ in range(WARMUP_STEPS):
-        rng, sub = jax.random.split(rng)
-        params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
+
+    if spec.get("e2e"):
+        # end-to-end: re-collate a fresh host batch every step (collation +
+        # host->device transfer are part of the measured rate)
+        chunks = [examples[i : i + B] for i in range(0, len(examples) - B + 1, B)]
+
+        def step_fn(i):
+            nonlocal rng, params, opt_state
+            batch = nlp.collate(chunks[i % len(chunks)], pad_batch_to=B, pad_len_to=T)
+            tokens = place_batch(batch["tokens"], mesh)
+            targets = place_batch(batch["targets"], mesh)
+            rng, sub = jax.random.split(rng)
+            params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
+            return loss, int(batch["n_words"])
+
+    else:
+        batch = nlp.collate(examples[:B], pad_batch_to=B, pad_len_to=T)
+        tokens = place_batch(batch["tokens"], mesh)
+        targets = place_batch(batch["targets"], mesh)
+        fixed_words = int(batch["n_words"])
+
+        def step_fn(i):
+            nonlocal rng, params, opt_state
+            rng, sub = jax.random.split(rng)
+            params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
+            return loss, fixed_words
+
+    for i in range(warmup):
+        loss, _ = step_fn(i)
     jax.block_until_ready(loss)
 
+    total_words = 0
     t0 = time.perf_counter()
-    for _ in range(BENCH_STEPS):
-        rng, sub = jax.random.split(rng)
-        params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
+    for i in range(steps):
+        loss, words = step_fn(i)
+        total_words += words
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
-    wps = n_words * BENCH_STEPS / dt
-    wps_chip = wps / n_chips
-    print(
-        json.dumps(
-            {
-                "metric": "train_words_per_sec_per_chip (CNN tok2vec tagger, fwd+bwd+Adam)",
-                "value": round(wps_chip, 1),
-                "unit": "words/s/chip",
-                "vs_baseline": round(wps_chip / NOMINAL_BASELINE_WPS, 3),
-            }
-        )
+    wps_chip = total_words / dt / n_chips
+    loss_val = float(loss)
+    if not np.isfinite(loss_val):
+        print(f"# {spec['name']}: non-finite loss {loss_val}, discarding", flush=True)
+        return None
+    return {
+        "metric": spec["metric"],
+        "value": round(wps_chip, 1),
+        "unit": "words/s/chip",
+        "platform": platform,
+        "devices": n_chips,
+        "B": B,
+        "T": T,
+        "name": spec["name"],
+    }
+
+
+def _accelerator_reachable(timeout: float = 180.0) -> bool:
+    """Probe the default (accelerator) backend in a THROWAWAY subprocess.
+
+    On this image a wedged TPU tunnel makes ``jax.devices()`` hang forever
+    instead of raising, so an in-process try/except can't catch it — the
+    probe must be a child we can abandon. The child is stopped with SIGTERM
+    only (SIGKILL on a process holding the tunnel client wedges the relay
+    for every later run); if it ignores SIGTERM it is left to die on its
+    own rather than killed.
+    """
+    import subprocess
+    import sys
+
+    p = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
     )
+    try:
+        out, _ = p.communicate(timeout=timeout)
+        return p.returncode == 0 and "ok" in (out or "")
+    except subprocess.TimeoutExpired:
+        p.terminate()
+        try:
+            p.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            pass  # deliberately NOT killed — see docstring
+        return False
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--measure-baseline", action="store_true",
+        help="record this run's numbers as the measured baseline "
+        "(run on the single-device CPU host)",
+    )
+    parser.add_argument("--configs", default="", help="comma-separated subset of names")
+    args = parser.parse_args()
+
+    import jax
+
+    import os
+
+    if args.measure_baseline:
+        # the baseline is by definition the single-device CPU host rate
+        jax.config.update("jax_platforms", "cpu")
+    elif "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        pass  # CPU explicitly requested; nothing to probe
+    elif not _accelerator_reachable():
+        print("# accelerator backend unreachable; falling back to CPU", flush=True)
+        jax.config.update("jax_platforms", "cpu")
+    try:  # init the backend (raises, rather than hangs, on a dead registration)
+        jax.devices()
+    except RuntimeError as e:
+        print(f"# backend init failed ({e}); falling back to CPU", flush=True)
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.default_backend()
+
+    baseline: Dict[str, Any] = {}
+    if BASELINE_FILE.exists():
+        baseline = json.loads(BASELINE_FILE.read_text(encoding="utf8"))
+
+    only = {n for n in args.configs.split(",") if n}
+    results = []
+    for spec in _configs(platform):
+        if only and spec["name"] not in only:
+            continue
+        try:
+            rec = run_one(spec, platform)
+        except Exception as e:  # one broken config must not hide the others
+            print(f"# {spec['name']}: FAILED {type(e).__name__}: {e}", flush=True)
+            continue
+        if rec is None:
+            continue
+        base = baseline.get(rec["name"])
+        rec["vs_baseline"] = (
+            round(rec["value"] / base["value"], 3)
+            if base and base.get("value")
+            else None
+        )
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    if args.measure_baseline:
+        # merge: a subset run (or a failed config) must not erase the other
+        # configs' previously measured baselines
+        merged = dict(baseline)
+        merged.update({r["name"]: r for r in results})
+        BASELINE_FILE.write_text(
+            json.dumps(merged, indent=2) + "\n", encoding="utf8"
+        )
+        print(f"# measured baseline written to {BASELINE_FILE}", flush=True)
 
 
 if __name__ == "__main__":

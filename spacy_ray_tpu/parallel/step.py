@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -147,8 +148,6 @@ def place_batch(batch_tree: Any, mesh: Mesh, accum: bool = False) -> Any:
     ``jax.make_array_from_process_local_data``: global B = per-host B ×
     process_count, each host contributing all of its local rows.
     """
-    import numpy as np
-
     sh = NamedSharding(mesh, P(None, "data") if accum else P("data"))
     if jax.process_count() == 1:
         return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch_tree)

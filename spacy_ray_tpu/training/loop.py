@@ -357,11 +357,15 @@ def train(
         if accum == 1:
             tokens, targets = collated[0]["tokens"], collated[0]["targets"]
         else:
+            # multi-host place_batch re-assembles leaves on the host, so
+            # stack there directly instead of device-stacking and paying a
+            # device->host->device round trip per step
+            stack = np.stack if process_count > 1 else jnp.stack
             tokens = jax.tree_util.tree_map(
-                lambda *xs: jnp.stack(xs), *[c["tokens"] for c in collated]
+                lambda *xs: stack(xs), *[c["tokens"] for c in collated]
             )
             targets = jax.tree_util.tree_map(
-                lambda *xs: jnp.stack(xs), *[c["targets"] for c in collated]
+                lambda *xs: stack(xs), *[c["targets"] for c in collated]
             )
         tokens = place_batch(tokens, mesh, accum=accum > 1)
         targets = place_batch(targets, mesh, accum=accum > 1)

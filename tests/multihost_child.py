@@ -103,7 +103,7 @@ path = ${{paths.dev}}
 [training]
 seed = 0
 dropout = 0.1
-accumulate_gradient = 1
+accumulate_gradient = 2
 patience = 0
 max_epochs = 2
 max_steps = 0
@@ -135,8 +135,10 @@ tag_acc = 1.0
 
     # Global words/epoch must be ~ the FULL corpus, not the ~half this host
     # saw locally (the pre-fix accounting), and not x2 (the reference's
-    # estimated scaling, worker.py:310). The last (incomplete) step group
-    # may be dropped at epoch end, hence >=90%.
+    # estimated scaling, worker.py:310). With accumulate_gradient=2 and
+    # unequal shards, up to a few batches per host are dropped when the
+    # shorter stream ends mid-group, hence the loose lower bound — the
+    # pre-fix failure modes land far outside [0.65, 1.0]x.
     import json
 
     with open(f"{data_dir}/train.jsonl") as f:
@@ -144,7 +146,7 @@ tag_acc = 1.0
             len(json.loads(line)["tokens"]) for line in f if line.strip()
         )
     expect = 2 * corpus_words  # max_epochs=2
-    assert 0.9 * expect <= result.words_seen <= expect, (
+    assert 0.65 * expect <= result.words_seen <= expect, (
         f"words_seen={result.words_seen} expected ~{expect} "
         f"(global sum over hosts, 2 epochs)"
     )
