@@ -99,6 +99,14 @@ def train_command(argv: List[str]) -> int:
         f"Done. steps={result.final_step} best_score={result.best_score:.4f} "
         f"(step {result.best_step}) words/sec={result.wps:,.0f}"
     )
+    for comp_name in nlp.pipe_names:
+        stats = getattr(nlp.components[comp_name], "oracle_stats", None)
+        if stats and (stats["projectivized"] or stats["skipped"]):
+            print(
+                f"[{comp_name}] collation: {stats['docs']} doc-passes, "
+                f"{stats['projectivized']} pseudo-projectivized, "
+                f"{stats['skipped']} skipped (unusable trees)"
+            )
     return 0
 
 
@@ -177,7 +185,7 @@ def debug_data_command(argv: List[str]) -> int:
 
     from collections import Counter
 
-    from .pipeline.transition import is_projective
+    from .pipeline.nonproj import is_projective, projectivize
     from .training.corpus import Corpus
 
     examples = list(Corpus(args.data_path, limit=args.limit)())
@@ -187,6 +195,7 @@ def debug_data_command(argv: List[str]) -> int:
     have = Counter()
     tag_labels, dep_labels, ent_labels, cat_labels = Counter(), Counter(), Counter(), Counter()
     nonproj = 0
+    proj_recoverable = 0
     for eg in examples:
         ref = eg.reference
         if ref.tags:
@@ -197,6 +206,8 @@ def debug_data_command(argv: List[str]) -> int:
             dep_labels.update(d for d in ref.deps if d)
             if not is_projective(ref.heads):
                 nonproj += 1
+                if projectivize(ref.heads, ref.deps) is not None:
+                    proj_recoverable += 1
         if ref.ents:
             have["ents"] += 1
             ent_labels.update(s.label for s in ref.ents)
@@ -228,8 +239,10 @@ def debug_data_command(argv: List[str]) -> int:
             print(f"{name} labels ({len(counter)}): {top}")
     if nonproj:
         print(
-            f"WARNING: {nonproj}/{have['deps']} parsed docs are non-projective "
-            "— the arc-eager parser skips them for training"
+            f"non-projective trees: {nonproj}/{have['deps']} parsed docs — "
+            f"{proj_recoverable} trainable via pseudo-projective lifting "
+            f"(label decoration), {nonproj - proj_recoverable} unusable "
+            "(would be skipped)"
         )
     if n_docs == 0:
         print("WARNING: corpus is empty")

@@ -170,13 +170,12 @@ class ParseState:
 
 
 def is_projective(heads: Sequence[int]) -> bool:
-    """heads[i] = head index, or i for root (our Doc convention)."""
-    arcs = [(min(h, d), max(h, d)) for d, h in enumerate(heads) if h != d]
-    for i, (a1, b1) in enumerate(arcs):
-        for a2, b2 in arcs[i + 1 :]:
-            if a1 < a2 < b1 < b2 or a2 < a1 < b2 < b1:
-                return False
-    return True
+    """Single source of truth lives in pipeline/nonproj.py (strict variant:
+    crossing arcs and covered roots are both non-projective — both are
+    unreachable for this machine). Re-exported here for the oracle's guard."""
+    from .nonproj import is_projective as _isp
+
+    return _isp(heads)
 
 
 def gold_oracle(
