@@ -195,7 +195,7 @@ def debug_data_command(argv: List[str]) -> int:
     have = Counter()
     tag_labels, dep_labels, ent_labels, cat_labels = Counter(), Counter(), Counter(), Counter()
     nonproj = 0
-    proj_recoverable = 0
+    parsed_trees = []
     for eg in examples:
         ref = eg.reference
         if ref.tags:
@@ -204,10 +204,9 @@ def debug_data_command(argv: List[str]) -> int:
         if ref.heads and ref.deps:
             have["deps"] += 1
             dep_labels.update(d for d in ref.deps if d)
+            parsed_trees.append((ref.heads, ref.deps))
             if not is_projective(ref.heads):
                 nonproj += 1
-                if projectivize(ref.heads, ref.deps) is not None:
-                    proj_recoverable += 1
         if ref.ents:
             have["ents"] += 1
             ent_labels.update(s.label for s in ref.ents)
@@ -237,13 +236,36 @@ def debug_data_command(argv: List[str]) -> int:
         if counter:
             top = ", ".join(f"{l}({c})" for l, c in counter.most_common(12))
             print(f"{name} labels ({len(counter)}): {top}")
-    if nonproj:
-        print(
-            f"non-projective trees: {nonproj}/{have['deps']} parsed docs — "
-            f"{proj_recoverable} trainable via pseudo-projective lifting "
-            f"(label decoration), {nonproj - proj_recoverable} unusable "
-            "(would be skipped)"
-        )
+    if parsed_trees:
+        # the EXACT check training collation applies: projectivize, then the
+        # arc-eager oracle (a doc can pass the crossing test yet still be
+        # oracle-unreachable, e.g. cyclic heads from bad annotation)
+        from .pipeline.nonproj import is_decorated
+        from .pipeline.transition import gold_oracle
+
+        all_labels = sorted(dep_labels)
+        lifted = unusable = 0
+        for heads, deps in parsed_trees:
+            res = projectivize(heads, deps)
+            if res is None:
+                unusable += 1
+                continue
+            proj_heads, deco, n_lifted = res
+            ids_map = {l: i for i, l in enumerate(
+                sorted(set(all_labels) | {d for d in deco if is_decorated(d)})
+            )}
+            ids = [ids_map.get(d, 0) for d in deco]
+            if gold_oracle(proj_heads, ids, len(ids_map)) is None:
+                unusable += 1
+            elif n_lifted:
+                lifted += 1
+        if nonproj or unusable:
+            print(
+                f"non-projective trees: {nonproj}/{len(parsed_trees)} parsed "
+                f"docs — {lifted} trainable via pseudo-projective lifting "
+                f"(label decoration); unusable trees (skipped at training): "
+                f"{unusable}"
+            )
     if n_docs == 0:
         print("WARNING: corpus is empty")
         return 1

@@ -1,0 +1,284 @@
+"""Local-file pretrained weight loading for the transformer trunk.
+
+The reference ecosystem starts en_core_web_trf from a pretrained RoBERTa
+checkpoint (BASELINE.json config #4; the reference trains whatever the
+config names, reference worker.py:91). This environment is zero-egress, so
+downloading is impossible — but a LOCAL file must load the moment an asset
+exists in-image (VERDICT r1 missing #3). Two formats:
+
+* ``.npz`` — the native schema. Keys are '/'-joined paths into the trunk's
+  param tree, exactly what ``save_trunk_params`` writes:
+
+      pos                     [max_len, width]   positional embeddings
+      ln_f_g, ln_f_b          [width]            final layernorm
+      layer_{i}/qkv_W         [width, 3*width]   fused q,k,v projection
+      layer_{i}/qkv_b         [3*width]
+      layer_{i}/o_W           [width, width]     attention output
+      layer_{i}/o_b           [width]
+      layer_{i}/ln1_g|ln1_b   [width]            pre-attention layernorm
+      layer_{i}/ffn_W1        [width, ffn]
+      layer_{i}/ffn_b1        [ffn]
+      layer_{i}/ffn_W2        [ffn, width]
+      layer_{i}/ffn_b2        [width]
+      layer_{i}/ln2_g|ln2_b   [width]            pre-FFN layernorm
+      embed/...               hash-embed featurizer tables (optional)
+
+* ``.safetensors`` — parsed with a built-in reader (the format is an 8-byte
+  little-endian header length + JSON header + raw buffer; no dependency).
+  If the key set looks like a HuggingFace RoBERTa/BERT encoder
+  (``encoder.layer.N.attention...``), it is remapped to the native schema:
+  q/k/v weights are fused into qkv_W (transposed: torch Linear stores
+  [out, in]), FFN and layernorm weights map by position. NOTE this trunk
+  is pre-LN while BERT/RoBERTa are post-LN, and the input featurizer is
+  hash-embed rather than BPE — an HF remap is a warm start for the encoder
+  stack, not an exact port; the embedding block always stays native.
+
+Every merged tensor is shape-checked; a mismatch is an error, not a warning.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from pathlib import Path
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+_SAFETENSORS_DTYPES = {
+    "F64": np.float64,
+    "F32": np.float32,
+    "F16": np.float16,
+    "I64": np.int64,
+    "I32": np.int32,
+    "I16": np.int16,
+    "I8": np.int8,
+    "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+
+
+def read_safetensors(path) -> Dict[str, np.ndarray]:
+    """Minimal safetensors reader (header-JSON + raw little-endian buffer)."""
+    raw = Path(path).read_bytes()
+    if len(raw) < 8:
+        raise ValueError(f"{path}: not a safetensors file (too short)")
+    (header_len,) = struct.unpack("<Q", raw[:8])
+    header = json.loads(raw[8 : 8 + header_len].decode("utf8"))
+    buf = raw[8 + header_len :]
+    out: Dict[str, np.ndarray] = {}
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        dtype_name = meta["dtype"]
+        if dtype_name == "BF16":
+            import ml_dtypes
+
+            dtype = ml_dtypes.bfloat16
+        else:
+            dtype = _SAFETENSORS_DTYPES.get(dtype_name)
+            if dtype is None:
+                raise ValueError(f"{path}: unsupported dtype {dtype_name} for {name}")
+        start, end = meta["data_offsets"]
+        arr = np.frombuffer(buf[start:end], dtype=dtype).reshape(meta["shape"])
+        if dtype_name in ("F64", "F16", "BF16"):
+            arr = arr.astype(np.float32)  # params are fp32 in this trunk
+        out[name] = arr
+    return out
+
+
+def write_safetensors(path, tensors: Dict[str, np.ndarray]) -> None:
+    """Minimal safetensors writer (float32/ints; the reader's inverse)."""
+    inv = {np.dtype(v): k for k, v in _SAFETENSORS_DTYPES.items()}
+    header: Dict[str, Any] = {}
+    offset = 0
+    blobs: List[bytes] = []
+    for name in sorted(tensors):
+        arr = np.ascontiguousarray(tensors[name])
+        dtype_name = inv.get(arr.dtype)
+        if dtype_name is None:
+            raise ValueError(f"unsupported dtype {arr.dtype} for {name}")
+        blob = arr.tobytes()
+        header[name] = {
+            "dtype": dtype_name,
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + len(blob)],
+        }
+        offset += len(blob)
+        blobs.append(blob)
+    hj = json.dumps(header).encode("utf8")
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hj)))
+        f.write(hj)
+        for blob in blobs:
+            f.write(blob)
+
+
+def load_flat(path) -> Dict[str, np.ndarray]:
+    """Load a checkpoint file into a flat {key: array} dict.
+
+    A directory (the standard HF save layout) resolves to its
+    ``model.safetensors``."""
+    path = Path(path)
+    if path.is_dir():
+        inner = path / "model.safetensors"
+        if not inner.exists():
+            raise ValueError(
+                f"{path} is a directory without model.safetensors; point at "
+                "the checkpoint file itself (.npz or .safetensors)"
+            )
+        path = inner
+    if path.suffix == ".npz":
+        with np.load(str(path)) as data:
+            return {k: data[k] for k in data.files}
+    if path.suffix == ".safetensors":
+        return read_safetensors(path)
+    raise ValueError(
+        f"Unsupported checkpoint format {path.suffix!r} (want .npz or .safetensors)"
+    )
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            sub = f"{prefix}/{k}" if prefix else str(k)
+            out.update(_flatten(tree[k], sub))
+    else:
+        out[prefix] = np.asarray(tree)
+    return out
+
+
+def save_trunk_params(path, trunk_params: Any) -> None:
+    """Write trunk params as the native .npz schema (see module docstring)."""
+    np.savez(str(path), **_flatten(trunk_params))
+
+
+def looks_like_hf_encoder(flat: Dict[str, np.ndarray]) -> bool:
+    return any(".attention.self.query.weight" in k for k in flat)
+
+
+def hf_encoder_to_native(flat: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Remap HuggingFace BERT/RoBERTa encoder keys to the native schema.
+
+    Torch Linear weights are [out, in] and are transposed; q, k, v fuse
+    into qkv_W/qkv_b. Embedding-block keys are dropped (the native trunk
+    featurizes with hash embeddings). Positional embeddings are taken if
+    present; RoBERTa checkpoints (detected from the key prefix) skip the 2
+    pad-reserved leading rows, BERT keeps all rows.
+    """
+
+    def find(suffix: str):
+        for k, v in flat.items():
+            if k.endswith(suffix):
+                return v
+        return None
+
+    out: Dict[str, np.ndarray] = {}
+    # RoBERTa reserves position rows 0-1 for padding (positions start at 2);
+    # BERT does not. Detectable from the model-prefix in the key names.
+    is_roberta = any("roberta" in k.lower() for k in flat)
+    i = 0
+    while True:
+        pre = None
+        for cand in (f"encoder.layer.{i}.", f"roberta.encoder.layer.{i}."):
+            if any(k.startswith(cand) for k in flat):
+                pre = cand
+                break
+        if pre is None:
+            break
+        q_w = flat[pre + "attention.self.query.weight"].T
+        k_w = flat[pre + "attention.self.key.weight"].T
+        v_w = flat[pre + "attention.self.value.weight"].T
+        out[f"layer_{i}/qkv_W"] = np.concatenate([q_w, k_w, v_w], axis=1)
+        out[f"layer_{i}/qkv_b"] = np.concatenate(
+            [
+                flat[pre + "attention.self.query.bias"],
+                flat[pre + "attention.self.key.bias"],
+                flat[pre + "attention.self.value.bias"],
+            ]
+        )
+        out[f"layer_{i}/o_W"] = flat[pre + "attention.output.dense.weight"].T
+        out[f"layer_{i}/o_b"] = flat[pre + "attention.output.dense.bias"]
+        out[f"layer_{i}/ln1_g"] = flat[pre + "attention.output.LayerNorm.weight"]
+        out[f"layer_{i}/ln1_b"] = flat[pre + "attention.output.LayerNorm.bias"]
+        out[f"layer_{i}/ffn_W1"] = flat[pre + "intermediate.dense.weight"].T
+        out[f"layer_{i}/ffn_b1"] = flat[pre + "intermediate.dense.bias"]
+        out[f"layer_{i}/ffn_W2"] = flat[pre + "output.dense.weight"].T
+        out[f"layer_{i}/ffn_b2"] = flat[pre + "output.dense.bias"]
+        out[f"layer_{i}/ln2_g"] = flat[pre + "output.LayerNorm.weight"]
+        out[f"layer_{i}/ln2_b"] = flat[pre + "output.LayerNorm.bias"]
+        i += 1
+    if i == 0:
+        raise ValueError("no encoder.layer.N.* keys found in HF checkpoint")
+    pos = find("position_embeddings.weight")
+    if pos is not None:
+        if is_roberta and pos.shape[0] > 2:
+            pos = pos[2:]  # skip the two pad-reserved rows
+        out["pos"] = pos
+    return out
+
+
+def merge_pretrained(
+    params: Dict[str, Any], flat_loaded: Dict[str, np.ndarray]
+) -> Tuple[Dict[str, Any], Dict[str, List[str]]]:
+    """Merge loaded tensors into a freshly initialized trunk param tree.
+
+    Returns (new_params, report) where report lists 'loaded', 'missing'
+    (param present, no tensor in file — stays at its random init) and
+    'unused' (tensor in file with no matching param). Shape mismatches
+    raise ValueError naming the key and both shapes.
+    """
+    import jax.numpy as jnp
+
+    flat_params = _flatten(params)
+    loaded: List[str] = []
+    unused = [k for k in flat_loaded if k not in flat_params]
+    missing = [k for k in flat_params if k not in flat_loaded]
+    merged_flat: Dict[str, np.ndarray] = {}
+    for key, cur in flat_params.items():
+        if key in flat_loaded:
+            new = np.asarray(flat_loaded[key], dtype=np.float32)
+            if tuple(new.shape) != tuple(cur.shape):
+                # pos tables may legitimately differ in length: truncate or
+                # keep-random-tail, but only for the leading (length) dim
+                if key == "pos" and new.shape[1:] == cur.shape[1:]:
+                    n = min(new.shape[0], cur.shape[0])
+                    out = np.array(cur, dtype=np.float32)
+                    out[:n] = new[:n]
+                    merged_flat[key] = out
+                    loaded.append(key)
+                    continue
+                raise ValueError(
+                    f"pretrained tensor {key!r} has shape {tuple(new.shape)}, "
+                    f"param expects {tuple(cur.shape)}"
+                )
+            merged_flat[key] = new
+            loaded.append(key)
+        else:
+            merged_flat[key] = np.asarray(cur)
+
+    root: Dict[str, Any] = {}
+    for path, arr in merged_flat.items():
+        parts = path.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(arr)
+    report = {"loaded": loaded, "missing": missing, "unused": unused}
+    return root, report
+
+
+def load_trunk_weights(params: Dict[str, Any], path) -> Dict[str, Any]:
+    """Load + (maybe) remap + shape-checked merge; prints a one-line report."""
+    flat = load_flat(path)
+    if looks_like_hf_encoder(flat):
+        flat = hf_encoder_to_native(flat)
+    merged, report = merge_pretrained(params, flat)
+    print(
+        f"[transformer] loaded {len(report['loaded'])} tensors from {path} "
+        f"({len(report['missing'])} left at init, "
+        f"{len(report['unused'])} unused in file)",
+        flush=True,
+    )
+    return merged

@@ -145,12 +145,18 @@ def TransformerEncoder(
     max_len: int = 512,
     embed_size: int = 10000,
     remat: bool = True,
+    init_weights: Optional[str] = None,
 ) -> Model:
     """Hash-embed featurized transformer trunk (tok2vec-compatible output).
 
     ``remat=True`` wraps each layer in jax.checkpoint — rematerialize
     activations in backward to trade FLOPs for HBM (the standard TPU
     memory/bandwidth tradeoff for deep trunks).
+
+    ``init_weights``: path to a local .npz (native schema) or .safetensors
+    (native or HuggingFace-encoder keys, remapped) checkpoint to start the
+    trunk from — see models/pretrained.py for the key schema. Every tensor
+    is shape-checked; keys absent from the file keep their random init.
     """
     if width % n_heads != 0:
         raise ValueError(f"width {width} not divisible by n_heads {n_heads}")
@@ -168,6 +174,10 @@ def TransformerEncoder(
         }
         for i in range(depth):
             params[f"layer_{i}"] = transformer_layer_params(rngs[i + 2], width, ffn)
+        if init_weights:
+            from .pretrained import load_trunk_weights
+
+            params = load_trunk_weights(params, init_weights)
         return params
 
     def apply_fn(params, batch: TokenBatch, ctx: Context) -> Padded:
@@ -224,9 +234,27 @@ def HFTransformerModel(
     tokenizer_config: Optional[dict] = None,
     transformer_config: Optional[dict] = None,
 ) -> Model:
-    raise NotImplementedError(
-        "Pretrained HuggingFace checkpoints are not loadable in this "
-        "zero-egress environment. Use @architectures "
-        '"spacy_ray_tpu.TransformerEncoder.v1" — the same RoBERTa-base '
-        "shape trained from scratch (width=768, depth=12, n_heads=12)."
+    """Reference-ecosystem config compatibility (spacy-transformers'
+    registered name). ``name`` must be a LOCAL path to a .safetensors or
+    .npz checkpoint (this environment is zero-egress — hub names can't be
+    downloaded); the encoder weights are remapped into the native RoBERTa-
+    base-shape trunk via models/pretrained.py. A bare hub name raises with
+    that guidance."""
+    from pathlib import Path
+
+    if not Path(name).exists():
+        raise NotImplementedError(
+            f"{name!r} is not a local file, and downloading HuggingFace "
+            "checkpoints is impossible in this zero-egress environment. "
+            "Point `name` at a local .safetensors/.npz checkpoint, or use "
+            '@architectures "spacy_ray_tpu.TransformerEncoder.v1" with '
+            "init_weights=<path> (same RoBERTa-base shape)."
+        )
+    cfg = dict(transformer_config or {})
+    return TransformerEncoder(
+        width=int(cfg.get("width", 768)),
+        depth=int(cfg.get("depth", 12)),
+        n_heads=int(cfg.get("n_heads", 12)),
+        max_len=int(cfg.get("max_len", 512)),
+        init_weights=name,
     )

@@ -1,0 +1,147 @@
+"""Local pretrained-weight loading for the transformer trunk
+(models/pretrained.py): native .npz round trip, safetensors reader/writer,
+HF-encoder remap, and shape-check errors. VERDICT r1 missing #3."""
+
+import numpy as np
+import pytest
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.models import pretrained as PT
+from spacy_ray_tpu.pipeline.language import Pipeline
+from spacy_ray_tpu.presets import TINY_TRF_TAGGER_CFG
+from spacy_ray_tpu.util import synth_corpus
+
+
+def _build(seed, init_weights=None):
+    cfg = Config.from_str(TINY_TRF_TAGGER_CFG)
+    if init_weights:
+        cfg = cfg.apply_overrides(
+            {"components.transformer.model.init_weights": str(init_weights)}
+        )
+    nlp = Pipeline.from_config(cfg)
+    egs = synth_corpus(32, "tagger", seed=0)
+    nlp.initialize(lambda: iter(egs), seed=seed)
+    return nlp, egs
+
+
+def _trunk_forward(nlp, egs):
+    batch = nlp.collate(egs[:8], with_targets=False, pad_batch_to=8, pad_len_to=16)
+    out = nlp.make_forward_fn()(nlp.params, batch["tokens"])
+    return np.asarray(out["transformer"].X)
+
+
+def test_npz_round_trip_identical_forward(tmp_path):
+    nlp_a, egs = _build(seed=0)
+    ckpt = tmp_path / "trunk.npz"
+    PT.save_trunk_params(ckpt, nlp_a.params["transformer"])
+    # fresh pipeline, DIFFERENT seed: without loading, the trunk differs;
+    # with init_weights, its forward must be bitwise-identical to A's
+    nlp_c, _ = _build(seed=7)
+    assert not np.allclose(_trunk_forward(nlp_a, egs), _trunk_forward(nlp_c, egs))
+    nlp_b, _ = _build(seed=7, init_weights=ckpt)
+    np.testing.assert_array_equal(_trunk_forward(nlp_a, egs), _trunk_forward(nlp_b, egs))
+
+
+def test_safetensors_native_round_trip(tmp_path):
+    nlp_a, egs = _build(seed=0)
+    flat = PT._flatten(nlp_a.params["transformer"])
+    st = tmp_path / "trunk.safetensors"
+    PT.write_safetensors(st, {k: np.asarray(v, np.float32) for k, v in flat.items()})
+    nlp_b, _ = _build(seed=5, init_weights=st)
+    np.testing.assert_allclose(
+        _trunk_forward(nlp_a, egs), _trunk_forward(nlp_b, egs), atol=1e-6
+    )
+
+
+def _hf_state(rng, prefix=""):
+    W, FFN = 32, 64
+    hf = {}
+    for i in range(2):
+        pre = f"{prefix}encoder.layer.{i}."
+        for part in ("query", "key", "value"):
+            hf[pre + f"attention.self.{part}.weight"] = rng.normal(size=(W, W)).astype(np.float32)
+            hf[pre + f"attention.self.{part}.bias"] = rng.normal(size=(W,)).astype(np.float32)
+        hf[pre + "attention.output.dense.weight"] = rng.normal(size=(W, W)).astype(np.float32)
+        hf[pre + "attention.output.dense.bias"] = rng.normal(size=(W,)).astype(np.float32)
+        hf[pre + "attention.output.LayerNorm.weight"] = np.ones(W, np.float32)
+        hf[pre + "attention.output.LayerNorm.bias"] = np.zeros(W, np.float32)
+        hf[pre + "intermediate.dense.weight"] = rng.normal(size=(FFN, W)).astype(np.float32)
+        hf[pre + "intermediate.dense.bias"] = rng.normal(size=(FFN,)).astype(np.float32)
+        hf[pre + "output.dense.weight"] = rng.normal(size=(W, FFN)).astype(np.float32)
+        hf[pre + "output.dense.bias"] = rng.normal(size=(W,)).astype(np.float32)
+        hf[pre + "output.LayerNorm.weight"] = np.ones(W, np.float32)
+        hf[pre + "output.LayerNorm.bias"] = np.zeros(W, np.float32)
+    return hf
+
+
+def test_hf_bert_positions_not_offset():
+    # BERT-style checkpoints have no pad-reserved rows: row i = position i
+    rng = np.random.default_rng(1)
+    hf = _hf_state(rng)
+    hf["embeddings.position_embeddings.weight"] = rng.normal(size=(64, 32)).astype(np.float32)
+    out = PT.hf_encoder_to_native(hf)
+    np.testing.assert_array_equal(
+        out["pos"], hf["embeddings.position_embeddings.weight"]
+    )
+
+
+def test_hf_encoder_remap(tmp_path):
+    # synthesize a 2-layer RoBERTa-style encoder checkpoint at width 32
+    rng = np.random.default_rng(0)
+    W, FFN = 32, 64
+    hf = {}
+    for i in range(2):
+        pre = f"roberta.encoder.layer.{i}."
+        for part in ("query", "key", "value"):
+            hf[pre + f"attention.self.{part}.weight"] = rng.normal(size=(W, W)).astype(np.float32)
+            hf[pre + f"attention.self.{part}.bias"] = rng.normal(size=(W,)).astype(np.float32)
+        hf[pre + "attention.output.dense.weight"] = rng.normal(size=(W, W)).astype(np.float32)
+        hf[pre + "attention.output.dense.bias"] = rng.normal(size=(W,)).astype(np.float32)
+        hf[pre + "attention.output.LayerNorm.weight"] = np.ones(W, np.float32)
+        hf[pre + "attention.output.LayerNorm.bias"] = np.zeros(W, np.float32)
+        hf[pre + "intermediate.dense.weight"] = rng.normal(size=(FFN, W)).astype(np.float32)
+        hf[pre + "intermediate.dense.bias"] = rng.normal(size=(FFN,)).astype(np.float32)
+        hf[pre + "output.dense.weight"] = rng.normal(size=(W, FFN)).astype(np.float32)
+        hf[pre + "output.dense.bias"] = rng.normal(size=(W,)).astype(np.float32)
+        hf[pre + "output.LayerNorm.weight"] = np.ones(W, np.float32)
+        hf[pre + "output.LayerNorm.bias"] = np.zeros(W, np.float32)
+    # RoBERTa-style positions with the 2-row pad offset (64 usable rows)
+    hf["roberta.embeddings.position_embeddings.weight"] = rng.normal(size=(66, W)).astype(np.float32)
+    st = tmp_path / "hf.safetensors"
+    PT.write_safetensors(st, hf)
+
+    nlp, egs = _build(seed=3, init_weights=st)
+    trunk = nlp.params["transformer"]
+    want_qkv = np.concatenate(
+        [
+            hf["roberta.encoder.layer.0.attention.self.query.weight"].T,
+            hf["roberta.encoder.layer.0.attention.self.key.weight"].T,
+            hf["roberta.encoder.layer.0.attention.self.value.weight"].T,
+        ],
+        axis=1,
+    )
+    np.testing.assert_allclose(np.asarray(trunk["layer_0"]["qkv_W"]), want_qkv, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(trunk["pos"]),
+        hf["roberta.embeddings.position_embeddings.weight"][2:],
+        atol=1e-7,
+    )
+    # and the loaded trunk still runs
+    assert np.isfinite(_trunk_forward(nlp, egs)).all()
+
+
+def test_shape_mismatch_raises(tmp_path):
+    nlp_a, _ = _build(seed=0)
+    flat = PT._flatten(nlp_a.params["transformer"])
+    flat["layer_0/qkv_W"] = np.zeros((8, 8), np.float32)  # wrong shape
+    bad = tmp_path / "bad.npz"
+    np.savez(str(bad), **{k: np.asarray(v) for k, v in flat.items()})
+    with pytest.raises(ValueError, match="qkv_W"):
+        _build(seed=1, init_weights=bad)
+
+
+def test_hub_name_still_raises_with_guidance():
+    from spacy_ray_tpu.models.transformer import HFTransformerModel
+
+    with pytest.raises(NotImplementedError, match="zero-egress"):
+        HFTransformerModel(name="roberta-base")
