@@ -243,7 +243,7 @@ def debug_data_command(argv: List[str]) -> int:
         from .pipeline.nonproj import is_decorated
         from .pipeline.transition import gold_oracle
 
-        all_labels = sorted(dep_labels)
+        base_ids = {l: i for i, l in enumerate(sorted(dep_labels))}
         lifted = unusable = 0
         for heads, deps in parsed_trees:
             res = projectivize(heads, deps)
@@ -251,9 +251,15 @@ def debug_data_command(argv: List[str]) -> int:
                 unusable += 1
                 continue
             proj_heads, deco, n_lifted = res
-            ids_map = {l: i for i, l in enumerate(
-                sorted(set(all_labels) | {d for d in deco if is_decorated(d)})
-            )}
+            extra = sorted(
+                {d for d in deco if is_decorated(d) and d not in base_ids}
+            )
+            if extra:
+                ids_map = dict(base_ids)
+                for d in extra:
+                    ids_map[d] = len(ids_map)
+            else:
+                ids_map = base_ids
             ids = [ids_map.get(d, 0) for d in deco]
             if gold_oracle(proj_heads, ids, len(ids_map)) is None:
                 unusable += 1

@@ -158,7 +158,9 @@ def looks_like_hf_encoder(flat: Dict[str, np.ndarray]) -> bool:
     return any(".attention.self.query.weight" in k for k in flat)
 
 
-def hf_encoder_to_native(flat: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+def hf_encoder_to_native(
+    flat: Dict[str, np.ndarray], native_pos_rows: "int | None" = None
+) -> Dict[str, np.ndarray]:
     """Remap HuggingFace BERT/RoBERTa encoder keys to the native schema.
 
     Torch Linear weights are [out, in] and are transposed; q, k, v fuse
@@ -213,8 +215,16 @@ def hf_encoder_to_native(flat: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         raise ValueError("no encoder.layer.N.* keys found in HF checkpoint")
     pos = find("position_embeddings.weight")
     if pos is not None:
-        if is_roberta and pos.shape[0] > 2:
-            pos = pos[2:]  # skip the two pad-reserved rows
+        # the target row count disambiguates prefix-less exports: a table
+        # exactly 2 rows longer than the trunk's is RoBERTa-style (2 pad-
+        # reserved rows), an exact match is BERT-style; otherwise fall back
+        # to the key-prefix heuristic
+        if native_pos_rows is not None and pos.shape[0] == native_pos_rows + 2:
+            pos = pos[2:]
+        elif native_pos_rows is not None and pos.shape[0] == native_pos_rows:
+            pass
+        elif is_roberta and pos.shape[0] > 2:
+            pos = pos[2:]
         out["pos"] = pos
     return out
 
@@ -273,8 +283,20 @@ def load_trunk_weights(params: Dict[str, Any], path) -> Dict[str, Any]:
     """Load + (maybe) remap + shape-checked merge; prints a one-line report."""
     flat = load_flat(path)
     if looks_like_hf_encoder(flat):
-        flat = hf_encoder_to_native(flat)
+        pos = params.get("pos")
+        flat = hf_encoder_to_native(
+            flat, native_pos_rows=None if pos is None else int(pos.shape[0])
+        )
     merged, report = merge_pretrained(params, flat)
+    if not report["loaded"]:
+        sample = ", ".join(sorted(flat)[:5])
+        raise ValueError(
+            f"no tensors in {path} matched the trunk schema — the file's "
+            f"keys (e.g. {sample}) are neither the native layout "
+            "(models/pretrained.py docstring) nor a recognizable "
+            "BERT/RoBERTa encoder; refusing to train from scratch when "
+            "pretrained weights were requested"
+        )
     print(
         f"[transformer] loaded {len(report['loaded'])} tensors from {path} "
         f"({len(report['missing'])} left at init, "

@@ -145,3 +145,27 @@ def test_hub_name_still_raises_with_guidance():
 
     with pytest.raises(NotImplementedError, match="zero-egress"):
         HFTransformerModel(name="roberta-base")
+
+
+def test_prefixless_roberta_positions_disambiguated_by_target_rows():
+    # RobertaModel.save_pretrained() exports without the 'roberta.' prefix;
+    # a pos table exactly 2 rows longer than the trunk's must still strip
+    # the pad-reserved rows
+    rng = np.random.default_rng(2)
+    hf = _hf_state(rng)
+    hf["embeddings.position_embeddings.weight"] = rng.normal(size=(66, 32)).astype(np.float32)
+    out = PT.hf_encoder_to_native(hf, native_pos_rows=64)
+    np.testing.assert_array_equal(
+        out["pos"], hf["embeddings.position_embeddings.weight"][2:]
+    )
+
+
+def test_unrecognized_schema_raises_instead_of_silent_random_init(tmp_path):
+    # DistilBERT-style keys: not native, not BERT/RoBERTa-shaped
+    bad = {
+        "transformer.layer.0.attention.q_lin.weight": np.zeros((32, 32), np.float32)
+    }
+    st = tmp_path / "distil.safetensors"
+    PT.write_safetensors(st, bad)
+    with pytest.raises(ValueError, match="matched the trunk schema"):
+        _build(seed=0, init_weights=st)
