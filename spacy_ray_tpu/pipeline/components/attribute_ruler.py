@@ -9,6 +9,12 @@ Pattern entries: ``{"patterns": [[{"LOWER": "who"}], [{"LOWER": "whom"}]],
 "attrs": {"TAG": "PRON", "LEMMA": "who"}, "index": 0}`` — every match of any
 listed token pattern sets the attrs on the matched token at ``index``
 (supports negative indices into the match, spaCy semantics).
+
+Patterns use the full shared matcher language (pipeline/matcher.py),
+including TAG/POS-keyed constraints — the common spaCy use of retagging by
+POS context, e.g. ``[{"TAG": "VBZ"}, {"LOWER": "not"}]``. Such rules read
+the doc's predicted tags, so place the component after the tagger in
+``[nlp] pipeline``.
 """
 
 from __future__ import annotations
@@ -17,8 +23,8 @@ from typing import Any, Dict, Iterable, List, Optional
 
 from ...registry import registry
 from ...pipeline.doc import Doc, Example
+from ..matcher import match_pattern, validate_token_patterns
 from .base import Component
-from .entity_ruler import _match_token_pattern, validate_token_patterns
 
 _ATTR_FIELDS = {
     "TAG": "tags",
@@ -87,6 +93,10 @@ class AttributeRulerComponent(Component):
 
     def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
         for doc in docs:
+            # match-all-THEN-apply (spaCy AttributeRuler semantics): TAG/POS-
+            # keyed patterns must see the doc's ORIGINAL annotations for every
+            # match, not annotations this very pass already rewrote
+            pending: List[tuple] = []
             for rule in self.patterns:
                 # attrs pre-validated at config time: resolve fields once
                 field_values = [
@@ -96,7 +106,7 @@ class AttributeRulerComponent(Component):
                 index = int(rule.get("index", 0))
                 for pattern in rule.get("patterns", []):
                     for start in range(len(doc.words)):
-                        end = _match_token_pattern(pattern, doc.words, start)
+                        end = match_pattern(doc, pattern, start)
                         if end is None or end <= start:
                             continue
                         span_len = end - start
@@ -109,9 +119,10 @@ class AttributeRulerComponent(Component):
                                 f"of range for a {span_len}-token match at "
                                 f"tokens {start}:{end}"
                             )
-                        tok = start + ti
-                        for field, value in field_values:
-                            self._ensure_field(doc, field)[tok] = value
+                        pending.append((start + ti, field_values))
+            for tok, field_values in pending:
+                for field, value in field_values:
+                    self._ensure_field(doc, field)[tok] = value
 
     def score(self, examples: List[Example]) -> Dict[str, float]:
         return {}

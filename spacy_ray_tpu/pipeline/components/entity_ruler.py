@@ -7,8 +7,10 @@ host-side preprocessing per the SURVEY.md §2.3 host/device split). Patterns:
   is run through the pipeline tokenizer and matched case-SENSITIVELY on the
   token sequence (use a token pattern with ``LOWER`` for case-insensitive)
 * token patterns: ``{"label": "CITY", "pattern": [{"LOWER": "new"},
-  {"LOWER": "york"}]}`` — each dict constrains one token: TEXT, LOWER,
-  IS_DIGIT, IS_ALPHA, SHAPE, and OP ("?", "*", "+") for optional/repeats
+  {"LOWER": "york"}]}`` — the full shared matcher language
+  (pipeline/matcher.py): TEXT/LOWER/TAG/POS/LEMMA/SHAPE/LENGTH/IS_* keys,
+  literal or predicate values (REGEX, IN, NOT_IN, comparisons), and OP
+  ``? * + ! {n} {n,m} {n,} {,m}``
 
 Longest match wins; overlapping matches resolved left-to-right longest-first.
 ``overwrite_ents`` controls whether rule matches replace model entities or
@@ -23,95 +25,14 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 from ...registry import registry
 from ...pipeline.doc import Doc, Example, Span
 from ...pipeline.tokenizer import Tokenizer
-from ...pipeline.vocab import shape_of
+from ..matcher import (  # noqa: F401  (validate_token_patterns re-exported)
+    SUPPORTED_TOKEN_KEYS,
+    match_pattern,
+    validate_token_patterns,
+)
 from .base import Component
 
 _PATTERN_TOKENIZER = Tokenizer()  # stateless; shared for phrase patterns
-
-SUPPORTED_TOKEN_KEYS = ("TEXT", "LOWER", "IS_DIGIT", "IS_ALPHA", "IS_TITLE", "SHAPE", "OP")
-SUPPORTED_OPS = ("1", "?", "*", "+")
-
-
-def validate_token_patterns(patterns) -> None:
-    """Config-time validation of token-pattern lists (key + OP names);
-    shared by entity_ruler and attribute_ruler so misconfigured rules fail
-    before training/inference rather than at the first matching token."""
-    for pattern in patterns:
-        if isinstance(pattern, str):
-            continue
-        for tok in pattern:
-            for key in tok:
-                if key not in SUPPORTED_TOKEN_KEYS:
-                    raise ValueError(
-                        f"Unsupported token-pattern key {key!r}; "
-                        f"supported: {sorted(SUPPORTED_TOKEN_KEYS)}"
-                    )
-            if str(tok.get("OP", "1")) not in SUPPORTED_OPS:
-                raise ValueError(
-                    f"Unsupported OP {tok.get('OP')!r}; supported: {SUPPORTED_OPS}"
-                )
-
-
-def _token_matches(constraint: Dict[str, Any], word: str) -> bool:
-    for key, want in constraint.items():
-        if key == "OP":
-            continue
-        if key == "TEXT":
-            ok = word == want
-        elif key == "LOWER":
-            ok = word.lower() == want
-        elif key == "IS_DIGIT":
-            ok = word.isdigit() == bool(want)
-        elif key == "IS_ALPHA":
-            ok = word.isalpha() == bool(want)
-        elif key == "IS_TITLE":
-            ok = word.istitle() == bool(want)
-        elif key == "SHAPE":
-            ok = shape_of(word) == want
-        else:
-            raise ValueError(f"Unsupported token-pattern key {key!r}")
-        if not ok:
-            return False
-    return True
-
-
-def _match_token_pattern(
-    pattern: List[Dict[str, Any]], words: List[str], start: int
-) -> Optional[int]:
-    """Match `pattern` at `start`; returns end index (exclusive) of the
-    LONGEST match or None. Supports OP: "?", "*", "+" per token constraint."""
-
-    def rec(pi: int, wi: int) -> Optional[int]:
-        if pi == len(pattern):
-            return wi
-        tok = pattern[pi]
-        op = tok.get("OP", "1")
-        if op == "1":
-            if wi < len(words) and _token_matches(tok, words[wi]):
-                return rec(pi + 1, wi + 1)
-            return None
-        if op == "?":
-            if wi < len(words) and _token_matches(tok, words[wi]):
-                longer = rec(pi + 1, wi + 1)
-                if longer is not None:
-                    return longer
-            return rec(pi + 1, wi)
-        if op in ("*", "+"):
-            # greedy: consume as many as possible, then backtrack
-            max_wi = wi
-            while max_wi < len(words) and _token_matches(tok, words[max_wi]):
-                max_wi += 1
-            min_needed = wi + 1 if op == "+" else wi
-            for end in range(max_wi, min_needed - 1, -1):
-                if op == "+" and end == wi:
-                    break
-                got = rec(pi + 1, end)
-                if got is not None:
-                    return got
-            return None
-        raise ValueError(f"Unsupported OP {op!r}")
-
-    return rec(0, start)
 
 
 class EntityRulerComponent(Component):
@@ -127,6 +48,7 @@ class EntityRulerComponent(Component):
     ):
         super().__init__(name, model_cfg or {})
         self.patterns: List[Dict[str, Any]] = []
+        self._compiled: List[Tuple[str, List[Dict[str, Any]]]] = []
         self.overwrite_ents = overwrite_ents
         if patterns:
             self.add_patterns(patterns)
@@ -150,11 +72,10 @@ class EntityRulerComponent(Component):
 
     def finish_labels(self) -> None:
         self.labels = sorted({p["label"] for p in self.patterns})
-
-    def _find_matches(self, words: List[str]) -> List[Span]:
-        matches: List[Tuple[int, int, str]] = []
+        # pre-tokenize phrase patterns ONCE (add/load time), not per doc:
+        # self.patterns keeps the user's original form for serialization
+        self._compiled = []
         for pat in self.patterns:
-            label = pat["label"]
             pattern = pat["pattern"]
             if isinstance(pattern, str):
                 # tokenize the phrase the same way docs are tokenized, so
@@ -162,8 +83,14 @@ class EntityRulerComponent(Component):
                 pattern = [
                     {"TEXT": w} for w in _PATTERN_TOKENIZER(pattern).words
                 ]
+            self._compiled.append((pat["label"], pattern))
+
+    def _find_matches(self, doc: Doc) -> List[Span]:
+        words = doc.words
+        matches: List[Tuple[int, int, str]] = []
+        for label, pattern in self._compiled:
             for start in range(len(words)):
-                end = _match_token_pattern(pattern, words, start)
+                end = match_pattern(doc, pattern, start)
                 if end is not None and end > start:
                     matches.append((start, end, label))
         # longest-first, then leftmost; drop overlaps
@@ -184,7 +111,7 @@ class EntityRulerComponent(Component):
 
     def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
         for doc in docs:
-            matches = self._find_matches(doc.words)
+            matches = self._find_matches(doc)
             if self.overwrite_ents:
                 primary, secondary = matches, doc.ents  # rules win
             else:

@@ -1,12 +1,14 @@
 """Entity ruler: phrase/token patterns, OP quantifiers, model-ent merging."""
 
 from spacy_ray_tpu.config import Config
-from spacy_ray_tpu.pipeline.components.entity_ruler import (
-    EntityRulerComponent,
-    _match_token_pattern,
-)
+from spacy_ray_tpu.pipeline.components.entity_ruler import EntityRulerComponent
 from spacy_ray_tpu.pipeline.doc import Doc, Span
 from spacy_ray_tpu.pipeline.language import Pipeline
+from spacy_ray_tpu.pipeline.matcher import match_pattern
+
+
+def _match_token_pattern(pattern, words, start):
+    return match_pattern(Doc(words=list(words)), pattern, start)
 
 
 def _ruler(patterns, **kw):

@@ -1,0 +1,146 @@
+"""Shared token-pattern matcher (pipeline/matcher.py): spaCy Matcher
+pattern-language parity — predicate dicts (REGEX/IN/NOT_IN/comparisons),
+LENGTH, TAG/POS keys, and the full OP set including ! and {n,m} ranges.
+VERDICT r1 #8."""
+
+import pytest
+
+from spacy_ray_tpu.pipeline.components.attribute_ruler import AttributeRulerComponent
+from spacy_ray_tpu.pipeline.components.entity_ruler import EntityRulerComponent
+from spacy_ray_tpu.pipeline.doc import Doc
+from spacy_ray_tpu.pipeline.matcher import match_pattern, validate_token_patterns
+
+
+def M(pattern, words, start=0, **doc_kw):
+    return match_pattern(Doc(words=list(words), **doc_kw), pattern, start)
+
+
+def test_regex_predicate():
+    pat = [{"TEXT": {"REGEX": r"^[A-Z]{2,4}$"}}]
+    assert M(pat, ["NASA"]) == 1
+    assert M(pat, ["NASAX"]) is None
+    assert M(pat, ["nasa"]) is None
+
+
+def test_in_not_in():
+    pat = [{"LOWER": {"IN": ["inc", "corp", "ltd"]}}]
+    assert M(pat, ["Corp"]) == 1
+    assert M(pat, ["LLC"]) is None
+    pat2 = [{"LOWER": {"NOT_IN": ["the", "a"]}}]
+    assert M(pat2, ["cat"]) == 1
+    assert M(pat2, ["the"]) is None
+
+
+def test_length_comparisons():
+    assert M([{"LENGTH": 3}], ["cat"]) == 1
+    assert M([{"LENGTH": 3}], ["cats"]) is None
+    assert M([{"LENGTH": {">=": 10}}], ["internationalization"]) == 1
+    assert M([{"LENGTH": {">=": 10}}], ["intl"]) is None
+    assert M([{"LENGTH": {">": 2, "<": 5}}], ["cats"]) == 1
+
+
+def test_negation_op():
+    # "not followed by 'york'": ! negates the constraint for one token
+    pat = [{"LOWER": "new"}, {"LOWER": "york", "OP": "!"}]
+    assert M(pat, ["new", "jersey"]) == 2
+    assert M(pat, ["new", "york"]) is None
+    assert M(pat, ["new"]) is None  # ! still consumes a token
+
+
+def test_range_ops():
+    digit = {"IS_DIGIT": True}
+    assert M([dict(digit, OP="{2}")], ["1", "2", "3"]) == 2
+    assert M([dict(digit, OP="{2}")], ["1", "x"]) is None
+    assert M([dict(digit, OP="{1,3}")], ["1", "2", "3", "4"]) == 3  # greedy, capped
+    assert M([dict(digit, OP="{2,}")], ["1"]) is None
+    assert M([dict(digit, OP="{2,}")], ["1", "2", "3"]) == 3
+    assert M([dict(digit, OP="{,2}")], ["x"]) == 0  # zero-width ok
+    # backtracking across a range: {1,3} must give back one token
+    pat = [dict(digit, OP="{1,3}"), {"IS_DIGIT": True}]
+    assert M(pat, ["1", "2", "3"]) == 3
+
+
+def test_tag_pos_keys():
+    doc = Doc(
+        words=["green", "ideas", "sleep"],
+        tags=["ADJ", "NOUN", "VERB"],
+        pos=["ADJ", "NOUN", "VERB"],
+    )
+    assert match_pattern(doc, [{"TAG": "ADJ"}, {"POS": "NOUN"}], 0) == 2
+    assert match_pattern(doc, [{"TAG": "NOUN"}], 0) is None
+    assert match_pattern(doc, [{"TAG": {"IN": ["NOUN", "PROPN"]}}], 1) == 2
+
+
+def test_validation_rejects_bad_patterns():
+    with pytest.raises(ValueError, match="Unsupported OP"):
+        validate_token_patterns([[{"TEXT": "x", "OP": "**"}]])
+    with pytest.raises(ValueError, match="Unsupported predicate"):
+        validate_token_patterns([[{"TEXT": {"LIKE": "x"}}]])
+    with pytest.raises(Exception):  # invalid regex fails at validation time
+        validate_token_patterns([[{"TEXT": {"REGEX": "["}}]])
+    with pytest.raises(ValueError, match="wants a list"):
+        validate_token_patterns([[{"LOWER": {"IN": "abc"}}]])
+    # all the new syntax validates cleanly
+    validate_token_patterns(
+        [[{"TEXT": {"REGEX": "^a"}, "OP": "{1,3}"}, {"LENGTH": {">=": 2}, "OP": "!"}]]
+    )
+
+
+def test_entity_ruler_with_regex_and_ranges():
+    r = EntityRulerComponent(
+        "entity_ruler",
+        None,
+        patterns=[
+            {"label": "TICKER", "pattern": [{"TEXT": {"REGEX": r"^[A-Z]{2,5}$"}}]},
+            {"label": "CODE", "pattern": [{"IS_DIGIT": True, "OP": "{3}"}]},
+        ],
+    )
+    doc = Doc(words=["buy", "GOOG", "at", "1", "2", "3"])
+    r.set_annotations([doc], None, [6])
+    got = {(s.start, s.end, s.label) for s in doc.ents}
+    assert got == {(1, 2, "TICKER"), (3, 6, "CODE")}
+
+
+def test_attribute_ruler_tag_keyed_retagging():
+    # the canonical spaCy use: retag by POS context — requires the doc's
+    # predicted tags, i.e. the component runs after the tagger
+    ar = AttributeRulerComponent(
+        "attribute_ruler",
+        None,
+        patterns=[
+            {
+                "patterns": [[{"TAG": "VERB"}, {"LOWER": "not"}]],
+                "attrs": {"TAG": "PART"},
+                "index": 1,
+            }
+        ],
+    )
+    doc = Doc(words=["did", "not", "go"], tags=["VERB", "ADV", "VERB"])
+    ar.set_annotations([doc], None, [3])
+    assert doc.tags == ["VERB", "PART", "VERB"]
+
+
+def test_attribute_ruler_matches_before_applying():
+    # spaCy semantics: one matcher pass over the ORIGINAL annotations, then
+    # apply — a rule's own rewrites must not suppress later matches
+    ar = AttributeRulerComponent(
+        "attribute_ruler",
+        None,
+        patterns=[
+            {
+                "patterns": [[{"TAG": "VBZ"}, {"TAG": "VBZ"}]],
+                "attrs": {"TAG": "X"},
+                "index": 1,
+            }
+        ],
+    )
+    doc = Doc(words=["a", "b", "c"], tags=["VBZ", "VBZ", "VBZ"])
+    ar.set_annotations([doc], None, [3])
+    assert doc.tags == ["VBZ", "X", "X"]
+
+
+def test_comparison_arg_types_validated_eagerly():
+    with pytest.raises(ValueError, match="wants a number"):
+        validate_token_patterns([[{"LENGTH": {">=": "10"}}]])
+    with pytest.raises(ValueError, match="wants a string"):
+        validate_token_patterns([[{"TEXT": {">=": 10}}]])
