@@ -137,16 +137,33 @@ def convert_command(argv: List[str]) -> int:
     parser.add_argument("output_path", type=Path)
     args = parser.parse_args(argv)
 
-    from .training.corpus import DocBin, read_conllu_docs, read_jsonl_docs
+    from .training.corpus import DocBin, _iter_path
 
-    if args.input_path.suffix == ".jsonl":
-        docs = list(read_jsonl_docs(args.input_path))
-    elif args.input_path.suffix == ".conllu":
-        docs = list(read_conllu_docs(args.input_path))
-    else:
-        print(f"Unsupported input: {args.input_path}", file=sys.stderr)
+    try:
+        docs = list(_iter_path(args.input_path))
+    except Exception as e:  # corrupt inputs raise zlib/msgpack/Key errors too
+        print(f"Could not read {args.input_path}: {e}", file=sys.stderr)
         return 1
-    DocBin(docs).to_disk(args.output_path)
+    if args.output_path.suffix == ".spacy":
+        # the real spaCy DocBin byte format (readable by spaCy itself);
+        # it cannot carry everything the internal formats can — say so
+        from .training.spacy_docbin import write_docbin
+
+        dropped = set()
+        for d in docs:
+            if d.morphs and any(d.morphs):
+                dropped.add("morphs")
+            if d.spans:
+                dropped.add("span groups")
+        if dropped:
+            print(
+                f"warning: .spacy output drops {', '.join(sorted(dropped))} "
+                "(use .msgdoc/.jsonl to keep them)",
+                file=sys.stderr,
+            )
+        write_docbin(args.output_path, docs)
+    else:
+        DocBin(docs).to_disk(args.output_path)
     print(f"Wrote {len(docs)} docs to {args.output_path}")
     return 0
 

@@ -81,8 +81,8 @@ def validate_token_patterns(patterns) -> None:
                     op = str(want)
                     if not _OP_RE.match(op):
                         raise ValueError(
-                            f"Unsupported OP {want!r}; supported: 1 ? * + ! "
-                            "{{n}} {{n,m}} {{n,}} {{,m}}"
+                            f"Unsupported OP {want!r}; supported: "
+                            "1 ? * + ! {n} {n,m} {n,} {,m}"
                         )
                     _op_bounds(op)  # range syntax must parse
                     continue

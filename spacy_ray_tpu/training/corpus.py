@@ -160,8 +160,19 @@ def _iter_path(path: Path) -> Iterator[Doc]:
         yield from read_jsonl_docs(path)
     elif suffix == ".conllu":
         yield from read_conllu_docs(path)
-    elif suffix in (".msgdoc", ".spacy"):
+    elif suffix == ".msgdoc":
         yield from DocBin.from_disk(path).docs
+    elif suffix == ".spacy":
+        # real spaCy DocBin (zlib-wrapped msgpack); legacy files from this
+        # repo's earlier .spacy spelling were gzip text — sniff the magic
+        with open(path, "rb") as f:
+            magic = f.read(2)
+        if magic == b"\x1f\x8b":
+            yield from DocBin.from_disk(path).docs
+        else:
+            from .spacy_docbin import read_docbin
+
+            yield from read_docbin(path)
     else:
         raise ValueError(f"Unsupported corpus format: {path}")
 

@@ -1,0 +1,175 @@
+"""Real spaCy DocBin (.spacy) format support (training/spacy_docbin.py):
+hash parity with spaCy's string store, byte-format round trip, reading a
+file with spaCy's default attr layout, and convert+train on .spacy.
+VERDICT r1 missing #7 / next #9."""
+
+import zlib
+
+import msgpack
+import numpy as np
+import pytest
+
+from spacy_ray_tpu.pipeline.doc import Doc, Span
+from spacy_ray_tpu.training import spacy_docbin as SD
+from spacy_ray_tpu.training.corpus import Corpus
+
+
+def test_string_hash_matches_spacy():
+    # spaCy's own documented string-store value (Vocab docs)
+    assert SD.spacy_string_hash("coffee") == 3197928453018144401
+    assert SD.spacy_string_hash("") == 0
+
+
+def _docs():
+    return [
+        Doc(
+            words=["Apple", "is", "great"],
+            spaces=[True, True, False],
+            tags=["PROPN", "AUX", "ADJ"],
+            pos=["PROPN", "AUX", "ADJ"],
+            heads=[1, 1, 1],
+            deps=["nsubj", "ROOT", "acomp"],
+            lemmas=["Apple", "be", "great"],
+            sent_starts=[1, 0, 0],
+        ),
+        Doc(
+            words=["visit", "New", "York"],
+            ents=[Span(1, 3, "GPE")],
+            cats={"travel": 1.0},
+        ),
+    ]
+
+
+def test_round_trip(tmp_path):
+    p = tmp_path / "corpus.spacy"
+    SD.write_docbin(p, _docs())
+    got = list(SD.read_docbin(p))
+    a, b = got
+    assert a.words == ["Apple", "is", "great"]
+    assert a.spaces == [True, True, False]
+    assert a.tags == ["PROPN", "AUX", "ADJ"]
+    assert a.heads == [1, 1, 1]
+    assert a.deps == ["nsubj", "ROOT", "acomp"]
+    assert a.lemmas == ["Apple", "be", "great"]
+    assert a.sent_starts == [1, 0, 0]
+    assert b.words == ["visit", "New", "York"]
+    assert [(s.start, s.end, s.label) for s in b.ents] == [(1, 3, "GPE")]
+    assert b.cats == {"travel": 1.0}
+
+
+def test_reads_spacy_default_attr_layout(tmp_path):
+    """Synthesize a file exactly as spaCy's DocBin.to_bytes lays it out:
+    default attrs incl. the version-dependent ENT_KB_ID/MORPH ids (>83),
+    relative HEAD offsets as two's-complement uint64."""
+    H = SD.spacy_string_hash
+    # spaCy default: sorted([ORTH, TAG, HEAD, DEP, ENT_IOB, ENT_TYPE,
+    #                        ENT_KB_ID, LEMMA, MORPH, POS, SPACY? no]) —
+    # SPACY is carried separately; use IDs incl. two >83 (ENT_KB_ID < MORPH)
+    attrs = [65, 73, 74, 75, 76, 77, 78, 79, 452, 453]
+    words = ["dogs", "bark"]
+    morphs = ["Number=Plur", ""]
+    rows = np.zeros((2, len(attrs)), dtype="<u8")
+    col = {a: i for i, a in enumerate(attrs)}
+    for i, w in enumerate(words):
+        rows[i, col[65]] = H(w)                       # ORTH
+        rows[i, col[73]] = H(["dog", "bark"][i])      # LEMMA
+        rows[i, col[74]] = H(["NOUN", "VERB"][i])     # POS
+        rows[i, col[75]] = H(["NNS", "VBP"][i])       # TAG
+        rows[i, col[76]] = H(["nsubj", "ROOT"][i])    # DEP
+        rows[i, col[77]] = 2                          # ENT_IOB = O
+        rows[i, col[78]] = 0                          # ENT_TYPE
+        rows[i, col[453]] = H(morphs[i])              # MORPH (id > 83)
+    rows[0, col[79]] = np.uint64(np.int64(1))         # HEAD delta +1
+    rows[1, col[79]] = 0                              # root
+    strings = ["dogs", "bark", "dog", "NOUN", "VERB", "NNS", "VBP",
+               "nsubj", "ROOT", "Number=Plur"]
+    msg = {
+        "version": "0.1",
+        "attrs": attrs,
+        "tokens": rows.tobytes("C"),
+        "spaces": np.asarray([[True], [False]], dtype=bool).tobytes("C"),
+        "lengths": np.asarray([2], dtype="<i4").tobytes("C"),
+        "strings": strings,
+        "cats": [{}],
+        "flags": [{"has_unknown_spaces": False}],
+    }
+    p = tmp_path / "ext.spacy"
+    p.write_bytes(zlib.compress(msgpack.packb(msg, use_bin_type=True)))
+
+    (doc,) = list(SD.read_docbin(p))
+    assert doc.words == ["dogs", "bark"]
+    assert doc.lemmas == ["dog", "bark"]
+    assert doc.pos == ["NOUN", "VERB"]
+    assert doc.tags == ["NNS", "VBP"]
+    assert doc.deps == ["nsubj", "ROOT"]
+    assert doc.heads == [1, 1]
+    assert doc.morphs == ["Number=Plur", ""]  # resolved positionally
+    assert doc.spaces == [True, False]
+
+
+def test_corpus_reads_spacy_file(tmp_path):
+    p = tmp_path / "train.spacy"
+    SD.write_docbin(p, _docs())
+    egs = list(Corpus(p)())
+    assert len(egs) == 2
+    assert egs[0].reference.words == ["Apple", "is", "great"]
+
+
+@pytest.mark.slow
+def test_convert_and_train_on_spacy_file(tmp_path):
+    """The reference's data flow: corpus -> .spacy -> train
+    (reference bin/get-data.sh:8-12)."""
+    from spacy_ray_tpu.cli import main as cli_main
+    from spacy_ray_tpu.util import write_synth_jsonl
+
+    write_synth_jsonl(tmp_path / "train.jsonl", 120, kind="tagger", seed=0)
+    write_synth_jsonl(tmp_path / "dev.jsonl", 30, kind="tagger", seed=1)
+    rc = cli_main(
+        ["convert", str(tmp_path / "train.jsonl"), str(tmp_path / "train.spacy")]
+    )
+    assert rc == 0
+    rc = cli_main(
+        ["convert", str(tmp_path / "dev.jsonl"), str(tmp_path / "dev.spacy")]
+    )
+    assert rc == 0
+
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.training.loop import train
+
+    cfg_text = open("configs/cnn.cfg").read()
+    cfg = Config.from_str(cfg_text).apply_overrides(
+        {
+            "paths.train": str(tmp_path / "train.spacy"),
+            "paths.dev": str(tmp_path / "dev.spacy"),
+            "training.max_steps": 20,
+            "training.eval_frequency": 10,
+            "components.tok2vec.model.width": 32,
+            "components.tok2vec.model.depth": 2,
+            "components.tok2vec.model.embed_size": 256,
+            "components.tagger.model.tok2vec.width": 32,
+        }
+    )
+    nlp, result = train(cfg, n_workers=1, stdout_log=False)
+    assert result.final_step == 20
+    assert result.best_score > 0.3
+
+
+def test_sent_start_tristate_preserved(tmp_path):
+    # spaCy semantics: 1=start, -1=explicitly-not, 0=unannotated — all three
+    # must survive a round trip (collapsing -1 to 0 would strip every
+    # negative gold label from senter training)
+    doc = Doc(words=["a", "b", "c", "d"], sent_starts=[1, -1, 0, 1])
+    p = tmp_path / "s.spacy"
+    SD.write_docbin(p, [doc])
+    (got,) = list(SD.read_docbin(p))
+    assert got.sent_starts == [1, -1, 0, 1]
+
+
+def test_corrupt_spacy_input_clean_cli_error(tmp_path, capsys):
+    from spacy_ray_tpu.cli import main as cli_main
+
+    bad = tmp_path / "broken.spacy"
+    bad.write_bytes(b"not a docbin at all")
+    rc = cli_main(["convert", str(bad), str(tmp_path / "out.msgdoc")])
+    assert rc == 1
+    assert "Could not read" in capsys.readouterr().err
