@@ -32,7 +32,7 @@ def console_logger(progress_bar: bool = False):
         score_keys = list(nlp.config.get("training", {}).get("score_weights", {}) or {})
         loss_cols = [f"Loss {n}" for n in pipe_names]
         score_cols = score_keys
-        header = ["E", "#", "W"] + loss_cols + score_cols + ["WPS", "Score"]
+        header = ["E", "#", "W"] + loss_cols + score_cols + ["WPS", "EvalS", "Score"]
         widths = [max(len(h), 8) for h in header]
         stdout.write(" ".join(h.rjust(w) for h, w in zip(header, widths)) + "\n")
         stdout.write(" ".join("-" * w for w in widths) + "\n")
@@ -53,7 +53,8 @@ def console_logger(progress_bar: bool = False):
                 val = scores.get(key)
                 col = widths[3 + len(pipe_names) + j]
                 row.append(_fmt(float(val) * 100, col) if val is not None else " " * col)
-            row.append(_fmt(float(info.get("wps", 0.0)), widths[-2], 0))
+            row.append(_fmt(float(info.get("wps", 0.0)), widths[-3], 0))
+            row.append(_fmt(float(info.get("eval_seconds", 0.0)), widths[-2]))
             score = info.get("score")
             row.append(
                 _fmt(float(score) * 100, widths[-1]) if score is not None else " " * widths[-1]
@@ -82,7 +83,10 @@ def jsonl_logger(path: Optional[str] = None):
                 return
             rec = {
                 k: info.get(k)
-                for k in ("epoch", "step", "words", "wps", "score", "losses", "other_scores")
+                for k in (
+                    "epoch", "step", "words", "wps", "eval_seconds",
+                    "score", "losses", "other_scores",
+                )
             }
             line = json.dumps(rec, default=float)
             if handle:

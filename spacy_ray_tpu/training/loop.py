@@ -394,9 +394,11 @@ def train(
         info: Optional[Dict[str, Any]] = None
         if step % eval_frequency == 0:
             drain_metrics()
-            # eval (and best-model save) uses averaged params when enabled
+            # eval (and best-model save) uses averaged params when enabled.
+            # Params stay ON DEVICE through prediction — gathering the full
+            # tree to host every eval (then re-uploading it per dev chunk)
+            # costs two full-model transfers for nothing.
             eval_src = avg_params if use_averages else params
-            host_params = jax.device_get(eval_src)
             # gather_to_host on the (possibly cross-host-sharded) opt state is
             # a COLLECTIVE on multi-host — must run on every process, not just
             # rank 0, or the pod deadlocks
@@ -405,7 +407,9 @@ def train(
                 if output_path is not None
                 else None
             )
-            scores = nlp.evaluate(dev_examples, host_params)
+            eval_t0 = time.perf_counter()
+            scores = nlp.evaluate(dev_examples, eval_src)
+            eval_seconds = time.perf_counter() - eval_t0
             score = weighted_score(scores, T.get("score_weights") or {})
             now = time.perf_counter()
             wps = words_since_log / max(now - last_log_time, 1e-9)
@@ -419,6 +423,7 @@ def train(
                 "other_scores": scores,
                 "score": score,
                 "wps": wps,
+                "eval_seconds": eval_seconds,
             }
             result.history.append(info)
             loss_accum = {}
@@ -426,7 +431,7 @@ def train(
                 best_score = score
                 best_step = step
                 if output_path is not None and jax.process_index() == 0:
-                    nlp.params = host_params
+                    nlp.params = jax.device_get(eval_src)
                     nlp.to_disk(Path(output_path) / "best-model")
             if output_path is not None and jax.process_index() == 0:
                 TrainCheckpoint.save(

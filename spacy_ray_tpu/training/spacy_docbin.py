@@ -110,14 +110,18 @@ def _resolve_attr_names(attr_ids: List[int]) -> List[Optional[str]]:
     resolved positionally (enum order ENT_KB_ID < MORPH < ENT_ID)."""
     high = sorted(a for a in attr_ids if a > 83)
     high_names: Dict[int, str] = {}
+    # only when the low IDs are the standard DocBin set is the high pair
+    # reliably (ENT_KB_ID, MORPH) — a custom attr config could carry e.g.
+    # (ENT_KB_ID, ENT_ID), and misreading entity IDs as morphs is worse
+    # than skipping the column
+    default_lows = {65, 73, 74, 75, 76, 77, 78, 79}
+    lows = {a for a in attr_ids if a <= 83}
     if len(high) == 3:
-        names = ["ENT_KB_ID", "MORPH", "ENT_ID"]
-    elif len(high) == 2:
+        names = ["ENT_KB_ID", "MORPH", "ENT_ID"]  # enum order, unambiguous
+    elif len(high) == 2 and default_lows <= lows:
         names = ["ENT_KB_ID", "MORPH"]  # the DocBin default pair
-    elif len(high) == 1:
-        names = [None]  # ambiguous: skip rather than misread
     else:
-        names = []
+        names = [None] * len(high)  # ambiguous: skip rather than misread
     for a, nm in zip(high, names):
         if nm:
             high_names[a] = nm
@@ -140,6 +144,7 @@ def read_docbin_bytes(data: bytes) -> Iterator[Doc]:
     hash_to_str = {spacy_string_hash(s): s for s in msg.get("strings", [])}
     hash_to_str[0] = ""
     cats = msg.get("cats") or [None] * len(lengths)
+    flags = msg.get("flags") or [{}] * len(lengths)
 
     col: Dict[str, int] = {nm: i for i, nm in enumerate(names) if nm}
 
@@ -150,9 +155,14 @@ def read_docbin_bytes(data: bytes) -> Iterator[Doc]:
     for di, n in enumerate(lengths):
         n = int(n)
         rows = tokens[offset : offset + n]
+        unknown_spaces = bool(
+            di < len(flags) and (flags[di] or {}).get("has_unknown_spaces")
+        )
         doc_spaces = (
             [bool(x) for x in spaces_all[offset : offset + n]]
-            if spaces_all is not None and len(spaces_all) >= offset + n
+            if not unknown_spaces
+            and spaces_all is not None
+            and len(spaces_all) >= offset + n
             else None
         )
         offset += n
@@ -172,6 +182,11 @@ def read_docbin_bytes(data: bytes) -> Iterator[Doc]:
             heads = [int(i + d) for i, d in enumerate(deltas)]
             if any(not (0 <= h < n) for h in heads):
                 heads = None  # corrupt column: drop rather than crash training
+            elif "DEP" in col and not any(sval(r, "DEP") for r in rows):
+                # spaCy marks "no parse" via empty DEP labels (heads default
+                # to self) — all-self-root deltas with no labels are missing
+                # annotation, not a fabricated flat tree
+                heads = None
         sent_starts = None
         if "SENT_START" in col:
             ss = rows[:, col["SENT_START"]].astype(np.int64)
@@ -242,7 +257,10 @@ def write_docbin(path: Union[str, Path], docs: Iterable[Doc]) -> None:
         lengths.append(n)
         cats.append(dict(doc.cats) if doc.cats else {})
         flags.append({"has_unknown_spaces": doc.spaces is None})
-        ent_iob = np.full(n, 2, np.int64)  # O
+        # no ents at all -> ENT_IOB 0 (missing annotation); writing explicit
+        # O everywhere would fabricate negative NER gold for consumers that
+        # honor the 0-vs-2 distinction (spaCy does)
+        ent_iob = np.full(n, 2 if doc.ents else 0, np.int64)
         ent_type = [""] * n
         for s in doc.ents:
             for i in range(s.start, s.end):

@@ -173,3 +173,47 @@ def test_corrupt_spacy_input_clean_cli_error(tmp_path, capsys):
     rc = cli_main(["convert", str(bad), str(tmp_path / "out.msgdoc")])
     assert rc == 1
     assert "Could not read" in capsys.readouterr().err
+
+
+def test_unannotated_fields_round_trip_as_missing(tmp_path):
+    # no heads, no ents, unknown spaces: must come back as MISSING, not as
+    # a fabricated all-self-root tree / explicit-O gold / all-True spaces
+    doc = Doc(words=["just", "words"], tags=["ADV", "NOUN"])
+    p = tmp_path / "u.spacy"
+    SD.write_docbin(p, [doc])
+    (got,) = list(SD.read_docbin(p))
+    assert got.heads is None
+    assert got.ents == []
+    assert got.spaces is None
+    # and the raw ENT_IOB column is 0 (missing), not 2 (explicit O)
+    msg = msgpack.unpackb(zlib.decompress(p.read_bytes()), raw=False)
+    attrs = msg["attrs"]
+    rows = np.frombuffer(msg["tokens"], dtype="<u8").reshape(2, len(attrs))
+    iob_col = attrs.index(77)
+    assert rows[:, iob_col].tolist() == [0, 0]
+
+
+def test_ambiguous_high_attr_pair_skipped_not_misread(tmp_path):
+    # custom attr set: ORTH + two version-dependent IDs that are NOT the
+    # default (ENT_KB_ID, MORPH) pair — must be skipped, not read as morphs
+    H = SD.spacy_string_hash
+    attrs = [65, 452, 454]  # ORTH + e.g. ENT_KB_ID + ENT_ID
+    rows = np.zeros((1, 3), dtype="<u8")
+    rows[0, 0] = H("hi")
+    rows[0, 1] = H("Q42")
+    rows[0, 2] = H("Q42")
+    msg = {
+        "version": "0.1",
+        "attrs": attrs,
+        "tokens": rows.tobytes("C"),
+        "spaces": np.asarray([[True]], dtype=bool).tobytes("C"),
+        "lengths": np.asarray([1], dtype="<i4").tobytes("C"),
+        "strings": ["hi", "Q42"],
+        "cats": [{}],
+        "flags": [{}],
+    }
+    p = tmp_path / "c.spacy"
+    p.write_bytes(zlib.compress(msgpack.packb(msg, use_bin_type=True)))
+    (doc,) = list(SD.read_docbin(p))
+    assert doc.words == ["hi"]
+    assert doc.morphs is None  # NOT "Q42"
