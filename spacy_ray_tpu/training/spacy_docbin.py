@@ -182,10 +182,15 @@ def read_docbin_bytes(data: bytes) -> Iterator[Doc]:
             heads = [int(i + d) for i, d in enumerate(deltas)]
             if any(not (0 <= h < n) for h in heads):
                 heads = None  # corrupt column: drop rather than crash training
-            elif "DEP" in col and not any(sval(r, "DEP") for r in rows):
-                # spaCy marks "no parse" via empty DEP labels (heads default
-                # to self) — all-self-root deltas with no labels are missing
-                # annotation, not a fabricated flat tree
+            elif (
+                not deltas.any()
+                and "DEP" in col
+                and not any(sval(r, "DEP") for r in rows)
+            ):
+                # spaCy's "no parse" default: ALL heads self (zero deltas)
+                # AND all DEP labels empty — that exact combination is
+                # missing annotation, not a fabricated flat tree. Real heads
+                # with empty labels (deltas.any()) are kept.
                 heads = None
         sent_starts = None
         if "SENT_START" in col:

@@ -217,3 +217,13 @@ def test_ambiguous_high_attr_pair_skipped_not_misread(tmp_path):
     (doc,) = list(SD.read_docbin(p))
     assert doc.words == ["hi"]
     assert doc.morphs is None  # NOT "Q42"
+
+
+def test_real_heads_with_empty_deps_are_kept(tmp_path):
+    # heads annotated but dep labels empty: only the exact spaCy no-parse
+    # default (all-self-root AND all-empty DEP) means missing
+    doc = Doc(words=["a", "b"], heads=[1, 1], deps=["", ""])
+    p = tmp_path / "h.spacy"
+    SD.write_docbin(p, [doc])
+    (got,) = list(SD.read_docbin(p))
+    assert got.heads == [1, 1]

@@ -1,0 +1,106 @@
+"""spaCy-architecture tokenizer: exceptions, prefix/suffix/infix rules,
+URL/email/number token_match, and exact text reconstruction."""
+
+import pytest
+
+from spacy_ray_tpu.pipeline.tokenizer import Tokenizer
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return Tokenizer()
+
+
+def words(tok, text):
+    return tok(text).words
+
+
+def reconstruct(doc):
+    return "".join(
+        w + (" " if s else "") for w, s in zip(doc.words, doc.spaces)
+    )
+
+
+def test_basic_punct(tok):
+    assert words(tok, "Hello, world!") == ["Hello", ",", "world", "!"]
+    assert words(tok, '(He said "hi".)') == [
+        "(", "He", "said", '"', "hi", '"', ".", ")",
+    ]
+
+
+def test_contractions(tok):
+    assert words(tok, "don't") == ["do", "n't"]
+    assert words(tok, "can't") == ["ca", "n't"]
+    assert words(tok, "Won't") == ["Wo", "n't"]
+    assert words(tok, "I'm we're they've she'll he'd") == [
+        "I", "'m", "we", "'re", "they", "'ve", "she", "'ll", "he", "'d",
+    ]
+    assert words(tok, "the dog's bone") == ["the", "dog", "'s", "bone"]
+
+
+def test_abbreviations_keep_period(tok):
+    assert words(tok, "Dr. Smith vs. Mr. Jones etc.") == [
+        "Dr.", "Smith", "vs.", "Mr.", "Jones", "etc.",
+    ]
+    assert words(tok, "the U.S. economy, e.g. trade") == [
+        "the", "U.S.", "economy", ",", "e.g.", "trade",
+    ]
+
+
+def test_urls_and_emails_kept_whole(tok):
+    assert words(tok, "see https://example.com/a?b=1, ok") == [
+        "see", "https://example.com/a?b=1", ",", "ok",
+    ]
+    assert words(tok, "mail me@example.co.uk today") == [
+        "mail", "me@example.co.uk", "today",
+    ]
+    assert words(tok, "visit www.example.org!") == [
+        "visit", "www.example.org", "!",
+    ]
+
+
+def test_numbers(tok):
+    assert words(tok, "costs 1,234.56 now") == ["costs", "1,234.56", "now"]
+    assert words(tok, "$5 and 10%") == ["$", "5", "and", "10", "%"]
+
+
+def test_infixes(tok):
+    assert words(tok, "a well-known fact") == ["a", "well", "-", "known", "fact"]
+    assert words(tok, "either/or") == ["either", "/", "or"]
+    assert words(tok, "wait...done") == ["wait", "...", "done"]
+    assert words(tok, "one--two") == ["one", "--", "two"]
+
+
+def test_quotes_and_brackets(tok):
+    assert words(tok, "[it's 'fine']") == ["[", "it", "'s", "'", "fine", "'", "]"]
+
+
+def test_text_reconstruction(tok):
+    for text in (
+        "Hello, world! It's Dr. Smith's turn.",
+        "(See https://x.io/a, e.g. the well-known case...)",
+        "I'm gonna pay $1,234.56 -- really!",
+    ):
+        doc = tok(text)
+        # collapse whitespace: alignment guarantees single-space recovery
+        assert reconstruct(doc).split() == text.split()
+        assert "".join(doc.words).replace(" ", "") == text.replace(" ", "")
+
+
+def test_bad_exception_rejected():
+    with pytest.raises(ValueError, match="concatenate"):
+        Tokenizer(exceptions={"don't": ["do", "not"]})
+
+
+def test_custom_rules():
+    t = Tokenizer(infixes=[r"\+"])
+    assert t("a+b").words == ["a", "+", "b"]
+
+
+def test_midchunk_punctuation_splits(tok):
+    assert words(tok, "yes;no") == ["yes", ";", "no"]
+    assert words(tok, "end.Next") == ["end", ".", "Next"]
+    assert words(tok, "time:30") == ["time", ":", "30"]
+    assert words(tok, "foo(bar)") == ["foo", "(", "bar", ")"]
+    # numbers keep their internal separators (token_match wins)
+    assert words(tok, "1,000") == ["1,000"]
