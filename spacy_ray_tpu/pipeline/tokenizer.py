@@ -44,11 +44,16 @@ DEFAULT_PREFIXES: Sequence[str] = (
     r"[-–—]",
 )
 
-_CLITICS = r"(?:'s|'S|’s|’S|n't|N'T|n’t|'ll|'re|'ve|'m|'d|'LL|'RE|'VE|'M|'D)"
+_CLITICS = (
+    r"(?:['’]s|['’]S|n['’]t|N['’]T"
+    r"|['’]ll|['’]re|['’]ve|['’]m|['’]d"
+    r"|['’]LL|['’]RE|['’]VE|['’]M|['’]D)"
+)
 
 DEFAULT_SUFFIXES: Sequence[str] = (
     rf"[{_CLOSERS}]",
     rf"[{re.escape(_QUOTES)}]",
+    rf"[{re.escape(_CURRENCY)}]",         # 50€
     r"\.\.\.|…",
     r"[.,!?:;%°]",
     r"[-–—]",
@@ -63,6 +68,7 @@ DEFAULT_INFIXES: Sequence[str] = (
     r"(?<=\w)[,;:!?](?=\w)",              # missing space after punctuation
     r"(?<=[a-z0-9])\.(?=[A-Z])",          # sentence glue: end.Next
     r"(?<=[a-zA-Z])[/](?=[a-zA-Z])",      # either/or
+    r"(?<=\w)[=+~*&^|](?=\w)",            # symbol glue: price=5, a+b
 )
 
 # kept whole regardless of punctuation inside (spaCy's token_match/url_match).
@@ -163,25 +169,27 @@ class Tokenizer:
             return self._tokenize_chunk(chunk[: m.start()], depth + 1) + [m.group(0)]
         if m and m.start() == 0:
             return [chunk]
-        pieces: List[str] = []
+        pieces: List[tuple] = []  # (text, is_infix_token)
         pos = 0
         for im in self._infix_re.finditer(chunk):
             if im.start() == 0 or im.end() == im.start():
                 continue
             if im.start() > pos:
-                pieces.append(chunk[pos : im.start()])
-            pieces.append(im.group(0))
+                pieces.append((chunk[pos : im.start()], False))
+            pieces.append((im.group(0), True))
             pos = im.end()
         if pos == 0:
             return [chunk]
         if pos < len(chunk):
-            pieces.append(chunk[pos:])
+            pieces.append((chunk[pos:], False))
+        # re-tokenize the non-infix pieces fully: "it's,fine" must split the
+        # clitic in "it's" exactly as it would with a space after it
         out: List[str] = []
-        for piece in pieces:
-            if piece in self.exceptions:
-                out.extend(self.exceptions[piece])
-            else:
+        for piece, is_infix in pieces:
+            if is_infix:
                 out.append(piece)
+            else:
+                out.extend(self._tokenize_chunk(piece, depth + 1))
         return out
 
 

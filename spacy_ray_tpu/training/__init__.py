@@ -4,3 +4,4 @@ from . import corpus  # noqa: F401  (registers readers)
 from . import batcher  # noqa: F401  (registers batchers/schedules)
 from . import optimizers  # noqa: F401  (registers optimizers/schedules)
 from . import loggers  # noqa: F401  (registers loggers)
+from . import augment  # noqa: F401  (registers augmenters)

@@ -1,0 +1,102 @@
+"""Data augmenters for the [corpora.train.augmenter] config slot.
+
+Capability parity with spaCy's training augmenters (spacy/training/augment.py
+— part of the training stack the reference drives, SURVEY.md §1 E2). An
+augmenter is ``Example -> Iterator[Example]``, applied to the training
+stream every epoch (training/corpus.py ``Corpus._augment``); yielding the
+original plus variants oversamples, yielding only a variant rewrites.
+
+Registered (same names as spaCy so configs port unchanged):
+
+* ``spacy.lower_case.v1(level)`` — with probability ``level``, also yield a
+  fully lower-cased copy of the example.
+* ``spacy.orth_variants.v1(level, lower, orth_variants)`` — with
+  probability ``level``, yield a copy where tokens are swapped for
+  spelling variants: ``orth_variants = {"single": [{"tags": [...],
+  "variants": [...]}, ...]}`` replaces any token whose text is in a
+  variant group (and whose tag matches, when tags are given) with another
+  member of the group; with probability ``lower`` the copy is additionally
+  lower-cased.
+
+Augmented copies keep all gold annotation (tags/heads/deps/ents/spans) —
+only surface forms change, which is the point: the model must be robust to
+casing/spelling variation the gold structure is invariant to.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from ..pipeline.doc import Doc, Example
+from ..registry import registry
+
+
+def _copy_with_words(doc: Doc, words: List[str]) -> Doc:
+    import copy
+
+    new = copy.deepcopy(doc)
+    new.words = list(words)
+    return new
+
+
+def _lowered(doc: Doc) -> Doc:
+    return _copy_with_words(doc, [w.lower() for w in doc.words])
+
+
+@registry.augmenters("spacy.lower_case.v1")
+def create_lower_casing_augmenter(level: float = 0.3, seed: int = 0) -> Callable:
+    rng = random.Random(seed)
+
+    def augment(eg: Example) -> Iterator[Example]:
+        yield eg
+        if rng.random() < level:
+            yield Example.from_gold(_lowered(eg.reference))
+
+    return augment
+
+
+@registry.augmenters("spacy.orth_variants.v1")
+def create_orth_variants_augmenter(
+    level: float = 0.3,
+    lower: float = 0.0,
+    orth_variants: Optional[Dict[str, Any]] = None,
+    seed: int = 0,
+) -> Callable:
+    singles = (orth_variants or {}).get("single", [])
+    # word -> (variant group, tag restriction) for O(1) lookup
+    table: Dict[str, Any] = {}
+    for entry in singles:
+        variants = entry.get("variants", [])
+        tags = set(entry.get("tags", []))
+        for v in variants:
+            table[v] = (variants, tags)
+    rng = random.Random(seed)
+
+    def augment(eg: Example) -> Iterator[Example]:
+        yield eg
+        if rng.random() >= level:
+            return
+        ref = eg.reference
+        new_words = list(ref.words)
+        changed = False
+        for i, w in enumerate(new_words):
+            hit = table.get(w)
+            if hit is None:
+                continue
+            variants, tags = hit
+            if tags and (not ref.tags or ref.tags[i] not in tags):
+                continue
+            alt = [v for v in variants if v != w]
+            if alt:
+                new_words[i] = rng.choice(alt)
+                changed = True
+        do_lower = rng.random() < lower
+        if not changed and not do_lower:
+            return
+        doc = _copy_with_words(ref, new_words)
+        if do_lower:
+            doc.words = [w.lower() for w in doc.words]
+        yield Example.from_gold(doc)
+
+    return augment

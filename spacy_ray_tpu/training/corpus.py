@@ -200,6 +200,7 @@ class Corpus:
         shuffle: bool = False,
         seed: int = 0,
         cache: bool = True,
+        augmenter: Optional[Callable] = None,
     ):
         self.path = Path(path)
         self.max_length = max_length
@@ -207,6 +208,7 @@ class Corpus:
         self.shuffle = shuffle
         self.seed = seed
         self.cache = cache  # materialize once; reuse Example objects across
+        self.augmenter = augmenter  # Example -> Iterator[Example], per epoch
         self._examples: Optional[List[Example]] = None  # epochs (enables the
         self._epoch = 0  # parser's per-Example oracle memo); cache=false
         # streams from disk every epoch for larger-than-RAM corpora
@@ -275,7 +277,7 @@ class Corpus:
             # pure streaming path (larger-than-RAM corpora)
             n = 0
             for eg in self._read_examples():
-                yield eg
+                yield from self._augment(eg)
                 n += 1
                 if self.limit and n >= self.limit:
                     return
@@ -293,7 +295,17 @@ class Corpus:
             examples = [examples[i] for i in order]
         if self.limit:
             examples = examples[: self.limit]
-        yield from examples
+        for eg in examples:
+            yield from self._augment(eg)
+
+    def _augment(self, eg: Example) -> Iterator[Example]:
+        # applied per epoch, AFTER caching: augmented copies are fresh
+        # Example objects, the cached originals stay pristine (the parser's
+        # oracle memo keys on gold content, so no staleness either way)
+        if self.augmenter is None:
+            yield eg
+        else:
+            yield from self.augmenter(eg)
 
 
 @registry.readers("spacy.Corpus.v1")
@@ -310,7 +322,8 @@ def create_corpus(
     if path is None:
         raise ValueError("Corpus path is required (set [paths.train]/[paths.dev])")
     return Corpus(
-        path, max_length=max_length, limit=limit, shuffle=shuffle, seed=seed, cache=cache
+        path, max_length=max_length, limit=limit, shuffle=shuffle, seed=seed,
+        cache=cache, augmenter=augmenter,
     )
 
 

@@ -1,0 +1,79 @@
+"""Training-data augmenters ([corpora.train.augmenter] slot):
+spacy.lower_case.v1 / spacy.orth_variants.v1, wired through the Corpus."""
+
+import json
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.registry import registry
+from spacy_ray_tpu.training.augment import (
+    create_lower_casing_augmenter,
+    create_orth_variants_augmenter,
+)
+from spacy_ray_tpu.training.corpus import Corpus, _doc_to_json
+from spacy_ray_tpu.util import synth_corpus
+
+
+def test_lower_case_augmenter_yields_original_and_lowered():
+    aug = create_lower_casing_augmenter(level=1.0)
+    (eg,) = synth_corpus(1, "tagger", seed=0)
+    eg.reference.words = ["The", "DOG"]
+    eg.reference.tags = ["DET", "NOUN"]
+    out = list(aug(eg))
+    assert len(out) == 2
+    assert out[0] is eg
+    assert out[1].reference.words == ["the", "dog"]
+    # gold annotation survives the surface change
+    assert out[1].reference.tags == ["DET", "NOUN"]
+
+
+def test_orth_variants_swaps_group_members():
+    aug = create_orth_variants_augmenter(
+        level=1.0,
+        orth_variants={"single": [{"tags": [], "variants": ["colour", "color"]}]},
+        seed=1,
+    )
+    (eg,) = synth_corpus(1, "tagger", seed=0)
+    eg.reference.words = ["nice", "colour"]
+    eg.reference.tags = ["ADJ", "NOUN"]
+    outs = list(aug(eg))
+    assert len(outs) == 2
+    assert outs[1].reference.words == ["nice", "color"]
+
+
+def test_orth_variants_respects_tag_restriction():
+    aug = create_orth_variants_augmenter(
+        level=1.0,
+        orth_variants={"single": [{"tags": ["VERB"], "variants": ["colour", "color"]}]},
+    )
+    (eg,) = synth_corpus(1, "tagger", seed=0)
+    eg.reference.words = ["colour"]
+    eg.reference.tags = ["NOUN"]  # not VERB -> no swap, no extra example
+    assert len(list(aug(eg))) == 1
+
+
+def test_corpus_applies_augmenter_per_epoch(tmp_path):
+    p = tmp_path / "c.jsonl"
+    with open(p, "w", encoding="utf8") as f:
+        for eg in synth_corpus(5, "tagger", seed=0):
+            f.write(json.dumps(_doc_to_json(eg.reference)) + "\n")
+    corpus = Corpus(p, augmenter=create_lower_casing_augmenter(level=1.0))
+    epoch1 = list(corpus())
+    epoch2 = list(corpus())
+    assert len(epoch1) == 10  # 5 originals + 5 lowered
+    assert len(epoch2) == 10
+    # cached originals stay pristine
+    assert any(w != w.lower() for eg in epoch1[::2] for w in eg.reference.words)
+
+
+def test_config_resolves_augmenter(tmp_path):
+    p = tmp_path / "c.jsonl"
+    with open(p, "w", encoding="utf8") as f:
+        for eg in synth_corpus(3, "tagger", seed=0):
+            f.write(json.dumps(_doc_to_json(eg.reference)) + "\n")
+    block = {
+        "@readers": "spacy.Corpus.v1",
+        "path": str(p),
+        "augmenter": {"@augmenters": "spacy.lower_case.v1", "level": 1.0},
+    }
+    corpus = registry.resolve(block)
+    assert len(list(corpus())) == 6

@@ -104,3 +104,21 @@ def test_midchunk_punctuation_splits(tok):
     assert words(tok, "foo(bar)") == ["foo", "(", "bar", ")"]
     # numbers keep their internal separators (token_match wins)
     assert words(tok, "1,000") == ["1,000"]
+
+
+def test_infix_pieces_fully_retokenized(tok):
+    # the clitic in "it's" must split the same with or without adjacent punct
+    assert words(tok, "it's,fine") == ["it", "'s", ",", "fine"]
+    assert words(tok, "don't/can't") == ["do", "n't", "/", "ca", "n't"]
+
+
+def test_curly_apostrophe_clitics(tok):
+    assert words(tok, "she’ll win") == ["she", "’ll", "win"]
+    assert words(tok, "I’m here") == ["I", "’m", "here"]
+    assert words(tok, "he’d won’t") == ["he", "’d", "wo", "n’t"]
+
+
+def test_symbol_glue_and_currency_suffix(tok):
+    assert words(tok, "price=5") == ["price", "=", "5"]
+    assert words(tok, "a+b") == ["a", "+", "b"]
+    assert words(tok, "50€") == ["50", "€"]
