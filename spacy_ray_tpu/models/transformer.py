@@ -135,6 +135,67 @@ def apply_transformer_layer(
     return X + out
 
 
+def _pipelined_layers(
+    params, X, mask, ctx, layer_fn, *, depth: int, n_microbatches: int
+):
+    """Run the layer stack under GPipe pipeline parallelism
+    (parallel/pipeline.py). Stacks the per-layer param dicts into leaves
+    with a leading [depth] dim (sharded over 'pipe' by the pipeline), and
+    splits the batch into microbatches along dim 0.
+
+    Inside the pipeline's manual (shard_map) region sharding constraints
+    don't apply, so TP/CP must be off — enforced here rather than
+    producing a cryptic trace error.
+    """
+    from ..parallel import pipeline as ppl
+
+    if pctx.tp_active() or pctx.context_parallel_active():
+        raise ValueError(
+            "pipeline parallelism (pipe axis > 1) cannot be combined with "
+            "model/context axes in this version — use pipe x data"
+        )
+    mesh = pctx.current_mesh()
+    S = int(mesh.shape["pipe"])
+    if depth % S != 0:
+        raise ValueError(f"depth {depth} not divisible by {S} pipeline stages")
+    B = X.shape[0]
+    d = int(mesh.shape.get("data", 1))
+    # each microbatch is sharded over the data axis, so M must divide B/d
+    # (keeping every microbatch's size a multiple of d)
+    per_data = max(B // d, 1)
+    M = min(n_microbatches or 2 * S, per_data)
+    while M > 1 and per_data % M != 0:
+        M -= 1
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[params[f"layer_{i}"] for i in range(depth)]
+    )
+    mb = X.reshape(M, B // M, *X.shape[1:])
+    mb_mask = mask.reshape(M, B // M, mask.shape[1])
+    ctx, sub = ctx.split()
+    rng = sub.rng if sub.rng is not None else jax.random.PRNGKey(0)
+    layers_per_stage = depth // S
+
+    def stage_fn(local_params, x, m, key):
+        # this stage's layers, sequentially; constraints disabled (manual
+        # region) so the dense single-device layer path runs. Fold the
+        # stage index into the key: without it every stage would reuse the
+        # same per-tick dropout masks on different microbatches
+        key = jax.random.fold_in(key, jax.lax.axis_index("pipe"))
+        with pctx.use_mesh(None):
+            def body(x, inp):
+                lp, li = inp
+                y = layer_fn(lp, x, m, jax.random.fold_in(key, li))
+                return y, None
+
+            x, _ = jax.lax.scan(
+                body, x, (local_params, jnp.arange(layers_per_stage))
+            )
+            return x
+
+    out = ppl.spmd_pipeline(stage_fn, stacked, mb, mb_mask, rng)
+    return out.reshape(B, *X.shape[1:])
+
+
 @registry.architectures("spacy_ray_tpu.TransformerEncoder.v1")
 def TransformerEncoder(
     width: int = 768,
@@ -146,12 +207,17 @@ def TransformerEncoder(
     embed_size: int = 10000,
     remat: bool = True,
     init_weights: Optional[str] = None,
+    pp_microbatches: int = 0,
 ) -> Model:
     """Hash-embed featurized transformer trunk (tok2vec-compatible output).
 
     ``remat=True`` wraps each layer in jax.checkpoint — rematerialize
     activations in backward to trade FLOPs for HBM (the standard TPU
     memory/bandwidth tradeoff for deep trunks).
+
+    ``pp_microbatches``: microbatch count for pipeline parallelism; used
+    only when the active mesh has a ``pipe`` axis > 1 (0 = auto: 2x the
+    stage count, a reasonable bubble/memory tradeoff).
 
     ``init_weights``: path to a local .npz (native schema) or .safetensors
     (native or HuggingFace-encoder keys, remapped) checkpoint to start the
@@ -212,9 +278,15 @@ def TransformerEncoder(
         if remat:
             # checkpointed callable takes only pytree args (p, X, mask, rng)
             layer_fn = jax.checkpoint(layer_fn)
-        for i in range(depth):
-            ctx, sub = ctx.split()
-            X = layer_fn(params[f"layer_{i}"], X, mask, sub.rng)
+        if pctx.pipeline_active():
+            X = _pipelined_layers(
+                params, X, mask, ctx, layer_fn, depth=depth,
+                n_microbatches=pp_microbatches,
+            )
+        else:
+            for i in range(depth):
+                ctx, sub = ctx.split()
+                X = layer_fn(params[f"layer_{i}"], X, mask, sub.rng)
         X = O.layer_norm(X, params["ln_f_g"], params["ln_f_b"])
         return Padded(X=X * mask[..., None].astype(X.dtype), mask=mask)
 

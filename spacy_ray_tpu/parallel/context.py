@@ -51,3 +51,7 @@ def tp_active() -> bool:
 
 def context_parallel_active() -> bool:
     return _axis_size("context") > 1
+
+
+def pipeline_active() -> bool:
+    return _axis_size("pipe") > 1

@@ -8,7 +8,8 @@ train_cli.py:66-82: ``ray.init`` + N actor spawn; SURVEY.md §5.8): here
   RayPeerProxy grad push/param broadcast protocol, reference
   proxies.py:71-109);
 * ``model`` — tensor parallelism for large trunks (transformer);
-* ``context`` — sequence/context parallelism (ring attention).
+* ``context`` — sequence/context parallelism (ring attention);
+* ``pipe`` — pipeline parallelism (GPipe schedule, parallel/pipeline.py).
 
 ``--n-workers N`` from the CLI (reference train_cli.py:27) maps to the data
 axis size.
@@ -22,25 +23,27 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("data", "model", "context")
+AXES = ("data", "model", "context", "pipe")
 
 
 def build_mesh(
     n_data: Optional[int] = None,
     n_model: int = 1,
     n_context: int = 1,
+    n_pipe: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
     devices = list(devices) if devices is not None else jax.devices()
     n_total = len(devices)
     if n_data is None:
-        n_data = n_total // (n_model * n_context)
-    want = n_data * n_model * n_context
+        n_data = n_total // (n_model * n_context * n_pipe)
+    want = n_data * n_model * n_context * n_pipe
     if want > n_total:
         raise ValueError(
-            f"Mesh {n_data}x{n_model}x{n_context} needs {want} devices, have {n_total}"
+            f"Mesh {n_data}x{n_model}x{n_context}x{n_pipe} needs "
+            f"{want} devices, have {n_total}"
         )
-    dev_array = np.array(devices[:want]).reshape(n_data, n_model, n_context)
+    dev_array = np.array(devices[:want]).reshape(n_data, n_model, n_context, n_pipe)
     return Mesh(dev_array, AXES)
 
 

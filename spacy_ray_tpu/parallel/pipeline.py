@@ -1,0 +1,112 @@
+"""Pipeline parallelism: GPipe-style SPMD schedule over the ``pipe`` axis.
+
+Absent from the reference (SURVEY.md §2.2 row PP: "NO"); here it is a
+first-class mesh axis for deep trunks whose layer stack exceeds one
+device's HBM. TPU-idiomatic formulation — no per-stage processes, no
+send/recv runtime: ALL devices run the same compiled program
+(``shard_map``), each holding ``depth/S`` of the stacked layer parameters
+(leading dim sharded over ``pipe``), and activations hop stage→stage+1
+via ``lax.ppermute`` over ICI inside a ``lax.scan`` of ``M + S - 1``
+ticks for M microbatches:
+
+    tick t: stage s processes microbatch (t - s); stage 0 feeds microbatch
+    t in; stage S-1 writes microbatch (t - S + 1) out.
+
+The bubble fraction is (S-1)/(M+S-1) — pick M >= S. Everything is
+differentiable (ppermute/psum transpose), so the same schedule runs the
+backward pass in reverse. Composes with the ``data`` axis (microbatch dim
+sharded over data); combining with model/context axes inside the pipeline
+is not supported in this version — the stage body runs with sharding
+constraints disabled (it executes inside the manual shard_map region).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax import shard_map  # jax >= 0.7
+    _CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+    _CHECK_KW = "check_rep"
+from jax.sharding import PartitionSpec as P
+
+from . import context as pctx
+
+AXIS = "pipe"
+
+
+def spmd_pipeline(
+    stage_fn: Callable,
+    stacked_params: Any,
+    microbatches: jnp.ndarray,
+    masks: jnp.ndarray,
+    rng: jax.Array,
+) -> jnp.ndarray:
+    """Run the pipelined layer stack.
+
+    stage_fn(local_params, x, mask, rng) -> y applies ONE STAGE's layers
+    to one microbatch (local_params leaves have leading dim depth/S).
+
+    stacked_params: pytree, leaves [depth, ...] (sharded over 'pipe' here).
+    microbatches:   [M, mb, T, D] activations (embedding+positions done).
+    masks:          [M, mb, T].
+    Returns [M, mb, T, D], replicated over the pipe axis.
+    """
+    mesh = pctx.current_mesh()
+    assert mesh is not None and AXIS in mesh.shape, "spmd_pipeline needs a pipe axis"
+    S = int(mesh.shape[AXIS])
+    M = int(microbatches.shape[0])
+    data = "data" if "data" in mesh.shape and mesh.shape["data"] > 1 else None
+
+    x_spec = P(None, data, None, None)  # [M, mb/data, T, D]
+    mask_spec = P(None, data, None)
+    param_spec = P(AXIS)  # leading (stacked-depth) dim -> stages
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(param_spec, x_spec, mask_spec, P()),
+        out_specs=x_spec,
+        **{_CHECK_KW: False},
+    )
+    def run(local_params, xs, ms, key):
+        stage = jax.lax.axis_index(AXIS)
+        state = jnp.zeros_like(xs[0])
+        outputs = jnp.zeros_like(xs)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def body(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (clipped: harmless compute on
+            # stale data during drain ticks, results never written)
+            feed = xs[jnp.clip(t, 0, M - 1)]
+            x = jnp.where(stage == 0, feed, state)
+            # the microbatch THIS stage processes at tick t is (t - stage)
+            mask = ms[jnp.clip(t - stage, 0, M - 1)]
+            y = stage_fn(local_params, x, mask, jax.random.fold_in(key, t))
+            out_idx = t - (S - 1)
+            write = (stage == S - 1) & (out_idx >= 0)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outputs, y, jnp.clip(out_idx, 0, M - 1), 0
+            )
+            outputs = jnp.where(write, updated, outputs)
+            state = jax.lax.ppermute(y, AXIS, perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = jax.lax.scan(
+            body, (state, outputs), jnp.arange(M + S - 1)
+        )
+        # finished microbatches live on the last stage; broadcast so the
+        # (pipe-replicated) heads downstream see them everywhere
+        outputs = jax.lax.psum(
+            jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)), AXIS
+        )
+        return outputs
+
+    return run(stacked_params, microbatches, masks, rng)

@@ -6,17 +6,21 @@ augmenter is ``Example -> Iterator[Example]``, applied to the training
 stream every epoch (training/corpus.py ``Corpus._augment``); yielding the
 original plus variants oversamples, yielding only a variant rewrites.
 
-Registered (same names as spaCy so configs port unchanged):
+Registered (same names AND semantics as spaCy so configs port unchanged —
+the variant REPLACES the original with probability ``level``; the epoch
+size does not change):
 
-* ``spacy.lower_case.v1(level)`` — with probability ``level``, also yield a
-  fully lower-cased copy of the example.
+* ``spacy.lower_case.v1(level)`` — with probability ``level``, yield a
+  fully lower-cased copy instead of the original.
 * ``spacy.orth_variants.v1(level, lower, orth_variants)`` — with
   probability ``level``, yield a copy where tokens are swapped for
   spelling variants: ``orth_variants = {"single": [{"tags": [...],
-  "variants": [...]}, ...]}`` replaces any token whose text is in a
-  variant group (and whose tag matches, when tags are given) with another
-  member of the group; with probability ``lower`` the copy is additionally
-  lower-cased.
+  "variants": [...]}, ...], "paired": [{"tags": [...], "variants":
+  [["``", "''"], ['"', '"']]}, ...]}``. "single" groups replace any member
+  token with another member; "paired" groups (quote pairs) pick one target
+  pair per doc and map each matched token to the same position in it.
+  Tag restrictions apply when given; with probability ``lower`` the copy
+  is additionally lower-cased.
 
 Augmented copies keep all gold annotation (tags/heads/deps/ents/spans) —
 only surface forms change, which is the point: the model must be robust to
@@ -49,9 +53,10 @@ def create_lower_casing_augmenter(level: float = 0.3, seed: int = 0) -> Callable
     rng = random.Random(seed)
 
     def augment(eg: Example) -> Iterator[Example]:
-        yield eg
         if rng.random() < level:
             yield Example.from_gold(_lowered(eg.reference))
+        else:
+            yield eg
 
     return augment
 
@@ -64,6 +69,7 @@ def create_orth_variants_augmenter(
     seed: int = 0,
 ) -> Callable:
     singles = (orth_variants or {}).get("single", [])
+    paired = (orth_variants or {}).get("paired", [])
     # word -> (variant group, tag restriction) for O(1) lookup
     table: Dict[str, Any] = {}
     for entry in singles:
@@ -71,28 +77,48 @@ def create_orth_variants_augmenter(
         tags = set(entry.get("tags", []))
         for v in variants:
             table[v] = (variants, tags)
+    # word -> (position in its pair, all pair groups, tag restriction)
+    pair_table: Dict[str, Any] = {}
+    for entry in paired:
+        groups = entry.get("variants", [])
+        tags = set(entry.get("tags", []))
+        for group in groups:
+            for pos, form in enumerate(group):
+                pair_table.setdefault(form, (pos, groups, tags))
     rng = random.Random(seed)
 
     def augment(eg: Example) -> Iterator[Example]:
-        yield eg
         if rng.random() >= level:
+            yield eg
             return
         ref = eg.reference
         new_words = list(ref.words)
         changed = False
+        chosen_pairs: Dict[int, List[str]] = {}  # id(groups) -> target pair
         for i, w in enumerate(new_words):
             hit = table.get(w)
-            if hit is None:
-                continue
-            variants, tags = hit
-            if tags and (not ref.tags or ref.tags[i] not in tags):
-                continue
-            alt = [v for v in variants if v != w]
-            if alt:
-                new_words[i] = rng.choice(alt)
-                changed = True
+            if hit is not None:
+                variants, tags = hit
+                if not tags or (ref.tags and ref.tags[i] in tags):
+                    alt = [v for v in variants if v != w]
+                    if alt:
+                        new_words[i] = rng.choice(alt)
+                        changed = True
+                    continue
+            phit = pair_table.get(w)
+            if phit is not None:
+                pos, groups, tags = phit
+                if tags and (not ref.tags or ref.tags[i] not in tags):
+                    continue
+                # one consistent target pair per doc per group set, so an
+                # opening quote and its closer swap together
+                target = chosen_pairs.setdefault(id(groups), rng.choice(groups))
+                if pos < len(target) and target[pos] != w:
+                    new_words[i] = target[pos]
+                    changed = True
         do_lower = rng.random() < lower
         if not changed and not do_lower:
+            yield eg
             return
         doc = _copy_with_words(ref, new_words)
         if do_lower:

@@ -58,6 +58,7 @@ DEFAULT_TRAINING = {
     "train_corpus": "corpora.train",
     "score_weights": {},
     "zero1": False,
+    "mesh": {},  # {"n_model": .., "n_context": .., "n_pipe": ..} axis sizes
 }
 
 
@@ -163,7 +164,13 @@ def train(
             )
 
     # ---- mesh / optimizer / step ----
-    mesh = build_mesh(n_data=n_workers)
+    mesh_cfg = dict(T.get("mesh") or {})
+    mesh = build_mesh(
+        n_data=n_workers if n_workers is not None else mesh_cfg.get("n_data"),
+        n_model=int(mesh_cfg.get("n_model", 1)),
+        n_context=int(mesh_cfg.get("n_context", 1)),
+        n_pipe=int(mesh_cfg.get("n_pipe", 1)),
+    )
     n_data = mesh.shape["data"]
     tx = registry.resolve(T.get("optimizer") or {"@optimizers": "Adam.v1"})
     tx = _optimizers.mask_frozen(tx, nlp.params)  # skip frozen_ leaves entirely

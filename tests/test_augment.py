@@ -1,5 +1,7 @@
 """Training-data augmenters ([corpora.train.augmenter] slot):
-spacy.lower_case.v1 / spacy.orth_variants.v1, wired through the Corpus."""
+spacy.lower_case.v1 / spacy.orth_variants.v1 with spaCy's REPLACE
+semantics (a variant substitutes the original; epoch size is unchanged),
+wired through the Corpus."""
 
 import json
 
@@ -13,17 +15,25 @@ from spacy_ray_tpu.training.corpus import Corpus, _doc_to_json
 from spacy_ray_tpu.util import synth_corpus
 
 
-def test_lower_case_augmenter_yields_original_and_lowered():
+def test_lower_case_augmenter_replaces_original():
     aug = create_lower_casing_augmenter(level=1.0)
     (eg,) = synth_corpus(1, "tagger", seed=0)
     eg.reference.words = ["The", "DOG"]
     eg.reference.tags = ["DET", "NOUN"]
     out = list(aug(eg))
-    assert len(out) == 2
-    assert out[0] is eg
-    assert out[1].reference.words == ["the", "dog"]
+    # spaCy semantics: level=1.0 -> the lowered copy INSTEAD of the original
+    assert len(out) == 1
+    assert out[0] is not eg
+    assert out[0].reference.words == ["the", "dog"]
     # gold annotation survives the surface change
-    assert out[1].reference.tags == ["DET", "NOUN"]
+    assert out[0].reference.tags == ["DET", "NOUN"]
+
+
+def test_lower_case_augmenter_level_zero_is_identity():
+    aug = create_lower_casing_augmenter(level=0.0)
+    (eg,) = synth_corpus(1, "tagger", seed=0)
+    out = list(aug(eg))
+    assert out == [eg]
 
 
 def test_orth_variants_swaps_group_members():
@@ -36,8 +46,8 @@ def test_orth_variants_swaps_group_members():
     eg.reference.words = ["nice", "colour"]
     eg.reference.tags = ["ADJ", "NOUN"]
     outs = list(aug(eg))
-    assert len(outs) == 2
-    assert outs[1].reference.words == ["nice", "color"]
+    assert len(outs) == 1
+    assert outs[0].reference.words == ["nice", "color"]
 
 
 def test_orth_variants_respects_tag_restriction():
@@ -47,8 +57,28 @@ def test_orth_variants_respects_tag_restriction():
     )
     (eg,) = synth_corpus(1, "tagger", seed=0)
     eg.reference.words = ["colour"]
-    eg.reference.tags = ["NOUN"]  # not VERB -> no swap, no extra example
-    assert len(list(aug(eg))) == 1
+    eg.reference.tags = ["NOUN"]  # not VERB -> no swap; original comes back
+    outs = list(aug(eg))
+    assert len(outs) == 1
+    assert outs[0].reference.words == ["colour"]
+
+
+def test_orth_variants_paired_quotes_swap_consistently():
+    aug = create_orth_variants_augmenter(
+        level=1.0,
+        orth_variants={
+            "paired": [{"tags": [], "variants": [["``", "''"], ['"', '"']]}]
+        },
+        seed=0,
+    )
+    (eg,) = synth_corpus(1, "tagger", seed=0)
+    eg.reference.words = ["``", "hi", "''"]
+    eg.reference.tags = ["PUNCT", "INTJ", "PUNCT"]
+    (out,) = list(aug(eg))
+    w = out.reference.words
+    # whichever pair was chosen, opener and closer come from the SAME pair
+    assert (w[0], w[2]) in {("``", "''"), ('"', '"')}
+    assert w[1] == "hi"
 
 
 def test_corpus_applies_augmenter_per_epoch(tmp_path):
@@ -59,10 +89,14 @@ def test_corpus_applies_augmenter_per_epoch(tmp_path):
     corpus = Corpus(p, augmenter=create_lower_casing_augmenter(level=1.0))
     epoch1 = list(corpus())
     epoch2 = list(corpus())
-    assert len(epoch1) == 10  # 5 originals + 5 lowered
-    assert len(epoch2) == 10
-    # cached originals stay pristine
-    assert any(w != w.lower() for eg in epoch1[::2] for w in eg.reference.words)
+    assert len(epoch1) == 5  # replace semantics: epoch size unchanged
+    assert len(epoch2) == 5
+    assert all(
+        w == w.lower() for eg in epoch1 for w in eg.reference.words
+    )
+    # cached originals stay pristine (augmented copies are fresh objects)
+    raw = list(Corpus(p)())
+    assert any(w != w.lower() for eg in raw for w in eg.reference.words)
 
 
 def test_config_resolves_augmenter(tmp_path):
@@ -76,4 +110,6 @@ def test_config_resolves_augmenter(tmp_path):
         "augmenter": {"@augmenters": "spacy.lower_case.v1", "level": 1.0},
     }
     corpus = registry.resolve(block)
-    assert len(list(corpus())) == 6
+    egs = list(corpus())
+    assert len(egs) == 3
+    assert all(w == w.lower() for eg in egs for w in eg.reference.words)

@@ -120,5 +120,7 @@ def test_curly_apostrophe_clitics(tok):
 
 def test_symbol_glue_and_currency_suffix(tok):
     assert words(tok, "price=5") == ["price", "=", "5"]
-    assert words(tok, "a+b") == ["a", "+", "b"]
     assert words(tok, "50€") == ["50", "€"]
+    # & and + stay inside real tokens
+    assert words(tok, "AT&T and R&D") == ["AT&T", "and", "R&D"]
+    assert words(tok, "about 1e+5") == ["about", "1e+5"]
