@@ -32,16 +32,27 @@ Params = Dict[str, Any]
 
 @dataclass
 class Context:
-    """Per-call context threaded through apply: dropout rng, train flag."""
+    """Per-call context threaded through apply: dropout rng, train flag,
+    and an optional auxiliary-loss sink (``aux_losses``) that layers with
+    regularizer terms (e.g. the MoE router's load-balancing loss) append
+    to during tracing; the loss builder sums it into the total."""
 
     train: bool = False
     rng: Optional[jax.Array] = None
+    aux_losses: Optional[list] = None
 
     def split(self) -> Tuple["Context", "Context"]:
         if self.rng is None:
             return self, self
         r1, r2 = jax.random.split(self.rng)
-        return Context(self.train, r1), Context(self.train, r2)
+        return (
+            Context(self.train, r1, self.aux_losses),
+            Context(self.train, r2, self.aux_losses),
+        )
+
+    def add_aux_loss(self, value) -> None:
+        if self.aux_losses is not None:
+            self.aux_losses.append(value)
 
 
 @dataclass

@@ -47,23 +47,90 @@ def _maybe_shard(x: jnp.ndarray, spec: P) -> jnp.ndarray:
     )
 
 
-def transformer_layer_params(rng, width: int, ffn: int):
+def transformer_layer_params(rng, width: int, ffn: int, n_experts: int = 0):
     r = jax.random.split(rng, 6)
     scale = 0.02
-    return {
+    params = {
         "qkv_W": normal_init(r[0], (width, 3 * width), scale),
         "qkv_b": jnp.zeros((3 * width,)),
         "o_W": normal_init(r[1], (width, width), scale),
         "o_b": jnp.zeros((width,)),
         "ln1_g": jnp.ones((width,)),
         "ln1_b": jnp.zeros((width,)),
-        "ffn_W1": normal_init(r[2], (width, ffn), scale),
-        "ffn_b1": jnp.zeros((ffn,)),
-        "ffn_W2": normal_init(r[3], (ffn, width), scale),
-        "ffn_b2": jnp.zeros((width,)),
         "ln2_g": jnp.ones((width,)),
         "ln2_b": jnp.zeros((width,)),
     }
+    if n_experts > 0:
+        # mixture-of-experts FFN (switch-style): E expert FFNs + a router
+        params.update(
+            router_W=normal_init(r[4], (width, n_experts), scale),
+            e_W1=normal_init(r[2], (n_experts, width, ffn), scale),
+            e_b1=jnp.zeros((n_experts, ffn)),
+            e_W2=normal_init(r[3], (n_experts, ffn, width), scale),
+            e_b2=jnp.zeros((n_experts, width)),
+        )
+    else:
+        params.update(
+            ffn_W1=normal_init(r[2], (width, ffn), scale),
+            ffn_b1=jnp.zeros((ffn,)),
+            ffn_W2=normal_init(r[3], (ffn, width), scale),
+            ffn_b2=jnp.zeros((width,)),
+        )
+    return params
+
+
+def _moe_ffn(p, h: jnp.ndarray, token_mask: jnp.ndarray, *,
+             capacity_factor: float, compute_dtype):
+    """Switch-transformer top-1 MoE FFN over flattened tokens.
+
+    h [N, D] (post-LN), token_mask [N] bool. Experts are EXPERT-PARALLEL:
+    the leading E dim of the dispatched activations carries a sharding
+    constraint over the ``model`` mesh axis, so GSPMD places each expert's
+    FFN on its own device group and inserts the all_to_alls (SURVEY.md
+    §2.2 row EP — absent from the reference, first-class here).
+
+    Returns (out [N, D] fp32, aux load-balancing loss scalar). Tokens
+    routed past an expert's capacity are dropped (contribute zero), the
+    standard switch behavior.
+    """
+    N, D = h.shape
+    E = p["e_W1"].shape[0]
+    F = p["e_W1"].shape[2]
+    maskf = token_mask.astype(jnp.float32)
+
+    logits = (h @ p["router_W"]).astype(jnp.float32)  # [N, E] fp32 routing
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(probs, axis=-1)  # [N]
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]  # [N]
+
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32) * maskf[:, None]
+    capacity = max(int(capacity_factor * N / max(E, 1)), 1)
+    # arrival position of each token in its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) - onehot  # [N, E]
+    pos_tok = jnp.sum(pos * onehot, axis=-1)  # [N]
+    keep = (pos_tok < capacity) & token_mask
+    disp = onehot * keep.astype(jnp.float32)[:, None]  # [N, E]
+    pos_oh = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch = (disp[:, :, None] * pos_oh[:, None, :]).astype(compute_dtype)  # [N, E, C]
+
+    h16 = h.astype(compute_dtype)
+    x_e = jnp.einsum("nec,nd->ecd", dispatch, h16)  # [E, C, D]
+    x_e = _maybe_shard(x_e, P("model", None, None))
+    inner = jnp.einsum("ecd,edf->ecf", x_e, p["e_W1"].astype(compute_dtype))
+    inner = inner + p["e_b1"].astype(compute_dtype)[:, None, :]
+    inner = _maybe_shard(inner, P("model", None, None))
+    inner = O.gelu(inner)
+    y_e = jnp.einsum("ecf,efd->ecd", inner, p["e_W2"].astype(compute_dtype))
+    y_e = y_e + p["e_b2"].astype(compute_dtype)[:, None, :]
+    y = jnp.einsum("nec,ecd->nd", dispatch, y_e).astype(jnp.float32)
+    y = y * gate[:, None]
+
+    # switch load-balancing loss: E * sum_e fraction_routed_e * mean_prob_e
+    denom = jnp.maximum(jnp.sum(maskf), 1.0)
+    frac = jnp.sum(onehot, axis=0) / denom  # [E]
+    mean_prob = jnp.sum(probs * maskf[:, None], axis=0) / denom  # [E]
+    aux = jnp.float32(E) * jnp.sum(frac * mean_prob)
+    return y, aux
 
 
 def apply_transformer_layer(
@@ -75,12 +142,16 @@ def apply_transformer_layer(
     n_heads: int,
     dropout: float,
     train: bool,
+    n_experts: int = 0,
+    capacity_factor: float = 1.25,
     compute_dtype=jnp.bfloat16,
-) -> jnp.ndarray:
+):
     """Pre-LN encoder layer. X [B, T, D] fp32, mask [B, T] bool.
 
-    Keyword args are static (bound with functools.partial before
-    jax.checkpoint, so the checkpointed callable takes only pytrees).
+    Returns (X, aux) — aux is the MoE router's load-balancing loss (0.0
+    for the dense FFN). Keyword args are static (bound with
+    functools.partial before jax.checkpoint, so the checkpointed callable
+    takes only pytrees).
     """
     B, T, D = X.shape
     H = n_heads
@@ -122,17 +193,28 @@ def apply_transformer_layer(
         out = O.dropout(rng1, out, dropout, True)
     X = X + out
 
-    # ---- ffn ----
+    # ---- ffn (dense or mixture-of-experts) ----
     h = O.layer_norm(X, p["ln2_g"], p["ln2_b"])
-    h16 = h.astype(compute_dtype)
-    inner = h16 @ p["ffn_W1"].astype(compute_dtype) + p["ffn_b1"].astype(compute_dtype)
-    inner = _maybe_shard(inner, P("data", "context", "model"))
-    inner = O.gelu(inner)
-    out = inner @ p["ffn_W2"].astype(compute_dtype) + p["ffn_b2"].astype(compute_dtype)
-    out = out.astype(jnp.float32)
+    aux = jnp.float32(0.0)
+    if n_experts > 0:
+        out2d, aux = _moe_ffn(
+            p,
+            h.reshape(B * T, D),
+            mask.reshape(B * T),
+            capacity_factor=capacity_factor,
+            compute_dtype=compute_dtype,
+        )
+        out = out2d.reshape(B, T, D)
+    else:
+        h16 = h.astype(compute_dtype)
+        inner = h16 @ p["ffn_W1"].astype(compute_dtype) + p["ffn_b1"].astype(compute_dtype)
+        inner = _maybe_shard(inner, P("data", "context", "model"))
+        inner = O.gelu(inner)
+        out = inner @ p["ffn_W2"].astype(compute_dtype) + p["ffn_b2"].astype(compute_dtype)
+        out = out.astype(jnp.float32)
     if use_dropout:
         out = O.dropout(rng2, out, dropout, True)
-    return X + out
+    return X + out, aux
 
 
 def _pipelined_layers(
@@ -163,9 +245,19 @@ def _pipelined_layers(
     # each microbatch is sharded over the data axis, so M must divide B/d
     # (keeping every microbatch's size a multiple of d)
     per_data = max(B // d, 1)
-    M = min(n_microbatches or 2 * S, per_data)
+    requested = n_microbatches or 2 * S
+    M = min(requested, per_data)
     while M > 1 and per_data % M != 0:
         M -= 1
+    if n_microbatches and M != n_microbatches:
+        import warnings
+
+        warnings.warn(
+            f"pp_microbatches={n_microbatches} cannot divide the per-data-"
+            f"shard batch ({per_data}); using {M} microbatches instead "
+            f"(pipeline bubble {(S - 1) / (M + S - 1):.0%})",
+            stacklevel=2,
+        )
     stacked = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs), *[params[f"layer_{i}"] for i in range(depth)]
     )
@@ -184,7 +276,8 @@ def _pipelined_layers(
         with pctx.use_mesh(None):
             def body(x, inp):
                 lp, li = inp
-                y = layer_fn(lp, x, m, jax.random.fold_in(key, li))
+                # aux is structurally 0.0 here (MoE under PP is rejected)
+                y, _aux = layer_fn(lp, x, m, jax.random.fold_in(key, li))
                 return y, None
 
             x, _ = jax.lax.scan(
@@ -208,8 +301,16 @@ def TransformerEncoder(
     remat: bool = True,
     init_weights: Optional[str] = None,
     pp_microbatches: int = 0,
+    n_experts: int = 0,
+    expert_capacity_factor: float = 1.25,
+    router_aux_weight: float = 0.01,
 ) -> Model:
     """Hash-embed featurized transformer trunk (tok2vec-compatible output).
+
+    ``n_experts > 0`` replaces each layer's dense FFN with a switch-style
+    top-1 mixture of experts (expert-parallel over the ``model`` mesh
+    axis); ``router_aux_weight`` scales the load-balancing loss added to
+    training via the Context aux sink.
 
     ``remat=True`` wraps each layer in jax.checkpoint — rematerialize
     activations in backward to trade FLOPs for HBM (the standard TPU
@@ -239,7 +340,9 @@ def TransformerEncoder(
             "ln_f_b": jnp.zeros((width,)),
         }
         for i in range(depth):
-            params[f"layer_{i}"] = transformer_layer_params(rngs[i + 2], width, ffn)
+            params[f"layer_{i}"] = transformer_layer_params(
+                rngs[i + 2], width, ffn, n_experts=n_experts
+            )
         if init_weights:
             from .pretrained import load_trunk_weights
 
@@ -274,19 +377,31 @@ def TransformerEncoder(
             n_heads=n_heads,
             dropout=dropout,
             train=ctx.train,
+            n_experts=n_experts,
+            capacity_factor=expert_capacity_factor,
         )
         if remat:
             # checkpointed callable takes only pytree args (p, X, mask, rng)
             layer_fn = jax.checkpoint(layer_fn)
         if pctx.pipeline_active():
+            if n_experts > 0:
+                raise ValueError(
+                    "MoE (n_experts > 0) cannot run under pipeline "
+                    "parallelism in this version — use expert parallelism "
+                    "(model axis) with data parallelism instead"
+                )
             X = _pipelined_layers(
                 params, X, mask, ctx, layer_fn, depth=depth,
                 n_microbatches=pp_microbatches,
             )
         else:
+            aux_total = jnp.float32(0.0)
             for i in range(depth):
                 ctx, sub = ctx.split()
-                X = layer_fn(params[f"layer_{i}"], X, mask, sub.rng)
+                X, aux = layer_fn(params[f"layer_{i}"], X, mask, sub.rng)
+                aux_total = aux_total + aux
+            if n_experts > 0:
+                ctx.add_aux_loss(jnp.float32(router_aux_weight) * aux_total)
         X = O.layer_norm(X, params["ln_f_g"], params["ln_f_b"])
         return Padded(X=X * mask[..., None].astype(X.dtype), mask=mask)
 

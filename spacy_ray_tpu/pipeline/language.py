@@ -297,13 +297,15 @@ class Pipeline:
             metrics: Dict[str, Any] = {}
             total = jnp.float32(0.0)
             t2v_out = None
+            aux_sink: List[Any] = []  # e.g. MoE router load-balancing loss
             if t2v_name is not None:
                 t2v_params = params[t2v_name]
                 if t2v_name in frozen:
                     t2v_params = jax.lax.stop_gradient(t2v_params)
                 rng, sub = jax.random.split(rng)
                 t2v_out = components[t2v_name].forward(
-                    t2v_params, tokens, Context(train=True, rng=sub)
+                    t2v_params, tokens,
+                    Context(train=True, rng=sub, aux_losses=aux_sink),
                 )
             for name in head_names:
                 comp = components[name]
@@ -314,14 +316,23 @@ class Pipeline:
                     comp_params = jax.lax.stop_gradient(comp_params)
                 inputs = t2v_out if comp.listens else tokens
                 rng, sub = jax.random.split(rng)
+                # heads with an inline (non-listener) tok2vec may embed an
+                # MoE trunk themselves — give them the same aux sink
                 loss, comp_metrics = comp.loss(
-                    comp_params, inputs, targets[name], Context(train=True, rng=sub)
+                    comp_params, inputs, targets[name],
+                    Context(train=True, rng=sub, aux_losses=aux_sink),
                 )
                 metrics[f"loss_{name}"] = loss
                 # namespace per component: shared base classes emit the same
                 # metric keys (e.g. tag_acc_batch) and would clobber
                 metrics.update({f"{name}_{k}": v for k, v in comp_metrics.items()})
                 total = total + loss
+            if aux_sink and (t2v_name is None or t2v_name not in frozen):
+                aux_total = jnp.float32(0.0)
+                for a in aux_sink:
+                    aux_total = aux_total + a
+                metrics["loss_aux"] = aux_total
+                total = total + aux_total
             return total, metrics
 
         return loss_fn

@@ -68,9 +68,9 @@ DEFAULT_INFIXES: Sequence[str] = (
     r"(?<=\w)[,;:!?](?=\w)",              # missing space after punctuation
     r"(?<=[a-z0-9])\.(?=[A-Z])",          # sentence glue: end.Next
     r"(?<=[a-zA-Z])[/](?=[a-zA-Z])",      # either/or
-    # symbol glue: price=5, x|y — deliberately NOT & or + or *, which live
-    # inside real tokens (AT&T, R&D, 1e+5, C*-algebra)
-    r"(?<=\w)[=~|](?=\w)",
+    # symbol glue: price=5, x^2, a|b — deliberately NOT & or + or *, which
+    # live inside real tokens (AT&T, R&D, 1e+5, C*-algebra)
+    r"(?<=\w)[=~^|](?=\w)",
 )
 
 # kept whole regardless of punctuation inside (spaCy's token_match/url_match).

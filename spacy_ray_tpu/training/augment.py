@@ -77,14 +77,19 @@ def create_orth_variants_augmenter(
         tags = set(entry.get("tags", []))
         for v in variants:
             table[v] = (variants, tags)
-    # word -> (position in its pair, all pair groups, tag restriction)
+    # word -> (positions it can occupy in a pair, all pair groups, tags);
+    # a form like the straight quote occupies BOTH positions of its pair —
+    # such forms alternate open/close by occurrence order in the doc
     pair_table: Dict[str, Any] = {}
     for entry in paired:
         groups = entry.get("variants", [])
         tags = set(entry.get("tags", []))
         for group in groups:
             for pos, form in enumerate(group):
-                pair_table.setdefault(form, (pos, groups, tags))
+                if form in pair_table:
+                    pair_table[form][0].add(pos)
+                else:
+                    pair_table[form] = ({pos}, groups, tags)
     rng = random.Random(seed)
 
     def augment(eg: Example) -> Iterator[Example]:
@@ -95,6 +100,7 @@ def create_orth_variants_augmenter(
         new_words = list(ref.words)
         changed = False
         chosen_pairs: Dict[int, List[str]] = {}  # id(groups) -> target pair
+        seen_count: Dict[str, int] = {}  # ambiguous-form occurrence parity
         for i, w in enumerate(new_words):
             hit = table.get(w)
             if hit is not None:
@@ -107,9 +113,17 @@ def create_orth_variants_augmenter(
                     continue
             phit = pair_table.get(w)
             if phit is not None:
-                pos, groups, tags = phit
+                positions, groups, tags = phit
                 if tags and (not ref.tags or ref.tags[i] not in tags):
                     continue
+                if len(positions) == 1:
+                    pos = next(iter(positions))
+                else:
+                    # e.g. the straight quote is both opener and closer:
+                    # alternate by occurrence (1st=open, 2nd=close, ...)
+                    n_seen = seen_count.get(w, 0)
+                    seen_count[w] = n_seen + 1
+                    pos = n_seen % 2
                 # one consistent target pair per doc per group set, so an
                 # opening quote and its closer swap together
                 target = chosen_pairs.setdefault(id(groups), rng.choice(groups))

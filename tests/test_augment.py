@@ -113,3 +113,21 @@ def test_config_resolves_augmenter(tmp_path):
     egs = list(corpus())
     assert len(egs) == 3
     assert all(w == w.lower() for eg in egs for w in eg.reference.words)
+
+
+def test_paired_straight_quotes_alternate_open_close():
+    # the straight quote is both opener and closer of its pair; swapped to
+    # a curly pair, occurrences must alternate open/close, not collapse
+    aug = create_orth_variants_augmenter(
+        level=1.0,
+        orth_variants={
+            "paired": [{"tags": [], "variants": [['"', '"'], ["“", "”"]]}]
+        },
+        seed=3,
+    )
+    (eg,) = synth_corpus(1, "tagger", seed=0)
+    eg.reference.words = ['"', "hi", '"']
+    eg.reference.tags = ["PUNCT", "INTJ", "PUNCT"]
+    (out,) = list(aug(eg))
+    w = out.reference.words
+    assert (w[0], w[2]) in {('"', '"'), ("“", "”")}, w

@@ -124,3 +124,7 @@ def test_symbol_glue_and_currency_suffix(tok):
     # & and + stay inside real tokens
     assert words(tok, "AT&T and R&D") == ["AT&T", "and", "R&D"]
     assert words(tok, "about 1e+5") == ["about", "1e+5"]
+
+
+def test_caret_is_infix(tok):
+    assert words(tok, "x^2 and 2^10") == ["x", "^", "2", "and", "2", "^", "10"]
