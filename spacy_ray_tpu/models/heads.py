@@ -132,3 +132,128 @@ def TextCatReduce(
         },
     )
     return m
+
+
+@registry.architectures("spacy.TextCatBOW.v2")
+@registry.architectures("spacy.TextCatBOW.v3")
+def TextCatBOW(
+    exclusive_classes: bool = False,
+    ngram_size: int = 1,
+    no_output_layer: bool = False,
+    nO: Optional[int] = None,
+    length: int = 262144,
+) -> Model:
+    """Hashed n-gram bag-of-words classifier (spaCy's sparse linear
+    textcat, the default fast architecture). No tok2vec: consumes the
+    TokenBatch directly — each unigram (and bigram, for ngram_size >= 2)
+    hashes to a row of a [length, nO] weight table; the doc score is the
+    mean of its n-gram rows. TPU-shaped as a masked gather + segment sum
+    (no sparse ops needed)."""
+    if nO is None:
+        nO = 1
+    n = max(int(ngram_size), 1)
+
+    def init_fn(rng):
+        # sparse-linear convention: start at zero so untouched rows stay
+        # exactly neutral (a random init would inject noise per rare ngram)
+        return {"W": jnp.zeros((length, nO)), "b": jnp.zeros((nO,))}
+
+    def apply_fn(params, tokens: TokenBatch, ctx: Context) -> jnp.ndarray:
+        # NORM hash halves (collate attr order: NORM first)
+        lo = tokens.attr_keys[:, :, 0, 0].astype(jnp.uint32)  # [B, T]
+        hi = tokens.attr_keys[:, :, 0, 1].astype(jnp.uint32)
+        mask = tokens.mask
+        L = jnp.uint32(length)
+        scores = jnp.zeros((lo.shape[0], nO), jnp.float32)
+        count = jnp.zeros((lo.shape[0], 1), jnp.float32)
+        prev = (lo ^ (hi >> jnp.uint32(1)))
+        gram_mask = mask
+        for k in range(n):
+            if k > 0:
+                # roll in the next token's hash for (k+1)-grams
+                nxt_lo = jnp.roll(lo, -k, axis=1)
+                prev = prev * jnp.uint32(2654435761) + nxt_lo
+                gram_mask = gram_mask & jnp.roll(mask, -k, axis=1)
+                gram_mask = gram_mask.at[:, -k:].set(False)
+            idx = (prev % L).astype(jnp.int32)  # [B, T]
+            rows = params["W"][idx]  # [B, T, nO]
+            m = gram_mask.astype(jnp.float32)[..., None]
+            scores = scores + jnp.sum(rows * m, axis=1)
+            count = count + jnp.sum(m, axis=1)
+        return scores / jnp.maximum(count, 1.0) + params["b"]
+
+    return Model(
+        "textcat_bow",
+        init_fn,
+        apply_fn,
+        dims={"nO": nO},
+        meta={"has_listener": False, "exclusive_classes": exclusive_classes},
+    )
+
+
+@registry.architectures("spacy.TextCatEnsemble.v2")
+def TextCatEnsemble(
+    tok2vec: Model,
+    linear_model: Model,
+    nO: Optional[int] = None,
+) -> Model:
+    """spaCy's default textcat: a neural (tok2vec + pooling) classifier
+    summed with a sparse linear (BOW) classifier."""
+    if _has_listener(tok2vec):
+        raise ValueError(
+            "spacy.TextCatEnsemble.v2 needs an INLINE tok2vec here: its "
+            "linear_model reads raw token features, which a listener-fed "
+            "head never receives. Put a full tok2vec block under "
+            "[components.textcat.model.tok2vec] instead of a listener."
+        )
+    neural = TextCatReduce(tok2vec, nO=nO)
+    if nO is None:
+        nO = neural.dims["nO"]
+    lm_nO = linear_model.dims.get("nO")
+    if lm_nO is not None and lm_nO != nO:
+        raise ValueError(
+            f"TextCatEnsemble: linear_model nO={lm_nO} != {nO} labels — "
+            "leave nO unset in the [linear_model] block (the component "
+            "injects the label count) or set it to match"
+        )
+
+    def init_fn(rng):
+        import jax
+
+        r1, r2 = jax.random.split(rng)
+        return {"neural": neural.init(r1), "linear": linear_model.init(r2)}
+
+    def apply_fn(params, x: Any, ctx: Context) -> jnp.ndarray:
+        c1, c2 = ctx.split()
+        a = neural.apply(params.get("neural", {}), x, c1)
+        b = linear_model.apply(params.get("linear", {}), x, c2)
+        return a + b
+
+    return Model(
+        "textcat_ensemble",
+        init_fn,
+        apply_fn,
+        dims={"nO": nO},
+        layers=[neural, linear_model],
+        meta={
+            "has_listener": _has_listener(tok2vec),
+            "exclusive_classes": neural.meta.get("exclusive_classes", False),
+        },
+    )
+
+
+@registry.architectures("spacy.TextCatCNN.v2")
+def TextCatCNN(
+    tok2vec: Model,
+    exclusive_classes: bool = False,
+    nO: Optional[int] = None,
+) -> Model:
+    """CNN tok2vec + mean pooling + linear — spaCy's TextCatCNN surface,
+    expressed through TextCatReduce."""
+    return TextCatReduce(
+        tok2vec,
+        nO=nO,
+        exclusive_classes=exclusive_classes,
+        use_reduce_max=False,
+        use_reduce_mean=True,
+    )

@@ -52,6 +52,20 @@ class Component:
         cfg = dict(self.model_cfg)
         if self.labels and "nO" in self._label_dim_keys():
             cfg["nO"] = len(self.labels)
+            # any direct sub-block that explicitly declares `nO = null`
+            # shares the component's output dim (spaCy fills these by dim
+            # inference at init — e.g. TextCatEnsemble's linear_model);
+            # here the label count is known before resolution
+            for key, sub in list(cfg.items()):
+                if (
+                    isinstance(sub, dict)
+                    and "@architectures" in sub
+                    and "nO" in sub
+                    and sub["nO"] is None
+                ):
+                    sub = dict(sub)
+                    sub["nO"] = len(self.labels)
+                    cfg[key] = sub
         model = registry.resolve(cfg)
         if not isinstance(model, Model):
             raise TypeError(f"[components.{self.name}.model] did not resolve to a Model")

@@ -415,7 +415,7 @@ def train(
                 else None
             )
             eval_t0 = time.perf_counter()
-            scores = nlp.evaluate(dev_examples, eval_src)
+            scores = nlp.evaluate(dev_examples, eval_src, mesh=mesh)
             eval_seconds = time.perf_counter() - eval_t0
             score = weighted_score(scores, T.get("score_weights") or {})
             now = time.perf_counter()

@@ -130,3 +130,114 @@ def test_spancat_respects_threshold():
     comp.threshold = 1.01  # impossible threshold -> no spans
     nlp.evaluate(dev)
     assert all(not eg.predicted.spans.get("sc") for eg in dev)
+
+
+def test_textcat_bow_learns(tmp_path):
+    """spacy.TextCatBOW (hashed ngram sparse-linear) end to end."""
+    from spacy_ray_tpu.training.loop import train
+    from spacy_ray_tpu.util import write_synth_jsonl
+
+    write_synth_jsonl(tmp_path / "train.jsonl", 200, kind="textcat", seed=0)
+    write_synth_jsonl(tmp_path / "dev.jsonl", 40, kind="textcat", seed=1)
+
+    cfg = Config.from_str(f"""
+[nlp]
+lang = "en"
+pipeline = ["textcat"]
+
+[components.textcat]
+factory = "textcat"
+
+[components.textcat.model]
+@architectures = "spacy.TextCatBOW.v2"
+exclusive_classes = true
+ngram_size = 2
+length = 16384
+
+[corpora.train]
+@readers = "spacy.JsonlCorpus.v1"
+path = "{tmp_path}/train.jsonl"
+
+[corpora.dev]
+@readers = "spacy.JsonlCorpus.v1"
+path = "{tmp_path}/dev.jsonl"
+
+[training]
+seed = 0
+max_steps = 60
+eval_frequency = 20
+patience = 0
+
+[training.optimizer]
+@optimizers = "Adam.v1"
+learn_rate = 0.05
+
+[training.batcher]
+@batchers = "spacy.batch_by_words.v1"
+size = 600
+
+[training.score_weights]
+cats_macro_f = 1.0
+""")
+    nlp, result = train(cfg, n_workers=1, stdout_log=False)
+    assert result.best_score > 0.6, f"BOW failed to learn: {result.best_score}"
+
+
+def test_textcat_ensemble_learns(tmp_path):
+    """spacy.TextCatEnsemble.v2: neural + BOW summed."""
+    from spacy_ray_tpu.training.loop import train
+    from spacy_ray_tpu.util import write_synth_jsonl
+
+    write_synth_jsonl(tmp_path / "train.jsonl", 200, kind="textcat", seed=0)
+    write_synth_jsonl(tmp_path / "dev.jsonl", 40, kind="textcat", seed=1)
+
+    cfg = Config.from_str(f"""
+[nlp]
+lang = "en"
+pipeline = ["textcat"]
+
+[components.textcat]
+factory = "textcat"
+
+[components.textcat.model]
+@architectures = "spacy.TextCatEnsemble.v2"
+
+[components.textcat.model.tok2vec]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 32
+depth = 1
+embed_size = 256
+
+[components.textcat.model.linear_model]
+@architectures = "spacy.TextCatBOW.v2"
+exclusive_classes = true
+nO = null
+length = 16384
+
+[corpora.train]
+@readers = "spacy.JsonlCorpus.v1"
+path = "{tmp_path}/train.jsonl"
+
+[corpora.dev]
+@readers = "spacy.JsonlCorpus.v1"
+path = "{tmp_path}/dev.jsonl"
+
+[training]
+seed = 0
+max_steps = 60
+eval_frequency = 20
+patience = 0
+
+[training.optimizer]
+@optimizers = "Adam.v1"
+learn_rate = 0.01
+
+[training.batcher]
+@batchers = "spacy.batch_by_words.v1"
+size = 600
+
+[training.score_weights]
+cats_macro_f = 1.0
+""")
+    nlp, result = train(cfg, n_workers=1, stdout_log=False)
+    assert result.best_score > 0.6, f"ensemble failed to learn: {result.best_score}"
