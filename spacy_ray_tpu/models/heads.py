@@ -56,6 +56,7 @@ def _has_listener(model: Model) -> bool:
     return any(m.meta.get("listener") for m in model.walk())
 
 
+@registry.architectures("spacy.Tagger.v1")
 @registry.architectures("spacy.Tagger.v2")
 def Tagger(tok2vec: Model, nO: Optional[int] = None, normalize: bool = False) -> Model:
     """Softmax tagger head: tok2vec → linear(nO). Loss/decode live in the
@@ -148,15 +149,20 @@ def TextCatBOW(
     TokenBatch directly — each unigram (and bigram, for ngram_size >= 2)
     hashes to a row of a [length, nO] weight table; the doc score is the
     mean of its n-gram rows. TPU-shaped as a masked gather + segment sum
-    (no sparse ops needed)."""
-    if nO is None:
-        nO = 1
+    (no sparse ops needed).
+
+    ``nO`` may be left unset (the stock spaCy config shape): the output
+    dim is read from ``dims`` at INIT time, so a wrapping TextCatEnsemble
+    or the owning component fills it in before params exist — spaCy's
+    dim-inference, without a second resolution pass."""
     n = max(int(ngram_size), 1)
+    dims = {"nO": nO}  # None until a parent fills it; read lazily below
 
     def init_fn(rng):
+        out = dims.get("nO") or 1
         # sparse-linear convention: start at zero so untouched rows stay
         # exactly neutral (a random init would inject noise per rare ngram)
-        return {"W": jnp.zeros((length, nO)), "b": jnp.zeros((nO,))}
+        return {"W": jnp.zeros((length, out)), "b": jnp.zeros((out,))}
 
     def apply_fn(params, tokens: TokenBatch, ctx: Context) -> jnp.ndarray:
         # NORM hash halves (collate attr order: NORM first)
@@ -164,7 +170,8 @@ def TextCatBOW(
         hi = tokens.attr_keys[:, :, 0, 1].astype(jnp.uint32)
         mask = tokens.mask
         L = jnp.uint32(length)
-        scores = jnp.zeros((lo.shape[0], nO), jnp.float32)
+        nO_now = params["W"].shape[-1]
+        scores = jnp.zeros((lo.shape[0], nO_now), jnp.float32)
         count = jnp.zeros((lo.shape[0], 1), jnp.float32)
         prev = (lo ^ (hi >> jnp.uint32(1)))
         gram_mask = mask
@@ -186,7 +193,7 @@ def TextCatBOW(
         "textcat_bow",
         init_fn,
         apply_fn,
-        dims={"nO": nO},
+        dims=dims,
         meta={"has_listener": False, "exclusive_classes": exclusive_classes},
     )
 
@@ -210,11 +217,14 @@ def TextCatEnsemble(
     if nO is None:
         nO = neural.dims["nO"]
     lm_nO = linear_model.dims.get("nO")
-    if lm_nO is not None and lm_nO != nO:
+    if lm_nO is None:
+        # stock spaCy config shape: the linear block omits nO — fill the
+        # label count in before init creates its params
+        linear_model.dims["nO"] = nO
+    elif lm_nO != nO:
         raise ValueError(
             f"TextCatEnsemble: linear_model nO={lm_nO} != {nO} labels — "
-            "leave nO unset in the [linear_model] block (the component "
-            "injects the label count) or set it to match"
+            "omit nO in the [linear_model] block to inherit the label count"
         )
 
     def init_fn(rng):
@@ -236,7 +246,8 @@ def TextCatEnsemble(
         dims={"nO": nO},
         layers=[neural, linear_model],
         meta={
-            "has_listener": _has_listener(tok2vec),
+            # listener tok2vecs are rejected above, so never a listener
+            "has_listener": False,
             "exclusive_classes": neural.meta.get("exclusive_classes", False),
         },
     )

@@ -98,6 +98,7 @@ class ParserModelFns:
         return self.logits(params, flat)
 
 
+@registry.architectures("spacy.TransitionBasedParser.v1")
 @registry.architectures("spacy.TransitionBasedParser.v2")
 def TransitionBasedParser(
     tok2vec: Model,

@@ -86,6 +86,31 @@ def MultiHashEmbed(
     return mix
 
 
+@registry.architectures("spacy.MultiHashEmbed.v1")
+def MultiHashEmbedV1(
+    width: int,
+    rows: int = 7000,
+    also_embed_subwords: bool = True,
+    also_use_static_vectors: bool = False,
+) -> Model:
+    """v1 signature adapter: a single row count + subword flag maps onto
+    the v2 attr/rows form (NORM at full rows; PREFIX/SUFFIX/SHAPE at half
+    when subwords are embedded)."""
+    if also_embed_subwords:
+        attrs = ["NORM", "PREFIX", "SUFFIX", "SHAPE"]
+        row_list = [rows, rows // 2, rows // 2, rows // 2]
+    else:
+        attrs = ["NORM"]
+        row_list = [rows]
+    return MultiHashEmbed(
+        width,
+        attrs=attrs,
+        rows=row_list,
+        include_static_vectors=also_use_static_vectors,
+    )
+
+
+@registry.architectures("spacy.MaxoutWindowEncoder.v1")
 @registry.architectures("spacy.MaxoutWindowEncoder.v2")
 def MaxoutWindowEncoder(
     width: int,
@@ -120,6 +145,7 @@ def TorchBiLSTMEncoder(width: int, depth: int = 2, dropout: float = 0.0) -> Mode
     )
 
 
+@registry.architectures("spacy.Tok2Vec.v1")
 @registry.architectures("spacy.Tok2Vec.v2")
 def Tok2Vec(embed: Model, encode: Model) -> Model:
     t2v = chain(embed, encode, name="tok2vec")
@@ -127,6 +153,7 @@ def Tok2Vec(embed: Model, encode: Model) -> Model:
     return t2v
 
 
+@registry.architectures("spacy.HashEmbedCNN.v1")
 @registry.architectures("spacy.HashEmbedCNN.v2")
 def HashEmbedCNN(
     width: int,

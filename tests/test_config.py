@@ -125,3 +125,28 @@ def test_global_registry_has_builtins():
     assert registry.has("batchers", "spacy.batch_by_words.v1")
     assert registry.has("loggers", "spacy-ray.ConsoleLogger.v1")
     assert registry.has("readers", "spacy.Corpus.v1")
+
+
+def test_v1_architecture_aliases_resolve():
+    """Older spaCy configs name .v1 architectures; they must resolve."""
+    from spacy_ray_tpu.registry import registry
+
+    for name, cfg in [
+        ("spacy.HashEmbedCNN.v1",
+         {"width": 32, "depth": 1, "embed_size": 128}),
+        ("spacy.Tagger.v1",
+         {"tok2vec": {"@architectures": "spacy.HashEmbedCNN.v1",
+                      "width": 32, "depth": 1, "embed_size": 128}}),
+        ("spacy.MultiHashEmbed.v1", {"width": 32, "rows": 500}),
+        ("spacy.Tok2Vec.v1",
+         {"embed": {"@architectures": "spacy.MultiHashEmbed.v1",
+                    "width": 32, "rows": 500},
+          "encode": {"@architectures": "spacy.MaxoutWindowEncoder.v1",
+                     "width": 32, "depth": 1}}),
+        ("spacy.TransitionBasedParser.v1",
+         {"state_type": "parser", "hidden_width": 32,
+          "tok2vec": {"@architectures": "spacy.Tok2VecListener.v1",
+                      "width": 32}}),
+    ]:
+        model = registry.resolve({"@architectures": name, **cfg})
+        assert model is not None, name

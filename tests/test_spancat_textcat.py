@@ -211,7 +211,6 @@ embed_size = 256
 [components.textcat.model.linear_model]
 @architectures = "spacy.TextCatBOW.v2"
 exclusive_classes = true
-nO = null
 length = 16384
 
 [corpora.train]
