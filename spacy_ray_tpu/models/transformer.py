@@ -225,16 +225,23 @@ def _pipelined_layers(
     with a leading [depth] dim (sharded over 'pipe' by the pipeline), and
     splits the batch into microbatches along dim 0.
 
-    Inside the pipeline's manual (shard_map) region sharding constraints
-    don't apply, so TP/CP must be off — enforced here rather than
-    producing a cryptic trace error.
+    With partial-manual shard_map (jax >= 0.7) the stage body keeps its
+    automatic axes, so TP constraints compose with PP; ring attention
+    (context axis) would need a nested shard_map inside the manual region
+    and stays unsupported — enforced here rather than producing a cryptic
+    trace error.
     """
     from ..parallel import pipeline as ppl
 
-    if pctx.tp_active() or pctx.context_parallel_active():
+    if pctx.context_parallel_active():
         raise ValueError(
             "pipeline parallelism (pipe axis > 1) cannot be combined with "
-            "model/context axes in this version — use pipe x data"
+            "the context axis — use pipe x data (x model)"
+        )
+    if pctx.tp_active() and not ppl.PARTIAL_MANUAL:
+        raise ValueError(
+            "pipe x model needs partial-manual shard_map (newer jax); "
+            "this jax only supports pipe x data"
         )
     mesh = pctx.current_mesh()
     S = int(mesh.shape["pipe"])
@@ -267,13 +274,17 @@ def _pipelined_layers(
     rng = sub.rng if sub.rng is not None else jax.random.PRNGKey(0)
     layers_per_stage = depth // S
 
+    # with partial-manual shard_map the body keeps automatic data/model
+    # axes, so TP constraints inside the layers still apply — keep the
+    # mesh active; the fully-manual fallback must disable constraints
+    keep_mesh = ppl.PARTIAL_MANUAL
+
     def stage_fn(local_params, x, m, key):
-        # this stage's layers, sequentially; constraints disabled (manual
-        # region) so the dense single-device layer path runs. Fold the
-        # stage index into the key: without it every stage would reuse the
-        # same per-tick dropout masks on different microbatches
+        # this stage's layers, sequentially. Fold the stage index into the
+        # key: without it every stage would reuse the same per-tick
+        # dropout masks on different microbatches
         key = jax.random.fold_in(key, jax.lax.axis_index("pipe"))
-        with pctx.use_mesh(None):
+        with pctx.use_mesh(mesh if keep_mesh else None):
             def body(x, inp):
                 lp, li = inp
                 # aux is structurally 0.0 here (MoE under PP is rejected)

@@ -40,6 +40,13 @@ from . import context as pctx
 
 AXIS = "pipe"
 
+# partial-manual shard_map (manual over `pipe` only, other axes stay
+# automatic) lets sharding constraints inside the stage body keep working,
+# so PP composes with tensor parallelism
+import inspect as _inspect
+
+PARTIAL_MANUAL = "axis_names" in _inspect.signature(shard_map).parameters
+
 
 def spmd_pipeline(
     stage_fn: Callable,
@@ -62,11 +69,19 @@ def spmd_pipeline(
     assert mesh is not None and AXIS in mesh.shape, "spmd_pipeline needs a pipe axis"
     S = int(mesh.shape[AXIS])
     M = int(microbatches.shape[0])
-    data = "data" if "data" in mesh.shape and mesh.shape["data"] > 1 else None
-
-    x_spec = P(None, data, None, None)  # [M, mb/data, T, D]
-    mask_spec = P(None, data, None)
     param_spec = P(AXIS)  # leading (stacked-depth) dim -> stages
+
+    if PARTIAL_MANUAL:
+        # manual over `pipe` only: activations keep their global (auto)
+        # batch semantics, so data/model constraints inside stage_fn apply
+        x_spec = P()
+        mask_spec = P()
+        sm_kwargs: dict = {"axis_names": frozenset({AXIS})}
+    else:  # pragma: no cover - older jax: fully manual fallback
+        data = "data" if "data" in mesh.shape and mesh.shape["data"] > 1 else None
+        x_spec = P(None, data, None, None)  # [M, mb/data, T, D]
+        mask_spec = P(None, data, None)
+        sm_kwargs = {}
 
     @partial(
         shard_map,
@@ -74,6 +89,7 @@ def spmd_pipeline(
         in_specs=(param_spec, x_spec, mask_spec, P()),
         out_specs=x_spec,
         **{_CHECK_KW: False},
+        **sm_kwargs,
     )
     def run(local_params, xs, ms, key):
         stage = jax.lax.axis_index(AXIS)

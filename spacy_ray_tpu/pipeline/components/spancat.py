@@ -32,6 +32,16 @@ def ngram_suggester(sizes: List[int]):
     return {"sizes": [int(s) for s in sizes]}
 
 
+@registry.misc("spacy.ngram_range_suggester.v1")
+def ngram_range_suggester(min_size: int = 1, max_size: int = 3):
+    """spaCy's range form: all ngram sizes in [min_size, max_size]."""
+    if min_size < 1:
+        raise ValueError(f"min_size must be >= 1, got {min_size}")
+    if max_size < min_size:
+        raise ValueError(f"max_size {max_size} < min_size {min_size}")
+    return {"sizes": list(range(int(min_size), int(max_size) + 1))}
+
+
 def span_grid(Tlen: int, sizes: List[int]) -> List[Tuple[int, int]]:
     """Static candidate list [(start, size)] for a padded length."""
     out = []

@@ -240,3 +240,23 @@ cats_macro_f = 1.0
 """)
     nlp, result = train(cfg, n_workers=1, stdout_log=False)
     assert result.best_score > 0.6, f"ensemble failed to learn: {result.best_score}"
+
+
+def test_ngram_range_suggester():
+    from spacy_ray_tpu.registry import registry
+
+    s = registry.resolve(
+        {"@misc": "spacy.ngram_range_suggester.v1", "min_size": 1, "max_size": 3}
+    )
+    assert s["sizes"] == [1, 2, 3]
+
+
+def test_ngram_range_suggester_rejects_bad_sizes():
+    import pytest
+
+    from spacy_ray_tpu.registry import registry
+
+    with pytest.raises(ValueError, match="min_size"):
+        registry.resolve(
+            {"@misc": "spacy.ngram_range_suggester.v1", "min_size": 0, "max_size": 2}
+        )
