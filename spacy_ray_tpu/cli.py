@@ -115,6 +115,10 @@ def evaluate_command(argv: List[str]) -> int:
     parser.add_argument("model_path", type=Path)
     parser.add_argument("data_path", type=Path)
     parser.add_argument("--device", type=str, default="tpu", choices=["tpu", "cpu"])
+    parser.add_argument(
+        "--output", type=Path, default=None,
+        help="write the metrics as JSON (spaCy's `evaluate --output` surface)",
+    )
     args = parser.parse_args(argv)
     _setup_device(args.device)
 
@@ -126,6 +130,15 @@ def evaluate_command(argv: List[str]) -> int:
     scores = nlp.evaluate(examples)
     for key, value in sorted(scores.items()):
         print(f"{key:24s} {value:.4f}")
+    if args.output is not None:
+        import json
+
+        args.output.parent.mkdir(parents=True, exist_ok=True)
+        args.output.write_text(
+            json.dumps(scores, indent=2, sort_keys=True, default=float) + "\n",
+            encoding="utf8",
+        )
+        print(f"metrics written to {args.output}")
     return 0
 
 

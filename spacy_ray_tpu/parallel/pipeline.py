@@ -14,10 +14,13 @@ ticks for M microbatches:
 
 The bubble fraction is (S-1)/(M+S-1) — pick M >= S. Everything is
 differentiable (ppermute/psum transpose), so the same schedule runs the
-backward pass in reverse. Composes with the ``data`` axis (microbatch dim
-sharded over data); combining with model/context axes inside the pipeline
-is not supported in this version — the stage body runs with sharding
-constraints disabled (it executes inside the manual shard_map region).
+backward pass in reverse. Composes with the ``data`` axis and — on jax
+with partial-manual shard_map (``axis_names``) — with the ``model`` axis:
+the stage body stays automatic over data/model, so TP sharding
+constraints inside the layers apply. The ``context`` (ring attention)
+axis cannot join a pipe mesh (it would need a nested shard_map inside the
+manual region); older jax without ``axis_names`` falls back to a fully
+manual region with constraints disabled (pipe x data only).
 """
 
 from __future__ import annotations
