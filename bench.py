@@ -162,20 +162,39 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
     update = make_train_step(nlp.make_loss_fn(), tx, mesh, opt_state_template=opt_state)
 
     rng = jax.random.PRNGKey(0)
+    cleanup = None
 
     if spec.get("e2e"):
         # end-to-end: re-collate a fresh host batch every step (collation +
-        # host->device transfer are part of the measured rate)
+        # host->device transfer are part of the measured rate), prefetched on
+        # a background thread exactly as the real training loop does
+        # (training/loop.py device_groups + prefetch_iter)
+        from spacy_ray_tpu.training.prefetch import prefetch_iter
+
         chunks = [examples[i : i + B] for i in range(0, len(examples) - B + 1, B)]
+
+        def produce():
+            i = 0
+            while True:
+                batch = nlp.collate(
+                    chunks[i % len(chunks)], pad_batch_to=B, pad_len_to=T
+                )
+                yield (
+                    place_batch(batch["tokens"], mesh),
+                    place_batch(batch["targets"], mesh),
+                    int(batch["n_words"]),
+                )
+                i += 1
+
+        stream = prefetch_iter(produce(), size=3)
+        cleanup = stream.close  # stop the producer thread when measured
 
         def step_fn(i):
             nonlocal rng, params, opt_state
-            batch = nlp.collate(chunks[i % len(chunks)], pad_batch_to=B, pad_len_to=T)
-            tokens = place_batch(batch["tokens"], mesh)
-            targets = place_batch(batch["targets"], mesh)
+            tokens, targets, n_words = next(stream)
             rng, sub = jax.random.split(rng)
             params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
-            return loss, int(batch["n_words"])
+            return loss, n_words
 
     else:
         batch = nlp.collate(examples[:B], pad_batch_to=B, pad_len_to=T)
@@ -189,17 +208,21 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
             params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
             return loss, fixed_words
 
-    for i in range(warmup):
-        loss, _ = step_fn(i)
-    jax.block_until_ready(loss)
+    try:
+        for i in range(warmup):
+            loss, _ = step_fn(i)
+        jax.block_until_ready(loss)
 
-    total_words = 0
-    t0 = time.perf_counter()
-    for i in range(steps):
-        loss, words = step_fn(i)
-        total_words += words
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+        total_words = 0
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss, words = step_fn(i)
+            total_words += words
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+    finally:
+        if cleanup is not None:
+            cleanup()  # a failed spec must not leak its producer thread
 
     wps_chip = total_words / dt / n_chips
     loss_val = float(loss)

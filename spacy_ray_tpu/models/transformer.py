@@ -12,10 +12,11 @@ worker.py:91/176-189). Differences, deliberate and TPU-native:
   one vector per token throughout.
 * bfloat16 matmuls on the MXU, fp32 layernorm/softmax accumulation,
   fp32 params.
-* Attention uses ``jax.nn.dot_product_attention`` (XLA flash-attention
-  path) on a single chip; with a ``context`` mesh axis the same layer
-  switches to ring attention over ICI (parallel/ring_attention.py,
-  SURVEY.md §5.7 — first-class here although the reference has none).
+* Attention on a single chip uses the pallas flash kernel
+  (ops/flash_attention.py, probe-gated; ``jax.nn.dot_product_attention``
+  fallback); with a ``context`` mesh axis the same layer switches to ring
+  attention over ICI (parallel/ring_attention.py, SURVEY.md §5.7 —
+  first-class here although the reference has none).
 * Tensor parallelism: head and FFN dims carry sharding constraints over
   the ``model`` mesh axis when TP is enabled (parallel/context.py).
 """
@@ -182,10 +183,11 @@ def apply_transformer_layer(
 
         attn = ring_attention(q, k, v, mask)
     else:
-        attn = jax.nn.dot_product_attention(
-            q, k, v,
-            mask=mask[:, None, None, :],  # [B, 1, 1, T] broadcast over heads+query
-        )
+        # pallas flash kernel when the startup probe enabled it (TPU),
+        # XLA's fused dot_product_attention otherwise
+        from ..ops.flash_attention import attention
+
+        attn = attention(q, k, v, mask)
     attn = attn.reshape(B, T, D)
     out = attn @ p["o_W"].astype(compute_dtype) + p["o_b"].astype(compute_dtype)
     out = out.astype(jnp.float32)
@@ -226,17 +228,21 @@ def _pipelined_layers(
     splits the batch into microbatches along dim 0.
 
     With partial-manual shard_map (jax >= 0.7) the stage body keeps its
-    automatic axes, so TP constraints compose with PP; ring attention
-    (context axis) would need a nested shard_map inside the manual region
-    and stays unsupported — enforced here rather than producing a cryptic
-    trace error.
+    automatic axes, so TP constraints compose with PP — and ring attention
+    nests as a second partial-manual region (manual over `context` only,
+    parallel/ring_attention.py), so PP x CP works too. On older jax (fully
+    manual fallback) the context axis cannot join a pipe mesh.
     """
     from ..parallel import pipeline as ppl
+    from ..parallel import ring_attention as ring
 
-    if pctx.context_parallel_active():
+    if pctx.context_parallel_active() and not (
+        ppl.PARTIAL_MANUAL and ring.PARTIAL_MANUAL
+    ):
         raise ValueError(
-            "pipeline parallelism (pipe axis > 1) cannot be combined with "
-            "the context axis — use pipe x data (x model)"
+            "pipe x context needs partial-manual shard_map (newer jax) so "
+            "the ring-attention region can nest inside the pipeline region "
+            "— use pipe x data (x model) on this jax"
         )
     if pctx.tp_active() and not ppl.PARTIAL_MANUAL:
         raise ValueError(

@@ -1,0 +1,332 @@
+"""Pallas TPU flash attention for the transformer trunk.
+
+The trunk's single-chip attention path was ``jax.nn.dot_product_attention``
+(models/transformer.py), whose XLA lowering materializes the [B, H, T, T]
+score tensor in HBM. This kernel computes exact attention without ever
+writing scores to HBM: per (batch, head, query-block) grid step it keeps the
+whole K/V for that head resident in VMEM, forms a [BQ, T] score block
+in-register, softmaxes, and contracts straight into the output block — the
+standard flash-attention memory shape (O(T) HBM traffic instead of O(T²)),
+sized for encoder sequence lengths (VMEM budget checked host-side, jnp
+fallback beyond it).
+
+Backward is a second pallas kernel via ``jax.custom_vjp`` (pallas_call has
+no automatic VJP): it recomputes the probability block from the saved
+logsumexp and accumulates dK/dV across query-block grid steps (TPU grids
+execute sequentially, so revisiting an output block is the idiomatic
+accumulation pattern).
+
+Like the hash-embed kernel (ops/pallas_kernels.py), a one-time startup
+probe compiles and numerically validates forward AND gradients on the
+current backend before enabling; force with SRT_PALLAS_ATTN=1/0. The
+capability matched is the reference ecosystem's fused attention (torch SDPA
+inside its transformer dependency); the implementation is TPU-first.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+BQ = 128  # query block (MXU-aligned)
+NEG = -1e30
+# VMEM budget for one (b, h) slice of K + V + score block before fallback
+VMEM_ATTN_BUDGET = 10 * 1024 * 1024
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_IMPORTED = True
+except Exception:  # pragma: no cover
+    _PALLAS_IMPORTED = False
+
+
+def reference_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Dense reference: q/k/v [B, T, H, Dh], mask [B, T] bool -> [B, T, H, Dh]."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    scores = jnp.where(mask[:, None, None, :], scores, NEG)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+
+# ---------------------------------------------------------------- kernels
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, scale):
+    # q [1,1,BQ,DP]  k/v [1,1,T,DP]  bias [1,T]  -> o [1,1,BQ,DP], lse [1,1,BQ]
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [BQ, T]
+    s = s * scale + bias_ref[0][None, :]
+    m = jnp.max(s, axis=-1)  # [BQ]
+    p = jnp.exp(s - m[:, None])
+    l = jnp.sum(p, axis=-1)  # [BQ]
+    o = jnp.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    ) / l[:, None]
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l)
+
+
+def _bwd_kernel(
+    q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref, lse_ref,
+    dq_ref, dk_ref, dv_ref, *, scale,
+):
+    # grid (B, H, nq); dk/dv blocks are revisited across the q-block axis
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        dk_ref[0, 0] = jnp.zeros_like(dk_ref[0, 0])
+        dv_ref[0, 0] = jnp.zeros_like(dv_ref[0, 0])
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)
+    o = o_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]  # [BQ]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * scale + bias_ref[0][None, :]
+    p = jnp.exp(s - lse[:, None])  # [BQ, T] softmax probs (recomputed)
+
+    delta = jnp.sum(do * o, axis=-1)  # [BQ]
+    dp = jax.lax.dot_general(
+        do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [BQ, T]
+    ds = p * (dp - delta[:, None]) * scale  # [BQ, T] fp32
+    ds16 = ds.astype(q.dtype)
+
+    dq_ref[0, 0] = jnp.dot(
+        ds16, k, preferred_element_type=jnp.float32
+    ).astype(dq_ref.dtype)
+    dk_ref[0, 0] += jax.lax.dot_general(
+        ds16, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(dk_ref.dtype)
+    dv_ref[0, 0] += jax.lax.dot_general(
+        p.astype(do_ref.dtype), do.astype(do_ref.dtype),
+        (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    ).astype(dv_ref.dtype)
+
+
+# ------------------------------------------------------- pallas_call wrappers
+
+
+_INTERPRET = False  # tests flip this to run the kernels on CPU
+
+
+def _fwd_raw(q, k, v, bias, *, scale, interpret=None):
+    # q/k/v [B, H, T, DP], bias [B, T]; T % BQ == 0, DP % 128 == 0
+    interpret = _INTERPRET if interpret is None else interpret
+    B, H, T, DP = q.shape
+    nq = T // BQ
+    kernel = functools.partial(_fwd_kernel, scale=scale)
+    qspec = pl.BlockSpec((1, 1, BQ, DP), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM)
+    kvspec = pl.BlockSpec((1, 1, T, DP), lambda b, h, i: (b, h, 0, 0),
+                          memory_space=pltpu.VMEM)
+    bspec = pl.BlockSpec((1, T), lambda b, h, i: (b, 0),
+                         memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, H, T, DP), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T), jnp.float32),
+        ),
+        grid=(B, H, nq),
+        in_specs=[qspec, kvspec, kvspec, bspec],
+        out_specs=(
+            qspec,
+            pl.BlockSpec((1, 1, BQ), lambda b, h, i: (b, h, i),
+                         memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(q, k, v, bias)
+
+
+def _bwd_raw(q, k, v, bias, do, o, lse, *, scale, interpret=None):
+    interpret = _INTERPRET if interpret is None else interpret
+    B, H, T, DP = q.shape
+    nq = T // BQ
+    kernel = functools.partial(_bwd_kernel, scale=scale)
+    qspec = pl.BlockSpec((1, 1, BQ, DP), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM)
+    kvspec = pl.BlockSpec((1, 1, T, DP), lambda b, h, i: (b, h, 0, 0),
+                          memory_space=pltpu.VMEM)
+    bspec = pl.BlockSpec((1, T), lambda b, h, i: (b, 0),
+                         memory_space=pltpu.VMEM)
+    lspec = pl.BlockSpec((1, 1, BQ), lambda b, h, i: (b, h, i),
+                         memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, H, T, DP), q.dtype),   # dq
+            jax.ShapeDtypeStruct((B, H, T, DP), jnp.float32),  # dk (accum)
+            jax.ShapeDtypeStruct((B, H, T, DP), jnp.float32),  # dv (accum)
+        ),
+        grid=(B, H, nq),
+        in_specs=[qspec, kvspec, kvspec, bspec, qspec, qspec, lspec],
+        out_specs=(qspec, kvspec, kvspec),
+        interpret=interpret,
+    )(q, k, v, bias, do, o, lse)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash(scale: float):
+    """Differentiable flash attention for one (static) softmax scale — the
+    scale must come from the REAL head dim, not the zero-padded kernel DP,
+    so the host wrapper passes it down explicitly."""
+
+    @jax.custom_vjp
+    def fl(q, k, v, bias):
+        o, _ = _fwd_raw(q, k, v, bias, scale=scale)
+        return o
+
+    def fl_fwd(q, k, v, bias):
+        o, lse = _fwd_raw(q, k, v, bias, scale=scale)
+        return o, (q, k, v, bias, o, lse)
+
+    def fl_bwd(res, do):
+        q, k, v, bias, o, lse = res
+        dq, dk, dv = _bwd_raw(q, k, v, bias, do, o, lse, scale=scale)
+        return dq, dk.astype(k.dtype), dv.astype(v.dtype), None
+
+    fl.defvjp(fl_fwd, fl_bwd)
+    return fl
+
+
+# ------------------------------------------------------------- host wrapper
+
+
+def _pad_to(x: jnp.ndarray, axis: int, mult: int, value=0.0) -> jnp.ndarray:
+    pad = (-x.shape[axis]) % mult
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def flash_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Exact masked attention, pallas-fused. q/k/v [B, T, H, Dh] (the trunk's
+    layout), mask [B, T] bool (key padding). Returns [B, T, H, Dh] in q.dtype.
+    """
+    B, T, H, Dh = q.shape
+    DP = max(((Dh + 127) // 128) * 128, 128)
+    # [B, H, T, DP] kernel layout; zero head-dim padding leaves scores and
+    # output columns exact
+    qk = _pad_to(_pad_to(q.transpose(0, 2, 1, 3), 3, DP), 2, BQ)
+    kk = _pad_to(_pad_to(k.transpose(0, 2, 1, 3), 3, DP), 2, BQ)
+    vk = _pad_to(_pad_to(v.transpose(0, 2, 1, 3), 3, DP), 2, BQ)
+    bias = jnp.where(mask, 0.0, NEG).astype(jnp.float32)
+    bias = _pad_to(bias, 1, BQ, value=NEG)
+
+    o = _make_flash(1.0 / (Dh ** 0.5))(qk, kk, vk, bias)
+    o = o[:, :, :T, :Dh].transpose(0, 2, 1, 3)
+    return o
+
+
+def attention_vmem_ok(T: int, DP: int, dtype_bytes: int = 2) -> bool:
+    """Whether one (b, h) slice (K + V + fp32 score block) fits the budget."""
+    Tp = ((T + BQ - 1) // BQ) * BQ
+    kv = 2 * Tp * DP * dtype_bytes
+    scores = BQ * Tp * 4
+    return kv + scores + 2 * BQ * DP * 4 <= VMEM_ATTN_BUDGET
+
+
+_PROBED: Optional[bool] = None
+
+
+def flash_attention_enabled() -> bool:
+    """One-time probe: compile + validate forward AND gradients vs the dense
+    reference on the current backend; cache the verdict. SRT_PALLAS_ATTN=1
+    forces on (any backend), =0 forces off; default auto-enables on TPU only.
+    """
+    global _PROBED
+    if _PROBED is not None:
+        return _PROBED
+    env = os.environ.get("SRT_PALLAS_ATTN")
+    if env == "0" or not _PALLAS_IMPORTED:
+        _PROBED = False
+        return False
+    if env != "1" and jax.default_backend() != "tpu":
+        _PROBED = False
+        return False
+    try:
+        r = jax.random.split(jax.random.PRNGKey(0), 4)
+        B, T, H, Dh = 2, 192, 2, 64
+        q = jax.random.normal(r[0], (B, T, H, Dh), jnp.bfloat16)
+        k = jax.random.normal(r[1], (B, T, H, Dh), jnp.bfloat16)
+        v = jax.random.normal(r[2], (B, T, H, Dh), jnp.bfloat16)
+        mask = jnp.arange(T)[None, :] < jnp.array([T, T - 57])[:, None]
+
+        got = jax.jit(flash_attention)(q, k, v, mask)
+        want = reference_attention(q, k, v, mask)
+        m = mask[:, :, None, None]
+        fwd_ok = bool(
+            jnp.allclose(
+                jnp.where(m, got.astype(jnp.float32), 0),
+                jnp.where(m, want.astype(jnp.float32), 0),
+                atol=2e-2,
+            )
+        )
+
+        def loss(fn, q, k, v):
+            out = fn(q, k, v, mask).astype(jnp.float32)
+            return jnp.sum(jnp.where(m, out, 0.0) ** 2)
+
+        g_got = jax.grad(functools.partial(loss, flash_attention), (0, 1, 2))(q, k, v)
+        g_want = jax.grad(functools.partial(loss, reference_attention), (0, 1, 2))(q, k, v)
+        grad_ok = all(
+            bool(jnp.allclose(a.astype(jnp.float32), b.astype(jnp.float32),
+                              atol=5e-2, rtol=5e-2))
+            for a, b in zip(g_got, g_want)
+        )
+        _PROBED = fwd_ok and grad_ok
+    except Exception:
+        _PROBED = False
+    return _PROBED
+
+
+def attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Single-chip attention entry point for the trunk: pallas flash kernel
+    when the probe enabled it and the shape fits VMEM, else XLA's fused
+    ``jax.nn.dot_product_attention``.
+
+    Under a multi-device mesh the pallas path is disabled: a pallas_call has
+    no GSPMD partitioning rule, so inside the automatically-partitioned jit
+    it would force replication of the global q/k/v (or fail to partition)
+    instead of riding the batch/head shardings — XLA's attention partitions
+    cleanly there. (Running the kernel per-shard would need a shard_map
+    wrapper around the whole trunk step; the ring-attention path already
+    covers the sequence-sharded case.)"""
+    from ..parallel import context as pctx
+
+    mesh = pctx.current_mesh()
+    single_device = mesh is None or mesh.size == 1
+    Dh = q.shape[-1]
+    DP = max(((Dh + 127) // 128) * 128, 128)
+    if (
+        single_device
+        and flash_attention_enabled()
+        and attention_vmem_ok(q.shape[1], DP)
+    ):
+        return flash_attention(q, k, v, mask)
+    return jax.nn.dot_product_attention(q, k, v, mask=mask[:, None, None, :])

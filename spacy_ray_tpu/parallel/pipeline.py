@@ -15,12 +15,13 @@ ticks for M microbatches:
 The bubble fraction is (S-1)/(M+S-1) — pick M >= S. Everything is
 differentiable (ppermute/psum transpose), so the same schedule runs the
 backward pass in reverse. Composes with the ``data`` axis and — on jax
-with partial-manual shard_map (``axis_names``) — with the ``model`` axis:
-the stage body stays automatic over data/model, so TP sharding
-constraints inside the layers apply. The ``context`` (ring attention)
-axis cannot join a pipe mesh (it would need a nested shard_map inside the
-manual region); older jax without ``axis_names`` falls back to a fully
-manual region with constraints disabled (pipe x data only).
+with partial-manual shard_map (``axis_names``) — with the ``model`` axis
+(the stage body stays automatic over data/model, so TP sharding
+constraints inside the layers apply) AND with the ``context`` axis: ring
+attention nests inside the stage body as a second partial-manual region,
+manual over ``context`` only (parallel/ring_attention.py). Older jax
+without ``axis_names`` falls back to a fully manual region with
+constraints disabled (pipe x data only, no context).
 """
 
 from __future__ import annotations
@@ -31,24 +32,17 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-try:
-    from jax import shard_map  # jax >= 0.7
-    _CHECK_KW = "check_vma"
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
-    _CHECK_KW = "check_rep"
 from jax.sharding import PartitionSpec as P
 
 from . import context as pctx
 
-AXIS = "pipe"
-
 # partial-manual shard_map (manual over `pipe` only, other axes stay
 # automatic) lets sharding constraints inside the stage body keep working,
-# so PP composes with tensor parallelism
-import inspect as _inspect
+# so PP composes with tensor parallelism — and with ring attention's
+# nested `context` region (smap.py holds the shared capability probe)
+from .smap import CHECK_KW as _CHECK_KW, PARTIAL_MANUAL, shard_map
 
-PARTIAL_MANUAL = "axis_names" in _inspect.signature(shard_map).parameters
+AXIS = "pipe"
 
 
 def spmd_pipeline(

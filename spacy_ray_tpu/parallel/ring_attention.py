@@ -19,15 +19,10 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-try:
-    from jax import shard_map  # jax >= 0.7 (replication check kwarg: check_vma)
-    _CHECK_KW = "check_vma"
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
-    _CHECK_KW = "check_rep"
 from jax.sharding import PartitionSpec as P
 
 from . import context as pctx
+from .smap import CHECK_KW as _CHECK_KW, PARTIAL_MANUAL, shard_map
 
 AXIS = "context"
 
@@ -69,17 +64,40 @@ def ring_attention(
     scale = 1.0 / (Dh ** 0.5)
     out_dtype = q.dtype
 
-    data = "data" if "data" in mesh.shape else None
-    model = "model" if "model" in mesh.shape and mesh.shape["model"] > 1 else None
-    qkv_spec = P(data, AXIS, model, None)
-    mask_spec = P(data, AXIS)
+    sm_mesh = mesh
+    if PARTIAL_MANUAL:
+        # manual over `context` ONLY: data/model dims keep their automatic
+        # (GSPMD) semantics, so the body's einsums still partition over
+        # them — and the whole region can nest inside another partial-
+        # manual shard_map (the pipeline's `pipe` region). When already
+        # inside such a region, shard_map must receive the AMBIENT abstract
+        # mesh (whose enclosing axes are marked Manual), not the concrete
+        # mesh it was built from.
+        qkv_spec = P(None, AXIS, None, None)
+        mask_spec = P(None, AXIS)
+        sm_kwargs: dict = {"axis_names": frozenset({AXIS})}
+        try:
+            from jax.sharding import get_abstract_mesh
+
+            am = get_abstract_mesh()
+            if am is not None and AXIS in (am.shape or {}):
+                sm_mesh = am
+        except Exception:  # pragma: no cover - API drift: concrete mesh
+            pass
+    else:  # pragma: no cover - older jax: fully manual over the whole mesh
+        data = "data" if "data" in mesh.shape else None
+        model = "model" if "model" in mesh.shape and mesh.shape["model"] > 1 else None
+        qkv_spec = P(data, AXIS, model, None)
+        mask_spec = P(data, AXIS)
+        sm_kwargs = {}
 
     @partial(
         shard_map,
-        mesh=mesh,
+        mesh=sm_mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec,
         **{_CHECK_KW: False},
+        **sm_kwargs,
     )
     def inner(q, k, v, kmask):
         B, Tq, H, _ = q.shape

@@ -1,0 +1,29 @@
+"""Shared shard_map import + capability probe.
+
+One place answers "which shard_map does this jax have, and does it support
+partial-manual regions?" so the pipeline (`pipe` axis) and ring attention
+(`context` axis) can't drift apart on the answer — PP x CP works only when
+BOTH regions can be partial-manual (nested), and both modules gate on the
+same flag.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map  # jax >= 0.7 (replication check kwarg: check_vma)
+
+    CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+    CHECK_KW = "check_rep"
+
+# partial-manual shard_map: manual over only the axes named in
+# ``axis_names``, every other mesh axis stays automatic (GSPMD) — the
+# mechanism that lets sharding constraints keep working inside a manual
+# region and lets manual regions nest over disjoint axis sets
+PARTIAL_MANUAL = "axis_names" in inspect.signature(shard_map).parameters
+
+__all__ = ["shard_map", "CHECK_KW", "PARTIAL_MANUAL"]

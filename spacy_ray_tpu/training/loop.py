@@ -59,6 +59,9 @@ DEFAULT_TRAINING = {
     "score_weights": {},
     "zero1": False,
     "mesh": {},  # {"n_model": .., "n_context": .., "n_pipe": ..} axis sizes
+    # batches collated + transferred ahead on a background thread (single-
+    # process only; 0/1 disables). Overlaps host work with the device step.
+    "prefetch_batches": 2,
 }
 
 
@@ -307,164 +310,209 @@ def train(
                     loss_accum[key[5:]] = loss_accum.get(key[5:], 0.0) + float(value)
         pending_metrics.clear()
 
-    batch_iter = batches_forever()
-    while not stop:
-        # gather `accum` raw batches (stacked microbatches per update)
-        raw_batches: List[List[Example]] = []
-        cur_epoch = epoch
-        try:
-            for _ in range(accum):
-                cur_epoch, b = next(batch_iter)
-                raw_batches.append(b)
-            have_group = True
-        except StopIteration:
-            # end of data: an incomplete accumulation group would underscale
-            # the mean gradient (scan still divides by `accum`) — drop it
-            have_group = False
-        if process_count > 1:
-            # loop termination must be COLLECTIVE: if any host ran out of
-            # data, all hosts stop this step, else the continuing hosts
-            # enter the update collectives alone and deadlock
-            from jax.experimental import multihost_utils
+    def device_groups() -> Iterator[Dict[str, Any]]:
+        """Produce one update's worth of data, collated and ON DEVICE.
 
-            flags = multihost_utils.process_allgather(
-                np.array([1 if have_group else 0], np.int32)
-            )
-            if int(np.min(flags)) == 0:
-                break
-        elif not have_group:
-            break
-        # collate to the same (B, T) bucket so stacking works
-        max_len = max(max(len(eg) for eg in b) for b in raw_batches)
-        max_b = max(len(b) for b in raw_batches)
-        T_pad = bucket_length(max_len, nlp.length_buckets)
-        # B must divide evenly over the mesh data axis for P("data") sharding
-        B_pad = max(bucket_batch_size(max_b), n_data)
-        B_pad = ((B_pad + n_data - 1) // n_data) * n_data
-        if process_count > 1:
-            # multi-controller SPMD: every host must launch the same program
-            # — sync padded shapes to the all-host max. The same allgather
-            # carries each host's word count: the global batch is the
-            # concatenation of all hosts' rows (place_batch), so the words
-            # consumed this step are the sum over hosts, not local × P.
-            from jax.experimental import multihost_utils
+        Each record carries its own data-position tags (batches_in_epoch /
+        corpus_epoch snapshots) so the consumer checkpoints the position of
+        the group it actually trained on — exact resume stays exact even
+        when this generator runs ahead on the prefetch thread.
+        """
+        batch_iter = batches_forever()
+        while True:
+            # gather `accum` raw batches (stacked microbatches per update)
+            raw_batches: List[List[Example]] = []
+            cur_epoch = epoch
+            try:
+                for _ in range(accum):
+                    cur_epoch, b = next(batch_iter)
+                    raw_batches.append(b)
+                have_group = True
+            except StopIteration:
+                # end of data: an incomplete accumulation group would under-
+                # scale the mean gradient (scan still divides by `accum`)
+                have_group = False
+            if process_count > 1:
+                # loop termination must be COLLECTIVE: if any host ran out
+                # of data, all hosts stop this step, else the continuing
+                # hosts enter the update collectives alone and deadlock
+                from jax.experimental import multihost_utils
 
-            local_words = sum(len(eg) for b in raw_batches for eg in b)
-            dims = multihost_utils.process_allgather(
-                np.array([T_pad, B_pad, local_words], np.int32)
-            ).reshape(-1, 3)
-            T_pad = int(dims[:, 0].max())
-            B_pad = int(dims[:, 1].max())
-            n_words = int(dims[:, 2].sum())
-        collated = [
-            nlp.collate(b, pad_batch_to=B_pad, pad_len_to=T_pad) for b in raw_batches
-        ]
-        if process_count == 1:
-            n_words = sum(c["n_words"] for c in collated)
-        if accum == 1:
-            tokens, targets = collated[0]["tokens"], collated[0]["targets"]
-        else:
-            # multi-host place_batch re-assembles leaves on the host, so
-            # stack there directly instead of device-stacking and paying a
-            # device->host->device round trip per step
-            stack = np.stack if process_count > 1 else jnp.stack
-            tokens = jax.tree_util.tree_map(
-                lambda *xs: stack(xs), *[c["tokens"] for c in collated]
-            )
-            targets = jax.tree_util.tree_map(
-                lambda *xs: stack(xs), *[c["targets"] for c in collated]
-            )
-        tokens = place_batch(tokens, mesh, accum=accum > 1)
-        targets = place_batch(targets, mesh, accum=accum > 1)
-        if profile_dir is not None and not profile_active and steps_run == 5:
-            jax.profiler.start_trace(str(profile_dir))
-            profile_active = True
-        rng, sub = jax.random.split(rng)
-        params, opt_state, loss, metrics = update(params, opt_state, tokens, targets, sub)
-        step += 1
-        steps_run += 1
-        if profile_active and steps_run >= 15:
-            jax.block_until_ready(loss)
-            jax.profiler.stop_trace()
-            profile_active = False
-        if use_averages:
-            avg_count += 1
-            avg_params = _avg_step(avg_params, params, avg_count)
-        result.words_seen += n_words
-        words_since_log += n_words
-
-        # keep metrics as device arrays — float() here would synchronize the
-        # host with the device EVERY step and kill host/device overlap; the
-        # accumulated scalars are only materialized at eval/log time
-        pending_metrics.append(metrics)
-
-        info: Optional[Dict[str, Any]] = None
-        if step % eval_frequency == 0:
-            drain_metrics()
-            # eval (and best-model save) uses averaged params when enabled.
-            # Params stay ON DEVICE through prediction — gathering the full
-            # tree to host every eval (then re-uploading it per dev chunk)
-            # costs two full-model transfers for nothing.
-            eval_src = avg_params if use_averages else params
-            # gather_to_host on the (possibly cross-host-sharded) opt state is
-            # a COLLECTIVE on multi-host — must run on every process, not just
-            # rank 0, or the pod deadlocks
-            host_opt = (
-                checkpoint_mod.gather_to_host(opt_state)
-                if output_path is not None
-                else None
-            )
-            eval_t0 = time.perf_counter()
-            scores = nlp.evaluate(dev_examples, eval_src, mesh=mesh)
-            eval_seconds = time.perf_counter() - eval_t0
-            score = weighted_score(scores, T.get("score_weights") or {})
-            now = time.perf_counter()
-            wps = words_since_log / max(now - last_log_time, 1e-9)
-            last_log_time = now
-            words_since_log = 0
-            info = {
-                "epoch": cur_epoch,
-                "step": step,
-                "words": result.words_seen,
-                "losses": dict(loss_accum),
-                "other_scores": scores,
-                "score": score,
-                "wps": wps,
-                "eval_seconds": eval_seconds,
-            }
-            result.history.append(info)
-            loss_accum = {}
-            if score > best_score:
-                best_score = score
-                best_step = step
-                if output_path is not None and jax.process_index() == 0:
-                    nlp.params = jax.device_get(eval_src)
-                    nlp.to_disk(Path(output_path) / "best-model")
-            if output_path is not None and jax.process_index() == 0:
-                TrainCheckpoint.save(
-                    Path(output_path) / "last-model",
-                    params=jax.device_get(params),  # raw (not averaged): resume state
-                    opt_state=host_opt,
-                    step=step,
-                    epoch=cur_epoch,
-                    # post-split rng, NOT this step's subkey: resume must
-                    # continue the exact rng chain the uninterrupted run
-                    # would have used
-                    rng=rng,
-                    best_score=best_score,
-                    best_step=best_step,
-                    extra={
-                        "batches_in_epoch": batches_in_epoch,
-                        "corpus_epoch": stream_corpus_epoch,
-                    },
+                flags = multihost_utils.process_allgather(
+                    np.array([1 if have_group else 0], np.int32)
                 )
-        log_step(info)
+                if int(np.min(flags)) == 0:
+                    return
+            elif not have_group:
+                return
+            # collate to the same (B, T) bucket so stacking works
+            max_len = max(max(len(eg) for eg in b) for b in raw_batches)
+            max_b = max(len(b) for b in raw_batches)
+            T_pad = bucket_length(max_len, nlp.length_buckets)
+            # B must divide evenly over the mesh data axis for P("data")
+            B_pad = max(bucket_batch_size(max_b), n_data)
+            B_pad = ((B_pad + n_data - 1) // n_data) * n_data
+            if process_count > 1:
+                # multi-controller SPMD: every host must launch the same
+                # program — sync padded shapes to the all-host max. The same
+                # allgather carries each host's word count: the global batch
+                # is the concatenation of all hosts' rows (place_batch), so
+                # the words consumed this step are the sum over hosts, not
+                # local × P.
+                from jax.experimental import multihost_utils
 
-        if max_steps and step >= max_steps:
-            stop = True
-        if patience and best_step >= 0 and (step - best_step) >= patience:
-            stop = True
+                local_words = sum(len(eg) for b in raw_batches for eg in b)
+                dims = multihost_utils.process_allgather(
+                    np.array([T_pad, B_pad, local_words], np.int32)
+                ).reshape(-1, 3)
+                T_pad = int(dims[:, 0].max())
+                B_pad = int(dims[:, 1].max())
+                n_words = int(dims[:, 2].sum())
+            collated = [
+                nlp.collate(b, pad_batch_to=B_pad, pad_len_to=T_pad)
+                for b in raw_batches
+            ]
+            if process_count == 1:
+                n_words = sum(c["n_words"] for c in collated)
+            if accum == 1:
+                tokens, targets = collated[0]["tokens"], collated[0]["targets"]
+            else:
+                # multi-host place_batch re-assembles leaves on the host, so
+                # stack there directly instead of device-stacking and paying
+                # a device->host->device round trip per step
+                stack = np.stack if process_count > 1 else jnp.stack
+                tokens = jax.tree_util.tree_map(
+                    lambda *xs: stack(xs), *[c["tokens"] for c in collated]
+                )
+                targets = jax.tree_util.tree_map(
+                    lambda *xs: stack(xs), *[c["targets"] for c in collated]
+                )
+            yield {
+                "tokens": place_batch(tokens, mesh, accum=accum > 1),
+                "targets": place_batch(targets, mesh, accum=accum > 1),
+                "n_words": n_words,
+                "cur_epoch": cur_epoch,
+                "batches_in_epoch": batches_in_epoch,
+                "corpus_epoch": stream_corpus_epoch,
+            }
 
+    last_consumed_epoch = epoch
+    groups: Iterator[Dict[str, Any]] = device_groups()
+    prefetch_n = int(T.get("prefetch_batches", 2) or 0)
+    if process_count == 1:
+        # overlap collation + host->device transfer with the running step
+        # (multi-host keeps the inline path: the producer's allgathers must
+        # stay ordered with the update collectives — see prefetch.py)
+        from .prefetch import prefetch_iter
+
+        groups = prefetch_iter(groups, prefetch_n)
+
+    try:
+        while not stop:
+            try:
+                group = next(groups)
+            except StopIteration:
+                break
+            tokens, targets = group["tokens"], group["targets"]
+            n_words = group["n_words"]
+            cur_epoch = last_consumed_epoch = group["cur_epoch"]
+            if profile_dir is not None and not profile_active and steps_run == 5:
+                jax.profiler.start_trace(str(profile_dir))
+                profile_active = True
+            rng, sub = jax.random.split(rng)
+            params, opt_state, loss, metrics = update(params, opt_state, tokens, targets, sub)
+            step += 1
+            steps_run += 1
+            if profile_active and steps_run >= 15:
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                profile_active = False
+            if use_averages:
+                avg_count += 1
+                avg_params = _avg_step(avg_params, params, avg_count)
+            result.words_seen += n_words
+            words_since_log += n_words
+
+            # keep metrics as device arrays — float() here would synchronize the
+            # host with the device EVERY step and kill host/device overlap; the
+            # accumulated scalars are only materialized at eval/log time
+            pending_metrics.append(metrics)
+
+            info: Optional[Dict[str, Any]] = None
+            if step % eval_frequency == 0:
+                drain_metrics()
+                # eval (and best-model save) uses averaged params when enabled.
+                # Params stay ON DEVICE through prediction — gathering the full
+                # tree to host every eval (then re-uploading it per dev chunk)
+                # costs two full-model transfers for nothing.
+                eval_src = avg_params if use_averages else params
+                # gather_to_host on the (possibly cross-host-sharded) opt state is
+                # a COLLECTIVE on multi-host — must run on every process, not just
+                # rank 0, or the pod deadlocks
+                host_opt = (
+                    checkpoint_mod.gather_to_host(opt_state)
+                    if output_path is not None
+                    else None
+                )
+                eval_t0 = time.perf_counter()
+                scores = nlp.evaluate(dev_examples, eval_src, mesh=mesh)
+                eval_seconds = time.perf_counter() - eval_t0
+                score = weighted_score(scores, T.get("score_weights") or {})
+                now = time.perf_counter()
+                wps = words_since_log / max(now - last_log_time, 1e-9)
+                last_log_time = now
+                words_since_log = 0
+                info = {
+                    "epoch": cur_epoch,
+                    "step": step,
+                    "words": result.words_seen,
+                    "losses": dict(loss_accum),
+                    "other_scores": scores,
+                    "score": score,
+                    "wps": wps,
+                    "eval_seconds": eval_seconds,
+                }
+                result.history.append(info)
+                loss_accum = {}
+                if score > best_score:
+                    best_score = score
+                    best_step = step
+                    if output_path is not None and jax.process_index() == 0:
+                        nlp.params = jax.device_get(eval_src)
+                        nlp.to_disk(Path(output_path) / "best-model")
+                if output_path is not None and jax.process_index() == 0:
+                    TrainCheckpoint.save(
+                        Path(output_path) / "last-model",
+                        params=jax.device_get(params),  # raw (not averaged): resume state
+                        opt_state=host_opt,
+                        step=step,
+                        epoch=cur_epoch,
+                        # post-split rng, NOT this step's subkey: resume must
+                        # continue the exact rng chain the uninterrupted run
+                        # would have used
+                        rng=rng,
+                        best_score=best_score,
+                        best_step=best_step,
+                        extra={
+                            # the CONSUMED group's position tags, not the (possibly
+                            # prefetched-ahead) producer counters
+                            "batches_in_epoch": group["batches_in_epoch"],
+                            "corpus_epoch": group["corpus_epoch"],
+                        },
+                    )
+            log_step(info)
+
+            if max_steps and step >= max_steps:
+                stop = True
+            if patience and best_step >= 0 and (step - best_step) >= patience:
+                stop = True
+
+    finally:
+        # stop the prefetch producer and drop its buffered (on-device)
+        # batches even when a step/eval raises — train() may be called
+        # again in the same process
+        if hasattr(groups, "close"):
+            groups.close()
     if profile_active:  # loop ended inside the window: still write the trace
         jax.profiler.stop_trace()
         profile_active = False
@@ -472,7 +520,10 @@ def train(
     result.best_score = best_score
     result.best_step = best_step
     result.final_step = step
-    result.epoch = epoch
+    # the producer may have run ahead under prefetch: report the epoch count
+    # as of the last CONSUMED group (matching the no-prefetch behavior of
+    # "completed epochs" when the stream ran dry, else the current epoch)
+    result.epoch = epoch if not stop else last_consumed_epoch
     nlp.params = jax.device_get(params)
     if output_path is not None and jax.process_index() == 0:
         nlp.to_disk(Path(output_path) / "last-model")

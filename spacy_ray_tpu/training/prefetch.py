@@ -1,0 +1,95 @@
+"""Background batch prefetch: overlap host collation + host->device transfer
+with the running device step.
+
+The compiled step dispatches asynchronously, but ``device_put`` of the next
+batch only starts once the host loop reaches it — on a remote-attached
+device (or any setup where transfer latency rivals step time) the device
+idles between steps. ``prefetch_iter`` runs the producer (collate +
+place_batch) on a daemon thread with a small bounded queue so batch N+1 is
+already on device when step N retires.
+
+Single-process only: the producer performs no collectives. Multi-host
+training keeps the inline path — its per-group allgathers (shape/termination
+sync, training/loop.py) must stay ordered with the update collectives on one
+thread per process, or two hosts can interleave collective launches
+differently and deadlock.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, TypeVar
+
+T = TypeVar("T")
+
+_DONE = object()
+
+
+class _Raised:
+    def __init__(self, err: BaseException):
+        self.err = err
+
+
+def prefetch_iter(it: Iterator[T], size: int = 2) -> Iterator[T]:
+    """Drain ``it`` on a background thread, at most ``size`` items ahead.
+
+    Exceptions in the producer re-raise at the consumer's next pull; the
+    thread is a daemon so an abandoned iterator cannot hang interpreter
+    exit. ``size < 2`` returns ``it`` unchanged (nothing to overlap).
+
+    Closing the returned generator (or dropping it — early stop, exceptions)
+    stops the producer: each ``put`` polls a stop event, so the thread exits
+    and the buffered items (which may pin device memory) are dropped rather
+    than sitting in a blocked ``q.put`` for the process lifetime.
+    """
+    if size < 2:
+        return it
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stopped = threading.Event()
+
+    def put(item) -> bool:
+        while not stopped.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce() -> None:
+        try:
+            for item in it:
+                if not put(item):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
+            put(_Raised(e))
+            return
+        put(_DONE)
+
+    thread = threading.Thread(target=produce, daemon=True, name="batch-prefetch")
+    thread.start()
+
+    def consume() -> Iterator[T]:
+        try:
+            while True:
+                item = q.get()
+                if item is _DONE:
+                    return
+                if isinstance(item, _Raised):
+                    raise item.err
+                yield item
+        finally:
+            # consumer closed/abandoned: release the producer, then drop any
+            # buffered (possibly on-device) batches. Join BEFORE draining —
+            # a producer mid-put could otherwise slip one item into the
+            # just-drained queue and keep it referenced after close.
+            stopped.set()
+            thread.join(timeout=5.0)
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+
+    return consume()

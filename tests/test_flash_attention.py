@@ -1,0 +1,72 @@
+"""Pallas flash-attention kernel vs the dense reference (interpret mode on
+the CPU harness; the TPU probe in ops/flash_attention.py runs the same
+comparison compiled on hardware)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import spacy_ray_tpu.ops.flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+
+
+def _mk(B=2, T=200, H=2, Dh=64, dtype=jnp.float32, seed=0):
+    r = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(r[0], (B, T, H, Dh), dtype)
+    k = jax.random.normal(r[1], (B, T, H, Dh), dtype)
+    v = jax.random.normal(r[2], (B, T, H, Dh), dtype)
+    # ragged key-padding mask, one row fully unmasked
+    lens = jnp.array([T] + [max(T - 17 * (i + 1), 3) for i in range(B - 1)])
+    mask = jnp.arange(T)[None, :] < lens[:, None]
+    return q, k, v, mask
+
+
+def test_forward_matches_dense():
+    q, k, v, mask = _mk()
+    got = fa.flash_attention(q, k, v, mask)
+    want = fa.reference_attention(q, k, v, mask)
+    m = np.asarray(mask)[:, :, None, None]
+    np.testing.assert_allclose(
+        np.where(m, np.asarray(got, np.float32), 0),
+        np.where(m, np.asarray(want, np.float32), 0),
+        atol=1e-4,
+    )
+
+
+def test_forward_bf16_and_unaligned_T():
+    # T not a BQ multiple and bf16 inputs (the trunk's compute dtype)
+    q, k, v, mask = _mk(B=1, T=130, Dh=32, dtype=jnp.bfloat16, seed=1)
+    got = fa.flash_attention(q, k, v, mask).astype(np.float32)
+    want = fa.reference_attention(q, k, v, mask).astype(np.float32)
+    m = np.asarray(mask)[:, :, None, None]
+    np.testing.assert_allclose(
+        np.where(m, np.asarray(got), 0), np.where(m, np.asarray(want), 0),
+        atol=2e-2,
+    )
+
+
+def test_gradients_match_dense():
+    q, k, v, mask = _mk(B=2, T=128, H=2, Dh=64)
+    m = mask[:, :, None, None]
+
+    def loss(fn, q, k, v):
+        out = fn(q, k, v, mask).astype(jnp.float32)
+        return jnp.sum(jnp.where(m, out, 0.0) ** 2)
+
+    g_got = jax.grad(lambda *a: loss(fa.flash_attention, *a), (0, 1, 2))(q, k, v)
+    g_want = jax.grad(lambda *a: loss(fa.reference_attention, *a), (0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-3, rtol=1e-3,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_vmem_guard():
+    assert fa.attention_vmem_ok(512, 128)
+    assert not fa.attention_vmem_ok(200_000, 128)

@@ -1,0 +1,47 @@
+"""Background batch prefetch (training/prefetch.py)."""
+
+import threading
+import time
+
+import pytest
+
+from spacy_ray_tpu.training.prefetch import prefetch_iter
+
+
+def test_yields_everything_in_order():
+    assert list(prefetch_iter(iter(range(100)), size=4)) == list(range(100))
+
+
+def test_size_below_two_is_passthrough():
+    it = iter([1, 2, 3])
+    assert prefetch_iter(it, size=1) is it
+
+
+def test_producer_exception_reraises_at_consumer():
+    def gen():
+        yield 1
+        raise ValueError("boom")
+
+    out = prefetch_iter(gen(), size=2)
+    assert next(out) == 1
+    with pytest.raises(ValueError, match="boom"):
+        next(out)
+
+
+def test_producer_runs_ahead_bounded():
+    produced = []
+
+    def gen():
+        for i in range(10):
+            produced.append(i)
+            yield i
+
+    out = prefetch_iter(gen(), size=2)
+    deadline = time.time() + 5.0
+    # producer should buffer up to size items without any consumption…
+    while len(produced) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.05)
+    assert 2 <= len(produced) <= 3  # size in queue (+1 in-flight at the put)
+    # …and the consumer still sees the full ordered stream
+    assert list(out) == list(range(10))
