@@ -234,17 +234,15 @@ def _pipelined_layers(
     manual fallback) the context axis cannot join a pipe mesh.
     """
     from ..parallel import pipeline as ppl
-    from ..parallel import ring_attention as ring
+    from ..parallel.smap import PARTIAL_MANUAL
 
-    if pctx.context_parallel_active() and not (
-        ppl.PARTIAL_MANUAL and ring.PARTIAL_MANUAL
-    ):
+    if pctx.context_parallel_active() and not PARTIAL_MANUAL:
         raise ValueError(
             "pipe x context needs partial-manual shard_map (newer jax) so "
             "the ring-attention region can nest inside the pipeline region "
             "— use pipe x data (x model) on this jax"
         )
-    if pctx.tp_active() and not ppl.PARTIAL_MANUAL:
+    if pctx.tp_active() and not PARTIAL_MANUAL:
         raise ValueError(
             "pipe x model needs partial-manual shard_map (newer jax); "
             "this jax only supports pipe x data"
@@ -283,7 +281,7 @@ def _pipelined_layers(
     # with partial-manual shard_map the body keeps automatic data/model
     # axes, so TP constraints inside the layers still apply — keep the
     # mesh active; the fully-manual fallback must disable constraints
-    keep_mesh = ppl.PARTIAL_MANUAL
+    keep_mesh = PARTIAL_MANUAL
 
     def stage_fn(local_params, x, m, key):
         # this stage's layers, sequentially. Fold the stage index into the

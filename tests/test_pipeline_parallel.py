@@ -171,8 +171,7 @@ def test_pipe_composes_with_context(trf_nlp):
     region (manual over `context` only) inside the pipeline's `pipe`
     region, and the result equals the dense loop. (On jax without
     partial-manual shard_map this combination raises instead.)"""
-    from spacy_ray_tpu.parallel import pipeline as ppl
-    from spacy_ray_tpu.parallel import ring_attention as ring
+    from spacy_ray_tpu.parallel.smap import PARTIAL_MANUAL
 
     nlp, egs = trf_nlp
     batch = nlp.collate(egs[:8], with_targets=False, pad_batch_to=8, pad_len_to=16)
@@ -181,7 +180,7 @@ def test_pipe_composes_with_context(trf_nlp):
     params = place_replicated(nlp.params, mesh)
     tokens = place_batch(batch["tokens"], mesh)
 
-    if not (ppl.PARTIAL_MANUAL and ring.PARTIAL_MANUAL):
+    if not PARTIAL_MANUAL:
         with pctx.use_mesh(mesh):
             with pytest.raises(ValueError, match="partial-manual"):
                 jax.jit(forward)(params, tokens)
