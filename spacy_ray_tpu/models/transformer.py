@@ -289,19 +289,20 @@ def _pipelined_layers(
         # dropout masks on different microbatches
         key = jax.random.fold_in(key, jax.lax.axis_index("pipe"))
         with pctx.use_mesh(mesh if keep_mesh else None):
-            def body(x, inp):
+            def body(carry, inp):
+                x, aux_sum = carry
                 lp, li = inp
-                # aux is structurally 0.0 here (MoE under PP is rejected)
-                y, _aux = layer_fn(lp, x, m, jax.random.fold_in(key, li))
-                return y, None
+                y, aux = layer_fn(lp, x, m, jax.random.fold_in(key, li))
+                return (y, aux_sum + aux), None
 
-            x, _ = jax.lax.scan(
-                body, x, (local_params, jnp.arange(layers_per_stage))
+            (x, aux_sum), _ = jax.lax.scan(
+                body, (x, jnp.float32(0.0)),
+                (local_params, jnp.arange(layers_per_stage)),
             )
-            return x
+            return x, aux_sum
 
-    out = ppl.spmd_pipeline(stage_fn, stacked, mb, mb_mask, rng)
-    return out.reshape(B, *X.shape[1:])
+    out, aux_total = ppl.spmd_pipeline(stage_fn, stacked, mb, mb_mask, rng)
+    return out.reshape(B, *X.shape[1:]), aux_total
 
 
 @registry.architectures("spacy_ray_tpu.TransformerEncoder.v1")
@@ -399,13 +400,7 @@ def TransformerEncoder(
             # checkpointed callable takes only pytree args (p, X, mask, rng)
             layer_fn = jax.checkpoint(layer_fn)
         if pctx.pipeline_active():
-            if n_experts > 0:
-                raise ValueError(
-                    "MoE (n_experts > 0) cannot run under pipeline "
-                    "parallelism in this version — use expert parallelism "
-                    "(model axis) with data parallelism instead"
-                )
-            X = _pipelined_layers(
+            X, aux_total = _pipelined_layers(
                 params, X, mask, ctx, layer_fn, depth=depth,
                 n_microbatches=pp_microbatches,
             )
@@ -415,8 +410,8 @@ def TransformerEncoder(
                 ctx, sub = ctx.split()
                 X, aux = layer_fn(params[f"layer_{i}"], X, mask, sub.rng)
                 aux_total = aux_total + aux
-            if n_experts > 0:
-                ctx.add_aux_loss(jnp.float32(router_aux_weight) * aux_total)
+        if n_experts > 0:
+            ctx.add_aux_loss(jnp.float32(router_aux_weight) * aux_total)
         X = O.layer_norm(X, params["ln_f_g"], params["ln_f_b"])
         return Padded(X=X * mask[..., None].astype(X.dtype), mask=mask)
 

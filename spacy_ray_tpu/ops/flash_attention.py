@@ -303,30 +303,72 @@ def flash_attention_enabled() -> bool:
     return _PROBED
 
 
+def _sharded_flash_attention(q, k, v, mask, mesh):
+    """Run the pallas kernel per device shard via partial-manual shard_map.
+
+    A pallas_call has no GSPMD partitioning rule, so under an automatically-
+    partitioned jit it would force replication of the global q/k/v. But
+    attention is INDEPENDENT per (batch row, head): manual over the data
+    and model axes, each device runs the kernel on its own [B/d, T, H/m, Dh]
+    shard with zero communication — exact. Returns None when the layout
+    doesn't divide (caller falls back to XLA attention)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.smap import CHECK_KW, PARTIAL_MANUAL, shard_map
+
+    if not PARTIAL_MANUAL:
+        return None
+    B, T, H, _ = q.shape
+    axes = [a for a in ("data", "model") if int(mesh.shape.get(a, 1)) > 1]
+    if not axes:
+        return None
+    d = int(mesh.shape.get("data", 1))
+    m = int(mesh.shape.get("model", 1))
+    if B % d or H % m:
+        return None
+    data_ax = "data" if d > 1 else None
+    model_ax = "model" if m > 1 else None
+    qkv_spec = P(data_ax, None, model_ax, None)
+    mask_spec = P(data_ax, None)
+    sm_mesh = mesh
+    try:  # inside another partial-manual region, use the ambient mesh
+        from jax.sharding import get_abstract_mesh
+
+        am = get_abstract_mesh()
+        if am is not None and all(a in (am.shape or {}) for a in axes):
+            sm_mesh = am
+    except Exception:  # pragma: no cover - API drift
+        pass
+
+    fn = functools.partial(
+        shard_map,
+        mesh=sm_mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        axis_names=frozenset(axes),
+        **{CHECK_KW: False},
+    )(flash_attention)
+    return fn(q, k, v, mask)
+
+
 def attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, mask: jnp.ndarray
 ) -> jnp.ndarray:
-    """Single-chip attention entry point for the trunk: pallas flash kernel
-    when the probe enabled it and the shape fits VMEM, else XLA's fused
-    ``jax.nn.dot_product_attention``.
-
-    Under a multi-device mesh the pallas path is disabled: a pallas_call has
-    no GSPMD partitioning rule, so inside the automatically-partitioned jit
-    it would force replication of the global q/k/v (or fail to partition)
-    instead of riding the batch/head shardings — XLA's attention partitions
-    cleanly there. (Running the kernel per-shard would need a shard_map
-    wrapper around the whole trunk step; the ring-attention path already
-    covers the sequence-sharded case.)"""
+    """Attention entry point for the trunk: pallas flash kernel when the
+    probe enabled it and the shape fits VMEM, else XLA's fused
+    ``jax.nn.dot_product_attention``. Under a multi-device mesh the kernel
+    runs per-shard inside a partial-manual shard_map over the data/model
+    axes (_sharded_flash_attention); layouts that don't divide fall back
+    to XLA attention, which partitions cleanly."""
     from ..parallel import context as pctx
 
     mesh = pctx.current_mesh()
-    single_device = mesh is None or mesh.size == 1
     Dh = q.shape[-1]
     DP = max(((Dh + 127) // 128) * 128, 128)
-    if (
-        single_device
-        and flash_attention_enabled()
-        and attention_vmem_ok(q.shape[1], DP)
-    ):
-        return flash_attention(q, k, v, mask)
+    if flash_attention_enabled() and attention_vmem_ok(q.shape[1], DP):
+        if mesh is None or mesh.size == 1:
+            return flash_attention(q, k, v, mask)
+        out = _sharded_flash_attention(q, k, v, mask, mesh)
+        if out is not None:
+            return out
     return jax.nn.dot_product_attention(q, k, v, mask=mask[:, None, None, :])

@@ -51,16 +51,25 @@ def spmd_pipeline(
     microbatches: jnp.ndarray,
     masks: jnp.ndarray,
     rng: jax.Array,
-) -> jnp.ndarray:
+) -> "tuple[jnp.ndarray, jnp.ndarray]":
     """Run the pipelined layer stack.
 
-    stage_fn(local_params, x, mask, rng) -> y applies ONE STAGE's layers
-    to one microbatch (local_params leaves have leading dim depth/S).
+    stage_fn(local_params, x, mask, rng) -> (y, aux) applies ONE STAGE's
+    layers to one microbatch (local_params leaves have leading dim
+    depth/S); aux is a scalar auxiliary loss for that stage+microbatch
+    (e.g. the MoE router's load-balancing term; 0.0 when unused).
 
     stacked_params: pytree, leaves [depth, ...] (sharded over 'pipe' here).
     microbatches:   [M, mb, T, D] activations (embedding+positions done).
     masks:          [M, mb, T].
-    Returns [M, mb, T, D], replicated over the pipe axis.
+    Returns ([M, mb, T, D] replicated over the pipe axis, aux) where aux
+    is the MEAN over microbatches of the per-microbatch aux sums across
+    all stages. Drain ticks (a stage holding stale data) are masked out
+    of the accumulation. NOTE: for a nonlinear aux (the MoE router's
+    load-balance term) mean-of-per-microbatch values is the standard
+    pipelined formulation (Switch/GShard practice) but is NOT numerically
+    identical to the dense loop's full-batch aux — activations ARE
+    dense-equal, the regularizer differs at O(1/M).
     """
     mesh = pctx.current_mesh()
     assert mesh is not None and AXIS in mesh.shape, "spmd_pipeline needs a pipe axis"
@@ -92,17 +101,22 @@ def spmd_pipeline(
         stage = jax.lax.axis_index(AXIS)
         state = jnp.zeros_like(xs[0])
         outputs = jnp.zeros_like(xs)
+        aux_acc = jnp.float32(0.0)
         perm = [(i, (i + 1) % S) for i in range(S)]
 
         def body(carry, t):
-            state, outputs = carry
+            state, outputs, aux_acc = carry
             # stage 0 ingests microbatch t (clipped: harmless compute on
             # stale data during drain ticks, results never written)
             feed = xs[jnp.clip(t, 0, M - 1)]
             x = jnp.where(stage == 0, feed, state)
             # the microbatch THIS stage processes at tick t is (t - stage)
-            mask = ms[jnp.clip(t - stage, 0, M - 1)]
-            y = stage_fn(local_params, x, mask, jax.random.fold_in(key, t))
+            mb_idx = t - stage
+            mask = ms[jnp.clip(mb_idx, 0, M - 1)]
+            y, aux = stage_fn(local_params, x, mask, jax.random.fold_in(key, t))
+            # drain ticks run on stale data: their aux must not count
+            valid = (mb_idx >= 0) & (mb_idx < M)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
             out_idx = t - (S - 1)
             write = (stage == S - 1) & (out_idx >= 0)
             updated = jax.lax.dynamic_update_index_in_dim(
@@ -110,16 +124,20 @@ def spmd_pipeline(
             )
             outputs = jnp.where(write, updated, outputs)
             state = jax.lax.ppermute(y, AXIS, perm)
-            return (state, outputs), None
+            return (state, outputs, aux_acc), None
 
-        (state, outputs), _ = jax.lax.scan(
-            body, (state, outputs), jnp.arange(M + S - 1)
+        (state, outputs, aux_acc), _ = jax.lax.scan(
+            body, (state, outputs, aux_acc), jnp.arange(M + S - 1)
         )
         # finished microbatches live on the last stage; broadcast so the
         # (pipe-replicated) heads downstream see them everywhere
         outputs = jax.lax.psum(
             jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)), AXIS
         )
-        return outputs
+        # every stage contributed its own layers' aux: sum over the ring,
+        # mean over microbatches (the dense loop computes each layer's aux
+        # once over the full batch)
+        aux_total = jax.lax.psum(aux_acc, AXIS) / jnp.float32(M)
+        return outputs, aux_total
 
     return run(stacked_params, microbatches, masks, rng)

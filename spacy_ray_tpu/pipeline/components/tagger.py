@@ -31,14 +31,29 @@ class TaggerComponent(Component):
         label_ids = {label: i for i, label in enumerate(self.labels)}
         tags = np.zeros((B, T), dtype=np.int32)
         mask = np.zeros((B, T), dtype=bool)
+        # per-Example target cache (examples recur every epoch; the label
+        # set is fixed after initialize — the key invalidates the cache on
+        # any label change, and is value-based: an id()-based key could
+        # alias a freed component's address)
+        cache_key = tuple(self.labels)
         for i, eg in enumerate(examples):
             ref = eg.reference
             if not ref.tags:
                 continue
-            for j, tag in enumerate(ref.tags[:T]):
-                if tag in label_ids:
-                    tags[i, j] = label_ids[tag]
-                    mask[i, j] = True
+            cached = getattr(eg, "_tag_target_cache", None)
+            if cached is None or cached[0] != cache_key:
+                ids = np.zeros(len(ref.tags), dtype=np.int32)
+                valid = np.zeros(len(ref.tags), dtype=bool)
+                for j, tag in enumerate(ref.tags):
+                    idx = label_ids.get(tag)
+                    if idx is not None:
+                        ids[j] = idx
+                        valid[j] = True
+                eg._tag_target_cache = cached = (cache_key, ids, valid)
+            _, ids, valid = cached
+            n = min(len(ids), T)
+            tags[i, :n] = ids[:n]
+            mask[i, :n] = valid[:n]
         return {"tags": tags, "tag_mask": mask}
 
     def loss(self, params: Params, inputs: Any, targets: Dict[str, Any], ctx: Context):

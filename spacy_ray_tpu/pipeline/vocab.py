@@ -87,27 +87,39 @@ class StringStore:
 
 
 class Vocab:
-    """Featurizer with a per-token LRU cache.
+    """Featurizer with a bounded per-token cache.
 
     ``featurize(words) -> uint32 [T, n_attrs, 2]`` (lo, hi halves of the
     uint64 attribute-hash keys).
+
+    Cached features live as rows of ONE contiguous array and the cache maps
+    word -> row index, so a batch lookup is a single fancy-index gather —
+    not an ``np.stack`` over thousands of tiny per-word arrays (the
+    collation hot spot: this path runs once per token per batch and sits on
+    the host side of the e2e words/sec rate).
     """
 
+    CACHE_MAX = 2 ** 20  # rows (= 32 MB of uint32 features at 4 attrs)
+
     def __init__(self):
+        import threading
+
         self.strings = StringStore()
-        self._cache: Dict[str, np.ndarray] = {}
+        self._index: Dict[str, int] = {}
+        self._rows = np.zeros((1024, len(ATTRS), 2), dtype=np.uint32)
+        self._n_rows = 0
+        # the prefetch producer and the eval path may featurize concurrently;
+        # row-append is a compound read-modify-write and needs the lock.
+        # The common all-cached path stays lock-free because of WRITE
+        # ORDERING under the lock: a row's data is fully written into
+        # `_rows` BEFORE its index is published in `_index` (and growth
+        # rebinds `_rows` to a copy, never shrinking it), so any index a
+        # lock-free reader can observe already has valid row data behind
+        # it. Do not publish indices before their rows are written.
+        self._append_lock = threading.Lock()
 
     def token_features(self, word: str) -> np.ndarray:
-        feats = self._cache.get(word)
-        if feats is None:
-            attrs = self._attr_strings(word)
-            keys = np.array([hash_string_u64(a) for a in attrs], dtype=np.uint64)
-            lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-            hi = (keys >> np.uint64(32)).astype(np.uint32)
-            feats = np.stack([lo, hi], axis=-1)  # [n_attrs, 2]
-            if len(self._cache) < 2 ** 20:
-                self._cache[word] = feats
-        return feats
+        return self.featurize([word])[0]
 
     @staticmethod
     def _attr_strings(word: str) -> List[str]:
@@ -119,26 +131,65 @@ class Vocab:
             "shape=" + shape_of(word),
         ]
 
-    def featurize(self, words: Sequence[str]) -> np.ndarray:
-        if not words:
-            return np.zeros((0, len(ATTRS), 2), dtype=np.uint32)
-        # batch-hash all uncached words through the native extension
-        # (11x the pure-Python path; see native/)
-        uncached = [w for w in set(words) if w not in self._cache]
-        direct: Dict[str, np.ndarray] = {}
-        if uncached:
-            from ..native import hash_strings_u64
+    def _compute_feats(self, words: List[str]) -> np.ndarray:
+        """Batch-hash through the native extension (11x the pure-Python
+        path; see native/). [len(words), n_attrs, 2] uint32."""
+        from ..native import hash_strings_u64
 
-            attr_strings: List[str] = []
-            for w in uncached:
-                attr_strings.extend(self._attr_strings(w))
-            keys = hash_strings_u64(attr_strings).reshape(len(uncached), len(ATTRS))
-            feats_all = split_u64(keys)  # [n, n_attrs, 2]
-            for i, w in enumerate(uncached):
-                if len(self._cache) < 2 ** 20:
-                    self._cache[w] = feats_all[i]
-                else:  # cache full: serve this batch without caching
-                    direct[w] = feats_all[i]
-        return np.stack(
-            [direct[w] if w in direct else self._cache[w] for w in words]
-        )
+        attr_strings: List[str] = []
+        for w in words:
+            attr_strings.extend(self._attr_strings(w))
+        keys = hash_strings_u64(attr_strings).reshape(len(words), len(ATTRS))
+        return split_u64(keys)
+
+    def _append_rows(self, feats: np.ndarray) -> int:
+        k = feats.shape[0]
+        while self._n_rows + k > self._rows.shape[0]:
+            self._rows = np.concatenate([self._rows, np.zeros_like(self._rows)])
+        start = self._n_rows
+        self._rows[start : start + k] = feats
+        self._n_rows = start + k
+        return start
+
+    def featurize(self, words: Sequence[str]) -> np.ndarray:
+        n = len(words)
+        if not n:
+            return np.zeros((0, len(ATTRS), 2), dtype=np.uint32)
+        index = self._index
+        idx = np.empty(n, dtype=np.intp)
+        missing_pos: List[int] = []
+        for i, w in enumerate(words):
+            j = index.get(w)
+            if j is None:
+                missing_pos.append(i)
+                idx[i] = 0  # patched below
+            else:
+                idx[i] = j
+        overflow: Dict[str, np.ndarray] = {}
+        if missing_pos:
+            with self._append_lock:
+                # another thread may have cached some of these meanwhile
+                uniq = list(
+                    dict.fromkeys(
+                        words[i] for i in missing_pos if words[i] not in index
+                    )
+                )
+                if uniq:
+                    feats_all = self._compute_feats(uniq)
+                    room = max(self.CACHE_MAX - self._n_rows, 0)
+                    if room:
+                        start = self._append_rows(feats_all[:room])
+                        for k, w in enumerate(uniq[:room]):
+                            index[w] = start + k
+                    for k in range(room, len(uniq)):  # cache full (rare)
+                        overflow[uniq[k]] = feats_all[k]
+            for i in missing_pos:
+                j = index.get(words[i])
+                idx[i] = j if j is not None else 0
+        result = self._rows[idx]
+        if overflow:
+            for i in missing_pos:
+                feats = overflow.get(words[i])
+                if feats is not None:
+                    result[i] = feats
+        return result
