@@ -219,6 +219,37 @@ def apply_transformer_layer(
     return X + out, aux
 
 
+def _stack_layer_params(params, depth: int):
+    """Stack the per-layer param dicts into leaves with a leading [depth]
+    dim. Storage stays per-layer ("layer_i" keys — the checkpoint and
+    pretrained-loader schema); stacking happens at apply time, costing one
+    HBM copy of the trunk per step (~0.3 ms for RoBERTa-base at HBM
+    bandwidth — noise next to the step) in exchange for a compiled program
+    with ONE layer body instead of `depth` copies."""
+    import jax.tree_util as jtu
+
+    return jtu.tree_map(
+        lambda *xs: jnp.stack(xs), *[params[f"layer_{i}"] for i in range(depth)]
+    )
+
+
+def _scan_layer_stack(layer_fn, stacked, X, mask, key, depth: int):
+    """Run the stacked layers as one lax.scan, accumulating the aux loss.
+    Per-layer rng = fold_in(key, layer_index) — the SAME derivation the
+    pipelined stage body uses, so the two paths stay in lockstep."""
+
+    def body(carry, inp):
+        x, aux_sum = carry
+        lp, li = inp
+        y, aux = layer_fn(lp, x, mask, jax.random.fold_in(key, li))
+        return (y, aux_sum + aux), None
+
+    (X, aux_total), _ = jax.lax.scan(
+        body, (X, jnp.float32(0.0)), (stacked, jnp.arange(depth))
+    )
+    return X, aux_total
+
+
 def _pipelined_layers(
     params, X, mask, ctx, layer_fn, *, depth: int, n_microbatches: int
 ):
@@ -269,9 +300,7 @@ def _pipelined_layers(
             f"(pipeline bubble {(S - 1) / (M + S - 1):.0%})",
             stacklevel=2,
         )
-    stacked = jax.tree_util.tree_map(
-        lambda *xs: jnp.stack(xs), *[params[f"layer_{i}"] for i in range(depth)]
-    )
+    stacked = _stack_layer_params(params, depth)
     mb = X.reshape(M, B // M, *X.shape[1:])
     mb_mask = mask.reshape(M, B // M, mask.shape[1])
     ctx, sub = ctx.split()
@@ -289,17 +318,9 @@ def _pipelined_layers(
         # dropout masks on different microbatches
         key = jax.random.fold_in(key, jax.lax.axis_index("pipe"))
         with pctx.use_mesh(mesh if keep_mesh else None):
-            def body(carry, inp):
-                x, aux_sum = carry
-                lp, li = inp
-                y, aux = layer_fn(lp, x, m, jax.random.fold_in(key, li))
-                return (y, aux_sum + aux), None
-
-            (x, aux_sum), _ = jax.lax.scan(
-                body, (x, jnp.float32(0.0)),
-                (local_params, jnp.arange(layers_per_stage)),
+            return _scan_layer_stack(
+                layer_fn, local_params, x, m, key, layers_per_stage
             )
-            return x, aux_sum
 
     out, aux_total = ppl.spmd_pipeline(stage_fn, stacked, mb, mb_mask, rng)
     return out.reshape(B, *X.shape[1:]), aux_total
@@ -320,6 +341,7 @@ def TransformerEncoder(
     n_experts: int = 0,
     expert_capacity_factor: float = 1.25,
     router_aux_weight: float = 0.01,
+    scan_layers: bool = True,
 ) -> Model:
     """Hash-embed featurized transformer trunk (tok2vec-compatible output).
 
@@ -340,6 +362,13 @@ def TransformerEncoder(
     (native or HuggingFace-encoder keys, remapped) checkpoint to start the
     trunk from — see models/pretrained.py for the key schema. Every tensor
     is shape-checked; keys absent from the file keep their random init.
+
+    ``scan_layers=True`` runs the (homogeneous) layer stack as ONE
+    ``lax.scan`` over stacked per-layer params instead of an unrolled
+    Python loop: the compiled program contains one layer body instead of
+    ``depth`` copies (~8x smaller HLO for RoBERTa-base — compile time and
+    compile-server memory scale with program size). Per-layer dropout rng
+    derives from fold_in(key, layer_index) on both paths.
     """
     if width % n_heads != 0:
         raise ValueError(f"width {width} not divisible by n_heads {n_heads}")
@@ -403,6 +432,15 @@ def TransformerEncoder(
             X, aux_total = _pipelined_layers(
                 params, X, mask, ctx, layer_fn, depth=depth,
                 n_microbatches=pp_microbatches,
+            )
+        elif scan_layers and depth > 1:
+            # one scanned layer body instead of `depth` unrolled copies —
+            # same math, ~depth-x smaller compiled program
+            ctx, sub = ctx.split()
+            key = sub.rng if sub.rng is not None else jax.random.PRNGKey(0)
+            X, aux_total = _scan_layer_stack(
+                layer_fn, _stack_layer_params(params, depth), X, mask, key,
+                depth,
             )
         else:
             aux_total = jnp.float32(0.0)
