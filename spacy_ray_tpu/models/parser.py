@@ -53,9 +53,28 @@ def ner_window_features(Tlen: int, lengths: jnp.ndarray) -> jnp.ndarray:
     ).astype(jnp.int32)
 
 
+# HBM budget for the one-hot operand ([*feats.shape, T] elements, live
+# across fwd+bwd as an einsum residual) — beyond it the vmap gather wins
+ONEHOT_GATHER_MAX_BYTES = 128 * 1024 * 1024
+
+
 def _gather(X: jnp.ndarray, feats: jnp.ndarray) -> jnp.ndarray:
-    """X [B, T, D], feats [B, S, F] -> [B, S, F, D], -1 slots zeroed."""
+    """X [B, T, D], feats [B, S, F] -> [B, S, F, D], -1 slots zeroed.
+
+    On TPU a batched row gather lowers to serialized dynamic-slices; for
+    the doc-length Ts this model sees, re-expressing it as a one-hot
+    einsum puts the work on the MXU instead (the standard TPU gather
+    rewrite: B*S*F*T*D MACs, trivially saturating the systolic array,
+    and -1 slots fall out as all-zero one-hot rows — no separate mask).
+    """
     Tlen = X.shape[1]
+    onehot_bytes = feats.size * Tlen * X.dtype.itemsize
+    if onehot_bytes <= ONEHOT_GATHER_MAX_BYTES and jax.default_backend() == "tpu":
+        # one_hot(-1) == all zeros, so invalid slots zero themselves.
+        # feats may be [B, S, F] (training grid) or [B, F] (decode step):
+        # the ellipsis spans whatever lies between batch and the T axis.
+        onehot = jax.nn.one_hot(feats, Tlen, dtype=X.dtype)  # [B, ..., T]
+        return jnp.einsum("b...t,btd->b...d", onehot, X)
     safe = jnp.clip(feats, 0, Tlen - 1).astype(jnp.int32)
 
     def per_row(Xrow, frow):  # [T, D], [S, F]

@@ -145,11 +145,20 @@ def pallas_enabled() -> bool:
     return _PROBED
 
 
+# HBM budget for the one-hot counts operand ([tokens, rows] elements) —
+# beyond it the plain gather's [tokens, 4, D] intermediate is cheaper
+ONEHOT_LOOKUP_MAX_BYTES = 64 * 1024 * 1024
+
+
 def hash_embed_lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
     """Gather-sum 4 rows per key: table [rows, D], ids [..., 4] -> [..., D].
 
     Uses the pallas kernel when the startup probe enabled it and the table
-    fits the VMEM budget; jnp gather otherwise.
+    fits the VMEM budget. On TPU without the kernel (probe failed/forced
+    off), small tables use a one-hot count-matrix matmul instead of the
+    gather (TPU gathers serialize; summing the 4 one-hots gives a count
+    row, and counts @ table == the multiplicity-weighted row sum). Plain
+    jnp gather otherwise (CPU, big tables).
     """
     lead_shape = ids.shape[:-1]
     if (
@@ -166,4 +175,15 @@ def hash_embed_lookup(table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
         if pad:
             out = out[:n]
         return out.reshape(*lead_shape, table.shape[1])
+    counts_bytes = (ids.size // 4) * table.shape[0] * table.dtype.itemsize
+    if (
+        jax.default_backend() == "tpu"
+        and counts_bytes <= ONEHOT_LOOKUP_MAX_BYTES
+    ):
+        counts = jnp.sum(
+            jax.nn.one_hot(ids.astype(jnp.int32), table.shape[0],
+                           dtype=table.dtype),
+            axis=-2,
+        )  # [..., rows]
+        return counts @ table
     return _reference_lookup(table, ids.astype(jnp.int32))

@@ -64,3 +64,23 @@ def test_custom_vjp_backward_matches_reference():
     g_ref = jax.grad(ref_loss)(table)
     g_ours = _table_grad(ids, ct, 50)
     np.testing.assert_allclose(np.asarray(g_ours), np.asarray(g_ref), atol=1e-5)
+
+
+def test_onehot_lookup_matches_gather(monkeypatch):
+    """The TPU one-hot fallback (probe off, small table) must equal the
+    reference gather-sum, including repeated ids (multiplicity counts)."""
+    import jax as _jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import spacy_ray_tpu.ops.pallas_kernels as PK
+
+    table = _jax.random.normal(_jax.random.PRNGKey(0), (64, 16))
+    ids = _jax.random.randint(_jax.random.PRNGKey(1), (10, 3, 4), 0, 64)
+    ids = ids.at[0, 0].set(jnp.array([5, 5, 5, 9]))  # repeats
+
+    monkeypatch.setattr(PK, "_PROBED", False)
+    monkeypatch.setattr(PK.jax, "default_backend", lambda: "tpu")
+    got = PK.hash_embed_lookup(table, ids)
+    want = PK._reference_lookup(table, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)

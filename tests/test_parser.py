@@ -224,3 +224,31 @@ def test_biluo_roundtrip():
     assert tags == ["O", "B-X", "L-X", "O", "U-Y", "O", "O"]
     spans = Doc.spans_from_biluo(tags)
     assert [(s.start, s.end, s.label) for s in spans] == [(1, 3, "X"), (4, 5, "Y")]
+
+
+def test_onehot_gather_matches_take(monkeypatch):
+    """The TPU one-hot einsum rewrite of the feature gather must equal the
+    take_along path (including -1 slot zeroing) for both the training grid
+    [B, S, F] and the decode-step [B, F] layouts."""
+    import jax as _jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spacy_ray_tpu.models import parser as P
+
+    rng = _jax.random.PRNGKey(0)
+    X = _jax.random.normal(rng, (3, 17, 8))
+
+    def take_path(X, feats):
+        safe = jnp.clip(feats, 0, X.shape[1] - 1).astype(jnp.int32)
+        out = _jax.vmap(lambda Xr, fr: Xr[fr])(X, safe)
+        return out * (feats >= 0)[..., None].astype(X.dtype)
+
+    feats3 = _jax.random.randint(_jax.random.PRNGKey(1), (3, 5, 4), -1, 17)
+    feats2 = _jax.random.randint(_jax.random.PRNGKey(2), (3, 4), -1, 17)
+
+    monkeypatch.setattr(P.jax, "default_backend", lambda: "tpu")
+    for feats in (feats3, feats2):
+        got = P._gather(X, feats)
+        want = take_path(X, feats)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
