@@ -80,7 +80,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, scale):
 
 
 def _bwd_kernel(
-    q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref, lse_ref,
+    q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref, lse_ref, dlse_ref,
     dq_ref, dk_ref, dv_ref, *, scale,
 ):
     # grid (B, H, nq); dk/dv blocks are revisited across the q-block axis
@@ -95,6 +95,7 @@ def _bwd_kernel(
     do = do_ref[0, 0].astype(jnp.float32)
     o = o_ref[0, 0].astype(jnp.float32)
     lse = lse_ref[0, 0]  # [BQ]
+    dlse = dlse_ref[0, 0]  # [BQ] cotangent of the logsumexp output
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -107,7 +108,10 @@ def _bwd_kernel(
         do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [BQ, T]
-    ds = p * (dp - delta[:, None]) * scale  # [BQ, T] fp32
+    # d(lse)/d(s_j) = p_j, so the lse cotangent folds straight into ds —
+    # this is what lets the ring-attention block merge differentiate
+    # through each block's logsumexp
+    ds = p * (dp - delta[:, None] + dlse[:, None]) * scale  # [BQ, T] fp32
     ds16 = ds.astype(q.dtype)
 
     dq_ref[0, 0] = jnp.dot(
@@ -157,7 +161,7 @@ def _fwd_raw(q, k, v, bias, *, scale, interpret=None):
     )(q, k, v, bias)
 
 
-def _bwd_raw(q, k, v, bias, do, o, lse, *, scale, interpret=None):
+def _bwd_raw(q, k, v, bias, do, o, lse, dlse, *, scale, interpret=None):
     interpret = _INTERPRET if interpret is None else interpret
     B, H, T, DP = q.shape
     nq = T // BQ
@@ -178,30 +182,41 @@ def _bwd_raw(q, k, v, bias, do, o, lse, *, scale, interpret=None):
             jax.ShapeDtypeStruct((B, H, T, DP), jnp.float32),  # dv (accum)
         ),
         grid=(B, H, nq),
-        in_specs=[qspec, kvspec, kvspec, bspec, qspec, qspec, lspec],
+        in_specs=[qspec, kvspec, kvspec, bspec, qspec, qspec, lspec, lspec],
         out_specs=(qspec, kvspec, kvspec),
         interpret=interpret,
-    )(q, k, v, bias, do, o, lse)
+    )(q, k, v, bias, do, o, lse, dlse)
 
 
-@functools.lru_cache(maxsize=None)
 def _make_flash(scale: float):
     """Differentiable flash attention for one (static) softmax scale — the
     scale must come from the REAL head dim, not the zero-padded kernel DP,
-    so the host wrapper passes it down explicitly."""
+    so the host wrapper passes it down explicitly. Output-only view of
+    :func:`_make_flash_lse`; JAX supplies a zero cotangent for the dropped
+    lse output, which the shared backward folds in at no cost."""
+    fl = _make_flash_lse(scale)
+    return lambda q, k, v, bias: fl(q, k, v, bias)[0]
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash_lse(scale: float):
+    """Like :func:`_make_flash` but also RETURNS the per-query logsumexp, with
+    a VJP that accepts its cotangent — the building block for ring attention,
+    whose online merge of per-ring-block partial results is a differentiable
+    function of each block's (output, logsumexp) pair."""
 
     @jax.custom_vjp
     def fl(q, k, v, bias):
-        o, _ = _fwd_raw(q, k, v, bias, scale=scale)
-        return o
+        return _fwd_raw(q, k, v, bias, scale=scale)
 
     def fl_fwd(q, k, v, bias):
         o, lse = _fwd_raw(q, k, v, bias, scale=scale)
-        return o, (q, k, v, bias, o, lse)
+        return (o, lse), (q, k, v, bias, o, lse)
 
-    def fl_bwd(res, do):
+    def fl_bwd(res, cts):
         q, k, v, bias, o, lse = res
-        dq, dk, dv = _bwd_raw(q, k, v, bias, do, o, lse, scale=scale)
+        do, dlse = cts
+        dq, dk, dv = _bwd_raw(q, k, v, bias, do, o, lse, dlse, scale=scale)
         return dq, dk.astype(k.dtype), dv.astype(v.dtype), None
 
     fl.defvjp(fl_fwd, fl_bwd)
@@ -220,6 +235,23 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int, value=0.0) -> jnp.ndarray:
     return jnp.pad(x, widths, constant_values=value)
 
 
+def _dp(head_dim: int) -> int:
+    """Kernel head dim: the real head dim zero-padded up to a lane multiple."""
+    return max(((head_dim + 127) // 128) * 128, 128)
+
+
+def _to_kernel_layout(x: jnp.ndarray) -> jnp.ndarray:
+    """[B, T, H, Dh] trunk layout -> [B, H, Tp, DP] kernel layout; zero
+    head-dim padding leaves scores and output columns exact."""
+    return _pad_to(_pad_to(x.transpose(0, 2, 1, 3), 3, _dp(x.shape[-1])), 2, BQ)
+
+
+def _mask_to_bias(mask: jnp.ndarray) -> jnp.ndarray:
+    """[B, T] bool key-padding mask -> [B, Tp] additive fp32 bias."""
+    bias = jnp.where(mask, 0.0, NEG).astype(jnp.float32)
+    return _pad_to(bias, 1, BQ, value=NEG)
+
+
 def flash_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, mask: jnp.ndarray
 ) -> jnp.ndarray:
@@ -227,18 +259,11 @@ def flash_attention(
     layout), mask [B, T] bool (key padding). Returns [B, T, H, Dh] in q.dtype.
     """
     B, T, H, Dh = q.shape
-    DP = max(((Dh + 127) // 128) * 128, 128)
-    # [B, H, T, DP] kernel layout; zero head-dim padding leaves scores and
-    # output columns exact
-    qk = _pad_to(_pad_to(q.transpose(0, 2, 1, 3), 3, DP), 2, BQ)
-    kk = _pad_to(_pad_to(k.transpose(0, 2, 1, 3), 3, DP), 2, BQ)
-    vk = _pad_to(_pad_to(v.transpose(0, 2, 1, 3), 3, DP), 2, BQ)
-    bias = jnp.where(mask, 0.0, NEG).astype(jnp.float32)
-    bias = _pad_to(bias, 1, BQ, value=NEG)
-
-    o = _make_flash(1.0 / (Dh ** 0.5))(qk, kk, vk, bias)
-    o = o[:, :, :T, :Dh].transpose(0, 2, 1, 3)
-    return o
+    o = _make_flash(1.0 / (Dh ** 0.5))(
+        _to_kernel_layout(q), _to_kernel_layout(k), _to_kernel_layout(v),
+        _mask_to_bias(mask),
+    )
+    return o[:, :, :T, :Dh].transpose(0, 2, 1, 3)
 
 
 def attention_vmem_ok(T: int, DP: int, dtype_bytes: int = 2) -> bool:
@@ -363,9 +388,7 @@ def attention(
     from ..parallel import context as pctx
 
     mesh = pctx.current_mesh()
-    Dh = q.shape[-1]
-    DP = max(((Dh + 127) // 128) * 128, 128)
-    if flash_attention_enabled() and attention_vmem_ok(q.shape[1], DP):
+    if flash_attention_enabled() and attention_vmem_ok(q.shape[1], _dp(q.shape[-1])):
         if mesh is None or mesh.size == 1:
             return flash_attention(q, k, v, mask)
         out = _sharded_flash_attention(q, k, v, mask, mesh)

@@ -27,6 +27,64 @@ from .smap import CHECK_KW as _CHECK_KW, PARTIAL_MANUAL, shard_map
 AXIS = "context"
 
 
+def _use_flash_blocks(t_block: int, head_dim: int) -> bool:
+    """Static host-side gate: run each ring block through the pallas flash
+    kernel (ops/flash_attention.py) instead of the dense jnp score block.
+    Exact either way; flash keeps the per-block [B, H, Tq, Tk] score tensor
+    out of HBM, which matters once the per-device sequence slice is long —
+    the whole point of the context axis."""
+    from ..ops import flash_attention as fa
+
+    return fa.flash_attention_enabled() and fa.attention_vmem_ok(
+        t_block, fa._dp(head_dim)
+    )
+
+
+def _ring_flash(q, k, v, kmask, *, scale, n_shards, out_dtype):
+    """Per-device ring loop with pallas flash blocks: q is laid out for the
+    kernel once; the RAW k/v/kmask rotate around the ring (padding them per
+    step is a fused VPU op, while rotating padded tensors would inflate
+    per-step ppermute ICI traffic by the pad ratio). Each block's (output,
+    logsumexp) pair merges associatively into a running pair — the flash
+    merge, differentiable end-to-end because the block kernel's VJP accepts
+    an lse cotangent (_make_flash_lse)."""
+    from ..ops import flash_attention as fa
+
+    B, T, H, Dh = q.shape
+    qk = fa._to_kernel_layout(q)
+    fl = fa._make_flash_lse(scale)
+
+    _, _, Tp, DP = qk.shape
+    o_acc = jnp.zeros((B, H, Tp, DP), jnp.float32)
+    lse_acc = jnp.full((B, H, Tp), fa.NEG, jnp.float32)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def body(carry, _):
+        k, v, kmask, o_acc, lse_acc = carry
+        o_b, lse_b = fl(
+            qk, fa._to_kernel_layout(k), fa._to_kernel_layout(v),
+            fa._mask_to_bias(kmask),
+        )
+        m = jnp.maximum(lse_acc, lse_b)
+        w_acc = jnp.exp(lse_acc - m)
+        w_b = jnp.exp(lse_b - m)
+        den = w_acc + w_b
+        o_acc = (
+            o_acc * (w_acc / den)[..., None]
+            + o_b.astype(jnp.float32) * (w_b / den)[..., None]
+        )
+        lse_acc = m + jnp.log(den)
+        k = jax.lax.ppermute(k, AXIS, perm)
+        v = jax.lax.ppermute(v, AXIS, perm)
+        kmask = jax.lax.ppermute(kmask, AXIS, perm)
+        return (k, v, kmask, o_acc, lse_acc), None
+
+    (_, _, _, o_acc, _), _ = jax.lax.scan(
+        body, (k, v, kmask, o_acc, lse_acc), None, length=n_shards
+    )
+    return o_acc[:, :, :T, :Dh].transpose(0, 2, 1, 3).astype(out_dtype)
+
+
 def _ring_body(carry, _, *, q, scale, axis_name, n_shards):
     k, v, kmask, m, num, den = carry
     # scores over the current key block: [B, H, Tq, Tk]
@@ -101,6 +159,11 @@ def ring_attention(
     )
     def inner(q, k, v, kmask):
         B, Tq, H, _ = q.shape
+        if _use_flash_blocks(Tq, Dh):
+            return _ring_flash(
+                q, k, v, kmask,
+                scale=scale, n_shards=n_shards, out_dtype=out_dtype,
+            )
         m = jnp.full((B, H, Tq), -1e30, jnp.float32)
         num = jnp.zeros((B, Tq, H, Dh), jnp.float32)
         den = jnp.zeros((B, H, Tq), jnp.float32)
