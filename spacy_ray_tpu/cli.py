@@ -324,8 +324,47 @@ def _load_plugins() -> None:
         pass
 
 
+def pretrain_command(argv: List[str]) -> int:
+    """`pretrain` — tok2vec pretraining from the config's [pretraining]
+    block (spaCy's `spacy pretrain` surface); weights go to --output and
+    load back via [initialize] init_tok2vec."""
+    parser = argparse.ArgumentParser(
+        prog="spacy_ray_tpu pretrain",
+        description="Pretrain the tok2vec/transformer trunk on raw text "
+        "([pretraining] config block); load results with "
+        "[initialize] init_tok2vec.",
+    )
+    parser.add_argument("config_path", type=Path)
+    parser.add_argument("output_dir", type=Path)
+    parser.add_argument("--n-workers", type=int, default=None, dest="n_workers")
+    parser.add_argument("--device", type=str, default="tpu", choices=["tpu", "cpu"])
+    parser.add_argument("--code", type=Path, default=None)
+    parser.add_argument("--verbose", "-V", action="store_true")
+    args, extra = parser.parse_known_args(argv)
+
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.ERROR)
+    _setup_device(args.device)
+
+    from .config import load_config, parse_cli_overrides
+    from .registry import import_code
+
+    import_code(str(args.code) if args.code else None)
+    overrides = parse_cli_overrides(extra)
+    config = load_config(args.config_path, overrides, interpolate=False)
+
+    from .training.pretrain import pretrain
+
+    stats = pretrain(config, args.output_dir, n_workers=args.n_workers)
+    print(
+        f"Pretraining done. steps={stats['steps']} loss={stats['loss']:.4f} "
+        f"words={stats['words']:,} -> {stats['output']}"
+    )
+    return 0
+
+
 COMMANDS = {
     "train": train_command,
+    "pretrain": pretrain_command,
     "evaluate": evaluate_command,
     "convert": convert_command,
     "init-config": init_config_command,
@@ -336,7 +375,7 @@ COMMANDS = {
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("Usage: python -m spacy_ray_tpu {train,evaluate,convert,init-config,debug-data} ...")
+        print("Usage: python -m spacy_ray_tpu {train,pretrain,evaluate,convert,init-config,debug-data} ...")
         return 0
     command = argv[0]
     if command not in COMMANDS:

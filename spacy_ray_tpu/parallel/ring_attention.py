@@ -118,27 +118,46 @@ def ring_attention(
     mesh = pctx.current_mesh()
     assert mesh is not None and AXIS in mesh.shape, "ring_attention needs a context axis"
     n_shards = int(mesh.shape[AXIS])
-    Dh = q.shape[-1]
+    B_g, T_g, H_g, Dh = q.shape
     scale = 1.0 / (Dh ** 0.5)
     out_dtype = q.dtype
+    n_data = int(mesh.shape.get("data", 1))
+    n_model = int(mesh.shape.get("model", 1))
+    # flash blocks run a pallas_call per device shard; the gate is decided
+    # HERE because under partial-manual the region's manual axis set depends
+    # on it (pallas_call has no GSPMD partitioning rule, so every mesh axis
+    # its operands are sharded over must be manual — see
+    # flash_attention._sharded_flash_attention for the single-chip analogue)
+    flash = _use_flash_blocks(T_g // n_shards, Dh)
 
     sm_mesh = mesh
     if PARTIAL_MANUAL:
-        # manual over `context` ONLY: data/model dims keep their automatic
-        # (GSPMD) semantics, so the body's einsums still partition over
-        # them — and the whole region can nest inside another partial-
-        # manual shard_map (the pipeline's `pipe` region). When already
+        # manual over `context` ONLY by default: data/model dims keep their
+        # automatic (GSPMD) semantics, so the dense body's einsums still
+        # partition over them — and the whole region can nest inside another
+        # partial-manual shard_map (the pipeline's `pipe` region). The flash
+        # path instead goes manual over data/model TOO (its kernel covers
+        # the whole per-device computation; nothing is left to partition),
+        # falling back to dense when the layout doesn't divide. When already
         # inside such a region, shard_map must receive the AMBIENT abstract
         # mesh (whose enclosing axes are marked Manual), not the concrete
         # mesh it was built from.
-        qkv_spec = P(None, AXIS, None, None)
-        mask_spec = P(None, AXIS)
-        sm_kwargs: dict = {"axis_names": frozenset({AXIS})}
+        manual = {AXIS}
+        if flash and (n_data > 1 or n_model > 1):
+            if B_g % max(n_data, 1) or H_g % max(n_model, 1):
+                flash = False  # indivisible layout: dense partitions cleanly
+            else:
+                manual |= {a for a, n in (("data", n_data), ("model", n_model)) if n > 1}
+        data_ax = "data" if "data" in manual else None
+        model_ax = "model" if "model" in manual else None
+        qkv_spec = P(data_ax, AXIS, model_ax, None)
+        mask_spec = P(data_ax, AXIS)
+        sm_kwargs: dict = {"axis_names": frozenset(manual)}
         try:
             from jax.sharding import get_abstract_mesh
 
             am = get_abstract_mesh()
-            if am is not None and AXIS in (am.shape or {}):
+            if am is not None and all(a in (am.shape or {}) for a in manual):
                 sm_mesh = am
         except Exception:  # pragma: no cover - API drift: concrete mesh
             pass
@@ -159,7 +178,7 @@ def ring_attention(
     )
     def inner(q, k, v, kmask):
         B, Tq, H, _ = q.shape
-        if _use_flash_blocks(Tq, Dh):
+        if flash:
             return _ring_flash(
                 q, k, v, kmask,
                 scale=scale, n_shards=n_shards, out_dtype=out_dtype,

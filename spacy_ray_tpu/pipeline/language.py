@@ -207,6 +207,36 @@ class Pipeline:
                 comp_params = comp.init_params(sub)
                 if comp_params:  # host-only components have no params; empty
                     params[name] = comp_params  # dicts break pytree matching
+        # [initialize] init_tok2vec: pretrained trunk weights from the
+        # `pretrain` command (spaCy's init_tok2vec semantics — the trunk
+        # starts from pretraining, heads stay freshly initialized)
+        init_t2v = init_cfg.get("init_tok2vec")
+        if init_t2v:
+            t2v_name = self.tok2vec_name
+            if t2v_name is None or t2v_name not in params:
+                raise ValueError(
+                    "[initialize] init_tok2vec is set but the pipeline has "
+                    "no tok2vec/transformer trunk with parameters"
+                )
+            from ..training.checkpoint import _flatten, load_params
+
+            loaded = load_params(init_t2v)
+            have = {k: tuple(v.shape) for k, v in _flatten(params[t2v_name]).items()}
+            got = {k: tuple(v.shape) for k, v in _flatten(loaded).items()}
+            if have != got:
+                missing = sorted(set(have) - set(got))[:5]
+                extra = sorted(set(got) - set(have))[:5]
+                mismatched = sorted(
+                    k for k in set(have) & set(got) if have[k] != got[k]
+                )[:5]
+                raise ValueError(
+                    f"init_tok2vec weights at {init_t2v!r} do not match the "
+                    f"{t2v_name!r} trunk this config builds "
+                    f"(missing={missing}, unexpected={extra}, "
+                    f"shape-mismatched={mismatched}); pretrain with the same "
+                    "trunk architecture settings"
+                )
+            params[t2v_name] = loaded
         # Width compatibility: a (possibly sourced) listening head must match
         # the trunk width, or jit fails later with an opaque shape error.
         t2v = self.tok2vec_name

@@ -32,9 +32,30 @@ from ..pipeline.doc import Doc, Example, Span
 CorpusReader = Callable[[], Iterator[Example]]
 
 
+_raw_text_tokenizer = None
+
+
+def set_raw_text_tokenizer(tokenizer) -> None:
+    """Install the PIPELINE's tokenizer for raw-text ({"text": ...}) corpus
+    lines, so pretraining sees the same token stream the pipeline produces
+    at train/inference time (spaCy's JsonlCorpus tokenizes with nlp.make_doc
+    for the same reason). ``pretrain`` calls this before reading."""
+    global _raw_text_tokenizer
+    _raw_text_tokenizer = tokenizer
+
+
 def _doc_from_json(obj: dict) -> Doc:
     words = obj.get("tokens") or obj.get("words")
     if words is None:
+        text = obj.get("text")
+        if text is not None:
+            # raw-text line ({"text": ...}): the pretraining data flow
+            global _raw_text_tokenizer
+            if _raw_text_tokenizer is None:
+                from ..pipeline.tokenizer import Tokenizer
+
+                _raw_text_tokenizer = Tokenizer()
+            return _raw_text_tokenizer(text)
         raise ValueError(f"Corpus line missing 'tokens': keys={list(obj)}")
     doc = Doc(
         words=list(words),

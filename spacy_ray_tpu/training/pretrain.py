@@ -1,0 +1,335 @@
+"""Tok2vec pretraining: the ``pretrain`` CLI / ``[pretraining]`` config block.
+
+Capability parity with ``spacy pretrain`` (part of the spaCy training stack
+the reference programs against, SURVEY.md §1 layer E2; the reference's
+``spacy ray train`` consumes configs whose ``[initialize] init_tok2vec``
+points at weights this command produces). The design is TPU-first, not a
+port of spaCy's thinc implementation:
+
+* The whole objective — trunk forward, head, masked loss — is ONE jitted
+  program built with the same ``make_train_step`` (psum over the data
+  axis, donated buffers) as supervised training; pretraining scales over
+  the mesh exactly like training does.
+* ``characters`` objective (default): for every token predict its first
+  ``n_characters`` and last ``n_characters`` UTF-8 bytes from the trunk's
+  output vector, as ``2 * n_characters`` independent 257-way softmaxes
+  (256 byte values + one "absent" class for tokens shorter than the
+  window). Targets are a statically-shaped [B, T, 2n] int array built at
+  collation — batched MXU-friendly classification, no ragged host loops.
+* ``vectors`` objective: predict the token's static vector (requires
+  ``[initialize] vectors``); cosine or L2 loss, masked to real tokens.
+
+Output: ``model-last.npz`` (+ periodic ``model{step}.npz``) holding the
+trunk component's params in the portable flattened-npz schema of
+``checkpoint.save_params`` — exactly what ``[initialize] init_tok2vec``
+loads (shape-checked) before supervised training.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..models.core import Context, Model, chain
+from ..models.layers import Linear, Maxout
+from ..registry import registry
+from ..types import Padded
+from .checkpoint import save_params
+from .corpus import Corpus
+from .loop import resolve_dot_name
+
+N_BYTE_CLASSES = 257  # 256 byte values + "absent" (token shorter than window)
+
+
+def char_targets(examples: List[Any], B: int, T: int, n: int) -> np.ndarray:
+    """[B, T, 2n] int32: first n and last n UTF-8 bytes of each token
+    (byte value + 1; 0 = absent). Cached per Example like the feature
+    cache — pretraining re-iterates the corpus every epoch."""
+    out = np.zeros((B, T, 2 * n), dtype=np.int32)
+    for i, eg in enumerate(examples[:B]):
+        cached = getattr(eg, "_char_cache", None)
+        if cached is None or cached.shape[1] != 2 * n:
+            words = eg.reference.words
+            cached = np.zeros((len(words), 2 * n), dtype=np.int32)
+            for j, w in enumerate(words):
+                bs = w.encode("utf8")
+                head, tail = bs[:n], bs[-n:]
+                cached[j, : len(head)] = np.frombuffer(head, np.uint8) + 1
+                cached[j, n : n + len(tail)] = (
+                    np.frombuffer(tail, np.uint8).astype(np.int32) + 1
+                )
+            try:
+                eg._char_cache = cached
+            except AttributeError:  # slots-restricted Example: skip caching
+                pass
+        L = min(len(cached), T)
+        out[i, :L] = cached[:L]
+    return out
+
+
+def build_char_head(width: int, n_characters: int, hidden: int = 0) -> Model:
+    """Trunk vector -> [..., 2n * 257] logits. A Maxout hidden layer when
+    ``hidden`` > 0 (spaCy's characters head shape), plain Linear otherwise."""
+    n_out = 2 * n_characters * N_BYTE_CLASSES
+    if hidden:
+        return chain(Maxout(width, hidden), Linear(hidden, n_out), name="char_head")
+    return Linear(width, n_out, name="char_head")
+
+
+def make_char_loss(trunk: Model, head: Model, n_characters: int):
+    """loss_fn(params, tokens, targets, rng) for make_train_step: masked
+    mean softmax cross-entropy over 2n byte slots per real token."""
+
+    def loss_fn(params, tokens, targets, rng):
+        ctx = Context(train=True, rng=rng)
+        enc: Padded = trunk.apply(params["trunk"], tokens, ctx)
+        logits = head.apply(params["head"], enc, ctx).X
+        B, T, _ = logits.shape
+        logits = logits.reshape(B, T, 2 * n_characters, N_BYTE_CLASSES)
+        tgt = targets["chars"]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        mask = enc.mask.astype(jnp.float32)[..., None]  # [B, T, 1]
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask) * 2 * n_characters, 1.0)
+        acc = jnp.sum((jnp.argmax(logp, -1) == tgt) * mask) / jnp.maximum(
+            jnp.sum(mask) * 2 * n_characters, 1.0
+        )
+        return loss, {"char_acc": acc}
+
+    return loss_fn
+
+
+def make_vector_loss(trunk: Model, head: Model, loss_kind: str):
+    """``vectors`` objective: predict each token's static vector; cosine or
+    L2, masked to rows that actually have a vector (targets["has_vec"])."""
+
+    def loss_fn(params, tokens, targets, rng):
+        ctx = Context(train=True, rng=rng)
+        enc: Padded = trunk.apply(params["trunk"], tokens, ctx)
+        pred = head.apply(params["head"], enc, ctx).X.astype(jnp.float32)
+        tgt = targets["vectors"].astype(jnp.float32)
+        mask = (enc.mask & targets["has_vec"]).astype(jnp.float32)
+        if loss_kind == "cosine":
+            pn = pred / jnp.maximum(jnp.linalg.norm(pred, axis=-1, keepdims=True), 1e-8)
+            tn = tgt / jnp.maximum(jnp.linalg.norm(tgt, axis=-1, keepdims=True), 1e-8)
+            per_tok = 1.0 - jnp.sum(pn * tn, axis=-1)
+        else:  # L2
+            per_tok = jnp.sum((pred - tgt) ** 2, axis=-1)
+        loss = jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return loss, {}
+
+    return loss_fn
+
+
+def _batches(corpus: Corpus, size: int) -> Iterator[List[Any]]:
+    buf: List[Any] = []
+    for eg in corpus():
+        buf.append(eg)
+        if len(buf) == size:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
+
+
+def pretrain(
+    config: Config,
+    output_dir: Path,
+    *,
+    n_workers: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Run the ``[pretraining]`` block of ``config``; write trunk weights to
+    ``output_dir``. Returns summary stats."""
+    from ..parallel.mesh import build_mesh
+    from ..parallel.step import (
+        make_train_step,
+        place_batch,
+        place_replicated,
+    )
+    from ..pipeline.language import Pipeline
+
+    config = config.interpolate()
+    P = dict(config.get("pretraining") or {})
+    if not P:
+        raise ValueError("Config has no [pretraining] block")
+
+    nlp = Pipeline.from_config(config)
+    comp_name = P.get("component") or nlp.tok2vec_name
+    if comp_name is None or comp_name not in nlp.components:
+        raise ValueError(
+            f"[pretraining] component {comp_name!r} not in pipeline "
+            f"{nlp.pipe_names} (and no tok2vec/transformer trunk found)"
+        )
+    comp = nlp.components[comp_name]
+
+    # [initialize] vectors load FIRST — the trunk may embed static vectors
+    # (include_static_vectors), so model build must see them, exactly as
+    # Pipeline.initialize orders it
+    init_cfg = config.get("initialize", {}) or {}
+    vec_path = init_cfg.get("vectors")
+    if vec_path and nlp.vectors is None:
+        from ..pipeline.vectors import Vectors
+
+        nlp.vectors = Vectors.from_disk(vec_path)
+    from ..pipeline.vectors import use_vectors
+
+    with use_vectors(nlp.vectors):
+        comp.build_model()
+    width = comp.model.dims.get("nO")
+    if not width:
+        raise ValueError(f"trunk {comp_name!r} does not expose an output width")
+
+    # ---- corpus (dot-name into [corpora], like train/dev) ----
+    # raw-text lines must tokenize with THIS pipeline's tokenizer, not a
+    # default rule set, or the trunk pretrains on a mismatched token stream
+    from .corpus import set_raw_text_tokenizer
+
+    set_raw_text_tokenizer(nlp.tokenizer)
+    corpora_cfg = config.get("corpora", {})
+    resolved = {name: registry.resolve(block) for name, block in corpora_cfg.items()}
+    corpus = resolve_dot_name(config, resolved, P.get("corpus", "corpora.pretrain"))
+
+    # ---- objective ----
+    obj = dict(P.get("objective") or {})
+    obj_type = obj.get("type", "characters")
+    n_chars = int(obj.get("n_characters", 4))
+    if obj_type == "characters":
+        head = build_char_head(width, n_chars, hidden=int(obj.get("hidden_size", 0)))
+        loss_fn = make_char_loss(comp.model, head, n_chars)
+    elif obj_type == "vectors":
+        if nlp.vectors is None:
+            raise ValueError("objective type 'vectors' needs [initialize] vectors")
+        head = Linear(width, nlp.vectors.width, name="vec_head")
+        loss_fn = make_vector_loss(
+            comp.model, head, obj.get("loss", "cosine")
+        )
+    else:
+        raise ValueError(f"Unknown [pretraining.objective] type {obj_type!r}")
+
+    # ---- params + step ----
+    rng = jax.random.PRNGKey(int(P.get("seed", 0)))
+    rng, r_trunk, r_head = jax.random.split(rng, 3)
+    with use_vectors(nlp.vectors):
+        params = {"trunk": comp.init_params(r_trunk), "head": head.init(r_head)}
+
+    n_devices = None
+    if n_workers is not None:
+        n_devices = int(n_workers)
+    mesh = build_mesh(n_data=n_devices)
+    opt_cfg = dict(P.get("optimizer") or {})
+    opt_name = opt_cfg.pop("@optimizers", "Adam.v1")
+    tx = registry.get("optimizers", opt_name)(**opt_cfg)
+    params = place_replicated(params, mesh)
+    opt_state = tx.init(params)
+    step = make_train_step(loss_fn, tx, mesh, opt_state_template=opt_state)
+
+    max_steps = int(P.get("max_steps", 1000))
+    max_epochs = int(P.get("max_epochs", 0))
+    batch_size = int(P.get("batch_size", 64))
+    n_save_every = int(P.get("n_save_every", 0))
+    if float(P.get("dropout", 0.0)):
+        print(
+            "# [pretraining] dropout is taken from the component's own model "
+            "config here (the trunk applies its configured dropout when "
+            "training); the standalone key is ignored",
+            flush=True,
+        )
+
+    output_dir = Path(output_dir)
+    output_dir.mkdir(parents=True, exist_ok=True)
+
+    def save(tag: str) -> None:
+        host = jax.tree_util.tree_map(np.asarray, params["trunk"])
+        save_params(output_dir / f"model-{tag}.npz", host)
+
+    n_data = int(mesh.shape.get("data", 1))
+    n_step = 0
+    epoch = 0
+    t0 = time.perf_counter()
+    total_words = 0
+    loss_val = float("nan")
+    done = False
+    while not done:
+        epoch += 1
+        for examples in _batches(corpus, batch_size):
+            # B must divide evenly over the mesh data axis for P("data")
+            # (same rounding the train loop applies, loop.py)
+            B_pad = ((max(len(examples), n_data) + n_data - 1) // n_data) * n_data
+            batch = nlp.collate(examples, with_targets=False, pad_batch_to=B_pad)
+            tokens = batch["tokens"]
+            if obj_type == "characters":
+                targets = {
+                    "chars": char_targets(
+                        examples, *_batch_bt(batch), n_chars
+                    )
+                }
+            else:
+                targets = _vector_targets(nlp, examples, *_batch_bt(batch))
+            rng, sub = jax.random.split(rng)
+            params, opt_state, loss, metrics = step(
+                params,
+                opt_state,
+                place_batch(tokens, mesh),
+                place_batch(targets, mesh),
+                sub,
+            )
+            n_step += 1
+            total_words += int(batch["n_words"])
+            if n_step % 50 == 0 or n_step == 1:
+                loss_val = float(loss)
+                extra = "".join(
+                    f"  {k}={float(v):.3f}" for k, v in (metrics or {}).items()
+                    if k != "grad_norm"
+                )
+                wps = total_words / max(time.perf_counter() - t0, 1e-9)
+                print(
+                    f"pretrain step {n_step:>6}  loss={loss_val:.4f}{extra}  "
+                    f"wps={wps:,.0f}",
+                    flush=True,
+                )
+            if n_save_every and n_step % n_save_every == 0:
+                save(str(n_step))
+            if n_step >= max_steps:
+                done = True
+                break
+        if n_step == 0:
+            raise ValueError(
+                "pretraining corpus yielded no batches (empty file, or "
+                "max_length filtered every text); nothing to train on"
+            )
+        if max_epochs and epoch >= max_epochs:
+            done = True
+    loss_val = float(loss)
+    save("last")
+    return {
+        "steps": n_step,
+        "epochs": epoch,
+        "loss": loss_val,
+        "words": total_words,
+        "output": str(output_dir / "model-last.npz"),
+    }
+
+
+def _batch_bt(batch: Dict[str, Any]) -> Tuple[int, int]:
+    """(B, T) of a collated batch, from whatever leaf is handy."""
+    leaf = jax.tree_util.tree_leaves(batch["tokens"])[0]
+    return int(leaf.shape[0]), int(leaf.shape[1])
+
+
+def _vector_targets(nlp, examples, B: int, T: int) -> Dict[str, np.ndarray]:
+    D = nlp.vectors.width
+    vecs = np.zeros((B, T, D), dtype=np.float32)
+    has = np.zeros((B, T), dtype=bool)
+    for i, eg in enumerate(examples[:B]):
+        for j, w in enumerate(eg.reference.words[:T]):
+            r = nlp.vectors.row_of(w)
+            if r >= 0:
+                vecs[i, j] = nlp.vectors.table[r]
+                has[i, j] = True
+    return {"vectors": vecs, "has_vec": has}

@@ -1,0 +1,163 @@
+"""`pretrain` command: tok2vec pretraining on raw text (characters
+objective), weights round-tripping into training via [initialize]
+init_tok2vec — the `spacy pretrain` capability surface, TPU-first (the
+objective is one jitted make_train_step program over the data axis)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.training.checkpoint import _flatten
+from spacy_ray_tpu.training.pretrain import char_targets, pretrain
+
+
+CFG = """
+[paths]
+raw_text = "{raw}"
+
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 64
+depth = 2
+embed_size = 300
+window_size = 1
+maxout_pieces = 2
+subword_features = true
+pretrained_vectors = null
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 64
+
+[corpora.pretrain]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${{paths.raw_text}}
+
+[pretraining]
+max_steps = 12
+batch_size = 8
+corpus = "corpora.pretrain"
+
+[pretraining.objective]
+type = "characters"
+n_characters = 3
+
+[pretraining.optimizer]
+@optimizers = "Adam.v1"
+learn_rate = 0.01
+"""
+
+
+@pytest.fixture(scope="module")
+def raw_jsonl(tmp_path_factory):
+    path = tmp_path_factory.mktemp("pretrain") / "raw.jsonl"
+    texts = [
+        "The quick brown fox jumps over the lazy dog.",
+        "Pretraining predicts characters from context vectors.",
+        "TPU meshes shard the batch over the data axis.",
+        "Hash embeddings use murmur keys for subword features.",
+    ] * 8
+    with open(path, "w", encoding="utf8") as f:
+        for t in texts:
+            f.write(json.dumps({"text": t}) + "\n")
+    return path
+
+
+def test_char_targets_bytes():
+    class Ref:
+        words = ["abc", "hello", "x"]
+
+    class Eg:
+        reference = Ref()
+
+    out = char_targets([Eg()], B=2, T=4, n=2)
+    assert out.shape == (2, 4, 4)
+    # "abc": first 2 = a,b ; last 2 = b,c (byte + 1)
+    assert list(out[0, 0]) == [ord("a") + 1, ord("b") + 1, ord("b") + 1, ord("c") + 1]
+    # "x": shorter than window -> absent (0) padding
+    assert list(out[0, 2]) == [ord("x") + 1, 0, ord("x") + 1, 0]
+    # batch row 1 is padding -> all absent
+    assert out[1].sum() == 0
+
+
+def test_pretrain_learns_and_roundtrips(tmp_path, raw_jsonl):
+    cfg = Config.from_str(CFG.format(raw=str(raw_jsonl)))
+    out = tmp_path / "pretrain_out"
+    stats = pretrain(cfg, out)
+    assert stats["steps"] == 12
+    assert np.isfinite(stats["loss"])
+    assert (out / "model-last.npz").exists()
+
+    # round-trip: a fresh pipeline initialized with init_tok2vec must carry
+    # EXACTLY the pretrained trunk params
+    from spacy_ray_tpu.pipeline.language import Pipeline
+    from spacy_ray_tpu.util import synth_corpus
+
+    cfg2 = Config.from_str(CFG.format(raw=str(raw_jsonl)))
+    cfg2.setdefault("initialize", {})["init_tok2vec"] = str(out / "model-last.npz")
+    nlp = Pipeline.from_config(cfg2.interpolate())
+    examples = synth_corpus(20, "tagger", seed=0)
+    params = nlp.initialize(lambda: iter(examples), seed=0)
+
+    from spacy_ray_tpu.training.checkpoint import load_params
+
+    saved = _flatten(load_params(out / "model-last.npz"))
+    got = _flatten(params["tok2vec"])
+    assert set(saved) == set(got)
+    for k in saved:
+        np.testing.assert_array_equal(np.asarray(saved[k]), np.asarray(got[k]))
+
+
+def test_pretrain_partial_batch_divides_mesh(tmp_path, raw_jsonl):
+    # batch_size 5 over 32 texts leaves a final partial batch of 2; every
+    # batch must still collate to a multiple of the 8-device data axis
+    cfg = Config.from_str(CFG.format(raw=str(raw_jsonl)))
+    cfg["pretraining"]["batch_size"] = 5
+    cfg["pretraining"]["max_steps"] = 7
+    stats = pretrain(cfg, tmp_path / "pt_partial")
+    assert stats["steps"] == 7
+    assert np.isfinite(stats["loss"])
+
+
+def test_pretrain_empty_corpus_is_loud(tmp_path):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    cfg = Config.from_str(CFG.format(raw=str(empty)))
+    with pytest.raises(ValueError, match="no batches"):
+        pretrain(cfg, tmp_path / "pt_empty")
+
+
+def test_init_tok2vec_shape_mismatch_is_loud(tmp_path, raw_jsonl):
+    cfg = Config.from_str(CFG.format(raw=str(raw_jsonl)))
+    cfg["pretraining"]["max_steps"] = 1
+    out = tmp_path / "pt"
+    pretrain(cfg, out)
+
+    # a DIFFERENT trunk width must refuse the weights, not silently misload
+    bad = CFG.replace("width = 64", "width = 96").replace(
+        "width = 64", "width = 96"
+    )
+    cfg2 = Config.from_str(bad.format(raw=str(raw_jsonl)))
+    cfg2.setdefault("initialize", {})["init_tok2vec"] = str(out / "model-last.npz")
+    from spacy_ray_tpu.pipeline.language import Pipeline
+    from spacy_ray_tpu.util import synth_corpus
+
+    nlp = Pipeline.from_config(cfg2.interpolate())
+    examples = synth_corpus(10, "tagger", seed=0)
+    with pytest.raises(ValueError, match="init_tok2vec"):
+        nlp.initialize(lambda: iter(examples), seed=0)

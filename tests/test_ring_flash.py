@@ -27,7 +27,7 @@ def _mk(B=2, T=128, H=2, Dh=32, seed=0):
     q = jax.random.normal(r[0], (B, T, H, Dh), jnp.float32)
     k = jax.random.normal(r[1], (B, T, H, Dh), jnp.float32)
     v = jax.random.normal(r[2], (B, T, H, Dh), jnp.float32)
-    lens = jnp.array([T, T - 41])[:B]
+    lens = jnp.array([T, T - 41, T - 7, 5, T - 13, 9, T - 3, T // 2])[:B]
     mask = jnp.arange(T)[None, :] < lens[:, None]
     return q, k, v, mask
 
@@ -70,6 +70,35 @@ def test_ring_flash_grads_match_dense():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=2e-3, rtol=2e-3
         )
+
+
+def test_ring_flash_matches_dense_dp_cp():
+    # data axis > 1: the flash region must go manual over data too (a
+    # pallas_call can't live under an automatic GSPMD axis); exactness
+    # must hold on the composed DP x CP mesh
+    q, k, v, mask = _mk(B=4, T=128)
+    want = np.asarray(fa.reference_attention(q, k, v, mask))
+    mesh = build_mesh(n_data=2, n_context=4)
+    with pctx.use_mesh(mesh):
+        got = jax.jit(ra.ring_attention)(q, k, v, mask)
+    m = np.asarray(mask)[:, :, None, None]
+    np.testing.assert_allclose(
+        np.where(m, np.asarray(got), 0), np.where(m, want, 0), atol=2e-4
+    )
+
+
+def test_ring_flash_indivisible_batch_falls_back():
+    # B=3 does not divide data=2: the gate must drop to the dense path (and
+    # still be exact) instead of mis-sharding the kernel
+    q, k, v, mask = _mk(B=3, T=128)
+    want = np.asarray(fa.reference_attention(q, k, v, mask))
+    mesh = build_mesh(n_data=2, n_context=4)
+    with pctx.use_mesh(mesh):
+        got = jax.jit(ra.ring_attention)(q, k, v, mask)
+    m = np.asarray(mask)[:, :, None, None]
+    np.testing.assert_allclose(
+        np.where(m, np.asarray(got), 0), np.where(m, want, 0), atol=2e-4
+    )
 
 
 def test_ring_flash_all_masked_rows_finite():
