@@ -268,3 +268,21 @@ def TextCatCNN(
         use_reduce_max=False,
         use_reduce_mean=True,
     )
+
+
+@registry.architectures("spacy.EntityLinker.v1")
+@registry.architectures("spacy.EntityLinker.v2")
+def EntityLinker(tok2vec: Model, nO: Optional[int] = None) -> Model:
+    """Entity-linking encoder: tok2vec → linear projection into the KB's
+    entity-vector space. Mention pooling, candidate scoring, and decode live
+    in the component (pipeline/components/nel.py) — the projection is the
+    only dense compute, so it is all that runs on device."""
+    width = tok2vec.dims.get("nO")
+    if nO is None:
+        # Re-resolved at Pipeline.initialize() with the KB's
+        # entity_vector_length injected; nO=1 placeholder is never trained.
+        nO = 1
+    head = chain(tok2vec, Linear(width, nO, name="project"), name="entity_linker_model")
+    head.dims.update({"nO": nO, "width": width})
+    head.meta["has_listener"] = _has_listener(tok2vec)
+    return head

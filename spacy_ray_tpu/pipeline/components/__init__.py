@@ -11,3 +11,4 @@ from . import token_classifiers  # noqa: F401
 from . import lemmatizer  # noqa: F401
 from . import entity_ruler  # noqa: F401
 from . import attribute_ruler  # noqa: F401
+from . import nel  # noqa: F401

@@ -16,11 +16,13 @@ from typing import Dict, List, Optional, Tuple
 
 @dataclass
 class Span:
-    """A labeled token-slice [start, end) of a doc."""
+    """A labeled token-slice [start, end) of a doc. ``kb_id`` carries the
+    knowledge-base link for entity linking ("" = unlinked / NIL)."""
 
     start: int
     end: int
     label: str
+    kb_id: str = ""
 
     def __iter__(self):
         yield from (self.start, self.end, self.label)

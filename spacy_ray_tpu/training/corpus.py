@@ -23,6 +23,7 @@ from __future__ import annotations
 import gzip
 import json
 import random
+from contextlib import contextmanager
 from pathlib import Path
 from typing import Callable, Iterable, Iterator, List, Optional, Union
 
@@ -35,27 +36,37 @@ CorpusReader = Callable[[], Iterator[Example]]
 _raw_text_tokenizer = None
 
 
-def set_raw_text_tokenizer(tokenizer) -> None:
-    """Install the PIPELINE's tokenizer for raw-text ({"text": ...}) corpus
-    lines, so pretraining sees the same token stream the pipeline produces
-    at train/inference time (spaCy's JsonlCorpus tokenizes with nlp.make_doc
-    for the same reason). ``pretrain`` calls this before reading."""
+@contextmanager
+def use_raw_text_tokenizer(tokenizer) -> Iterator[None]:
+    """Enable raw-text ({"text": ...}) corpus lines, tokenized with the
+    PIPELINE's tokenizer so pretraining sees the same token stream the
+    pipeline produces at train/inference time (spaCy's JsonlCorpus
+    tokenizes with nlp.make_doc for the same reason). Scoped: outside this
+    context a raw-text line in a supervised corpus stays a LOUD error —
+    silently tokenizing annotation-free docs would train on all-masked
+    targets. ``pretrain`` wraps its whole run in this."""
     global _raw_text_tokenizer
+    prev = _raw_text_tokenizer
     _raw_text_tokenizer = tokenizer
+    try:
+        yield
+    finally:
+        _raw_text_tokenizer = prev
 
 
 def _doc_from_json(obj: dict) -> Doc:
     words = obj.get("tokens") or obj.get("words")
     if words is None:
         text = obj.get("text")
-        if text is not None:
-            # raw-text line ({"text": ...}): the pretraining data flow
-            global _raw_text_tokenizer
-            if _raw_text_tokenizer is None:
-                from ..pipeline.tokenizer import Tokenizer
-
-                _raw_text_tokenizer = Tokenizer()
+        if text is not None and _raw_text_tokenizer is not None:
             return _raw_text_tokenizer(text)
+        if text is not None:
+            raise ValueError(
+                "Corpus line has raw 'text' but no 'tokens': raw-text lines "
+                "are only readable under a pretraining run (use the "
+                "`pretrain` command); supervised corpora need tokenized, "
+                "annotated lines"
+            )
         raise ValueError(f"Corpus line missing 'tokens': keys={list(obj)}")
     doc = Doc(
         words=list(words),
@@ -69,8 +80,10 @@ def _doc_from_json(obj: dict) -> Doc:
         sent_starts=obj.get("sent_starts"),
         cats=dict(obj.get("cats") or {}),
     )
-    for s, e, label in obj.get("ents") or []:
-        doc.ents.append(Span(int(s), int(e), str(label)))
+    for ent in obj.get("ents") or []:
+        s, e, label = ent[0], ent[1], ent[2]
+        kb_id = str(ent[3]) if len(ent) > 3 else ""  # optional KB link
+        doc.ents.append(Span(int(s), int(e), str(label), kb_id=kb_id))
     for group, spans in (obj.get("spans") or {}).items():
         doc.spans[group] = [Span(int(s), int(e), str(label)) for s, e, label in spans]
     return doc
@@ -85,7 +98,10 @@ def _doc_to_json(doc: Doc) -> dict:
         if val is not None:
             out[attr] = val
     if doc.ents:
-        out["ents"] = [[s.start, s.end, s.label] for s in doc.ents]
+        out["ents"] = [
+            [s.start, s.end, s.label] + ([s.kb_id] if s.kb_id else [])
+            for s in doc.ents
+        ]
     if doc.spans:
         out["spans"] = {
             g: [[s.start, s.end, s.label] for s in spans] for g, spans in doc.spans.items()

@@ -186,11 +186,6 @@ def pretrain(
         raise ValueError(f"trunk {comp_name!r} does not expose an output width")
 
     # ---- corpus (dot-name into [corpora], like train/dev) ----
-    # raw-text lines must tokenize with THIS pipeline's tokenizer, not a
-    # default rule set, or the trunk pretrains on a mismatched token stream
-    from .corpus import set_raw_text_tokenizer
-
-    set_raw_text_tokenizer(nlp.tokenizer)
     corpora_cfg = config.get("corpora", {})
     resolved = {name: registry.resolve(block) for name, block in corpora_cfg.items()}
     corpus = resolve_dot_name(config, resolved, P.get("corpus", "corpora.pretrain"))
@@ -248,6 +243,11 @@ def pretrain(
         host = jax.tree_util.tree_map(np.asarray, params["trunk"])
         save_params(output_dir / f"model-{tag}.npz", host)
 
+    # raw-text corpus lines must tokenize with THIS pipeline's tokenizer,
+    # not a default rule set, or the trunk pretrains on a mismatched token
+    # stream; the context keeps the enablement scoped to this run
+    from .corpus import use_raw_text_tokenizer
+
     n_data = int(mesh.shape.get("data", 1))
     n_step = 0
     epoch = 0
@@ -255,56 +255,57 @@ def pretrain(
     total_words = 0
     loss_val = float("nan")
     done = False
-    while not done:
-        epoch += 1
-        for examples in _batches(corpus, batch_size):
-            # B must divide evenly over the mesh data axis for P("data")
-            # (same rounding the train loop applies, loop.py)
-            B_pad = ((max(len(examples), n_data) + n_data - 1) // n_data) * n_data
-            batch = nlp.collate(examples, with_targets=False, pad_batch_to=B_pad)
-            tokens = batch["tokens"]
-            if obj_type == "characters":
-                targets = {
-                    "chars": char_targets(
-                        examples, *_batch_bt(batch), n_chars
+    with use_raw_text_tokenizer(nlp.tokenizer):
+        while not done:
+            epoch += 1
+            for examples in _batches(corpus, batch_size):
+                # B must divide evenly over the mesh data axis for P("data")
+                # (same rounding the train loop applies, loop.py)
+                B_pad = ((max(len(examples), n_data) + n_data - 1) // n_data) * n_data
+                batch = nlp.collate(examples, with_targets=False, pad_batch_to=B_pad)
+                tokens = batch["tokens"]
+                if obj_type == "characters":
+                    targets = {
+                        "chars": char_targets(
+                            examples, *_batch_bt(batch), n_chars
+                        )
+                    }
+                else:
+                    targets = _vector_targets(nlp, examples, *_batch_bt(batch))
+                rng, sub = jax.random.split(rng)
+                params, opt_state, loss, metrics = step(
+                    params,
+                    opt_state,
+                    place_batch(tokens, mesh),
+                    place_batch(targets, mesh),
+                    sub,
+                )
+                n_step += 1
+                total_words += int(batch["n_words"])
+                if n_step % 50 == 0 or n_step == 1:
+                    loss_val = float(loss)
+                    extra = "".join(
+                        f"  {k}={float(v):.3f}" for k, v in (metrics or {}).items()
+                        if k != "grad_norm"
                     )
-                }
-            else:
-                targets = _vector_targets(nlp, examples, *_batch_bt(batch))
-            rng, sub = jax.random.split(rng)
-            params, opt_state, loss, metrics = step(
-                params,
-                opt_state,
-                place_batch(tokens, mesh),
-                place_batch(targets, mesh),
-                sub,
-            )
-            n_step += 1
-            total_words += int(batch["n_words"])
-            if n_step % 50 == 0 or n_step == 1:
-                loss_val = float(loss)
-                extra = "".join(
-                    f"  {k}={float(v):.3f}" for k, v in (metrics or {}).items()
-                    if k != "grad_norm"
+                    wps = total_words / max(time.perf_counter() - t0, 1e-9)
+                    print(
+                        f"pretrain step {n_step:>6}  loss={loss_val:.4f}{extra}  "
+                        f"wps={wps:,.0f}",
+                        flush=True,
+                    )
+                if n_save_every and n_step % n_save_every == 0:
+                    save(str(n_step))
+                if n_step >= max_steps:
+                    done = True
+                    break
+            if n_step == 0:
+                raise ValueError(
+                    "pretraining corpus yielded no batches (empty file, or "
+                    "max_length filtered every text); nothing to train on"
                 )
-                wps = total_words / max(time.perf_counter() - t0, 1e-9)
-                print(
-                    f"pretrain step {n_step:>6}  loss={loss_val:.4f}{extra}  "
-                    f"wps={wps:,.0f}",
-                    flush=True,
-                )
-            if n_save_every and n_step % n_save_every == 0:
-                save(str(n_step))
-            if n_step >= max_steps:
+            if max_epochs and epoch >= max_epochs:
                 done = True
-                break
-        if n_step == 0:
-            raise ValueError(
-                "pretraining corpus yielded no batches (empty file, or "
-                "max_length filtered every text); nothing to train on"
-            )
-        if max_epochs and epoch >= max_epochs:
-            done = True
     loss_val = float(loss)
     save("last")
     return {

@@ -214,24 +214,28 @@ def read_docbin_bytes(data: bytes) -> Iterator[Doc]:
             sent_starts=sent_starts,
             cats=dict(cats[di]) if cats[di] else {},
         )
-        # entities: ENT_IOB (1=I, 2=O, 3=B, 0=unset) + ENT_TYPE hashes
+        # entities: ENT_IOB (1=I, 2=O, 3=B, 0=unset) + ENT_TYPE hashes;
+        # ENT_KB_ID (when present) carries the entity-linking gold
         if "ENT_IOB" in col and "ENT_TYPE" in col:
+            has_kb = "ENT_KB_ID" in col
             iob = rows[:, col["ENT_IOB"]].astype(np.int64)
             start = None
             label = ""
+            kb_id = ""
             for i in range(n):
                 tag = int(iob[i])
                 if tag == 3 or (tag == 1 and start is None):
                     if start is not None:
-                        doc.ents.append(Span(start, i, label))
+                        doc.ents.append(Span(start, i, label, kb_id=kb_id))
                     start = i
                     label = sval(rows[i], "ENT_TYPE")
+                    kb_id = sval(rows[i], "ENT_KB_ID") if has_kb else ""
                 elif tag in (0, 2):
                     if start is not None:
-                        doc.ents.append(Span(start, i, label))
+                        doc.ents.append(Span(start, i, label, kb_id=kb_id))
                         start = None
             if start is not None:
-                doc.ents.append(Span(start, n, label))
+                doc.ents.append(Span(start, n, label, kb_id=kb_id))
         yield doc
 
 
@@ -248,8 +252,12 @@ def write_docbin(path: Union[str, Path], docs: Iterable[Doc]) -> None:
     import msgpack
 
     docs = list(docs)
-    attr_ids = sorted(_IDS[a] for a in _WRITE_ATTRS)
-    names = [ATTR_NAMES[a] for a in attr_ids]
+    # ENT_KB_ID and MORPH sit above the fixed enum (84/85 — the "default
+    # pair" position _resolve_attr_names maps back positionally; modern
+    # spaCy readers resolve them by their own enum the same way)
+    write_ids = {**{_IDS[a]: a for a in _WRITE_ATTRS}, 84: "ENT_KB_ID", 85: "MORPH"}
+    attr_ids = sorted(write_ids)
+    names = [write_ids[a] for a in attr_ids]
     strings: set = set()
     rows_all: List[np.ndarray] = []
     spaces_all: List[np.ndarray] = []
@@ -267,10 +275,12 @@ def write_docbin(path: Union[str, Path], docs: Iterable[Doc]) -> None:
         # honor the 0-vs-2 distinction (spaCy does)
         ent_iob = np.full(n, 2 if doc.ents else 0, np.int64)
         ent_type = [""] * n
+        ent_kb = [""] * n
         for s in doc.ents:
             for i in range(s.start, s.end):
                 ent_iob[i] = 3 if i == s.start else 1
                 ent_type[i] = s.label
+                ent_kb[i] = s.kb_id
         arr = np.zeros((n, len(attr_ids)), dtype="<u8")
         for ci, nm in enumerate(names):
             if nm == "ORTH":
@@ -297,6 +307,13 @@ def write_docbin(path: Union[str, Path], docs: Iterable[Doc]) -> None:
             elif nm == "ENT_TYPE":
                 vals = [spacy_string_hash(x) for x in ent_type]
                 strings.update(x for x in ent_type if x)
+            elif nm == "ENT_KB_ID":
+                vals = [spacy_string_hash(x) for x in ent_kb]
+                strings.update(x for x in ent_kb if x)
+            elif nm == "MORPH":
+                mo = doc.morphs or [""] * n
+                vals = [spacy_string_hash(x) for x in mo]
+                strings.update(x for x in mo if x)
             elif nm == "HEAD":
                 if doc.heads:
                     vals = [int(h) - i for i, h in enumerate(doc.heads)]

@@ -1,0 +1,217 @@
+"""entity_linker: KB candidate lookup, device-side mention pooling +
+candidate scoring, NIL threshold decode, and end-to-end training to
+high link accuracy on a synthetic ambiguous-alias corpus."""
+
+import numpy as np
+import pytest
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.pipeline.doc import Doc, Example, Span
+from spacy_ray_tpu.pipeline.kb import KnowledgeBase
+from spacy_ray_tpu.pipeline.language import Pipeline
+
+
+VEC_D = 16
+
+
+def _kb():
+    rng = np.random.RandomState(0)
+    kb = KnowledgeBase(VEC_D)
+    # two entities sharing the ambiguous alias "Python"
+    for ent in ("Q_python_lang", "Q_python_snake", "Q_java_lang", "Q_java_island"):
+        kb.add_entity(ent, freq=10.0, vector=rng.normal(size=VEC_D))
+    kb.add_alias("Python", ["Q_python_lang", "Q_python_snake"], [0.6, 0.4])
+    kb.add_alias("Java", ["Q_java_lang", "Q_java_island"], [0.7, 0.3])
+    return kb
+
+
+def _docs(n=120, seed=0):
+    """Mentions whose correct entity is fully determined by context words."""
+    rng = np.random.RandomState(seed)
+    docs = []
+    contexts = [
+        (["code", "in"], "Python", "Q_python_lang"),
+        (["bite", "from"], "Python", "Q_python_snake"),
+        (["compile", "some"], "Java", "Q_java_lang"),
+        (["sail", "to"], "Java", "Q_java_island"),
+    ]
+    for _ in range(n):
+        pre, mention, ent = contexts[rng.randint(len(contexts))]
+        words = ["I", *pre, mention, "today"]
+        doc = Doc(words=words)
+        start = len(words) - 2
+        doc.ents.append(Span(start, start + 1, "TOPIC", kb_id=ent))
+        docs.append(doc)
+    return docs
+
+
+CFG = """
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","entity_linker"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 32
+depth = 2
+embed_size = 200
+window_size = 1
+maxout_pieces = 2
+subword_features = true
+pretrained_vectors = null
+
+[components.entity_linker]
+factory = "entity_linker"
+n_candidates = 4
+
+[components.entity_linker.model]
+@architectures = "spacy.EntityLinker.v2"
+
+[components.entity_linker.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 32
+"""
+
+
+def test_kb_roundtrip(tmp_path):
+    kb = _kb()
+    kb.to_disk(tmp_path / "kb.npz")
+    kb2 = KnowledgeBase.from_disk(tmp_path / "kb.npz")
+    assert kb2.entities == kb.entities
+    cands = kb2.candidates("Python")
+    assert [c.entity for c in cands] == ["Q_python_lang", "Q_python_snake"]
+    assert cands[0].prior == pytest.approx(0.6)
+    np.testing.assert_allclose(
+        kb2.vector_of("Q_java_lang"), kb.vector_of("Q_java_lang")
+    )
+    assert kb2.candidates("unknown") == []
+
+
+def test_kb_validates():
+    kb = KnowledgeBase(VEC_D)
+    kb.add_entity("A", 1.0, np.zeros(VEC_D))
+    with pytest.raises(ValueError, match="vector length"):
+        kb.add_entity("B", 1.0, np.zeros(VEC_D + 1))
+    with pytest.raises(ValueError, match="unknown entity"):
+        kb.add_alias("x", ["missing"], [1.0])
+    with pytest.raises(ValueError, match="sum"):
+        kb.add_alias("x", ["A"], [1.5])
+
+
+def test_entity_linker_trains_and_links(tmp_path):
+    kb = _kb()
+    nlp = Pipeline.from_config(Config.from_str(CFG))
+    nlp.components["entity_linker"].set_kb(kb)
+    train = [Example.from_gold(d) for d in _docs(120, seed=0)]
+    nlp.initialize(lambda: iter(train), seed=0)
+
+    import jax
+
+    from spacy_ray_tpu.parallel.mesh import build_mesh
+    from spacy_ray_tpu.parallel.step import (
+        make_train_step,
+        place_batch,
+        place_replicated,
+    )
+    from spacy_ray_tpu.registry import registry
+
+    mesh = build_mesh(n_data=1, devices=jax.devices()[:1])
+    tx = registry.get("optimizers", "Adam.v1")(learn_rate=0.01)
+    params = place_replicated(nlp.params, mesh)
+    opt_state = tx.init(params)
+    step = make_train_step(nlp.make_loss_fn(), tx, mesh, opt_state_template=opt_state)
+    rng = jax.random.PRNGKey(0)
+    for i in range(40):
+        batch = nlp.collate(train[:64], pad_batch_to=64)
+        rng, sub = jax.random.split(rng)
+        params, opt_state, loss, metrics = step(
+            params,
+            opt_state,
+            place_batch(batch["tokens"], mesh),
+            place_batch(batch["targets"], mesh),
+            sub,
+        )
+    assert float(metrics["entity_linker_nel_acc"]) > 0.95, float(metrics["entity_linker_nel_acc"])
+
+    # decode: docs with ents (as an upstream ner would set them) get kb_ids
+    nlp.params = jax.tree_util.tree_map(np.asarray, params)
+    dev_docs = _docs(24, seed=1)
+    gold = [d.ents[0].kb_id for d in dev_docs]
+    shells = []
+    for d in dev_docs:
+        shell = d.copy_shell()
+        shell.ents = [Span(s.start, s.end, s.label) for s in d.ents]
+        shells.append(shell)
+    nlp.predict_docs(shells)
+    pred = [d.ents[0].kb_id for d in shells]
+    acc = np.mean([p == g for p, g in zip(pred, gold)])
+    assert acc > 0.9, (acc, list(zip(pred, gold))[:6])
+
+    # scoring protocol
+    examples = [
+        Example(predicted=s, reference=d) for s, d in zip(shells, dev_docs)
+    ]
+    scores = nlp.components["entity_linker"].score(examples)
+    assert scores["nel_micro_f"] > 0.9
+
+
+def test_entity_linker_nil_for_unknown_alias():
+    kb = _kb()
+    nlp = Pipeline.from_config(Config.from_str(CFG))
+    nlp.components["entity_linker"].set_kb(kb)
+    train = [Example.from_gold(d) for d in _docs(16, seed=0)]
+    nlp.initialize(lambda: iter(train), seed=0)
+    doc = Doc(words=["visit", "Atlantis", "now"])
+    doc.ents.append(Span(1, 2, "TOPIC"))
+    nlp.predict_docs([doc])
+    assert doc.ents[0].kb_id == ""  # no candidates -> NIL, not a guess
+
+
+def test_pipeline_serialization_carries_kb(tmp_path):
+    kb = _kb()
+    nlp = Pipeline.from_config(Config.from_str(CFG))
+    nlp.components["entity_linker"].set_kb(kb)
+    train = [Example.from_gold(d) for d in _docs(16, seed=0)]
+    nlp.initialize(lambda: iter(train), seed=0)
+    nlp.to_disk(tmp_path / "model")
+    nlp2 = Pipeline.from_disk(tmp_path / "model")
+    kb2 = nlp2.components["entity_linker"].kb
+    assert kb2 is not None and kb2.entities == kb.entities
+    assert [c.entity for c in kb2.candidates("Python")] == [
+        "Q_python_lang",
+        "Q_python_snake",
+    ]
+    # linking works on the reloaded pipeline
+    doc = Doc(words=["code", "in", "Python", "now"])
+    doc.ents.append(Span(2, 3, "TOPIC"))
+    nlp2.predict_docs([doc])
+    assert doc.ents[0].kb_id in ("Q_python_lang", "Q_python_snake")
+
+
+def test_docbin_kb_id_roundtrip(tmp_path):
+    from spacy_ray_tpu.training.spacy_docbin import read_docbin, write_docbin
+
+    doc = Doc(words=["use", "Python", "here"], spaces=[True, True, False])
+    doc.ents.append(Span(1, 2, "TOPIC", kb_id="Q_python_lang"))
+    path = tmp_path / "d.spacy"
+    write_docbin(path, [doc])
+    (doc2,) = read_docbin(path)
+    assert doc2.ents[0].kb_id == "Q_python_lang"
+    assert doc2.ents[0].label == "TOPIC"
+
+
+def test_jsonl_kb_id_roundtrip(tmp_path):
+    from spacy_ray_tpu.training.corpus import _doc_from_json, _doc_to_json
+
+    doc = Doc(words=["use", "Python", "here"])
+    doc.ents.append(Span(1, 2, "TOPIC", kb_id="Q_python_lang"))
+    obj = _doc_to_json(doc)
+    assert obj["ents"] == [[1, 2, "TOPIC", "Q_python_lang"]]
+    doc2 = _doc_from_json(obj)
+    assert doc2.ents[0].kb_id == "Q_python_lang"
+    # 3-element form still reads (kb_id defaults empty)
+    doc3 = _doc_from_json({"tokens": ["a"], "ents": [[0, 1, "X"]]})
+    assert doc3.ents[0].kb_id == ""
