@@ -27,6 +27,7 @@ from .pipeline import components  # noqa: F401
 from . import training  # noqa: F401
 from .pipeline.language import Pipeline  # noqa: F401
 from .pipeline.doc import Doc, Example, Span  # noqa: F401
+from .packaging import load  # noqa: F401
 
 __all__ = [
     "registry",
@@ -36,5 +37,6 @@ __all__ = [
     "Doc",
     "Example",
     "Span",
+    "load",
     "__version__",
 ]

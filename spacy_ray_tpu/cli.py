@@ -362,6 +362,43 @@ def pretrain_command(argv: List[str]) -> int:
     return 0
 
 
+def package_command(argv: List[str]) -> int:
+    """`package` — wrap a trained pipeline directory into an installable
+    Python package (spaCy's `spacy package` surface)."""
+    parser = argparse.ArgumentParser(
+        prog="spacy_ray_tpu package",
+        description="Package a saved pipeline as an installable Python "
+        "project; load it back with spacy_ray_tpu.load(name).",
+    )
+    parser.add_argument("model_dir", type=Path)
+    parser.add_argument("output_dir", type=Path)
+    parser.add_argument("--name", type=str, default="pipeline")
+    parser.add_argument("--version", type=str, default="0.0.0")
+    parser.add_argument(
+        "--build", type=str, default="none", choices=["none", "sdist", "wheel"]
+    )
+    parser.add_argument("--force", "-f", action="store_true",
+                        help="overwrite an existing package directory")
+    args = parser.parse_args(argv)
+
+    from .packaging import package
+
+    project = package(
+        args.model_dir,
+        args.output_dir,
+        name=args.name,
+        version=args.version,
+        build=args.build,
+        force=args.force,
+    )
+    print(f"Package written to {project}")
+    if args.build != "none":
+        dist = project / "dist"
+        for f in sorted(dist.iterdir()):
+            print(f"  built: {f}")
+    return 0
+
+
 COMMANDS = {
     "train": train_command,
     "pretrain": pretrain_command,
@@ -369,6 +406,7 @@ COMMANDS = {
     "convert": convert_command,
     "init-config": init_config_command,
     "debug-data": debug_data_command,
+    "package": package_command,
 }
 
 

@@ -31,6 +31,10 @@ class Component:
 
     #: does this component's model contain a Tok2VecListener?
     listens: bool = False
+    #: does this component WRITE doc.ents at prediction time? (gates
+    #: use_gold_ents seeding in evaluate: gold mention boundaries are only
+    #: safe to seed when nothing upstream produces mentions itself)
+    sets_ents: bool = False
     #: does this component produce a trainable loss?
     trainable: bool = True
 

@@ -36,6 +36,7 @@ _PATTERN_TOKENIZER = Tokenizer()  # stateless; shared for phrase patterns
 
 
 class EntityRulerComponent(Component):
+    sets_ents = True
     trainable = False
     listens = False
 

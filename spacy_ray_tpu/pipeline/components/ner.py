@@ -55,6 +55,7 @@ def action_to_biluo(action: int, labels: List[str]) -> str:
 
 
 class NERComponent(Component):
+    sets_ents = True
     def __init__(self, name, model_cfg, decode: str = "viterbi"):
         super().__init__(name, model_cfg)
         if decode not in ("viterbi", "greedy"):

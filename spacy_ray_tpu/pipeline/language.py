@@ -491,11 +491,14 @@ class Pipeline:
         docs = [eg.reference.copy_shell() for eg in examples]
         # use_gold_ents (spaCy's entity_linker semantics): seed prediction
         # shells with gold mention BOUNDARIES (never kb ids) so a linker
-        # without an upstream ner in the pipeline is evaluable; with a real
-        # ner upstream, set use_gold_ents = false to measure the full path
+        # without an upstream mention producer is evaluable. NEVER seed when
+        # any component writes doc.ents itself — preset gold spans would
+        # leak into the ner/entity_ruler predictions and inflate ents_f
         if any(
             getattr(self.components[n], "use_gold_ents", False)
             for n in self.pipe_names
+        ) and not any(
+            self.components[n].sets_ents for n in self.pipe_names
         ):
             from .doc import Span
 

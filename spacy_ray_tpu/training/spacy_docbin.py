@@ -25,10 +25,14 @@ present IDs > 83, enum order is ENT_KB_ID < MORPH < ENT_ID (two such IDs —
 the DocBin default — are ENT_KB_ID and MORPH). Unknown columns are skipped,
 never misread.
 
-The writer emits only certain-ID columns (no MORPH — its ID is
-version-dependent), which spaCy reads fine; morphs survive the repo's own
-formats (.jsonl/.msgdoc) instead. ``span_groups`` payloads are not decoded
-(spancat corpora: use jsonl/msgdoc).
+The writer emits the certain-ID columns plus ENT_KB_ID/MORPH at 84/85 —
+the same position-based convention the reader resolves, so this repo's
+own .spacy round trip preserves entity links and morphs. CAVEAT: real
+spaCy resolves attr IDs against its version's symbols enum, so a real
+spaCy reader may skip (not misread) those two columns; data meant for
+real-spaCy consumption with links/morphs should also keep .jsonl.
+``span_groups`` payloads are not decoded (spancat corpora: use
+jsonl/msgdoc).
 """
 
 from __future__ import annotations
@@ -252,9 +256,10 @@ def write_docbin(path: Union[str, Path], docs: Iterable[Doc]) -> None:
     import msgpack
 
     docs = list(docs)
-    # ENT_KB_ID and MORPH sit above the fixed enum (84/85 — the "default
-    # pair" position _resolve_attr_names maps back positionally; modern
-    # spaCy readers resolve them by their own enum the same way)
+    # ENT_KB_ID and MORPH sit above the fixed enum at 84/85 — the "default
+    # pair" position _resolve_attr_names maps back positionally. A real
+    # spaCy reader resolves IDs against its own enum and may skip these two
+    # columns (see module docstring); the certain-ID columns interoperate.
     write_ids = {**{_IDS[a]: a for a in _WRITE_ATTRS}, 84: "ENT_KB_ID", 85: "MORPH"}
     attr_ids = sorted(write_ids)
     names = [write_ids[a] for a in attr_ids]

@@ -170,6 +170,31 @@ def test_entity_linker_nil_for_unknown_alias():
     assert doc.ents[0].kb_id == ""  # no candidates -> NIL, not a guess
 
 
+def test_use_gold_ents_seeding_suppressed_by_ents_producer():
+    # evaluate() seeds gold mention boundaries ONLY when nothing in the
+    # pipeline writes doc.ents itself — otherwise gold spans would leak
+    # into the ner/entity_ruler predictions and inflate ents_f
+    kb = _kb()
+    nlp = Pipeline.from_config(Config.from_str(CFG))
+    nlp.components["entity_linker"].set_kb(kb)
+    train = [Example.from_gold(d) for d in _docs(32, seed=0)]
+    nlp.initialize(lambda: iter(train), seed=0)
+
+    dev = [Example.from_gold(d) for d in _docs(8, seed=1)]
+    scores = nlp.evaluate(dev)
+    # linker-only pipeline: shells seeded -> recall possible (f measured)
+    assert any(eg.predicted.ents for eg in dev)
+
+    # now pretend a component produces ents: seeding must be suppressed
+    dev2 = [Example.from_gold(d) for d in _docs(8, seed=1)]
+    nlp.components["tok2vec"].sets_ents = True
+    try:
+        nlp.evaluate(dev2)
+        assert all(not eg.predicted.ents for eg in dev2)
+    finally:
+        nlp.components["tok2vec"].sets_ents = False
+
+
 def test_pipeline_serialization_carries_kb(tmp_path):
     kb = _kb()
     nlp = Pipeline.from_config(Config.from_str(CFG))

@@ -1,0 +1,101 @@
+"""`package` command + `load()` API: a saved pipeline wraps into an
+installable package whose load() round-trips predictions; load() also
+accepts bare paths and fails loudly on unknown names."""
+
+import subprocess
+import sys
+
+import pytest
+
+import spacy_ray_tpu
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.packaging import package, package_name
+from spacy_ray_tpu.pipeline.doc import Example
+from spacy_ray_tpu.pipeline.language import Pipeline
+from spacy_ray_tpu.util import synth_corpus
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory, tagger_config_text):
+    nlp = Pipeline.from_config(Config.from_str(tagger_config_text).interpolate())
+    examples = synth_corpus(30, "tagger", seed=0)
+    nlp.initialize(lambda: iter(examples), seed=0)
+    out = tmp_path_factory.mktemp("model") / "saved"
+    nlp.to_disk(out)
+    return out
+
+
+def test_package_name_sanitizes():
+    assert package_name("en", "core-web.sm") == "en_core_web_sm"
+    assert package_name("en", "en_already") == "en_already"
+    assert package_name("99", "x")[0] == "_"
+
+
+def test_package_and_load_by_path(tmp_path, saved_model):
+    project = package(saved_model, tmp_path, name="test_pipe", version="1.2.3")
+    assert project.name == "en_test_pipe-1.2.3"
+    assert (project / "pyproject.toml").exists()
+    assert (project / "en_test_pipe" / "data" / "params.npz").exists()
+    # the generated package dir is importable as-is from sys.path
+    sys.path.insert(0, str(project))
+    try:
+        nlp = spacy_ray_tpu.load("en_test_pipe")
+        doc = nlp("The quick brown fox jumps")
+        assert doc.tags and len(doc.tags) == 5
+    finally:
+        sys.path.remove(str(project))
+
+
+def test_load_accepts_directory(saved_model):
+    nlp = spacy_ray_tpu.load(saved_model)
+    doc = nlp("A small test")
+    assert doc.tags
+
+
+def test_load_unknown_name_is_loud():
+    with pytest.raises(OSError, match="Can't find pipeline"):
+        spacy_ray_tpu.load("definitely_not_installed_xyz")
+
+
+def test_package_builds_sdist(tmp_path, saved_model):
+    project = package(
+        saved_model, tmp_path, name="b", version="0.1.0", build="sdist"
+    )
+    dist = list((project / "dist").glob("*.tar.gz"))
+    assert dist, "no sdist built"
+    # the sdist carries the model data (packaged pipelines must be
+    # self-contained)
+    import tarfile
+
+    with tarfile.open(dist[0]) as tf:
+        names = tf.getnames()
+    assert any(n.endswith("data/params.npz") for n in names), names[:20]
+
+
+def test_package_rejects_non_model(tmp_path):
+    with pytest.raises(ValueError, match="meta.json"):
+        package(tmp_path, tmp_path / "out", name="x")
+
+
+def test_package_refuses_overwrite_without_force(tmp_path, saved_model):
+    package(saved_model, tmp_path, name="ow", version="0.1.0")
+    with pytest.raises(FileExistsError, match="--force"):
+        package(saved_model, tmp_path, name="ow", version="0.1.0")
+    # force succeeds
+    package(saved_model, tmp_path, name="ow", version="0.1.0", force=True)
+
+
+
+
+def test_package_cli(tmp_path, saved_model):
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "spacy_ray_tpu", "package",
+            str(saved_model), str(tmp_path), "--name", "cli_pipe",
+            "--version", "0.2.0",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "Package written to" in r.stdout
