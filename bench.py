@@ -40,9 +40,26 @@ BASELINE_FILE = Path(__file__).parent / "MEASURED_BASELINE.json"
 WARMUP = 3
 
 
-def _corpus(kinds: List[str], n: int, seed: int = 0):
+def _corpus(kinds: List[str], n: int, seed: int = 0, doc_len: int = 0):
     from spacy_ray_tpu.util import synth_corpus
 
+    if doc_len:
+        # long-sequence benches need docs that actually FILL the padded
+        # length, or words/sec measures padding. Tagger docs only — other
+        # kinds would silently lose their annotations in this branch.
+        assert kinds == ["tagger"], f"doc_len only supports tagger docs, got {kinds}"
+        import random
+
+        from spacy_ray_tpu.pipeline.doc import Example
+        from spacy_ray_tpu.util import synth_tagged_doc
+
+        rng = random.Random(seed)
+        return [
+            Example.from_gold(
+                synth_tagged_doc(rng, min_len=int(doc_len * 0.9), max_len=doc_len)
+            )
+            for _ in range(n)
+        ]
     per = n // len(kinds)
     out = []
     for i, kind in enumerate(kinds):
@@ -108,6 +125,27 @@ def _configs(platform: str) -> List[Dict[str, Any]]:
             B=4 if cpu else 16, T=32 if cpu else 128,
             steps=3 if cpu else 10, warmup=1 if cpu else 3,
         ),
+        # long-sequence A/B: same transformer, T=2048, flash attention
+        # auto-enabled (probe) vs forced off — the pallas kernel's win is
+        # the delta between these two lines. Attention dominates at this
+        # length (score tensor would be [B, H, 2048, 2048] without flash).
+        dict(
+            name="trf_longseq",
+            metric=f"train_words_per_sec_per_chip (trf long-seq T={256 if cpu else 2048}, flash auto)",
+            cfg=LONGSEQ_CFG_CPU if cpu else LONGSEQ_CFG, kinds=["tagger"],
+            B=2 if cpu else 4, T=256 if cpu else 2048,
+            doc_len=256 if cpu else 2048,
+            steps=2 if cpu else 8, warmup=1 if cpu else 2,
+        ),
+        dict(
+            name="trf_longseq_noflash",
+            metric=f"train_words_per_sec_per_chip (trf long-seq T={256 if cpu else 2048}, flash OFF)",
+            cfg=LONGSEQ_CFG_CPU if cpu else LONGSEQ_CFG, kinds=["tagger"],
+            B=2 if cpu else 4, T=256 if cpu else 2048,
+            doc_len=256 if cpu else 2048,
+            steps=2 if cpu else 8, warmup=1 if cpu else 2,
+            env={"SRT_PALLAS_ATTN": "0"},
+        ),
     ]
 
 
@@ -139,6 +177,62 @@ factory = "tagger"
 width = 768
 """
 
+
+LONGSEQ_CFG = """
+[nlp]
+lang = "en"
+pipeline = ["transformer","tagger"]
+
+[components.transformer]
+factory = "transformer"
+
+[components.transformer.model]
+@architectures = "spacy_ray_tpu.TransformerEncoder.v1"
+width = 512
+depth = 8
+n_heads = 8
+dropout = 0.1
+max_len = 2048
+embed_size = 10000
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 512
+"""
+
+LONGSEQ_CFG_CPU = """
+[nlp]
+lang = "en"
+pipeline = ["transformer","tagger"]
+
+[components.transformer]
+factory = "transformer"
+
+[components.transformer.model]
+@architectures = "spacy_ray_tpu.TransformerEncoder.v1"
+width = 64
+depth = 2
+n_heads = 2
+dropout = 0.1
+max_len = 256
+embed_size = 2000
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 64
+"""
 
 NER_CFG = """
 [nlp]
@@ -192,7 +286,9 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
     warmup = int(spec.get("warmup", WARMUP))
 
     nlp = Pipeline.from_config(Config.from_str(cfg_text))
-    examples = _corpus(spec["kinds"], max(2 * B, 512))
+    doc_len = int(spec.get("doc_len", 0))
+    n_corpus = max(2 * B, 16) if doc_len else max(2 * B, 512)
+    examples = _corpus(spec["kinds"], n_corpus, doc_len=doc_len)
     nlp.initialize(lambda: iter(examples), seed=0)
 
     mesh = build_mesh(n_data=n_chips)
@@ -315,7 +411,7 @@ def _accelerator_reachable(timeout: float = 180.0) -> bool:
 PER_CONFIG_TIMEOUT = 1800.0  # seconds; remote compiles can be very slow
 
 
-def _run_spec_subprocess(name: str, cpu: bool = False) -> int:
+def _run_spec_subprocess(name: str, cpu: bool = False, env: Optional[Dict[str, str]] = None) -> int:
     """Run ONE benchmark config in a child process (``--configs name``).
 
     Crash/hang isolation: a compile-server crash or a wedged relay inside
@@ -325,13 +421,14 @@ def _run_spec_subprocess(name: str, cpu: bool = False) -> int:
     SIGKILL on a process holding the relay client wedges the relay.
     Child stdout passes through, so its JSON lines reach the caller.
     """
+    import os
     import subprocess
     import sys
 
     cmd = [sys.executable, __file__, "--configs", name]
     if cpu:
         cmd.append("--cpu")
-    p = subprocess.Popen(cmd)
+    p = subprocess.Popen(cmd, env={**os.environ, **(env or {})})
     try:
         return p.wait(timeout=PER_CONFIG_TIMEOUT)
     except subprocess.TimeoutExpired:
@@ -396,7 +493,9 @@ def main() -> None:
                   flush=True)
             _print_recorded_tpu_results()
         for spec in _configs("tpu" if tpu_ok else "cpu"):
-            rc = _run_spec_subprocess(spec["name"], cpu=not tpu_ok)
+            rc = _run_spec_subprocess(
+                spec["name"], cpu=not tpu_ok, env=spec.get("env")
+            )
             if tpu_ok and rc != 0:
                 # the child crashed or timed out against the accelerator —
                 # re-probe before trusting it with the next config
@@ -434,11 +533,29 @@ def main() -> None:
     for spec in _configs(platform):
         if only and spec["name"] not in only:
             continue
+        spec_env = spec.get("env") or {}
+        saved_env = {k: os.environ.get(k) for k in spec_env}
+        os.environ.update(spec_env)
+        if spec_env:
+            # the flash probe caches its verdict at first call; a spec that
+            # changes SRT_* env must force a re-probe, and the env must not
+            # leak into later specs in this process
+            import spacy_ray_tpu.ops.flash_attention as _fa
+
+            _fa._PROBED = None
         try:
             rec = run_one(spec, platform)
         except Exception as e:  # one broken config must not hide the others
             print(f"# {spec['name']}: FAILED {type(e).__name__}: {e}", flush=True)
             continue
+        finally:
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            if spec_env:
+                _fa._PROBED = None
         if rec is None:
             continue
         base = baseline.get(rec["name"])
