@@ -164,8 +164,6 @@ def convert_command(argv: List[str]) -> int:
 
         dropped = set()
         for d in docs:
-            if d.morphs and any(d.morphs):
-                dropped.add("morphs")
             if d.spans:
                 dropped.add("span groups")
         if dropped:
@@ -399,12 +397,75 @@ def package_command(argv: List[str]) -> int:
     return 0
 
 
+def init_vectors_command(argv: List[str]) -> int:
+    """`init-vectors` — convert word2vec-text / glove-text / .npz embeddings
+    into the vectors.npz format `[initialize] vectors` loads (spaCy's
+    `spacy init vectors` surface)."""
+    parser = argparse.ArgumentParser(
+        prog="spacy_ray_tpu init-vectors",
+        description="Convert word embeddings (word2vec/glove text, optionally "
+        ".gz, or an npz with words+vectors) for [initialize] vectors.",
+    )
+    parser.add_argument("input_path", type=Path)
+    parser.add_argument("output_path", type=Path)
+    parser.add_argument("--truncate", type=int, default=0,
+                        help="keep only the first N rows (0 = all)")
+    args = parser.parse_args(argv)
+
+    import gzip
+
+    import numpy as np
+
+    from .pipeline.vectors import Vectors
+
+    if args.input_path.suffix == ".npz":
+        vec = Vectors.from_disk(args.input_path)
+        words, table = list(vec.key_to_row), vec.table
+        if args.truncate:
+            words, table = words[: args.truncate], table[: args.truncate]
+    else:
+        opener = gzip.open if args.input_path.suffix == ".gz" else open
+        words, rows = [], []
+        with opener(args.input_path, "rt", encoding="utf8") as f:
+            first = f.readline()
+            parts = first.split()
+            if len(parts) != 2 or not all(p.isdigit() for p in parts):
+                # glove-style: no "N D" header; first line is already a row
+                words.append(parts[0])
+                rows.append(np.asarray(parts[1:], dtype=np.float32))
+            for line in f:
+                if args.truncate and len(words) >= args.truncate:
+                    break
+                parts = line.split()
+                if len(parts) < 2:
+                    continue
+                words.append(parts[0])
+                rows.append(np.asarray(parts[1:], dtype=np.float32))
+        if not rows:
+            print("No vectors found in input", file=sys.stderr)
+            return 1
+        widths = {r.shape[0] for r in rows}
+        if len(widths) != 1:
+            print(f"Inconsistent vector widths in input: {sorted(widths)}",
+                  file=sys.stderr)
+            return 1
+        table = np.stack(rows)
+    Vectors(words, table).to_disk(args.output_path)
+    print(
+        f"Wrote {len(words)} vectors (dim {table.shape[1]}) to "
+        f"{args.output_path}; use via [initialize] vectors = "
+        f"\"{args.output_path}\""
+    )
+    return 0
+
+
 COMMANDS = {
     "train": train_command,
     "pretrain": pretrain_command,
     "evaluate": evaluate_command,
     "convert": convert_command,
     "init-config": init_config_command,
+    "init-vectors": init_vectors_command,
     "debug-data": debug_data_command,
     "package": package_command,
 }

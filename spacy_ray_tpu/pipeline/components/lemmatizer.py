@@ -1,14 +1,26 @@
-"""Lookup lemmatizer: host-side, trained from gold lemma counts.
+"""Lemmatizer: host-side, lookup and rule modes.
 
-Capability parity with spaCy's lookup-mode ``lemmatizer`` pipe (rule/lookup
-host-side preprocessing — SURVEY.md §2.3 places Doc-level string work on the
-host). No device compute: at initialize it builds (word, pos) -> lemma and
-word -> lemma tables from the gold corpus by majority count; prediction is a
-dictionary lookup with suffix-strip fallbacks. Score: ``lemma_acc``.
+Capability parity with spaCy's ``lemmatizer`` pipe (rule/lookup host-side
+preprocessing — SURVEY.md §2.3 places Doc-level string work on the host).
+No device compute.
+
+* ``lookup`` (default): at initialize, build (word, pos) -> lemma and
+  word -> lemma tables from the gold corpus by majority count; prediction
+  is a dictionary lookup with suffix-strip fallbacks.
+* ``rule``: spaCy's rule-lemmatizer algorithm — per-POS exception table,
+  then per-POS suffix rewrite rules validated against a lemma INDEX (a
+  rewrite counts only if it lands on a known lemma). Ships a built-in
+  English morphy-style rule set + core irregulars (spaCy loads these from
+  spacy-lookups-data; this image is zero-egress, so a compact built-in
+  plus config-supplied ``tables_path`` JSON covers the surface), and the
+  index extends itself from gold lemmas at initialize.
+
+Score: ``lemma_acc``.
 """
 
 from __future__ import annotations
 
+import json
 from collections import Counter, defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -24,16 +36,119 @@ _SUFFIX_RULES = [
     ("s", ""),
 ]
 
+# Built-in English rule tables (WordNet-morphy shape, the same structure
+# spaCy's EnglishLemmatizer consumes from spacy-lookups-data)
+_EN_RULES: Dict[str, List[List[str]]] = {
+    "NOUN": [
+        ["ses", "s"], ["ves", "f"], ["xes", "x"], ["zes", "z"],
+        ["ches", "ch"], ["shes", "sh"], ["men", "man"], ["ies", "y"],
+        ["s", ""],
+    ],
+    "VERB": [
+        ["ies", "y"], ["ees", "ee"], ["es", "e"], ["es", ""],
+        ["ied", "y"], ["ed", "e"], ["ed", ""], ["ing", "e"], ["ing", ""],
+        ["s", ""],
+    ],
+    "ADJ": [["er", ""], ["est", ""], ["er", "e"], ["est", "e"], ["ier", "y"], ["iest", "y"]],
+    "ADV": [],
+}
+
+_EN_EXCEPTIONS: Dict[str, Dict[str, str]] = {
+    "VERB": {
+        "am": "be", "are": "be", "is": "be", "was": "be", "were": "be",
+        "been": "be", "being": "be", "has": "have", "had": "have",
+        "having": "have", "does": "do", "did": "do", "done": "do",
+        "goes": "go", "went": "go", "gone": "go", "said": "say",
+        "made": "make", "took": "take", "taken": "take", "came": "come",
+        "saw": "see", "seen": "see", "got": "get", "gotten": "get",
+        "knew": "know", "known": "know", "thought": "think",
+        "gave": "give", "given": "give", "found": "find", "told": "tell",
+        "became": "become", "left": "leave", "felt": "feel", "put": "put",
+        "brought": "bring", "began": "begin", "begun": "begin",
+        "kept": "keep", "held": "hold", "wrote": "write", "written": "write",
+        "stood": "stand", "heard": "hear", "let": "let", "meant": "mean",
+        "set": "set", "met": "meet", "ran": "run", "paid": "pay",
+        "sat": "sit", "spoke": "speak", "spoken": "speak", "lay": "lie",
+        "led": "lead", "read": "read", "grew": "grow", "grown": "grow",
+        "lost": "lose", "fell": "fall", "fallen": "fall", "sent": "send",
+        "built": "build", "understood": "understand", "drew": "draw",
+        "drawn": "draw", "broke": "break", "broken": "break",
+        "spent": "spend", "cut": "cut", "rose": "rise", "risen": "rise",
+        "drove": "drive", "driven": "drive", "bought": "buy",
+        "wore": "wear", "worn": "wear", "chose": "choose", "chosen": "choose",
+    },
+    "NOUN": {
+        "men": "man", "women": "woman", "children": "child", "people": "person",
+        "teeth": "tooth", "feet": "foot", "mice": "mouse", "geese": "goose",
+        "oxen": "ox", "lives": "life", "wives": "wife", "knives": "knife",
+        "leaves": "leaf", "halves": "half", "selves": "self",
+        "criteria": "criterion", "phenomena": "phenomenon", "data": "datum",
+        "analyses": "analysis", "theses": "thesis", "crises": "crisis",
+        "indices": "index", "matrices": "matrix",
+    },
+    "ADJ": {
+        "better": "good", "best": "good", "worse": "bad", "worst": "bad",
+        "further": "far", "furthest": "far", "farther": "far", "farthest": "far",
+    },
+    "ADV": {"better": "well", "best": "well", "worse": "badly", "worst": "badly"},
+}
+
 
 class LemmatizerComponent(Component):
     trainable = False
     listens = False
 
-    def __init__(self, name: str, model_cfg: Optional[Dict[str, Any]] = None, mode: str = "lookup"):
+    def __init__(
+        self,
+        name: str,
+        model_cfg: Optional[Dict[str, Any]] = None,
+        mode: str = "lookup",
+        tables_path: Optional[str] = None,
+    ):
         super().__init__(name, model_cfg or {})
+        if mode not in ("lookup", "rule"):
+            raise ValueError(f"lemmatizer mode must be lookup/rule, got {mode!r}")
         self.mode = mode
         self.table: Dict[Tuple[str, str], str] = {}
         self.word_table: Dict[str, str] = {}
+        # rule mode: per-POS rewrite rules / exceptions / valid-lemma index
+        self.rules: Dict[str, List[List[str]]] = {
+            p: [list(r) for r in rs] for p, rs in _EN_RULES.items()
+        }
+        self.exceptions: Dict[str, Dict[str, str]] = {
+            p: dict(t) for p, t in _EN_EXCEPTIONS.items()
+        }
+        self.index: Dict[str, set] = {p: set() for p in self.rules}
+        if tables_path:
+            self._load_tables_file(tables_path)
+
+    def _load_tables_file(self, path: str) -> None:
+        """User tables (JSON: {"rules": {POS: [[suf, repl]...]}, "exceptions":
+        {POS: {form: lemma}}, "index": {POS: [lemma...]}}) REPLACE the
+        built-in English tables per key present — the spacy-lookups-data
+        extension point."""
+        from pathlib import Path
+
+        if not Path(path).exists():
+            # a model trained with tables_path must stay loadable where the
+            # file is absent: from_disk re-runs this factory BEFORE
+            # load_table_data restores the serialized (authoritative) tables
+            import warnings
+
+            warnings.warn(
+                f"lemmatizer tables_path {path!r} not found; using built-in "
+                "tables (serialized model tables, if any, load afterwards)"
+            )
+            return
+        data = json.loads(Path(path).read_text(encoding="utf8"))
+        if "rules" in data:
+            self.rules = {p: [list(r) for r in rs] for p, rs in data["rules"].items()}
+        if "exceptions" in data:
+            self.exceptions = {p: dict(t) for p, t in data["exceptions"].items()}
+        if "index" in data:
+            self.index = {p: set(v) for p, v in data["index"].items()}
+        for p in self.rules:
+            self.index.setdefault(p, set())
 
     # host-only: no model/params
     def build_model(self):
@@ -53,17 +168,52 @@ class LemmatizerComponent(Component):
             for i, lemma in enumerate(ref.lemmas):
                 if not lemma:
                     continue
-                word = ref.words[i].lower()
                 pos = ref.pos[i] if ref.pos else ""
+                if self.mode == "rule":
+                    if pos in self.index:
+                        # gold lemmas extend the validation index
+                        self.index[pos].add(lemma.lower())
+                    continue
+                word = ref.words[i].lower()
                 counts[(word, pos)][lemma] += 1
                 word_counts[word][lemma] += 1
-        self.table = {k: c.most_common(1)[0][0] for k, c in counts.items()}
-        self.word_table = {w: c.most_common(1)[0][0] for w, c in word_counts.items()}
+        if self.mode == "lookup":
+            self.table = {k: c.most_common(1)[0][0] for k, c in counts.items()}
+            self.word_table = {
+                w: c.most_common(1)[0][0] for w, c in word_counts.items()
+            }
 
     def finish_labels(self) -> None:
         pass
 
+    def lemmatize_rule(self, word: str, pos: str) -> str:
+        """spaCy's rule-lemmatizer algorithm: exceptions first; a form
+        already in the index IS a lemma; else apply suffix rules and keep
+        the first rewrite the index validates, falling back to the first
+        rewrite at all, else the form itself."""
+        low = word.lower()
+        exc = self.exceptions.get(pos, {})
+        if low in exc:
+            return exc[low]
+        rules = self.rules.get(pos)
+        if rules is None:  # POS with no rule table (PUNCT, PROPN, ...)
+            return low
+        index = self.index.get(pos, set())
+        if low in index:
+            return low
+        first_rewrite: Optional[str] = None
+        for suffix, repl in rules:
+            if low.endswith(suffix) and len(low) > len(suffix):
+                form = low[: -len(suffix)] + repl
+                if form in index:
+                    return form
+                if first_rewrite is None:
+                    first_rewrite = form
+        return first_rewrite if first_rewrite is not None else low
+
     def lemmatize(self, word: str, pos: str = "") -> str:
+        if self.mode == "rule":
+            return self.lemmatize_rule(word, pos)
         low = word.lower()
         hit = self.table.get((low, pos)) or self.word_table.get(low)
         if hit:
@@ -102,20 +252,34 @@ class LemmatizerComponent(Component):
     # ------------------------------------------------------------------
     # serialization: the tables must survive to_disk/from_disk
     def table_data(self) -> Dict[str, Any]:
-        return {
+        data: Dict[str, Any] = {
             "mode": self.mode,
             "table": [[w, p, l] for (w, p), l in self.table.items()],
             "word_table": self.word_table,
         }
+        if self.mode == "rule":  # lookup models never consult these
+            data["rules"] = self.rules
+            data["exceptions"] = self.exceptions
+            data["index"] = {p: sorted(v) for p, v in self.index.items()}
+        return data
 
     def load_table_data(self, data: Dict[str, Any]) -> None:
         self.mode = data.get("mode", "lookup")
         self.table = {(w, p): l for w, p, l in data.get("table", [])}
         self.word_table = dict(data.get("word_table", {}))
+        if "rules" in data:
+            self.rules = {p: [list(r) for r in rs] for p, rs in data["rules"].items()}
+        if "exceptions" in data:
+            self.exceptions = {p: dict(t) for p, t in data["exceptions"].items()}
+        if "index" in data:
+            self.index = {p: set(v) for p, v in data["index"].items()}
 
 
 @registry.factories("lemmatizer")
 def make_lemmatizer(
-    name: str, model: Optional[Dict[str, Any]] = None, mode: str = "lookup"
+    name: str,
+    model: Optional[Dict[str, Any]] = None,
+    mode: str = "lookup",
+    tables_path: Optional[str] = None,
 ) -> LemmatizerComponent:
-    return LemmatizerComponent(name, model, mode=mode)
+    return LemmatizerComponent(name, model, mode=mode, tables_path=tables_path)

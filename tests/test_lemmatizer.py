@@ -65,3 +65,86 @@ def test_lemmatizer_lookup_and_fallback(tmp_path):
     assert reloaded.components["lemmatizer"].lemmatize("ran") == "run"
     doc = reloaded("cats running")
     assert doc.lemmas == ["cat", "run"]
+
+
+# ---------------------------------------------------------------- rule mode
+
+
+def _rule_lemmatizer(**kwargs):
+    from spacy_ray_tpu.pipeline.components.lemmatizer import LemmatizerComponent
+
+    return LemmatizerComponent("lemmatizer", mode="rule", **kwargs)
+
+
+def test_rule_mode_exceptions():
+    lem = _rule_lemmatizer()
+    assert lem.lemmatize("went", "VERB") == "go"
+    assert lem.lemmatize("Was", "VERB") == "be"
+    assert lem.lemmatize("children", "NOUN") == "child"
+    assert lem.lemmatize("better", "ADJ") == "good"
+    assert lem.lemmatize("better", "ADV") == "well"
+
+
+def test_rule_mode_suffix_rules_validated_by_index():
+    lem = _rule_lemmatizer()
+    lem.index["VERB"].update({"jump", "make", "run"})
+    lem.index["NOUN"].update({"city", "box", "wolf"})
+    # rewrite accepted only when it lands on a known lemma
+    assert lem.lemmatize("jumps", "VERB") == "jump"
+    assert lem.lemmatize("jumping", "VERB") == "jump"
+    assert lem.lemmatize("making", "VERB") == "make"  # ing->e validated
+    assert lem.lemmatize("cities", "NOUN") == "city"
+    assert lem.lemmatize("boxes", "NOUN") == "box"
+    assert lem.lemmatize("wolves", "NOUN") == "wolf"
+    # form already in index IS the lemma (no 's' stripping on 'gas'-likes)
+    lem.index["NOUN"].add("lens")
+    assert lem.lemmatize("lens", "NOUN") == "lens"
+
+
+def test_rule_mode_pos_without_rules_passes_through():
+    lem = _rule_lemmatizer()
+    assert lem.lemmatize("Paris", "PROPN") == "paris"
+    assert lem.lemmatize(",", "PUNCT") == ","
+
+
+def test_rule_mode_index_from_gold_and_serialization(tmp_path):
+    cfg = Config.from_str(CFG.replace('factory = "lemmatizer"',
+                                      'factory = "lemmatizer"\nmode = "rule"'))
+    nlp = Pipeline.from_config(cfg)
+    docs = [
+        Doc(words=["dogs", "ran"], tags=["NNS", "VBD"],
+            pos=["NOUN", "VERB"], lemmas=["dog", "run"]),
+        Doc(words=["cats", "sleeping"], tags=["NNS", "VBG"],
+            pos=["NOUN", "VERB"], lemmas=["cat", "sleep"]),
+    ] * 8
+    examples = [Example.from_gold(d) for d in docs]
+    nlp.initialize(lambda: iter(examples), seed=0)
+    lem = nlp.components["lemmatizer"]
+    assert "dog" in lem.index["NOUN"] and "sleep" in lem.index["VERB"]
+    # rules validated against the gold-built index
+    assert lem.lemmatize("dogs", "NOUN") == "dog"
+    assert lem.lemmatize("sleeps", "VERB") == "sleep"
+    # serialization round trip
+    nlp.to_disk(tmp_path / "m")
+    nlp2 = Pipeline.from_disk(tmp_path / "m")
+    lem2 = nlp2.components["lemmatizer"]
+    assert lem2.mode == "rule"
+    assert lem2.lemmatize("dogs", "NOUN") == "dog"
+    assert lem2.lemmatize("went", "VERB") == "go"
+
+
+def test_rule_mode_user_tables(tmp_path):
+    import json
+
+    tables = {
+        "rules": {"NOUN": [["en", ""]], "VERB": []},
+        "exceptions": {"NOUN": {"kine": "cow"}},
+        "index": {"NOUN": ["ox"]},
+    }
+    path = tmp_path / "tables.json"
+    path.write_text(json.dumps(tables))
+    lem = _rule_lemmatizer(tables_path=str(path))
+    assert lem.lemmatize("kine", "NOUN") == "cow"
+    assert lem.lemmatize("oxen", "NOUN") == "ox"
+    # built-ins were REPLACED by the user tables
+    assert lem.lemmatize("went", "VERB") == "went"

@@ -99,3 +99,31 @@ def test_package_cli(tmp_path, saved_model):
     )
     assert r.returncode == 0, r.stderr
     assert "Package written to" in r.stdout
+
+
+def test_init_vectors_cli(tmp_path):
+    emb = tmp_path / "emb.txt"
+    emb.write_text("2 3\nfoo 1 2 3\nbar 4 5 6\n")
+    out = tmp_path / "vec.npz"
+    r = subprocess.run(
+        [sys.executable, "-m", "spacy_ray_tpu", "init-vectors", str(emb), str(out)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    from spacy_ray_tpu.pipeline.vectors import Vectors
+
+    v = Vectors.from_disk(out)
+    assert len(v) == 2 and v.width == 3
+    assert v.row_of("bar") == 1
+
+
+def test_init_vectors_rejects_ragged(tmp_path):
+    emb = tmp_path / "bad.txt"
+    emb.write_text("a 1 2\nb 3 4 5\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "spacy_ray_tpu", "init-vectors", str(emb),
+         str(tmp_path / "o.npz")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "Inconsistent vector widths" in r.stderr
