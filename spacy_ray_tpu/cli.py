@@ -201,6 +201,135 @@ def init_config_command(argv: List[str]) -> int:
     return 0
 
 
+def assemble_command(argv: List[str]) -> int:
+    """`assemble` — build a pipeline from a config WITHOUT training and save
+    it (spaCy's `spacy assemble`): the path for rule/lookup-only pipelines
+    (entity_ruler, attribute_ruler, lemmatizer) and for materializing
+    sourced-component combinations."""
+    parser = argparse.ArgumentParser(
+        prog="spacy_ray_tpu assemble",
+        description="Build a pipeline from a config without training; "
+        "initializes components (labels from [initialize] data when "
+        "present, else empty) and writes the pipeline to output.",
+    )
+    parser.add_argument("config_path", type=Path)
+    parser.add_argument("output_path", type=Path)
+    parser.add_argument("--device", type=str, default="cpu", choices=["tpu", "cpu"])
+    parser.add_argument("--code", type=Path, default=None)
+    args, extra = parser.parse_known_args(argv)
+    _setup_device(args.device)
+
+    from .config import load_config, parse_cli_overrides
+    from .pipeline.language import Pipeline
+    from .registry import import_code, registry
+
+    import_code(str(args.code) if args.code else None)
+    overrides = parse_cli_overrides(extra)
+    config = load_config(args.config_path, overrides, interpolate=False).interpolate()
+    nlp = Pipeline.from_config(config)
+
+    get_examples = None
+    corpora_cfg = config.get("corpora", {})
+    train_name = (config.get("training") or {}).get("train_corpus", "corpora.train")
+    block = corpora_cfg.get(train_name.split(".", 1)[-1]) if corpora_cfg else None
+    if block and (block.get("path") or "").strip():
+        corpus = registry.resolve(block)
+        get_examples = lambda: iter(corpus())  # noqa: E731
+    nlp.initialize(get_examples, seed=0)
+    nlp.to_disk(args.output_path)
+    print(f"Assembled pipeline ({', '.join(nlp.pipe_names)}) -> {args.output_path}")
+    return 0
+
+
+def _check_arch_names(block, registry, where: str) -> None:
+    """Recursively verify @-references resolve to registered callables and
+    that non-@ keys are accepted argument names — without calling anything."""
+    import inspect
+
+    if not isinstance(block, dict):
+        return
+    ref_keys = [k for k in block if k.startswith("@")]
+    for k in ref_keys:
+        namespace = k[1:]
+        func = registry.get(namespace, block[k])  # raises if unknown
+        sig = inspect.signature(func)
+        accepts_kwargs = any(
+            p.kind == inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values()
+        )
+        if not accepts_kwargs:
+            unknown = [
+                a for a in block
+                if not a.startswith("@") and a not in sig.parameters
+            ]
+            if unknown:
+                raise ValueError(
+                    f"[{where}] invalid argument(s) {unknown} for "
+                    f"@{namespace} = {block[k]!r}"
+                )
+    for key, sub in block.items():
+        if isinstance(sub, dict):
+            _check_arch_names(sub, registry, f"{where}.{key}")
+
+
+def debug_config_command(argv: List[str]) -> int:
+    """`debug config` — resolve every block of a config and report what's
+    wrong (or print the resolved summary), without touching any data."""
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu debug-config")
+    parser.add_argument("config_path", type=Path)
+    parser.add_argument("--code", type=Path, default=None)
+    args, extra = parser.parse_known_args(argv)
+
+    from .config import load_config, parse_cli_overrides
+    from .registry import import_code, registry
+
+    import_code(str(args.code) if args.code else None)
+    overrides = parse_cli_overrides(extra)
+    try:
+        config = load_config(args.config_path, overrides, interpolate=False)
+        config = config.interpolate()
+    except Exception as e:
+        print(f"[config] INVALID: {e}", file=sys.stderr)
+        return 1
+    problems = 0
+    nlp_block = config.get("nlp") or {}
+    pipeline = list(nlp_block.get("pipeline") or [])
+    comps = config.get("components") or {}
+    for name in pipeline:
+        block = comps.get(name)
+        if block is None:
+            print(f"[components.{name}] MISSING (listed in nlp.pipeline)",
+                  file=sys.stderr)
+            problems += 1
+            continue
+        if "source" in block:
+            print(f"[components.{name}] sourced from {block['source']!r}")
+            continue
+        try:
+            factory = block.get("factory")
+            registry.get("factories", factory)
+            # validate architecture names + argument names WITHOUT invoking
+            # the factories: eager construction would run model-building
+            # code that legitimately needs runtime context (loaded vectors,
+            # devices) and must not decide config validity
+            _check_arch_names(block.get("model"), registry, f"components.{name}.model")
+            print(f"[components.{name}] ok (factory={factory})")
+        except Exception as e:
+            print(f"[components.{name}] INVALID: {e}", file=sys.stderr)
+            problems += 1
+    for section in ("corpora", "training", "pretraining", "initialize"):
+        if section in config and config[section]:
+            print(f"[{section}] present ({len(dict(config[section]))} keys)")
+    extra_comps = sorted(set(comps) - set(pipeline))
+    if extra_comps:
+        print(f"note: components defined but not in nlp.pipeline: {extra_comps}")
+    if problems:
+        print(f"{problems} problem(s) found", file=sys.stderr)
+        return 1
+    print("Config OK")
+    return 0
+
+
 def debug_data_command(argv: List[str]) -> int:
     """Corpus sanity report (spaCy's `debug data` role): doc/token counts,
     annotation coverage, label distributions, length histogram, and
@@ -429,10 +558,14 @@ def init_vectors_command(argv: List[str]) -> int:
         with opener(args.input_path, "rt", encoding="utf8") as f:
             first = f.readline()
             parts = first.split()
-            if len(parts) != 2 or not all(p.isdigit() for p in parts):
-                # glove-style: no "N D" header; first line is already a row
+            if len(parts) == 2 and all(p.isdigit() for p in parts):
+                pass  # word2vec "N D" header line
+            elif len(parts) >= 2:
+                # glove-style: no header; first line is already a row
                 words.append(parts[0])
                 rows.append(np.asarray(parts[1:], dtype=np.float32))
+            # else: empty/blank first line -> fall through; the "No vectors
+            # found" check below reports cleanly
             for line in f:
                 if args.truncate and len(words) >= args.truncate:
                     break
@@ -466,7 +599,9 @@ COMMANDS = {
     "convert": convert_command,
     "init-config": init_config_command,
     "init-vectors": init_vectors_command,
+    "assemble": assemble_command,
     "debug-data": debug_data_command,
+    "debug-config": debug_config_command,
     "package": package_command,
 }
 
@@ -474,7 +609,7 @@ COMMANDS = {
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("Usage: python -m spacy_ray_tpu {train,pretrain,evaluate,convert,init-config,debug-data} ...")
+        print(f"Usage: python -m spacy_ray_tpu {{{','.join(COMMANDS)}}} ...")
         return 0
     command = argv[0]
     if command not in COMMANDS:

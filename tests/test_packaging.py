@@ -127,3 +127,45 @@ def test_init_vectors_rejects_ragged(tmp_path):
     )
     assert r.returncode == 1
     assert "Inconsistent vector widths" in r.stderr
+
+
+def test_assemble_cli(tmp_path):
+    cfg = tmp_path / "ruler.cfg"
+    cfg.write_text(
+        "[nlp]\nlang = \"en\"\npipeline = [\"entity_ruler\"]\n\n"
+        "[components.entity_ruler]\nfactory = \"entity_ruler\"\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "spacy_ray_tpu", "assemble", str(cfg),
+         str(tmp_path / "model")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    from spacy_ray_tpu.pipeline.language import Pipeline
+
+    nlp = Pipeline.from_disk(tmp_path / "model")
+    assert nlp.pipe_names == ["entity_ruler"]
+
+
+def test_debug_config_cli(tmp_path):
+    good = tmp_path / "good.cfg"
+    good.write_text(
+        "[nlp]\nlang = \"en\"\npipeline = [\"entity_ruler\"]\n\n"
+        "[components.entity_ruler]\nfactory = \"entity_ruler\"\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "spacy_ray_tpu", "debug-config", str(good)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0 and "Config OK" in r.stdout
+
+    bad = tmp_path / "bad.cfg"
+    bad.write_text(
+        "[nlp]\nlang = \"en\"\npipeline = [\"missing_comp\"]\n\n[components]\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "spacy_ray_tpu", "debug-config", str(bad)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "MISSING" in r.stderr
