@@ -12,3 +12,4 @@ from . import lemmatizer  # noqa: F401
 from . import entity_ruler  # noqa: F401
 from . import attribute_ruler  # noqa: F401
 from . import nel  # noqa: F401
+from . import edit_tree_lemmatizer  # noqa: F401

@@ -1,0 +1,230 @@
+"""``trainable_lemmatizer``: neural lemmatization over induced edit trees.
+
+Capability parity with spaCy's ``trainable_lemmatizer`` (EditTreeLemmatizer;
+part of the spaCy pipeline family the reference trains through its
+config-driven loop). The split is the framework's standard one:
+
+* HOST, at initialize: induce an edit tree per (form, lemma) pair —
+  recursive longest-common-substring decomposition with substitution
+  leaves, the same structure spaCy uses — and keep trees seen at least
+  ``min_tree_freq`` times as the label set.
+* DEVICE: a per-token classifier over tree labels (reuses the tagger's
+  loss machinery — one Linear over the shared tok2vec, masked CE), so
+  training is the same MXU-friendly batched classification as tagging.
+* HOST, at decode: for each token try the top-``top_k`` scoring trees in
+  order and apply the first one that matches the form (a tree is partial:
+  substitution leaves must match their original string and length
+  constraints must hold); fall back to the identity.
+
+Score: ``lemma_acc`` (same key as the rule/lookup lemmatizer).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from ...registry import registry
+from ...pipeline.doc import Doc, Example
+from .tagger import TaggerComponent
+
+# An edit tree is nested tuples:
+#   ("s", orig, subst)                      substitution leaf
+#   ("m", pfx_len, sfx_len, left, right)    match node: the middle
+#       (longest common substring) is kept verbatim; left transforms the
+#       first pfx_len chars, right the last sfx_len chars (None = empty)
+Tree = Union[Tuple, None]
+
+
+def _lcs(a: str, b: str) -> Tuple[int, int, int]:
+    """(start_a, start_b, length) of the longest common substring."""
+    best = (0, 0, 0)
+    if not a or not b:
+        return best
+    prev = [0] * (len(b) + 1)
+    for i in range(1, len(a) + 1):
+        cur = [0] * (len(b) + 1)
+        for j in range(1, len(b) + 1):
+            if a[i - 1] == b[j - 1]:
+                cur[j] = prev[j - 1] + 1
+                if cur[j] > best[2]:
+                    best = (i - cur[j], j - cur[j], cur[j])
+        prev = cur
+    return best
+
+
+def build_tree(form: str, lemma: str) -> Tree:
+    """Induce the edit tree transforming ``form`` into ``lemma``."""
+    if form == lemma:
+        return None  # identity
+    sa, sb, n = _lcs(form, lemma)
+    if n == 0:
+        return ("s", form, lemma)
+    left = build_tree(form[:sa], lemma[:sb])
+    right = build_tree(form[sa + n :], lemma[sb + n :])
+    return ("m", sa, len(form) - sa - n, left, right)
+
+
+def apply_tree(tree: Tree, form: str) -> Optional[str]:
+    """Apply; None when the tree does not match the form."""
+    if tree is None:
+        return form
+    if tree[0] == "s":
+        return tree[2] if form == tree[1] else None
+    _, pfx, sfx, left, right = tree
+    if pfx + sfx > len(form):
+        return None
+    mid = form[pfx : len(form) - sfx] if sfx else form[pfx:]
+    lp = apply_tree(left, form[:pfx])
+    if lp is None:
+        return None
+    rp = apply_tree(right, form[len(form) - sfx :] if sfx else "")
+    if rp is None:
+        return None
+    return lp + mid + rp
+
+
+def tree_key(tree: Tree) -> str:
+    return json.dumps(tree, separators=(",", ":"), ensure_ascii=False)
+
+
+def tree_from_key(key: str) -> Tree:
+    def tup(x):
+        return tuple(tup(v) for v in x) if isinstance(x, list) else x
+
+    return tup(json.loads(key))
+
+
+class EditTreeLemmatizerComponent(TaggerComponent):
+    def __init__(
+        self,
+        name: str,
+        model_cfg: Dict[str, Any],
+        *,
+        min_tree_freq: int = 3,
+        top_k: int = 3,
+        overwrite: bool = True,
+    ):
+        super().__init__(name, model_cfg)
+        self.min_tree_freq = int(min_tree_freq)
+        self.top_k = int(top_k)
+        self.overwrite = bool(overwrite)
+
+    # labels[0] is always the identity tree ("null"), the decode fallback
+    def add_labels_from(self, examples) -> None:
+        counts: Counter = Counter()
+        for eg in examples:
+            ref = eg.reference
+            if not ref.lemmas:
+                continue
+            for i, lemma in enumerate(ref.lemmas):
+                if not lemma:
+                    continue
+                counts[tree_key(build_tree(ref.words[i], lemma))] += 1
+        ident = tree_key(None)
+        kept = {k for k, c in counts.items() if c >= self.min_tree_freq}
+        kept.discard(ident)
+        self.labels = list(set(self.labels) | kept)
+
+    def finish_labels(self) -> None:
+        ident = tree_key(None)
+        rest = sorted(l for l in self.labels if l != ident)
+        self.labels = [ident] + rest
+
+    @property
+    def trees(self) -> List[Tree]:
+        """Decoded trees, rebuilt whenever labels change — from_disk
+        restores labels by plain assignment (language.py), so the decoded
+        list derives lazily instead of trusting a hook to run."""
+        if getattr(self, "_trees_for", None) is not self.labels:
+            self._trees = [tree_from_key(k) for k in self.labels]
+            self._trees_for = self.labels
+        return self._trees
+
+    def make_targets(self, examples: List[Example], B: int, T: int) -> Dict[str, np.ndarray]:
+        label_ids = {label: i for i, label in enumerate(self.labels)}
+        tags = np.zeros((B, T), dtype=np.int32)
+        mask = np.zeros((B, T), dtype=bool)
+        # per-Example cache, as in TaggerComponent.make_targets: examples
+        # recur every epoch and tree induction is an O(|form|*|lemma|) DP
+        # per token — induce once, key on the (fixed-after-init) label set
+        cache_key = tuple(self.labels)
+        for i, eg in enumerate(examples):
+            ref = eg.reference
+            if not ref.lemmas:
+                continue
+            cached = getattr(eg, "_etl_target_cache", None)
+            if cached is None or cached[0] != cache_key:
+                ids = np.zeros(len(ref.lemmas), dtype=np.int32)
+                valid = np.zeros(len(ref.lemmas), dtype=bool)
+                for j, lemma in enumerate(ref.lemmas):
+                    if not lemma:
+                        continue
+                    tid = label_ids.get(tree_key(build_tree(ref.words[j], lemma)))
+                    if tid is not None:
+                        ids[j] = tid
+                        valid[j] = True
+                eg._etl_target_cache = cached = (cache_key, ids, valid)
+            _, ids, valid = cached
+            n = min(len(ids), T)
+            tags[i, :n] = ids[:n]
+            mask[i, :n] = valid[:n]
+        return {"tags": tags, "tag_mask": mask}
+
+    def set_annotations(self, docs: List[Doc], outputs, lengths: List[int]) -> None:
+        logits = np.asarray(outputs.X, dtype=np.float32)  # [B, T, L]
+        k = min(self.top_k, logits.shape[-1])
+        # top-k per token, best first (argpartition then sort the slice)
+        part = np.argpartition(-logits, k - 1, axis=-1)[..., :k]
+        order = np.take_along_axis(logits, part, axis=-1).argsort(axis=-1)[..., ::-1]
+        topk = np.take_along_axis(part, order, axis=-1)  # [B, T, k]
+        for i, doc in enumerate(docs):
+            if doc.lemmas and not self.overwrite:
+                continue
+            n = lengths[i]
+            lemmas = []
+            for j in range(n):
+                form = doc.words[j]
+                out = None
+                for tid in topk[i, j]:
+                    out = apply_tree(self.trees[tid], form)
+                    if out:  # empty string = no-match (spaCy semantics)
+                        break
+                    out = None
+                lemmas.append(out if out else form)
+            lemmas += list(doc.words[n:])  # tokens beyond the padded length
+            doc.lemmas = lemmas
+
+    def score(self, examples: List[Example]) -> Dict[str, float]:
+        correct = total = 0
+        for eg in examples:
+            gold = eg.reference.lemmas
+            pred = eg.predicted.lemmas
+            if not gold or not pred:
+                continue
+            for g, p in zip(gold, pred):
+                if not g:
+                    continue
+                total += 1
+                correct += int(g == p)
+        return {"lemma_acc": correct / total if total else 0.0}
+
+
+@registry.factories("trainable_lemmatizer")
+def make_trainable_lemmatizer(
+    name: str,
+    model: Dict[str, Any],
+    min_tree_freq: int = 3,
+    top_k: int = 3,
+    overwrite: bool = True,
+) -> EditTreeLemmatizerComponent:
+    return EditTreeLemmatizerComponent(
+        name,
+        model,
+        min_tree_freq=min_tree_freq,
+        top_k=top_k,
+        overwrite=overwrite,
+    )
