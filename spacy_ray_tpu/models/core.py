@@ -35,20 +35,34 @@ class Context:
     """Per-call context threaded through apply: dropout rng, train flag,
     and an optional auxiliary-loss sink (``aux_losses``) that layers with
     regularizer terms (e.g. the MoE router's load-balancing loss) append
-    to during tracing; the loss builder sums it into the total."""
+    to during tracing; the loss builder sums it into the total.
+
+    ``dropout`` is the global training-time dropout override: when set,
+    every dropout site uses it in place of its architecture-configured
+    rate — the equivalent of spaCy's ``set_dropout_rate(model, drop)``
+    call with ``[training] dropout`` before each update (reference
+    worker.py:181 passes it into ``train_while_improving``). ``None``
+    (the predict path and direct ``apply`` calls) keeps each layer's own
+    configured rate."""
 
     train: bool = False
     rng: Optional[jax.Array] = None
     aux_losses: Optional[list] = None
+    dropout: Optional[float] = None
 
     def split(self) -> Tuple["Context", "Context"]:
         if self.rng is None:
             return self, self
         r1, r2 = jax.random.split(self.rng)
         return (
-            Context(self.train, r1, self.aux_losses),
-            Context(self.train, r2, self.aux_losses),
+            Context(self.train, r1, self.aux_losses, self.dropout),
+            Context(self.train, r2, self.aux_losses, self.dropout),
         )
+
+    def dropout_rate(self, configured: float) -> float:
+        """The effective dropout rate at a site whose architecture default
+        is ``configured`` (static Python float — resolved at trace time)."""
+        return self.dropout if self.dropout is not None else configured
 
     def add_aux_loss(self, value) -> None:
         if self.aux_losses is not None:

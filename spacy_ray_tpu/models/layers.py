@@ -60,8 +60,9 @@ def Dropout(rate: float, name: str = "dropout") -> Model:
         return {}
 
     def apply_fn(params, x: Padded, ctx: Context) -> Padded:
-        if ctx.train and ctx.rng is not None and rate > 0:
-            return Padded(X=O.dropout(ctx.rng, x.X, rate, True), mask=x.mask)
+        r = ctx.dropout_rate(rate)
+        if ctx.train and ctx.rng is not None and r > 0:
+            return Padded(X=O.dropout(ctx.rng, x.X, r, True), mask=x.mask)
         return x
 
     return Model(name, init_fn, apply_fn)

@@ -420,7 +420,7 @@ def TransformerEncoder(
         layer_fn = _partial(
             apply_transformer_layer,
             n_heads=n_heads,
-            dropout=dropout,
+            dropout=ctx.dropout_rate(dropout),
             train=ctx.train,
             n_experts=n_experts,
             capacity_factor=expert_capacity_factor,

@@ -98,6 +98,24 @@ class EntityLinkerComponent(Component):
         return super().build_model()
 
     # ----------------------------------------------------------- collate
+    def _training_mentions(self, eg: Example) -> List[tuple]:
+        """(start, end, gold_kb_id) spans to supervise. Gold ents by
+        default; with ``use_gold_ents = false`` the mentions an upstream
+        ``[training] annotating_components`` ner predicted onto
+        ``eg.predicted`` (spaCy's EL-under-annotating-ner training setup;
+        reference worker.py:187 threads the list into
+        ``train_while_improving``), each supervised by boundary-matching
+        against gold — predicted spans with no gold match are skipped. A doc
+        with no predicted ents contributes no mentions (spaCy semantics: EL
+        with use_gold_ents = false trains on doc.ents as-is)."""
+        if self.use_gold_ents:
+            return [(s.start, s.end, s.kb_id) for s in eg.reference.ents]
+        gold = {(s.start, s.end): s.kb_id for s in eg.reference.ents if s.kb_id}
+        return [
+            (s.start, s.end, gold.get((s.start, s.end), ""))
+            for s in eg.predicted.ents
+        ]
+
     def make_targets(self, examples: List[Example], B: int, T: int) -> Dict[str, np.ndarray]:
         assert self.kb is not None
         K = self.n_candidates
@@ -106,18 +124,18 @@ class EntityLinkerComponent(Component):
         m_max = 1
         for eg in examples[:B]:
             rows = []
-            for span in eg.reference.ents:
-                if not span.kb_id or span.end > T or span.end <= span.start:
+            for start, end, kb_id in self._training_mentions(eg):
+                if not kb_id or end > T or end <= start:
                     continue
                 cands = self.kb.candidates(
-                    _mention_text(eg.reference, span.start, span.end)
+                    _mention_text(eg.reference, start, end)
                 )[:K]
                 gold = next(
-                    (i for i, c in enumerate(cands) if c.entity == span.kb_id), None
+                    (i for i, c in enumerate(cands) if c.entity == kb_id), None
                 )
                 if gold is None:
                     continue  # gold entity not reachable through top-K priors
-                rows.append((span.start, span.end, gold, cands))
+                rows.append((start, end, gold, cands))
             per_doc.append(rows)
             m_max = max(m_max, len(rows))
         M = _bucket_mentions(m_max)

@@ -329,12 +329,19 @@ class Pipeline:
     # ------------------------------------------------------------------
     # Pure loss (jit-traceable)
     # ------------------------------------------------------------------
-    def make_loss_fn(self) -> Callable:
-        """Returns loss_fn(params, tokens, targets, rng) -> (loss, metrics)."""
+    def make_loss_fn(self, dropout: Optional[float] = None) -> Callable:
+        """Returns loss_fn(params, tokens, targets, rng) -> (loss, metrics).
+
+        ``dropout``: global training dropout override (``[training] dropout``,
+        spaCy semantics — reference worker.py:181 passes it into
+        ``train_while_improving``, where ``set_dropout_rate`` overrides every
+        dropout node's architecture rate). ``None`` keeps per-architecture
+        rates (the pre-round-3 behavior, and the behavior of direct calls)."""
         t2v_name = self.tok2vec_name
         head_names = self.head_names()
         components = self.components
         frozen = set(self.frozen_components)
+        drop = None if dropout is None else float(dropout)
 
         def loss_fn(params: Params, tokens: TokenBatch, targets: Dict[str, Any], rng):
             metrics: Dict[str, Any] = {}
@@ -348,7 +355,7 @@ class Pipeline:
                 rng, sub = jax.random.split(rng)
                 t2v_out = components[t2v_name].forward(
                     t2v_params, tokens,
-                    Context(train=True, rng=sub, aux_losses=aux_sink),
+                    Context(train=True, rng=sub, aux_losses=aux_sink, dropout=drop),
                 )
             for name in head_names:
                 comp = components[name]
@@ -363,7 +370,7 @@ class Pipeline:
                 # MoE trunk themselves — give them the same aux sink
                 loss, comp_metrics = comp.loss(
                     comp_params, inputs, targets[name],
-                    Context(train=True, rng=sub, aux_losses=aux_sink),
+                    Context(train=True, rng=sub, aux_losses=aux_sink, dropout=drop),
                 )
                 metrics[f"loss_{name}"] = loss
                 # namespace per component: shared base classes emit the same
@@ -380,10 +387,16 @@ class Pipeline:
 
         return loss_fn
 
-    def make_forward_fn(self) -> Callable:
-        """Returns forward(params, tokens) -> {component: output} (eval mode)."""
+    def make_forward_fn(self, only: Optional[Sequence[str]] = None) -> Callable:
+        """Returns forward(params, tokens) -> {component: output} (eval mode).
+
+        ``only``: compute just the listed head components (plus the trunk) —
+        the annotating_components path uses this so a training-time
+        annotation pass doesn't pay for the downstream heads it discards."""
         t2v_name = self.tok2vec_name
         head_names = self.head_names()
+        if only is not None:
+            head_names = [n for n in head_names if n in set(only)]
         components = self.components
 
         def forward(params: Params, tokens: TokenBatch):
@@ -413,11 +426,18 @@ class Pipeline:
         params: Optional[Params] = None,
         batch_size: int = 128,
         mesh=None,
+        annotate: Optional[List[str]] = None,
     ) -> List[Doc]:
         """Batched prediction. With ``mesh`` (single-process), eval batches
         are sharded over the ``data`` axis so prediction uses every device
         instead of computing replicated — eval time scales down with the
-        mesh instead of stalling the loop (VERDICT r1 weak #10)."""
+        mesh instead of stalling the loop (VERDICT r1 weak #10).
+
+        ``annotate``: restrict ``set_annotations`` to the listed components
+        (the training loop's ``[training] annotating_components`` path —
+        reference worker.py:187 passes the list into
+        ``train_while_improving`` so downstream components train against
+        upstream predictions). ``None`` annotates with every component."""
         params = params if params is not None else self.params
         assert params is not None, "Pipeline not initialized"
         shard_eval = (
@@ -427,19 +447,34 @@ class Pipeline:
         )
         n_data = int(mesh.shape["data"]) if shard_eval else 1
         # cache keyed on decode-affecting component settings, so e.g.
-        # changing parser.beam_width or ner.decode takes effect immediately.
-        # The mesh is NOT part of the key: the same jitted callable serves
-        # sharded and unsharded inputs (jax keeps one executable per input
-        # sharding internally), so eval/inference interleaving never
-        # rebuilds the trace
-        decode_sig = tuple(
-            (name, getattr(self.components[name], "beam_width", None),
-             getattr(self.components[name], "decode", None))
-            for name in self.pipe_names
+        # changing parser.beam_width or ner.decode takes effect immediately,
+        # plus the ``annotate`` restriction (the annotating pass compiles a
+        # trunk+annotators-only program; interleaving it with full eval must
+        # not retrace either one). The mesh is NOT part of the key: the same
+        # jitted callable serves sharded and unsharded inputs (jax keeps one
+        # executable per input sharding internally), so eval/inference
+        # interleaving never rebuilds the trace
+        decode_sig = (
+            tuple(
+                (name, getattr(self.components[name], "beam_width", None),
+                 getattr(self.components[name], "decode", None))
+                for name in self.pipe_names
+            ),
+            tuple(sorted(annotate)) if annotate is not None else None,
         )
-        if self._jit_forward is None or self._jit_forward[0] != decode_sig:
-            self._jit_forward = (decode_sig, jax.jit(self.make_forward_fn()))
-        forward = self._jit_forward[1]
+        if self._jit_forward is None:
+            self._jit_forward = {}
+        if decode_sig not in self._jit_forward:
+            # evict entries traced under DIFFERENT decode settings (stale),
+            # keeping other `annotate` restrictions alive — the training
+            # loop alternates annotation and eval programs every step
+            for k in list(self._jit_forward):
+                if k[0] != decode_sig[0]:
+                    del self._jit_forward[k]
+            self._jit_forward[decode_sig] = jax.jit(
+                self.make_forward_fn(only=decode_sig[1])
+            )
+        forward = self._jit_forward[decode_sig]
         for start in range(0, len(docs), batch_size):
             chunk = docs[start : start + batch_size]
             examples = [Example.from_gold(d) for d in chunk]
@@ -456,6 +491,8 @@ class Pipeline:
             outputs = forward(params, tokens)
             lengths = [min(len(d), batch["tokens"].seq_len) for d in chunk]
             for name in self.head_names():
+                if annotate is not None and name not in annotate:
+                    continue
                 self.components[name].set_annotations(
                     chunk, outputs.get(name), lengths
                 )
