@@ -158,20 +158,9 @@ def convert_command(argv: List[str]) -> int:
         print(f"Could not read {args.input_path}: {e}", file=sys.stderr)
         return 1
     if args.output_path.suffix == ".spacy":
-        # the real spaCy DocBin byte format (readable by spaCy itself);
-        # it cannot carry everything the internal formats can — say so
+        # the real spaCy DocBin byte format (readable by spaCy itself)
         from .training.spacy_docbin import write_docbin
 
-        dropped = set()
-        for d in docs:
-            if d.spans:
-                dropped.add("span groups")
-        if dropped:
-            print(
-                f"warning: .spacy output drops {', '.join(sorted(dropped))} "
-                "(use .msgdoc/.jsonl to keep them)",
-                file=sys.stderr,
-            )
         write_docbin(args.output_path, docs)
     else:
         DocBin(docs).to_disk(args.output_path)

@@ -31,8 +31,15 @@ own .spacy round trip preserves entity links and morphs. CAVEAT: real
 spaCy resolves attr IDs against its version's symbols enum, so a real
 spaCy reader may skip (not misread) those two columns; data meant for
 real-spaCy consumption with links/morphs should also keep .jsonl.
-``span_groups`` payloads are not decoded (spancat corpora: use
-jsonl/msgdoc).
+
+``span_groups`` (spancat corpora) round-trip: one bytes entry per doc =
+msgpack list of per-group bytes (spacy/tokens/_dict_proxies.py
+``SpanGroups.to_bytes``); each group is msgpack
+``{"name", "attrs", "spans"}`` with every span struct-packed big-endian
+(spacy/tokens/span_group.pyx ``SpanGroup.to_bytes``) — 7 fields
+``>QQQllll`` (id, kb_id, label, start, end, start_char, end_char) since
+spaCy 3.4, with the older 6-field ``>QQllll`` (no id) layout accepted on
+read. Label/kb-id hashes resolve through the same string store.
 """
 
 from __future__ import annotations
@@ -109,6 +116,98 @@ def spacy_string_hash(s: str) -> int:
     return murmur_hash64a(s.encode("utf8"), 1)
 
 
+def _char_offsets(words: List[str], spaces: Optional[List[bool]]) -> List[int]:
+    """Cumulative character start offset per token (text reconstructed as
+    word + trailing space when ``spaces[i]``; unknown spaces assume True —
+    the same convention the SPACY column writer uses)."""
+    sp = spaces if spaces is not None else [True] * len(words)
+    offsets = []
+    pos = 0
+    for w, s in zip(words, sp):
+        offsets.append(pos)
+        pos += len(w) + (1 if s else 0)
+    offsets.append(pos)  # sentinel: end of text
+    return offsets
+
+
+def _span_groups_to_bytes(doc: Doc, strings: set) -> bytes:
+    """Serialize ``doc.spans`` in spaCy's SpanGroups byte format (see
+    module docstring). Adds group names / span labels / kb ids to the
+    DocBin string store so readers can resolve the hashes."""
+    import msgpack
+
+    offsets = _char_offsets(doc.words, doc.spaces)
+    groups: List[bytes] = []
+    for name, spans in (doc.spans or {}).items():
+        packed = []
+        for s in spans:
+            if s.label:
+                strings.add(s.label)
+            if s.kb_id:
+                strings.add(s.kb_id)
+            end_char = (
+                offsets[s.end - 1] + len(doc.words[s.end - 1])
+                if s.end > s.start
+                else offsets[s.start]
+            )
+            packed.append(
+                struct.pack(
+                    ">QQQllll",
+                    0,  # span id: unset
+                    spacy_string_hash(s.kb_id),
+                    spacy_string_hash(s.label),
+                    int(s.start),
+                    int(s.end),
+                    int(offsets[s.start]),
+                    int(end_char),
+                )
+            )
+        strings.add(name)
+        groups.append(
+            msgpack.packb(
+                {"name": name, "attrs": {}, "spans": packed}, use_bin_type=True
+            )
+        )
+    return msgpack.packb(groups, use_bin_type=True)
+
+
+def _span_groups_from_bytes(
+    data: bytes, hash_to_str: Dict[int, str]
+) -> Dict[str, List[Span]]:
+    """Decode one doc's SpanGroups payload. Tolerates both the 7-field
+    (id, kb_id, label) and pre-3.4 6-field (kb_id, label) span layouts."""
+    import msgpack
+
+    if not data:
+        return {}
+    out: Dict[str, List[Span]] = {}
+    for group_bytes in msgpack.unpackb(data, raw=False):
+        g = msgpack.unpackb(group_bytes, raw=False)
+        name = g.get("name", "")
+        spans: List[Span] = []
+        for sb in g.get("spans", []):
+            if len(sb) == 40:  # >QQQllll
+                _sid, kb_h, label_h, start, end, _sc, _ec = struct.unpack(
+                    ">QQQllll", sb
+                )
+            elif len(sb) == 32:  # >QQllll (no id field)
+                kb_h, label_h, start, end, _sc, _ec = struct.unpack(">QQllll", sb)
+            else:
+                continue  # unknown layout: skip rather than misread
+            spans.append(
+                Span(
+                    int(start),
+                    int(end),
+                    hash_to_str.get(int(label_h), ""),
+                    kb_id=hash_to_str.get(int(kb_h), ""),
+                )
+            )
+        # duplicate group names: keep the first (spaCy keys by name too)
+        if name not in out:
+            out[name] = spans
+    return out
+
+
 def _resolve_attr_names(attr_ids: List[int]) -> List[Optional[str]]:
     """Map the file's attr-ID list to names; version-dependent high IDs are
     resolved positionally (enum order ENT_KB_ID < MORPH < ENT_ID)."""
@@ -149,6 +248,7 @@ def read_docbin_bytes(data: bytes) -> Iterator[Doc]:
     hash_to_str[0] = ""
     cats = msg.get("cats") or [None] * len(lengths)
     flags = msg.get("flags") or [{}] * len(lengths)
+    span_groups = msg.get("span_groups") or [b""] * len(lengths)
 
     col: Dict[str, int] = {nm: i for i, nm in enumerate(names) if nm}
 
@@ -240,6 +340,15 @@ def read_docbin_bytes(data: bytes) -> Iterator[Doc]:
                         start = None
             if start is not None:
                 doc.ents.append(Span(start, n, label, kb_id=kb_id))
+        if di < len(span_groups) and span_groups[di]:
+            for name, spans in _span_groups_from_bytes(
+                span_groups[di], hash_to_str
+            ).items():
+                # drop out-of-range spans (corrupt or truncated doc) rather
+                # than crash downstream target construction
+                doc.spans[name] = [
+                    s for s in spans if 0 <= s.start <= s.end <= n
+                ]
         yield doc
 
 
@@ -269,12 +378,14 @@ def write_docbin(path: Union[str, Path], docs: Iterable[Doc]) -> None:
     lengths: List[int] = []
     cats: List[dict] = []
     flags: List[dict] = []
+    span_groups: List[bytes] = []
 
     for doc in docs:
         n = len(doc.words)
         lengths.append(n)
         cats.append(dict(doc.cats) if doc.cats else {})
         flags.append({"has_unknown_spaces": doc.spaces is None})
+        span_groups.append(_span_groups_to_bytes(doc, strings))
         # no ents at all -> ENT_IOB 0 (missing annotation); writing explicit
         # O everywhere would fabricate negative NER gold for consumers that
         # honor the 0-vs-2 distinction (spaCy does)
@@ -361,5 +472,6 @@ def write_docbin(path: Union[str, Path], docs: Iterable[Doc]) -> None:
         "strings": sorted(strings),
         "cats": cats,
         "flags": flags,
+        "span_groups": span_groups,
     }
     Path(path).write_bytes(zlib.compress(msgpack.packb(msg, use_bin_type=True)))

@@ -227,3 +227,110 @@ def test_real_heads_with_empty_deps_are_kept(tmp_path):
     SD.write_docbin(p, [doc])
     (got,) = list(SD.read_docbin(p))
     assert got.heads == [1, 1]
+
+
+# ----------------------------------------------------------------------
+# span groups (spancat corpora) — VERDICT r2 missing #5
+# ----------------------------------------------------------------------
+
+
+def test_span_groups_round_trip(tmp_path):
+    d1 = Doc(words=["find", "acute", "lymphoblastic", "leukemia", "here"])
+    d1.spans["sc"] = [
+        Span(1, 4, "DISEASE"),
+        Span(2, 4, "DISEASE"),  # nested/overlapping: the spancat case
+        Span(3, 4, "DISEASE", kb_id="Q29496"),
+    ]
+    d1.spans["other"] = [Span(0, 1, "VERB")]
+    d2 = Doc(words=["no", "groups"])  # empty spans must stay empty
+    p = tmp_path / "sg.spacy"
+    SD.write_docbin(p, [d1, d2])
+    got1, got2 = list(SD.read_docbin(p))
+    assert set(got1.spans) == {"sc", "other"}
+    assert [(s.start, s.end, s.label, s.kb_id) for s in got1.spans["sc"]] == [
+        (1, 4, "DISEASE", ""),
+        (2, 4, "DISEASE", ""),
+        (3, 4, "DISEASE", "Q29496"),
+    ]
+    assert [(s.start, s.end, s.label) for s in got1.spans["other"]] == [
+        (0, 1, "VERB")
+    ]
+    assert got2.spans == {}
+
+
+def test_span_groups_char_offsets_written():
+    # spaCy readers use start_char/end_char; check they encode the
+    # reconstructed text offsets
+    import struct
+
+    doc = Doc(words=["New", "York", "City"], spaces=[True, True, False])
+    doc.spans["sc"] = [Span(1, 3, "GPE")]
+    strings = set()
+    payload = SD._span_groups_to_bytes(doc, strings)
+    (group_bytes,) = msgpack.unpackb(payload, raw=False)
+    g = msgpack.unpackb(group_bytes, raw=False)
+    (_sid, _kb, _label, start, end, start_char, end_char) = struct.unpack(
+        ">QQQllll", g["spans"][0]
+    )
+    assert (start, end) == (1, 3)
+    assert (start_char, end_char) == (4, 13)  # "York City" in "New York City"
+    assert {"GPE", "sc"} <= strings
+
+
+def test_span_groups_old_6_field_layout_read():
+    # pre-3.4 SpanGroup bytes had no id field (>QQllll)
+    import struct
+
+    label = "EVENT"
+    h = SD.spacy_string_hash(label)
+    span_bytes = struct.pack(">QQllll", 0, h, 0, 2, 0, 9)
+    group = msgpack.packb(
+        {"name": "sc", "attrs": {}, "spans": [span_bytes]}, use_bin_type=True
+    )
+    payload = msgpack.packb([group], use_bin_type=True)
+    groups = SD._span_groups_from_bytes(payload, {h: label, 0: ""})
+    assert [(s.start, s.end, s.label) for s in groups["sc"]] == [(0, 2, "EVENT")]
+
+
+def test_spancat_trains_identically_from_jsonl_and_spacy(tmp_path):
+    """jsonl -> .spacy -> train-spancat reproduces the jsonl-trained scores
+    (VERDICT r2 missing #5 'Done' criterion)."""
+    from spacy_ray_tpu.cli import main as cli_main
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.training.loop import train
+    from spacy_ray_tpu.util import write_synth_jsonl
+
+    write_synth_jsonl(tmp_path / "train.jsonl", 100, kind="spancat", seed=0)
+    write_synth_jsonl(tmp_path / "dev.jsonl", 24, kind="spancat", seed=1)
+    for split in ("train", "dev"):
+        rc = cli_main(
+            [
+                "convert",
+                str(tmp_path / f"{split}.jsonl"),
+                str(tmp_path / f"{split}.spacy"),
+            ]
+        )
+        assert rc == 0
+
+    def run(train_path, dev_path):
+        cfg = Config.from_str(open("configs/spancat.cfg").read()).apply_overrides(
+            {
+                "paths.train": str(train_path),
+                "paths.dev": str(dev_path),
+                "training.max_steps": 16,
+                "training.eval_frequency": 8,
+                "components.tok2vec.model.width": 32,
+                "components.tok2vec.model.depth": 1,
+                "components.tok2vec.model.embed_size": 256,
+                "components.spancat.model.tok2vec.width": 32,
+                "components.textcat_multilabel.model.tok2vec.width": 32,
+            }
+        )
+        _, result = train(cfg, n_workers=1, stdout_log=False)
+        return result
+
+    r_jsonl = run(tmp_path / "train.jsonl", tmp_path / "dev.jsonl")
+    r_spacy = run(tmp_path / "train.spacy", tmp_path / "dev.spacy")
+    assert r_spacy.best_score == pytest.approx(r_jsonl.best_score, abs=1e-6), (
+        f"jsonl {r_jsonl.best_score} vs .spacy {r_spacy.best_score}"
+    )
