@@ -199,18 +199,10 @@ class EditTreeLemmatizerComponent(TaggerComponent):
             doc.lemmas = lemmas
 
     def score(self, examples: List[Example]) -> Dict[str, float]:
-        correct = total = 0
-        for eg in examples:
-            gold = eg.reference.lemmas
-            pred = eg.predicted.lemmas
-            if not gold or not pred:
-                continue
-            for g, p in zip(gold, pred):
-                if not g:
-                    continue
-                total += 1
-                correct += int(g == p)
-        return {"lemma_acc": correct / total if total else 0.0}
+        from ..scoring import score_token_acc
+
+        # spaCy lemma_acc semantics (exact match, None when unannotated)
+        return score_token_acc(examples, "lemma_acc", lambda d: d.lemmas)
 
 
 @registry.factories("trainable_lemmatizer")

@@ -236,18 +236,11 @@ class LemmatizerComponent(Component):
         return None  # host-side only
 
     def score(self, examples: List[Example]) -> Dict[str, float]:
-        correct = total = 0
-        for eg in examples:
-            gold = eg.reference.lemmas
-            pred = eg.predicted.lemmas
-            if not gold or not pred:
-                continue
-            for g, p in zip(gold, pred):
-                if not g:
-                    continue
-                total += 1
-                correct += int(g.lower() == p.lower())
-        return {"lemma_acc": correct / total if total else 0.0}
+        from ..scoring import score_token_acc
+
+        # spaCy lemma_acc: exact (case-sensitive) match, missing gold
+        # excluded, None when no gold lemmas exist anywhere
+        return score_token_acc(examples, "lemma_acc", lambda d: d.lemmas)
 
     # ------------------------------------------------------------------
     # serialization: the tables must survive to_disk/from_disk

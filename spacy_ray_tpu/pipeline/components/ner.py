@@ -141,33 +141,19 @@ class NERComponent(Component):
                 doc.ents = model_ents
 
     def score(self, examples: List[Example]) -> Dict[str, float]:
-        tp = fp = fn = 0
-        per_type: Dict[str, List[int]] = {l: [0, 0, 0] for l in self.labels}
-        for eg in examples:
-            gold = {(s.start, s.end, s.label) for s in eg.reference.ents}
-            pred = {(s.start, s.end, s.label) for s in eg.predicted.ents}
-            for p in pred:
-                if p in gold:
-                    tp += 1
-                    if p[2] in per_type:
-                        per_type[p[2]][0] += 1
-                else:
-                    fp += 1
-                    if p[2] in per_type:
-                        per_type[p[2]][1] += 1
-            for g in gold - pred:
-                fn += 1
-                if g[2] in per_type:
-                    per_type[g[2]][2] += 1
-        p = tp / (tp + fp) if tp + fp else 0.0
-        r = tp / (tp + fn) if tp + fn else 0.0
-        f = 2 * p * r / (p + r) if p + r else 0.0
-        scores = {"ents_p": p, "ents_r": r, "ents_f": f}
-        for label, (ltp, lfp, lfn) in per_type.items():
-            lp = ltp / (ltp + lfp) if ltp + lfp else 0.0
-            lr = ltp / (ltp + lfn) if ltp + lfn else 0.0
-            scores[f"ents_f_{label}"] = 2 * lp * lr / (lp + lr) if lp + lr else 0.0
-        return scores
+        from ..scoring import score_spans
+
+        # spaCy Scorer.score_spans semantics: docs without gold entity
+        # annotation are skipped entirely (predictions there are NOT false
+        # positives — Doc.has_ents_annotation carries the DocBin 0-vs-2
+        # missing/O distinction); per-type PRF beside the micro scores;
+        # None when no gold doc is annotated
+        return score_spans(
+            examples,
+            "ents",
+            lambda d: d.ents,
+            has_annotation=lambda d: d.has_ents_annotation,
+        )
 
 
 @registry.factories("ner")

@@ -196,27 +196,11 @@ class ParserComponent(Component):
                     doc.deps[j] = "ROOT"
 
     def score(self, examples: List[Example]) -> Dict[str, float]:
-        correct_u = correct_l = total = 0
-        for eg in examples:
-            gold_heads = eg.reference.heads
-            gold_deps = eg.reference.deps
-            pred_heads = eg.predicted.heads
-            pred_deps = eg.predicted.deps
-            if not gold_heads or not pred_heads:
-                continue
-            for j in range(min(len(gold_heads), len(pred_heads))):
-                total += 1
-                if gold_heads[j] == pred_heads[j]:
-                    correct_u += 1
-                    gd = gold_deps[j] if gold_deps else None
-                    pd = pred_deps[j] if pred_deps else None
-                    if gd is not None and (
-                        gd == pd or (gold_heads[j] == j and pd == "ROOT")
-                    ):
-                        correct_l += 1
-        uas = correct_u / total if total else 0.0
-        las = correct_l / total if total else 0.0
-        return {"dep_uas": uas, "dep_las": las}
+        from ..scoring import score_deps
+
+        # spaCy Scorer.score_deps semantics: gold-punct tokens excluded
+        # from UAS/LAS, labels compared lowercased, None when no gold parse
+        return score_deps(examples)
 
 
 @registry.factories("parser")

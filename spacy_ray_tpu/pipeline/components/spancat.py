@@ -199,24 +199,17 @@ class SpanCatComponent(Component):
         return (n_spans + sum(sizes) - k) // k
 
     def score(self, examples: List[Example]) -> Dict[str, float]:
-        tp = fp = fn = 0
-        for eg in examples:
-            gold = {
-                (s.start, s.end, s.label)
-                for s in eg.reference.spans.get(self.spans_key, [])
-            }
-            pred = {
-                (s.start, s.end, s.label)
-                for s in eg.predicted.spans.get(self.spans_key, [])
-            }
-            tp += len(gold & pred)
-            fp += len(pred - gold)
-            fn += len(gold - pred)
-        p = tp / (tp + fp) if tp + fp else 0.0
-        r = tp / (tp + fn) if tp + fn else 0.0
-        f = 2 * p * r / (p + r) if p + r else 0.0
+        from ..scoring import score_spans
+
         key = self.spans_key
-        return {f"spans_{key}_p": p, f"spans_{key}_r": r, f"spans_{key}_f": f}
+        # spaCy semantics: docs without the spans key are skipped (their
+        # predictions aren't false positives); key-present-but-empty counts
+        return score_spans(
+            examples,
+            f"spans_{key}",
+            lambda d: d.spans.get(key, []),
+            has_annotation=lambda d: key in d.spans,
+        )
 
 
 @registry.factories("spancat")

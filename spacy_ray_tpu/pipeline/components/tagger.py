@@ -71,17 +71,11 @@ class TaggerComponent(Component):
             doc.tags = [self.labels[t] for t in pred[i, :n]]
 
     def score(self, examples: List[Example]) -> Dict[str, float]:
-        correct = 0
-        total = 0
-        for eg in examples:
-            gold = eg.reference.tags or []
-            pred = eg.predicted.tags or []
-            for g, p in zip(gold, pred):
-                if not g:
-                    continue
-                total += 1
-                correct += int(g == p)
-        return {"tag_acc": (correct / total) if total else 0.0}
+        from ..scoring import score_token_acc
+
+        # spaCy Scorer.score_token_attr semantics: missing gold positions
+        # excluded; None (not 0.0) when no gold tags exist anywhere
+        return score_token_acc(examples, "tag_acc", lambda d: d.tags)
 
 
 @registry.factories("tagger")

@@ -64,17 +64,26 @@ class TextCatComponent(Component):
             doc.cats = {label: float(probs[i, j]) for j, label in enumerate(self.labels)}
 
     def score(self, examples: List[Example]) -> Dict[str, float]:
-        # micro-F over label decisions at threshold; accuracy for exclusive
-        tp = fp = fn = 0
+        # spaCy Scorer.score_cats surface: micro P/R/F over per-label
+        # decisions (gold positive at 0.5, prediction at the component
+        # threshold), macro F, per-type PRF (cats_f_per_type), macro ROC
+        # AUC (rank statistic; labels with one gold class are undefined and
+        # excluded), accuracy for mutually-exclusive cats. Docs with no
+        # gold cats are skipped; all keys None when none are annotated.
+        from ..scoring import PRF, rank_auc
+
+        micro = PRF()
+        per_label: Dict[str, PRF] = {l: PRF() for l in self.labels}
+        gold_by_label: Dict[str, List[int]] = {l: [] for l in self.labels}
+        score_by_label: Dict[str, List[float]] = {l: [] for l in self.labels}
         correct = total = 0
-        per_label_tp = {l: 0 for l in self.labels}
-        per_label_fp = {l: 0 for l in self.labels}
-        per_label_fn = {l: 0 for l in self.labels}
+        any_annotation = False
         for eg in examples:
             gold = eg.reference.cats
             pred = eg.predicted.cats
             if not gold:
                 continue
+            any_annotation = True
             if self.exclusive:
                 total += 1
                 g = max(gold, key=gold.get)
@@ -83,28 +92,49 @@ class TextCatComponent(Component):
             for label in self.labels:
                 gv = gold.get(label, 0.0) >= 0.5
                 pv = pred.get(label, 0.0) >= self.threshold
+                gold_by_label[label].append(int(gv))
+                score_by_label[label].append(float(pred.get(label, 0.0)))
+                prf = per_label[label]
                 if pv and gv:
-                    tp += 1
-                    per_label_tp[label] += 1
+                    micro.tp += 1
+                    prf.tp += 1
                 elif pv:
-                    fp += 1
-                    per_label_fp[label] += 1
+                    micro.fp += 1
+                    prf.fp += 1
                 elif gv:
-                    fn += 1
-                    per_label_fn[label] += 1
-        micro_p = tp / (tp + fp) if tp + fp else 0.0
-        micro_r = tp / (tp + fn) if tp + fn else 0.0
-        micro_f = 2 * micro_p * micro_r / (micro_p + micro_r) if micro_p + micro_r else 0.0
-        macro_fs = []
-        for label in self.labels:
-            ltp, lfp, lfn = per_label_tp[label], per_label_fp[label], per_label_fn[label]
-            p = ltp / (ltp + lfp) if ltp + lfp else 0.0
-            r = ltp / (ltp + lfn) if ltp + lfn else 0.0
-            macro_fs.append(2 * p * r / (p + r) if p + r else 0.0)
+                    micro.fn += 1
+                    prf.fn += 1
+        if not any_annotation:
+            return {
+                "cats_micro_p": None,
+                "cats_micro_r": None,
+                "cats_micro_f": None,
+                "cats_macro_f": None,
+                "cats_macro_auc": None,
+                "cats_f_per_type": None,
+                "cats_score": None,
+            }
+        aucs = [
+            a
+            for a in (
+                rank_auc(gold_by_label[l], score_by_label[l]) for l in self.labels
+            )
+            if a is not None
+        ]
         out = {
-            "cats_micro_f": micro_f,
-            "cats_macro_f": float(np.mean(macro_fs)) if macro_fs else 0.0,
-            "cats_score": micro_f,
+            "cats_micro_p": micro.precision,
+            "cats_micro_r": micro.recall,
+            "cats_micro_f": micro.fscore,
+            "cats_macro_f": (
+                float(np.mean([per_label[l].fscore for l in self.labels]))
+                if self.labels
+                else 0.0
+            ),
+            "cats_macro_auc": float(np.mean(aucs)) if aucs else None,
+            "cats_f_per_type": {
+                l: per_label[l].to_dict() for l in sorted(per_label)
+            },
+            "cats_score": micro.fscore,
         }
         if self.exclusive and total:
             out["cats_acc"] = correct / total

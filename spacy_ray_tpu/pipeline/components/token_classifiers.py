@@ -74,27 +74,16 @@ class MorphologizerComponent(TaggerComponent):
             doc.morphs = morphs
 
     def score(self, examples: List[Example]) -> Dict[str, float]:
-        pos_correct = morph_correct = total = 0
-        for eg in examples:
-            ref, pred = eg.reference, eg.predicted
-            if not (ref.pos or ref.morphs):
-                continue
-            n = min(len(ref), len(pred.pos or []))
-            for i in range(n):
-                gold = self._gold_label(ref, i)
-                if not gold:
-                    continue
-                total += 1
-                gp, _, gm = gold.partition("|")
-                if pred.pos and pred.pos[i] == gp:
-                    pos_correct += 1
-                pm = pred.morphs[i] if pred.morphs else ""
-                if pm == gm:
-                    morph_correct += 1
-        return {
-            "pos_acc": pos_correct / total if total else 0.0,
-            "morph_acc": morph_correct / total if total else 0.0,
-        }
+        from ..scoring import score_morph_per_feat, score_token_acc
+
+        # spaCy morphologizer surface: pos_acc + morph_acc (exact FEATS
+        # string) + morph_per_feat (independent PRF per UD feature); each
+        # None when that gold layer is absent everywhere
+        out: Dict[str, Any] = {}
+        out.update(score_token_acc(examples, "pos_acc", lambda d: d.pos))
+        out.update(score_token_acc(examples, "morph_acc", lambda d: d.morphs))
+        out.update(score_morph_per_feat(examples))
+        return out
 
 
 class SenterComponent(TaggerComponent):
@@ -128,23 +117,11 @@ class SenterComponent(TaggerComponent):
             doc.sent_starts = starts
 
     def score(self, examples: List[Example]) -> Dict[str, float]:
-        tp = fp = fn = 0
-        for eg in examples:
-            gold = eg.reference.sent_starts
-            pred = eg.predicted.sent_starts
-            if not gold or not pred:
-                continue
-            n = min(len(gold), len(pred))
-            # skip position 0: trivially a start
-            g = {i for i in range(1, n) if gold[i] == 1}
-            p = {i for i in range(1, n) if pred[i] == 1}
-            tp += len(g & p)
-            fp += len(p - g)
-            fn += len(g - p)
-        prec = tp / (tp + fp) if tp + fp else 0.0
-        rec = tp / (tp + fn) if tp + fn else 0.0
-        f = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
-        return {"sents_p": prec, "sents_r": rec, "sents_f": f}
+        from ..scoring import score_sents
+
+        # spaCy scores sentences as SPANS (both boundaries must match),
+        # not per boundary token — Scorer.score_spans over doc.sents
+        return score_sents(examples)
 
 
 @registry.factories("morphologizer")

@@ -47,6 +47,19 @@ class Doc:
     spans: Dict[str, List[Span]] = field(default_factory=dict)  # spancat groups
     # doc-level
     cats: Dict[str, float] = field(default_factory=dict)
+    # tri-state entity-annotation marker (spaCy's has_annotation("ENT_IOB")):
+    # True = annotated (empty ents means "no entities here" — predictions
+    # count as false positives), False = unannotated (the scorer skips the
+    # doc entirely), None = infer: annotated iff ents is non-empty. The
+    # DocBin reader sets it explicitly from the ENT_IOB column's 0-vs-2
+    # missing/O distinction.
+    ents_annotated: Optional[bool] = None
+
+    @property
+    def has_ents_annotation(self) -> bool:
+        if self.ents_annotated is not None:
+            return self.ents_annotated
+        return bool(self.ents)
 
     def __len__(self) -> int:
         return len(self.words)

@@ -186,15 +186,24 @@ class TrainResult:
 
 
 def weighted_score(scores: Dict[str, float], weights: Dict[str, float]) -> float:
+    """spaCy final-score semantics: None scores (no gold annotation for
+    that metric) are EXCLUDED rather than counted as 0."""
     if not weights:
-        # fall back: mean of all numeric scores
-        vals = [v for v in scores.values() if isinstance(v, (int, float))]
+        # fall back: mean of all numeric scores (None / nested excluded)
+        vals = [
+            v
+            for v in scores.values()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        ]
         return float(np.mean(vals)) if vals else 0.0
     total = 0.0
     for key, weight in weights.items():
         if weight in (None, 0.0):
             continue
-        total += float(scores.get(key, 0.0)) * float(weight)
+        value = scores.get(key)
+        if value is None:
+            continue
+        total += float(value) * float(weight)
     return total
 
 

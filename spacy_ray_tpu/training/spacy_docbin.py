@@ -323,6 +323,9 @@ def read_docbin_bytes(data: bytes) -> Iterator[Doc]:
         if "ENT_IOB" in col and "ENT_TYPE" in col:
             has_kb = "ENT_KB_ID" in col
             iob = rows[:, col["ENT_IOB"]].astype(np.int64)
+            # 0 everywhere = missing annotation; any 1/2/3 = annotated
+            # (even all-O) — the distinction spaCy's scorer skip honors
+            doc.ents_annotated = bool((iob != 0).any())
             start = None
             label = ""
             kb_id = ""
@@ -386,10 +389,11 @@ def write_docbin(path: Union[str, Path], docs: Iterable[Doc]) -> None:
         cats.append(dict(doc.cats) if doc.cats else {})
         flags.append({"has_unknown_spaces": doc.spaces is None})
         span_groups.append(_span_groups_to_bytes(doc, strings))
-        # no ents at all -> ENT_IOB 0 (missing annotation); writing explicit
-        # O everywhere would fabricate negative NER gold for consumers that
-        # honor the 0-vs-2 distinction (spaCy does)
-        ent_iob = np.full(n, 2 if doc.ents else 0, np.int64)
+        # unannotated -> ENT_IOB 0 (missing); annotated (even with zero
+        # entities, when ents_annotated says so) -> explicit O everywhere.
+        # Writing O for missing would fabricate negative NER gold for
+        # consumers that honor the 0-vs-2 distinction (spaCy does)
+        ent_iob = np.full(n, 2 if doc.has_ents_annotation else 0, np.int64)
         ent_type = [""] * n
         ent_kb = [""] * n
         for s in doc.ents:
