@@ -40,6 +40,25 @@ def _setup_device(device: str) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    elif device == "gpu":
+        # reference --gpu-id surface (train_cli.py:29): pin the platform so
+        # a CUDA-capable jax install fails loudly if no GPU is present
+        # instead of silently training on CPU; device *selection* within
+        # the platform stays with JAX (CUDA_VISIBLE_DEVICES for pinning)
+        import jax
+
+        prev = jax.config.jax_platforms
+        jax.config.update("jax_platforms", "cuda")
+        try:
+            jax.devices()  # init now: a missing backend raises opaquely later
+        except Exception as e:
+            # restore: the CLI exits anyway, but an embedding process (or
+            # the test suite) must not be left pinned to a dead platform
+            jax.config.update("jax_platforms", prev)
+            raise SystemExit(
+                "--device gpu: no usable CUDA backend in this jax install "
+                f"({type(e).__name__}: {e})"
+            )
     # tpu: default jax platform selection
 
 
@@ -66,7 +85,7 @@ def train_command(argv: List[str]) -> int:
                         help="jax.distributed coordinator address (multi-host)")
     parser.add_argument("--num-processes", type=int, default=None)
     parser.add_argument("--process-id", type=int, default=None)
-    parser.add_argument("--device", type=str, default="tpu", choices=["tpu", "cpu"])
+    parser.add_argument("--device", type=str, default="tpu", choices=["tpu", "cpu", "gpu"])
     parser.add_argument("--code", type=Path, default=None)
     parser.add_argument("--output", "-o", type=Path, default=None)
     parser.add_argument("--resume", action="store_true")
@@ -114,7 +133,7 @@ def evaluate_command(argv: List[str]) -> int:
     parser = argparse.ArgumentParser(prog="spacy_ray_tpu evaluate")
     parser.add_argument("model_path", type=Path)
     parser.add_argument("data_path", type=Path)
-    parser.add_argument("--device", type=str, default="tpu", choices=["tpu", "cpu"])
+    parser.add_argument("--device", type=str, default="tpu", choices=["tpu", "cpu", "gpu"])
     parser.add_argument(
         "--output", type=Path, default=None,
         help="write the metrics as JSON (spaCy's `evaluate --output` surface)",
@@ -203,7 +222,7 @@ def assemble_command(argv: List[str]) -> int:
     )
     parser.add_argument("config_path", type=Path)
     parser.add_argument("output_path", type=Path)
-    parser.add_argument("--device", type=str, default="cpu", choices=["tpu", "cpu"])
+    parser.add_argument("--device", type=str, default="cpu", choices=["tpu", "cpu", "gpu"])
     parser.add_argument("--code", type=Path, default=None)
     args, extra = parser.parse_known_args(argv)
     _setup_device(args.device)
@@ -452,7 +471,7 @@ def pretrain_command(argv: List[str]) -> int:
     parser.add_argument("config_path", type=Path)
     parser.add_argument("output_dir", type=Path)
     parser.add_argument("--n-workers", type=int, default=None, dest="n_workers")
-    parser.add_argument("--device", type=str, default="tpu", choices=["tpu", "cpu"])
+    parser.add_argument("--device", type=str, default="tpu", choices=["tpu", "cpu", "gpu"])
     parser.add_argument("--code", type=Path, default=None)
     parser.add_argument("--verbose", "-V", action="store_true")
     args, extra = parser.parse_known_args(argv)

@@ -150,3 +150,15 @@ def test_v1_architecture_aliases_resolve():
     ]:
         model = registry.resolve({"@architectures": name, **cfg})
         assert model is not None, name
+
+
+def test_device_gpu_fails_loudly_without_cuda():
+    # reference --gpu-id surface: in a CUDA-less install --device gpu must
+    # exit with a clear message, not silently train on CPU (and certainly
+    # not crash later with a bare AssertionError)
+    import pytest
+
+    from spacy_ray_tpu.cli import _setup_device
+
+    with pytest.raises(SystemExit, match="no usable CUDA backend"):
+        _setup_device("gpu")
