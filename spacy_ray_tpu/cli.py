@@ -50,7 +50,14 @@ def _setup_device(device: str) -> None:
         prev = jax.config.jax_platforms
         jax.config.update("jax_platforms", "cuda")
         try:
-            jax.devices()  # init now: a missing backend raises opaquely later
+            # init now: a missing backend raises opaquely later. In a
+            # process whose backends are ALREADY initialized, jax returns
+            # the cached platform instead of raising — check what we got.
+            devs = jax.devices()
+            if not devs or devs[0].platform not in ("gpu", "cuda"):
+                raise RuntimeError(
+                    f"got {devs[0].platform if devs else 'no'} devices"
+                )
         except Exception as e:
             # restore: the CLI exits anyway, but an embedding process (or
             # the test suite) must not be left pinned to a dead platform
@@ -148,7 +155,15 @@ def evaluate_command(argv: List[str]) -> int:
     examples = list(Corpus(args.data_path)())
     scores = nlp.evaluate(examples)
     for key, value in sorted(scores.items()):
-        print(f"{key:24s} {value:.4f}")
+        if isinstance(value, dict):
+            # per-type tables (ents_per_type, cats_f_per_type, ...)
+            for sub, prf in sorted(value.items()):
+                line = "  ".join(f"{m}={prf[m]:.4f}" for m in ("p", "r", "f"))
+                print(f"{key:24s} {sub:14s} {line}")
+        elif value is None:
+            print(f"{key:24s} -")  # no gold annotation for this metric
+        else:
+            print(f"{key:24s} {value:.4f}")
     if args.output is not None:
         import json
 

@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: full tier only
+
 from spacy_ray_tpu.config import Config
 from spacy_ray_tpu.models.parser import decode_parser, decode_parser_beam
 from spacy_ray_tpu.pipeline.language import Pipeline

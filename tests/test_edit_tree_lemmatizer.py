@@ -84,6 +84,7 @@ def _docs(n, seed=0):
     return docs
 
 
+@pytest.mark.slow
 def test_trainable_lemmatizer_trains(tmp_path):
     import jax
 

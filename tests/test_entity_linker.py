@@ -101,6 +101,7 @@ def test_kb_validates():
         kb.add_alias("x", ["A"], [1.5])
 
 
+@pytest.mark.slow
 def test_entity_linker_trains_and_links(tmp_path):
     kb = _kb()
     nlp = Pipeline.from_config(Config.from_str(CFG))

@@ -6,6 +6,8 @@ import json
 
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: full tier only
+
 from spacy_ray_tpu.config import Config
 from spacy_ray_tpu.training.loop import train
 from spacy_ray_tpu.training.corpus import _doc_to_json

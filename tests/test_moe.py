@@ -54,6 +54,7 @@ width = 32
 """
 
 
+@pytest.mark.slow
 def test_moe_ffn_routing_and_capacity():
     rng = jax.random.PRNGKey(0)
     p = transformer_layer_params(rng, width=8, ffn=16, n_experts=2)
@@ -121,6 +122,7 @@ def test_moe_expert_parallel_matches_single_device(moe_nlp):
     np.testing.assert_allclose(ep_X, dense_X, atol=2e-4, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_moe_trains(moe_nlp):
     nlp, egs = moe_nlp
     mesh = build_mesh(n_data=2, n_model=4)

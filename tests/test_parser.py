@@ -184,6 +184,7 @@ width = 64
 """
 
 
+@pytest.mark.slow
 def test_ner_learns_and_decode_is_constrained():
     import jax
     import optax

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: full tier only
+
 from spacy_ray_tpu.config import Config
 from spacy_ray_tpu.parallel import context as pctx
 from spacy_ray_tpu.parallel.mesh import build_mesh

@@ -95,6 +95,7 @@ def test_char_targets_bytes():
     assert out[1].sum() == 0
 
 
+@pytest.mark.slow
 def test_pretrain_learns_and_roundtrips(tmp_path, raw_jsonl):
     cfg = Config.from_str(CFG.format(raw=str(raw_jsonl)))
     out = tmp_path / "pretrain_out"
@@ -123,6 +124,7 @@ def test_pretrain_learns_and_roundtrips(tmp_path, raw_jsonl):
         np.testing.assert_array_equal(np.asarray(saved[k]), np.asarray(got[k]))
 
 
+@pytest.mark.slow
 def test_pretrain_partial_batch_divides_mesh(tmp_path, raw_jsonl):
     # batch_size 5 over 32 texts leaves a final partial batch of 2; every
     # batch must still collate to a multiple of the 8-device data axis

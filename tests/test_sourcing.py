@@ -3,6 +3,8 @@ nlp.pipe bulk inference."""
 
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy: full tier only
+
 from spacy_ray_tpu.config import Config
 from spacy_ray_tpu.pipeline.language import Pipeline
 from spacy_ray_tpu.training.loop import train

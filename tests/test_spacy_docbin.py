@@ -292,6 +292,7 @@ def test_span_groups_old_6_field_layout_read():
     assert [(s.start, s.end, s.label) for s in groups["sc"]] == [(0, 2, "EVENT")]
 
 
+@pytest.mark.slow
 def test_spancat_trains_identically_from_jsonl_and_spacy(tmp_path):
     """jsonl -> .spacy -> train-spancat reproduces the jsonl-trained scores
     (VERDICT r2 missing #5 'Done' criterion)."""

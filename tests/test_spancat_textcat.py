@@ -105,6 +105,7 @@ def _train(cfg_text, kind, steps=60, lr=3e-3):
     return nlp
 
 
+@pytest.mark.slow
 def test_spancat_learns():
     nlp = _train(SPANCAT_CFG, "spancat")
     dev = synth_corpus(40, "spancat", seed=5)
@@ -123,6 +124,7 @@ def test_textcat_multilabel_learns():
     assert all(eg.predicted.cats for eg in dev)
 
 
+@pytest.mark.slow
 def test_spancat_respects_threshold():
     nlp = _train(SPANCAT_CFG, "spancat", steps=30)
     comp = nlp.components["spancat"]
@@ -183,6 +185,7 @@ cats_macro_f = 1.0
     assert result.best_score > 0.6, f"BOW failed to learn: {result.best_score}"
 
 
+@pytest.mark.slow
 def test_textcat_ensemble_learns(tmp_path):
     """spacy.TextCatEnsemble.v2: neural + BOW summed."""
     from spacy_ray_tpu.training.loop import train

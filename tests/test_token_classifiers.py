@@ -1,5 +1,7 @@
 """morphologizer + senter component tests."""
 
+import pytest
+
 import random
 
 import jax
@@ -58,6 +60,7 @@ def _multi_sentence_doc(rng):
     return Doc(words=words, tags=tags, pos=tags, morphs=morphs, sent_starts=sent_starts)
 
 
+@pytest.mark.slow
 def test_morphologizer_and_senter_learn():
     rng = random.Random(0)
     examples = [Example.from_gold(_multi_sentence_doc(rng)) for _ in range(200)]

@@ -392,6 +392,7 @@ def test_use_gold_ents_false_without_annotator_rejected(tmp_path):
         train(Config.from_str(cfg_text), n_workers=1, stdout_log=False)
 
 
+@pytest.mark.slow
 def test_annotating_components_end_to_end_learns(tmp_path):
     # full loop: ruler annotates during training, linker reaches high
     # link F on a context-determined synthetic split

@@ -57,6 +57,7 @@ def trf_nlp():
     return nlp, examples
 
 
+@pytest.mark.slow
 def test_transformer_tagger_learns(trf_nlp):
     import optax
 
@@ -83,6 +84,7 @@ def test_transformer_tagger_learns(trf_nlp):
     assert scores["tag_acc"] > 0.8, scores
 
 
+@pytest.mark.slow
 def test_transformer_3d_mesh_step(trf_nlp):
     """One train step on a 2(data) x 2(model) x 2(context) mesh: real TP
     constraints + ring attention + gradient allreduce in one program."""

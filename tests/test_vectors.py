@@ -92,6 +92,7 @@ def test_vectors_roundtrip(tmp_path, vectors_file):
     np.testing.assert_array_equal(v2.table, v.table)
 
 
+@pytest.mark.slow
 def test_static_vectors_pipeline_trains_and_reloads(tmp_path, vectors_file):
     from spacy_ray_tpu.training.loop import train
 

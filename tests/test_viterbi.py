@@ -1,5 +1,7 @@
 """Viterbi BILUO decode: exactness vs brute force, dominance over greedy."""
 
+import pytest
+
 import itertools
 
 import jax.numpy as jnp
@@ -47,6 +49,7 @@ def brute_force(logits, length, n_labels):
     return best_score
 
 
+@pytest.mark.slow
 def test_viterbi_matches_brute_force():
     rng = np.random.default_rng(0)
     for _ in range(20):
