@@ -126,10 +126,18 @@ def make_train_step(
         with pctx.use_mesh(mesh):
             return jitted(params, opt_state, tokens, targets, rng)
 
+    def lower(params, opt_state, tokens, targets, rng):
+        # same mesh install as ``run``: model code consults the mesh at
+        # trace time, and lowering traces without executing (used by
+        # bench.py for XLA cost analysis — FLOPs/step for MFU accounting)
+        with pctx.use_mesh(mesh):
+            return jitted.lower(params, opt_state, tokens, targets, rng)
+
     run.mesh = mesh
     run.batch_shard = batch_shard
     run.replicated = repl
     run.opt_shardings = opt_sh
+    run.lower = lower
     return run
 
 
