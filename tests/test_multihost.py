@@ -22,7 +22,7 @@ import pytest
 from spacy_ray_tpu.util import write_synth_jsonl
 
 CHILD = Path(__file__).parent / "multihost_child.py"
-TIMEOUT = 420
+TIMEOUT = 600
 
 
 def _free_port() -> int:
@@ -87,3 +87,22 @@ def test_two_process_train(tmp_path):
     line0 = [l for l in outs[0].splitlines() if l.startswith("CHILD_OK")][0]
     line1 = [l for l in outs[1].splitlines() if l.startswith("CHILD_OK")][0]
     assert line0.split("rank=0 ")[1] == line1.split("rank=1 ")[1]
+
+    # annotating_components multi-host vs single-process (VERDICT r3 next
+    # #2): the same annotating config trained in THIS process (one host,
+    # 8 virtual devices, unsharded stream) must land in the same quality
+    # band as the 2-process run — batches differ (per-host sharding), so
+    # the comparison is converged-score proximity, not bit identity.
+    mh_ann = float(line0.split("ann_score=")[1].split()[0])
+    from multihost_child import CFG_TEMPLATE
+
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.training.loop import train as sp_train
+
+    cfg = CFG_TEMPLATE.format(data_dir=tmp_path)
+    cfg = cfg.replace("[training]\n", '[training]\nannotating_components = ["tagger"]\n', 1)
+    _, sp_res = sp_train(Config.from_str(cfg), stdout_log=False)
+    assert abs(sp_res.best_score - mh_ann) <= 0.1, (
+        f"single-process annotating score {sp_res.best_score} vs "
+        f"multi-host {mh_ann}"
+    )
