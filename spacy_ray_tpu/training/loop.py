@@ -307,12 +307,27 @@ def train(
             # shuffle order (restore the corpus's own epoch counter — it may
             # be offset from the loop's epoch by initialize() passes), then
             # fast-forward past the batches already consumed. On multi-host,
-            # rank 0's position is saved for everyone; per-host epoch
-            # boundaries can drift when shards are unequal, so cross-host
-            # resume is exact for rank 0 and off by at most one batch
-            # elsewhere.
+            # the checkpoint carries EVERY rank's (epoch, batches_in_epoch,
+            # corpus_epoch) — per-host epoch boundaries drift when shards
+            # are unequal, so each rank fast-forwards to its OWN position
+            # (VERDICT r3 next #4; rank-0 scalars kept for old checkpoints).
             resume_skip = int(ckpt["extra"].get("batches_in_epoch", 0))
             corpus_epoch = ckpt["extra"].get("corpus_epoch")
+            per_rank = ckpt["extra"].get("per_rank_positions")
+            if per_rank is not None:
+                if len(per_rank) == jax.process_count():
+                    my_epoch, my_skip, my_corpus_epoch = per_rank[jax.process_index()]
+                    epoch = int(my_epoch)
+                    resume_skip = int(my_skip)
+                    corpus_epoch = int(my_corpus_epoch)
+                else:
+                    print(
+                        f"[resume] checkpoint was written by {len(per_rank)} "
+                        f"processes but this run has {jax.process_count()}; "
+                        "data position restored from rank 0's scalars "
+                        "(approximate — the stream sharding changed)",
+                        flush=True,
+                    )
             if corpus_epoch is not None and hasattr(train_corpus, "_epoch"):
                 train_corpus._epoch = int(corpus_epoch)
 
@@ -644,6 +659,28 @@ def train(
                     if output_path is not None
                     else None
                 )
+                # every rank's data position, gathered on EVERY process (a
+                # collective — all hosts reach this block in lockstep, step
+                # counters are global); saved by rank 0 so each rank can
+                # fast-forward to its own exact position on resume
+                per_rank_pos = None
+                if output_path is not None and process_count > 1:
+                    from jax.experimental import multihost_utils
+
+                    per_rank_pos = (
+                        multihost_utils.process_allgather(
+                            np.array(
+                                [
+                                    group["cur_epoch"],
+                                    group["batches_in_epoch"],
+                                    group["corpus_epoch"],
+                                ],
+                                np.int64,
+                            )
+                        )
+                        .reshape(-1, 3)
+                        .tolist()
+                    )
                 eval_t0 = time.perf_counter()
                 scores = nlp.evaluate(dev_examples, eval_src, mesh=mesh)
                 eval_seconds = time.perf_counter() - eval_t0
@@ -688,6 +725,11 @@ def train(
                             # prefetched-ahead) producer counters
                             "batches_in_epoch": group["batches_in_epoch"],
                             "corpus_epoch": group["corpus_epoch"],
+                            **(
+                                {"per_rank_positions": per_rank_pos}
+                                if per_rank_pos is not None
+                                else {}
+                            ),
                         },
                     )
             log_step(info)

@@ -178,6 +178,55 @@ def main() -> int:
         f"{res_ann.best_score} vs {result.best_score}"
     )
 
+    # --- exact per-rank resume (VERDICT r3 next #4) ---
+    # resume_train.jsonl: 9 same-length docs -> 5 vs 4 docs/epoch per rank
+    # -> 3 vs 2 batches/epoch (size=40 packs two 20-token docs) -> the
+    # ranks' (epoch, batches_in_epoch) drift apart after the first epoch
+    # rollover. The interrupted-and-resumed run must reproduce the
+    # uninterrupted run BIT-FOR-BIT on both ranks; pre-fix, rank 1 resumed
+    # from rank 0's saved position and silently trained on the wrong
+    # batch sequence.
+    from pathlib import Path
+
+    from spacy_ray_tpu.training.checkpoint import TrainCheckpoint
+
+    def resume_cfg():
+        text = (
+            CFG_TEMPLATE.format(data_dir=data_dir)
+            .replace(f"{data_dir}/train.jsonl", f"{data_dir}/resume_train.jsonl")
+            .replace("max_epochs = 3", "max_epochs = 0")
+            .replace("accumulate_gradient = 2", "accumulate_gradient = 1")
+            .replace("size = 300", "size = 40")
+        )
+        return Config.from_str(text)
+
+    out_dir = Path(data_dir) / "resume_out"
+    nlp_a, _ = train(resume_cfg(), max_steps_override=8, stdout_log=False)
+    nlp_b, _ = train(
+        resume_cfg(), output_path=out_dir, max_steps_override=4, stdout_log=False
+    )
+    # barrier: rank 1 must not read the checkpoint before rank 0's writes
+    # (which happen inside its train()) are all flushed
+    multihost_utils.sync_global_devices("resume_checkpoint_written")
+    ck = TrainCheckpoint.load(out_dir / "last-model")
+    pos = ck["extra"].get("per_rank_positions")
+    assert pos is not None and len(pos) == 2, f"per-rank positions missing: {pos}"
+    assert pos[0] != pos[1], (
+        f"per-rank positions did not drift — test corpus no longer "
+        f"discriminates: {pos}"
+    )
+    nlp_c, _ = train(
+        resume_cfg(), output_path=out_dir, resume=True, max_steps_override=8,
+        stdout_log=False,
+    )
+    leaves_a = jax.tree_util.tree_leaves(nlp_a.params)
+    leaves_c = jax.tree_util.tree_leaves(nlp_c.params)
+    assert len(leaves_a) == len(leaves_c)
+    for la, lc in zip(leaves_a, leaves_c):
+        assert np.array_equal(np.asarray(la), np.asarray(lc)), (
+            "resumed run diverged from uninterrupted run"
+        )
+
     print(
         f"CHILD_OK rank={rank} words={result.words_seen} "
         f"step={result.final_step} score={result.best_score:.4f} "

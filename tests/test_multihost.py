@@ -39,6 +39,22 @@ def test_two_process_train(tmp_path):
     write_synth_jsonl(tmp_path / "train.jsonl", 151, kind="tagger", seed=0)
     write_synth_jsonl(tmp_path / "dev.jsonl", 30, kind="tagger", seed=1)
 
+    # For the per-rank resume check: 9 SAME-LENGTH docs round-robin over 2
+    # hosts -> always 5 vs 4 docs/epoch -> different batches-per-epoch ->
+    # the ranks' (epoch, position) drift apart deterministically after the
+    # first epoch rollover, whatever the shuffle order.
+    import json as _json
+    import random as _random
+
+    from spacy_ray_tpu.training.corpus import _doc_to_json
+    from spacy_ray_tpu.util import synth_tagged_doc
+
+    _rng = _random.Random(7)
+    with open(tmp_path / "resume_train.jsonl", "w") as f:
+        for _ in range(9):
+            doc = synth_tagged_doc(_rng, min_len=20, max_len=20)
+            f.write(_json.dumps(_doc_to_json(doc)) + "\n")
+
     # Children pick their own platform/device count via jax.config (the
     # reliable seam on this image); scrub the parent harness's env so the
     # conftest's 8-device setting doesn't leak into them.
