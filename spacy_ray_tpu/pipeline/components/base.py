@@ -37,6 +37,12 @@ class Component:
     sets_ents: bool = False
     #: does this component produce a trainable loss?
     trainable: bool = True
+    #: default [training] score weights contributed by this component when
+    #: the config declares none — spaCy's per-factory default_score_weights
+    #: metadata (combined and normalized in the loop, spacy
+    #: util.combine_score_weights semantics). Keys are OUR emitted score
+    #: keys; 0.0 marks a score that's reported but unweighted.
+    default_score_weights: Dict[str, float] = {}
 
     def __init__(self, name: str, model_cfg: Dict[str, Any]):
         self.name = name

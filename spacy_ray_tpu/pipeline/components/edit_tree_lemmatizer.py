@@ -99,6 +99,9 @@ def tree_from_key(key: str) -> Tree:
 
 
 class EditTreeLemmatizerComponent(TaggerComponent):
+
+    default_score_weights = {"lemma_acc": 1.0}
+
     def __init__(
         self,
         name: str,

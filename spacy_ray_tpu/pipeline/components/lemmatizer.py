@@ -95,6 +95,9 @@ _EN_EXCEPTIONS: Dict[str, Dict[str, str]] = {
 
 
 class LemmatizerComponent(Component):
+
+    default_score_weights = {"lemma_acc": 1.0}
+
     trainable = False
     listens = False
 

@@ -53,6 +53,9 @@ def _bucket_mentions(n: int) -> int:
 
 
 class EntityLinkerComponent(Component):
+
+    default_score_weights = {"nel_micro_f": 1.0, "nel_micro_p": 0.0, "nel_micro_r": 0.0}
+
     def __init__(
         self,
         name: str,

@@ -55,6 +55,9 @@ def action_to_biluo(action: int, labels: List[str]) -> str:
 
 
 class NERComponent(Component):
+
+    default_score_weights = {"ents_f": 1.0, "ents_p": 0.0, "ents_r": 0.0}
+
     sets_ents = True
     def __init__(self, name, model_cfg, decode: str = "viterbi"):
         super().__init__(name, model_cfg)

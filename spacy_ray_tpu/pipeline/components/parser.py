@@ -30,6 +30,9 @@ from .base import Component
 
 
 class ParserComponent(Component):
+
+    default_score_weights = {"dep_uas": 0.5, "dep_las": 0.5}
+
     def __init__(self, name, model_cfg, beam_width: int = 1):
         super().__init__(name, model_cfg)
         self.beam_width = int(beam_width)

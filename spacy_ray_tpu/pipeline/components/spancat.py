@@ -123,6 +123,12 @@ class SpanCatComponent(Component):
         self.spans_key = spans_key
         self.threshold = threshold
         self.max_positive = max_positive
+        # per-instance: the score keys carry the configured spans_key
+        self.default_score_weights = {
+            f"spans_{spans_key}_f": 1.0,
+            f"spans_{spans_key}_p": 0.0,
+            f"spans_{spans_key}_r": 0.0,
+        }
 
     def add_labels_from(self, examples) -> None:
         labels = set(self.labels)

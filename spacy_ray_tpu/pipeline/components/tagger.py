@@ -20,6 +20,9 @@ from .base import Component
 
 
 class TaggerComponent(Component):
+
+    default_score_weights = {"tag_acc": 1.0}
+
     def add_labels_from(self, examples) -> None:
         labels = set(self.labels)
         for eg in examples:

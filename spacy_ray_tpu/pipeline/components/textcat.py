@@ -18,6 +18,9 @@ from .base import Component
 
 
 class TextCatComponent(Component):
+
+    default_score_weights = {"cats_score": 1.0}
+
     def __init__(self, name: str, model_cfg: Dict[str, Any], exclusive: bool, threshold: float = 0.5):
         super().__init__(name, model_cfg)
         self.exclusive = exclusive

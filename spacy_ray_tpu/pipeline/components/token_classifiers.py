@@ -26,6 +26,8 @@ from .tagger import TaggerComponent
 
 
 class MorphologizerComponent(TaggerComponent):
+
+    default_score_weights = {"pos_acc": 0.5, "morph_acc": 0.5}
     @staticmethod
     def _gold_label(doc: Doc, i: int) -> str:
         pos = doc.pos[i] if doc.pos else ""
@@ -88,6 +90,8 @@ class MorphologizerComponent(TaggerComponent):
 
 class SenterComponent(TaggerComponent):
     """Binary sentence-start classifier. Labels fixed: ["I", "S"]."""
+
+    default_score_weights = {"sents_f": 1.0, "sents_p": 0.0, "sents_r": 0.0}
 
     def add_labels_from(self, examples) -> None:
         self.labels = ["I", "S"]
