@@ -167,3 +167,37 @@ def test_sharded_eval_matches_replicated(tagger_config_text, data_dir):
     assert plain.keys() == sharded.keys()
     for k in plain:
         assert plain[k] == pytest.approx(sharded[k], abs=1e-6), k
+
+
+def test_console_logger_elapsed_column_and_progress(tagger_config_text, data_dir, tmp_path):
+    """The console table leads with a wall-clock elapsed column (reference
+    loggers.py:52) and progress_bar=True draws/clears an in-place bar on
+    stderr between rows."""
+    import io
+    import re
+
+    from spacy_ray_tpu.registry import registry
+    from spacy_ray_tpu.training.loop import train
+    from spacy_ray_tpu.config import Config
+
+    cfg = Config.from_str(tagger_config_text).apply_overrides(
+        {
+            "paths.train": str(data_dir / "train.jsonl"),
+            "paths.dev": str(data_dir / "dev.jsonl"),
+        }
+    )
+    nlp = __import__("spacy_ray_tpu.pipeline.language", fromlist=["Pipeline"]).Pipeline.from_config(cfg)
+    setup = registry.get("loggers", "spacy_ray_tpu.ConsoleLogger.v1")(progress_bar=True)
+    out, err = io.StringIO(), io.StringIO()
+    log_step, finalize = setup(nlp, out, err)
+    header = out.getvalue().splitlines()[0]
+    assert header.split()[0] == "T"
+    log_step(None)  # non-eval step -> progress bar on stderr
+    assert "1/" in err.getvalue() or "+1" in err.getvalue()
+    log_step(
+        {"epoch": 0, "step": 5, "words": 100, "losses": {}, "other_scores": {},
+         "score": 0.5, "wps": 10.0, "eval_seconds": 0.1}
+    )
+    finalize()
+    row = out.getvalue().splitlines()[2]
+    assert re.match(r"\s*\d+:\d\d:\d\d\b", row), row
