@@ -336,6 +336,7 @@ def TransformerEncoder(
     max_len: int = 512,
     embed_size: int = 10000,
     remat: bool = True,
+    remat_policy: str = "dots",
     init_weights: Optional[str] = None,
     pp_microbatches: int = 0,
     n_experts: int = 0,
@@ -352,7 +353,12 @@ def TransformerEncoder(
 
     ``remat=True`` wraps each layer in jax.checkpoint — rematerialize
     activations in backward to trade FLOPs for HBM (the standard TPU
-    memory/bandwidth tradeoff for deep trunks).
+    memory/bandwidth tradeoff for deep trunks). ``remat_policy`` picks
+    WHAT is saved: "dots" (default) saves weight-matmul outputs and
+    recomputes only cheap elementwise/norm/attention-score work — ~25%
+    fewer backward FLOPs than full recompute for a modest HBM cost;
+    "all_dots" additionally saves batched (attention) matmuls; "nothing"
+    is full recompute (the pre-round-4 behavior, minimum memory).
 
     ``pp_microbatches``: microbatch count for pipeline parallelism; used
     only when the active mesh has a ``pipe`` axis > 1 (0 = auto: 2x the
@@ -427,7 +433,22 @@ def TransformerEncoder(
         )
         if remat:
             # checkpointed callable takes only pytree args (p, X, mask, rng)
-            layer_fn = jax.checkpoint(layer_fn)
+            policies = {
+                "nothing": None,  # full recompute
+                "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                "all_dots": jax.checkpoint_policies.dots_saveable,
+            }
+            if remat_policy not in policies:
+                raise ValueError(
+                    f"remat_policy must be one of {sorted(policies)}, "
+                    f"got {remat_policy!r}"
+                )
+            policy = policies[remat_policy]
+            layer_fn = (
+                jax.checkpoint(layer_fn, policy=policy)
+                if policy is not None
+                else jax.checkpoint(layer_fn)
+            )
         if pctx.pipeline_active():
             X, aux_total = _pipelined_layers(
                 params, X, mask, ctx, layer_fn, depth=depth,
