@@ -724,6 +724,12 @@ def main() -> None:
         "(overrides --probe-retries) — for unattended runs that should "
         "start the moment the accelerator comes back",
     )
+    parser.add_argument(
+        "--tpu-only", action="store_true",
+        help="parent mode: if the accelerator never serves, exit WITHOUT "
+        "the CPU fallback — for a background campaign that must not "
+        "contend with a separate CPU bench run at round end",
+    )
     args = parser.parse_args()
 
     import os
@@ -751,6 +757,10 @@ def main() -> None:
                 time.sleep(60)
                 tpu_ok = _accelerator_reachable()
         if not tpu_ok:
+            if args.tpu_only:
+                print("# accelerator never served and --tpu-only is set; "
+                      "exiting without the CPU fallback", flush=True)
+                return
             print("# accelerator backend unreachable; falling back to CPU",
                   flush=True)
             _print_recorded_tpu_results()
