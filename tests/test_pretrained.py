@@ -169,3 +169,64 @@ def test_unrecognized_schema_raises_instead_of_silent_random_init(tmp_path):
     PT.write_safetensors(st, bad)
     with pytest.raises(ValueError, match="matched the trunk schema"):
         _build(seed=0, init_weights=st)
+
+
+def test_real_transformers_checkpoint_remap_and_attention_parity(tmp_path):
+    """External-oracle check (torch + transformers are in-image): a REAL
+    HuggingFace BertModel checkpoint — written by transformers'
+    save_pretrained, not a synthetic dict — must be recognized and
+    remapped, and the remapped attention sublayer must reproduce torch's
+    self-attention + output projection numerically (catches the classic
+    transpose / head-ordering / q-k-v-fusion bugs that shape checks
+    can't)."""
+    torch = pytest.importorskip("torch")
+    tfm = pytest.importorskip("transformers")
+
+    cfg = tfm.BertConfig(
+        hidden_size=32,
+        num_attention_heads=4,
+        num_hidden_layers=2,
+        intermediate_size=64,
+        vocab_size=100,
+        max_position_embeddings=16,
+    )
+    torch.manual_seed(0)
+    model = tfm.BertModel(cfg)
+    model.eval()
+    model.save_pretrained(tmp_path / "hf", safe_serialization=True)
+
+    flat = PT.load_flat(tmp_path / "hf")
+    assert PT.looks_like_hf_encoder(flat)
+    native = PT.hf_encoder_to_native(flat, native_pos_rows=16)
+    for i in range(2):
+        for key in ("qkv_W", "qkv_b", "o_W", "o_b", "ffn_W1", "ffn_W2",
+                    "ln1_g", "ln2_g"):
+            assert f"layer_{i}/{key}" in native, sorted(native)[:8]
+    assert native["layer_0/qkv_W"].shape == (32, 96)
+    assert native["pos"].shape == (16, 32)  # BERT: all rows kept
+
+    # --- numerical parity of the attention sublayer ---
+    B, T, D, H = 1, 5, 32, 4
+    Dh = D // H
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((B, T, D)).astype(np.float32)
+
+    layer = model.encoder.layer[0]
+    with torch.no_grad():
+        ctx = layer.attention.self(torch.from_numpy(x))[0]
+        want = layer.attention.output.dense(ctx).numpy()
+
+    qkv = x @ native["layer_0/qkv_W"] + native["layer_0/qkv_b"]
+    q, k, v = np.split(qkv, 3, axis=-1)
+
+    def heads(a):  # [B, T, D] -> [B, H, T, Dh]
+        return a.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+
+    scores = heads(q) @ heads(k).transpose(0, 1, 3, 2) / np.sqrt(Dh)
+    scores = scores - scores.max(axis=-1, keepdims=True)
+    probs = np.exp(scores)
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    merged = (probs @ heads(v)).transpose(0, 2, 1, 3).reshape(B, T, D)
+    got = merged @ native["layer_0/o_W"] + native["layer_0/o_b"]
+
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
