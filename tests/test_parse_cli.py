@@ -1,0 +1,69 @@
+"""`parse` command: bulk parallel inference over a corpus (the reference
+README advertises `spacy ray parse` as planned surface, README.md:15).
+Covers: training a model, parsing .spacy input sharded over the 8-device
+mesh, raw-.txt input through the tokenizer, and jsonl/.spacy outputs."""
+
+import json
+
+import pytest
+
+from spacy_ray_tpu.cli import main as cli_main
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.util import write_synth_jsonl
+
+pytestmark = pytest.mark.slow  # trains a model first
+
+
+@pytest.fixture(scope="module")
+def trained_model(tagger_config_text, tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("parse_model")
+    write_synth_jsonl(tmp / "train.jsonl", 120, kind="tagger", seed=0)
+    write_synth_jsonl(tmp / "dev.jsonl", 30, kind="tagger", seed=1)
+    from spacy_ray_tpu.training.loop import train
+
+    cfg = Config.from_str(tagger_config_text).apply_overrides(
+        {
+            "paths.train": str(tmp / "train.jsonl"),
+            "paths.dev": str(tmp / "dev.jsonl"),
+            "training.max_steps": 40,
+        }
+    )
+    train(cfg, output_path=tmp / "out", n_workers=1, stdout_log=False)
+    return tmp / "out" / "best-model"
+
+
+def test_parse_spacy_input_jsonl_output(trained_model, tmp_path):
+    write_synth_jsonl(tmp_path / "in.jsonl", 40, kind="tagger", seed=2)
+    assert cli_main([
+        "convert", str(tmp_path / "in.jsonl"), str(tmp_path / "in.spacy"),
+    ]) == 0
+    assert cli_main([
+        "parse", str(trained_model), str(tmp_path / "in.spacy"),
+        str(tmp_path / "out.jsonl"), "--device", "cpu",
+    ]) == 0
+    rows = [json.loads(l) for l in (tmp_path / "out.jsonl").read_text().splitlines()]
+    assert len(rows) == 40
+    # predictions, not gold: every doc must carry model-assigned tags
+    assert all(r.get("tags") and all(t for t in r["tags"]) for r in rows)
+
+
+def test_parse_txt_input_docbin_output(trained_model, tmp_path):
+    (tmp_path / "raw.txt").write_text("the cat runs .\nthe dog sleeps .\n")
+    assert cli_main([
+        "parse", str(trained_model), str(tmp_path / "raw.txt"),
+        str(tmp_path / "out.spacy"), "--device", "cpu",
+    ]) == 0
+    from spacy_ray_tpu.training.corpus import _iter_path
+
+    docs = list(_iter_path(tmp_path / "out.spacy"))
+    assert len(docs) == 2
+    assert [t for t in docs[0].words] == ["the", "cat", "runs", "."]
+    assert all(docs[0].tags), docs[0].tags
+
+
+def test_parse_empty_input_fails_loudly(trained_model, tmp_path):
+    (tmp_path / "empty.txt").write_text("")
+    assert cli_main([
+        "parse", str(trained_model), str(tmp_path / "empty.txt"),
+        str(tmp_path / "out.jsonl"), "--device", "cpu",
+    ]) == 1
