@@ -227,6 +227,24 @@ def main() -> int:
             "resumed run diverged from uninterrupted run"
         )
 
+    # --- multi-host parse: each process annotates a round-robin shard of
+    # the input and writes its own output part (cli.py parse_command) ---
+    from spacy_ray_tpu.cli import main as cli_main
+
+    parse_out = Path(data_dir) / "parsed.jsonl"
+    rc = cli_main([
+        "parse", str(out_dir / "last-model"), f"{data_dir}/dev.jsonl",
+        str(parse_out), "--device", "cpu",
+    ])
+    assert rc == 0
+    my_part = parse_out.with_name(f"{parse_out.stem}.part{rank}{parse_out.suffix}")
+    assert my_part.exists(), f"missing per-rank parse output {my_part}"
+    import json as _json
+
+    rows = [_json.loads(l) for l in my_part.read_text().splitlines()]
+    assert len(rows) == 15, len(rows)  # 30 dev docs round-robin over 2 hosts
+    assert all(r.get("tags") for r in rows)
+
     print(
         f"CHILD_OK rank={rank} words={result.words_seen} "
         f"step={result.final_step} score={result.best_score:.4f} "
