@@ -201,3 +201,24 @@ def test_console_logger_elapsed_column_and_progress(tagger_config_text, data_dir
     finalize()
     row = out.getvalue().splitlines()[2]
     assert re.match(r"\s*\d+:\d\d:\d\d\b", row), row
+
+
+def test_profile_flag_writes_trace(tagger_config_text, data_dir, tmp_path):
+    """--profile captures a jax.profiler trace of steps 5-15 (SURVEY §5.1:
+    tracing is first-class here, unlike the reference's unwired timers)."""
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.training.loop import train
+
+    cfg = Config.from_str(tagger_config_text).apply_overrides(
+        {
+            "paths.train": str(data_dir / "train.jsonl"),
+            "paths.dev": str(data_dir / "dev.jsonl"),
+            "training.max_steps": 20,
+            "training.eval_frequency": 10,
+        }
+    )
+    train(cfg, n_workers=1, stdout_log=False, profile_dir=tmp_path / "trace")
+    produced = list((tmp_path / "trace").rglob("*"))
+    assert any(p.is_file() for p in produced), (
+        f"no profiler artifacts under {tmp_path/'trace'}: {produced}"
+    )
