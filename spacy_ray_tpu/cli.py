@@ -703,10 +703,88 @@ def parse_command(argv: List[str]) -> int:
     return 0
 
 
+def find_threshold_command(argv: List[str]) -> int:
+    """Sweep a component's decision threshold against dev data and report
+    the best value — spaCy's `find-threshold` surface for spancat /
+    textcat_multilabel / entity_linker-style thresholded components."""
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu find-threshold")
+    parser.add_argument("model_path", type=Path)
+    parser.add_argument("data_path", type=Path)
+    parser.add_argument("pipe_name", type=str)
+    parser.add_argument("--threshold-key", type=str, default="threshold",
+                        help="component attribute to sweep")
+    parser.add_argument("--scores-key", type=str, default=None,
+                        help="score metric to maximize (default: the "
+                        "component's positively-weighted default score)")
+    parser.add_argument("--n-trials", type=int, default=11)
+    parser.add_argument("--device", type=str, default="tpu",
+                        choices=["tpu", "cpu", "gpu"])
+    args = parser.parse_args(argv)
+    _setup_device(args.device)
+
+    from .pipeline.language import Pipeline
+    from .training.corpus import Corpus
+
+    nlp = Pipeline.from_disk(args.model_path)
+    if args.pipe_name not in nlp.pipe_names:
+        print(
+            f"No component {args.pipe_name!r} in pipeline "
+            f"(have: {', '.join(nlp.pipe_names)})", file=sys.stderr,
+        )
+        return 1
+    comp = nlp.components[args.pipe_name]
+    if not hasattr(comp, args.threshold_key):
+        print(
+            f"[components.{args.pipe_name}] has no attribute "
+            f"{args.threshold_key!r} to sweep", file=sys.stderr,
+        )
+        return 1
+    scores_key = args.scores_key
+    if scores_key is None:
+        positive = [
+            k for k, v in (getattr(comp, "default_score_weights", None) or {}).items()
+            if v and v > 0
+        ]
+        if not positive:
+            print(
+                f"--scores-key required: [components.{args.pipe_name}] "
+                "declares no default score weights", file=sys.stderr,
+            )
+            return 1
+        scores_key = positive[0]
+
+    examples = list(Corpus(args.data_path)())
+    if not examples:
+        print(f"No documents in {args.data_path}", file=sys.stderr)
+        return 1
+
+    n = max(int(args.n_trials), 2)
+    best = (None, -1.0)
+    for i in range(n):
+        t = i / (n - 1)
+        setattr(comp, args.threshold_key, t)
+        scores = nlp.evaluate(examples)
+        value = scores.get(scores_key)
+        shown = f"{value:.4f}" if value is not None else "-"
+        print(f"threshold={t:.3f}  {scores_key}={shown}")
+        if value is not None and value > best[1]:
+            best = (t, float(value))
+    if best[0] is None:
+        print(f"{scores_key} was None at every threshold (no gold "
+              "annotation for this metric in the dev data?)", file=sys.stderr)
+        return 1
+    print(
+        f"Best: {args.threshold_key}={best[0]:.3f} ({scores_key}={best[1]:.4f}) "
+        f"— set [components.{args.pipe_name}] {args.threshold_key} = {best[0]:.3f}"
+    )
+    return 0
+
+
 COMMANDS = {
     "train": train_command,
     "pretrain": pretrain_command,
     "parse": parse_command,
+    "find-threshold": find_threshold_command,
     "evaluate": evaluate_command,
     "convert": convert_command,
     "init-config": init_config_command,

@@ -67,3 +67,82 @@ def test_parse_empty_input_fails_loudly(trained_model, tmp_path):
         "parse", str(trained_model), str(tmp_path / "empty.txt"),
         str(tmp_path / "out.jsonl"), "--device", "cpu",
     ]) == 1
+
+
+TEXTCAT_CFG = """
+[paths]
+train = null
+dev = null
+
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","textcat_multilabel"]
+
+[components]
+[components.tok2vec]
+factory = "tok2vec"
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 32
+depth = 1
+embed_size = 256
+[components.textcat_multilabel]
+factory = "textcat_multilabel"
+[components.textcat_multilabel.model]
+@architectures = "spacy.TextCatCNN.v2"
+[components.textcat_multilabel.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 32
+
+[corpora]
+[corpora.train]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${paths.train}
+[corpora.dev]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${paths.dev}
+
+[training]
+seed = 0
+max_steps = 60
+eval_frequency = 30
+patience = 0
+"""
+
+
+def test_find_threshold_sweeps_and_reports_best(tmp_path, capsys):
+    """find-threshold: sweep textcat_multilabel's threshold on dev data,
+    report the best value by the component's default positive score key
+    (spaCy's find-threshold surface)."""
+    write_synth_jsonl(tmp_path / "train.jsonl", 120, kind="textcat", seed=0)
+    write_synth_jsonl(tmp_path / "dev.jsonl", 40, kind="textcat", seed=1)
+    from spacy_ray_tpu.training.loop import train
+
+    cfg = Config.from_str(TEXTCAT_CFG).apply_overrides(
+        {
+            "paths.train": str(tmp_path / "train.jsonl"),
+            "paths.dev": str(tmp_path / "dev.jsonl"),
+        }
+    )
+    train(cfg, output_path=tmp_path / "out", n_workers=1, stdout_log=False)
+
+    rc = cli_main([
+        "find-threshold", str(tmp_path / "out" / "best-model"),
+        str(tmp_path / "dev.jsonl"), "textcat_multilabel",
+        "--device", "cpu", "--n-trials", "5",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    # 5 sweep rows + a Best line naming the config key to set
+    assert out.count("threshold=") >= 5
+    assert "Best: threshold=" in out
+    assert "cats_score=" in out
+
+
+def test_find_threshold_unknown_pipe_fails(tmp_path, trained_model):
+    write_synth_jsonl(tmp_path / "dev.jsonl", 10, kind="tagger", seed=1)
+    rc = cli_main([
+        "find-threshold", str(trained_model), str(tmp_path / "dev.jsonl"),
+        "nope", "--device", "cpu",
+    ])
+    assert rc == 1
