@@ -203,24 +203,57 @@ def convert_command(argv: List[str]) -> int:
 
 
 def init_config_command(argv: List[str]) -> int:
-    """Write a ready-to-train preset config (spacy's `init config` role)."""
-    from .presets import INIT_PRESETS
+    """Write a ready-to-train config (spacy's `init config` role): either a
+    named preset, or an arbitrary `--pipeline` component list composed over
+    a shared trunk (spacy's `init config --pipeline` surface)."""
+    from .presets import INIT_PRESETS, compose_pipeline_config
 
     parser = argparse.ArgumentParser(prog="spacy_ray_tpu init-config")
     parser.add_argument("output_path", type=Path)
     parser.add_argument(
         "--preset",
-        default="cnn",
+        default=None,
         choices=sorted(INIT_PRESETS),
         help="cnn: tagger-only CNN tok2vec; sm: tagger+parser+ner shared CNN; "
         "trf: RoBERTa-base-shape transformer pipeline; spancat: spancat+textcat",
     )
+    parser.add_argument(
+        "--pipeline", default=None,
+        help="comma-separated component list composed over one shared trunk "
+        "(e.g. tagger,parser,ner,entity_ruler); mutually exclusive with "
+        "--preset",
+    )
+    parser.add_argument(
+        "--trunk", default="cnn", choices=["cnn", "trf"],
+        help="shared trunk for --pipeline: CNN tok2vec or transformer",
+    )
+    parser.add_argument(
+        "--width", type=int, default=0,
+        help="trunk width for --pipeline (default: 96 cnn / 768 trf)",
+    )
     args = parser.parse_args(argv)
+    if args.preset and args.pipeline:
+        print("--preset and --pipeline are mutually exclusive", file=sys.stderr)
+        return 1
     from .config import Config
 
-    cfg = Config.from_str(INIT_PRESETS[args.preset])  # parse = validate
+    if args.pipeline:
+        try:
+            text = compose_pipeline_config(
+                [c.strip() for c in args.pipeline.split(",") if c.strip()],
+                trunk=args.trunk,
+                width=args.width,
+            )
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        label = f"pipeline [{args.pipeline}] over {args.trunk} trunk"
+    else:
+        text = INIT_PRESETS[args.preset or "cnn"]
+        label = f"{args.preset or 'cnn'!r} preset"
+    cfg = Config.from_str(text)  # parse = validate
     args.output_path.write_text(cfg.to_str(), encoding="utf8")
-    print(f"Wrote {args.preset!r} preset to {args.output_path}")
+    print(f"Wrote {label} to {args.output_path}")
     return 0
 
 

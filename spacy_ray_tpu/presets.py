@@ -281,3 +281,146 @@ factory = "tagger"
 @architectures = "spacy.Tok2VecListener.v1"
 width = 32
 """
+
+
+# ---------------------------------------------------------------------------
+# init-config --pipeline composition (spacy `init config --pipeline` role)
+# ---------------------------------------------------------------------------
+
+_CNN_TRUNK = """
+[components.{trunk}]
+factory = "tok2vec"
+
+[components.{trunk}.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = {width}
+depth = 4
+embed_size = 2000
+"""
+
+_TRF_TRUNK = """
+[components.{trunk}]
+factory = "transformer"
+
+[components.{trunk}.model]
+@architectures = "spacy_ray_tpu.TransformerEncoder.v1"
+width = {width}
+depth = 12
+n_heads = 12
+dropout = 0.1
+max_len = 512
+embed_size = 20000
+"""
+
+_LISTENER = """
+[components.{name}.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = {width}
+"""
+
+_TAGGER_LIKE = """
+[components.{name}]
+factory = "{factory}"
+
+[components.{name}.model]
+@architectures = "spacy.Tagger.v2"
+""" + _LISTENER
+
+_PARSER_LIKE = """
+[components.{name}]
+factory = "{factory}"
+
+[components.{name}.model]
+@architectures = "spacy.TransitionBasedParser.v2"
+state_type = "{state_type}"
+hidden_width = 128
+maxout_pieces = 2
+""" + _LISTENER
+
+_SPANCAT_BLOCK = """
+[components.{name}]
+factory = "spancat"
+spans_key = "sc"
+threshold = 0.5
+
+[components.{name}.suggester]
+@misc = "spacy.ngram_suggester.v1"
+sizes = [1,2,3]
+
+[components.{name}.model]
+@architectures = "spacy.SpanCategorizer.v1"
+hidden_size = 128
+""" + _LISTENER
+
+_TEXTCAT_BLOCK = """
+[components.{name}]
+factory = "{factory}"
+
+[components.{name}.model]
+@architectures = "spacy.TextCatReduce.v1"
+""" + _LISTENER
+
+_HOST_ONLY_BLOCK = """
+[components.{name}]
+factory = "{factory}"
+"""
+
+# component name -> (template, template kwargs beyond name/width)
+COMPOSABLE = {
+    "tagger": (_TAGGER_LIKE, {"factory": "tagger"}),
+    "morphologizer": (_TAGGER_LIKE, {"factory": "morphologizer"}),
+    "senter": (_TAGGER_LIKE, {"factory": "senter"}),
+    "trainable_lemmatizer": (_TAGGER_LIKE, {"factory": "trainable_lemmatizer"}),
+    "parser": (_PARSER_LIKE, {"factory": "parser", "state_type": "parser"}),
+    "ner": (_PARSER_LIKE, {"factory": "ner", "state_type": "ner"}),
+    "spancat": (_SPANCAT_BLOCK, {}),
+    "textcat": (_TEXTCAT_BLOCK, {"factory": "textcat"}),
+    "textcat_multilabel": (_TEXTCAT_BLOCK, {"factory": "textcat_multilabel"}),
+    "lemmatizer": (_HOST_ONLY_BLOCK, {"factory": "lemmatizer"}),
+    "entity_ruler": (_HOST_ONLY_BLOCK, {"factory": "entity_ruler"}),
+    "attribute_ruler": (_HOST_ONLY_BLOCK, {"factory": "attribute_ruler"}),
+}
+
+_HOST_ONLY = {"lemmatizer", "entity_ruler", "attribute_ruler"}
+
+
+def compose_pipeline_config(
+    pipeline, trunk: str = "cnn", width: int = 0
+) -> str:
+    """Generate a full trainable config for an arbitrary component list over
+    one shared trunk (spacy's ``init config --pipeline`` role). Score
+    weights are left to the components' declared ``default_score_weights``
+    (the training loop combines and normalizes them when the section is
+    empty)."""
+    if trunk not in ("cnn", "trf"):
+        raise ValueError(f"trunk must be 'cnn' or 'trf', got {trunk!r}")
+    unknown = [c for c in pipeline if c not in COMPOSABLE]
+    if unknown:
+        raise ValueError(
+            f"Can't compose {unknown!r} (supported: {', '.join(sorted(COMPOSABLE))}; "
+            "entity_linker needs a knowledge base — start from a full config)"
+        )
+    if not pipeline:
+        raise ValueError("pipeline must name at least one component")
+    width = width or (96 if trunk == "cnn" else 768)
+    trunk_name = "tok2vec" if trunk == "cnn" else "transformer"
+    needs_trunk = any(c not in _HOST_ONLY for c in pipeline)
+    names = ([trunk_name] if needs_trunk else []) + list(pipeline)
+    parts = [
+        "\n[nlp]\nlang = \"en\"\npipeline = ["
+        + ",".join(f'"{n}"' for n in names)
+        + "]\n"
+    ]
+    if needs_trunk:
+        tmpl = _CNN_TRUNK if trunk == "cnn" else _TRF_TRUNK
+        parts.append(tmpl.format(trunk=trunk_name, width=width))
+    for comp in pipeline:
+        tmpl, kwargs = COMPOSABLE[comp]
+        parts.append(tmpl.format(name=comp, width=width, **kwargs))
+    zero1 = trunk == "trf"
+    return _full(
+        "".join(parts),
+        "",  # empty: loop derives weights from component metadata
+        accumulate_gradient=3 if trunk == "trf" else 1,
+        zero1=zero1,
+    )

@@ -146,3 +146,47 @@ def test_find_threshold_unknown_pipe_fails(tmp_path, trained_model):
         "nope", "--device", "cpu",
     ])
     assert rc == 1
+
+
+def test_init_config_pipeline_composition_trains(tmp_path):
+    """init-config --pipeline composes an arbitrary component list over a
+    shared trunk into a config that ACTUALLY TRAINS (score weights come
+    from the components' default_score_weights since the section is left
+    empty)."""
+    cfg_path = tmp_path / "composed.cfg"
+    assert cli_main([
+        "init-config", str(cfg_path),
+        "--pipeline", "tagger,senter,entity_ruler",
+    ]) == 0
+    write_synth_jsonl(tmp_path / "train.jsonl", 60, kind="tagger", seed=0)
+    write_synth_jsonl(tmp_path / "dev.jsonl", 20, kind="tagger", seed=1)
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.training.loop import train
+
+    cfg = Config.from_str(cfg_path.read_text()).apply_overrides(
+        {
+            "paths.train": str(tmp_path / "train.jsonl"),
+            "paths.dev": str(tmp_path / "dev.jsonl"),
+            "training.max_steps": 20,
+            "training.eval_frequency": 10,
+        }
+    )
+    nlp, result = train(cfg, n_workers=1, stdout_log=False)
+    assert nlp.pipe_names == ["tok2vec", "tagger", "senter", "entity_ruler"]
+    assert result.best_score >= 0  # eval ran with derived score weights
+
+
+def test_init_config_pipeline_rejects_unknown(tmp_path):
+    rc = cli_main([
+        "init-config", str(tmp_path / "x.cfg"), "--pipeline", "tagger,entity_linker",
+    ])
+    assert rc == 1
+
+
+def test_init_config_preset_still_works(tmp_path):
+    assert cli_main([
+        "init-config", str(tmp_path / "p.cfg"), "--preset", "sm",
+    ]) == 0
+    from spacy_ray_tpu.config import Config
+
+    Config.from_str((tmp_path / "p.cfg").read_text())
