@@ -813,11 +813,76 @@ def find_threshold_command(argv: List[str]) -> int:
     return 0
 
 
+def info_command(argv: List[str]) -> int:
+    """Environment + install diagnostics (spacy's `info` role). Deliberately
+    does NOT initialize the jax backend by default: on relay-attached
+    images a wedged accelerator tunnel makes backend init hang forever
+    (see devices.py). `--probe` checks reachability from a throwaway
+    subprocess with a timeout instead."""
+    import os
+    import platform as _platform
+
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu info")
+    parser.add_argument(
+        "--probe", action="store_true",
+        help="probe accelerator reachability (subprocess, 60s timeout)",
+    )
+    parser.add_argument("model_path", nargs="?", type=Path, default=None,
+                        help="optional: show a saved pipeline's metadata")
+    args = parser.parse_args(argv)
+
+    from . import __version__
+
+    import jax
+
+    print(f"spacy-ray-tpu    {__version__}")
+    print(f"python           {_platform.python_version()} ({_platform.system()})")
+    print(f"jax              {jax.__version__}")
+    print(f"JAX_PLATFORMS    {os.environ.get('JAX_PLATFORMS', '(unset)')}")
+    print(f"XLA_FLAGS        {os.environ.get('XLA_FLAGS', '(unset)')}")
+    if args.probe:
+        import subprocess
+
+        p = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print(d[0].platform, len(d))"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        try:
+            out, _ = p.communicate(timeout=60)
+            if p.returncode == 0 and out.strip():
+                platform_name, n = out.split()
+                print(f"accelerator      reachable: {platform_name} x{n}")
+            else:
+                print("accelerator      UNREACHABLE (backend init failed)")
+        except subprocess.TimeoutExpired:
+            p.terminate()  # SIGTERM only: SIGKILL wedges relay clients
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+            print("accelerator      UNREACHABLE (backend init hung >60s)")
+    if args.model_path is not None:
+        import json
+
+        meta_path = args.model_path / "meta.json"
+        if not meta_path.exists():
+            print(f"\nNo pipeline at {args.model_path} (missing meta.json)",
+                  file=sys.stderr)
+            return 1
+        meta = json.loads(meta_path.read_text(encoding="utf8"))
+        print(f"\npipeline         {meta.get('lang', '?')}/{meta.get('name', '?')}")
+        print(f"version          {meta.get('version', '?')}")
+        print(f"components       {', '.join(meta.get('pipeline', []))}")
+    return 0
+
+
 COMMANDS = {
     "train": train_command,
     "pretrain": pretrain_command,
     "parse": parse_command,
     "find-threshold": find_threshold_command,
+    "info": info_command,
     "evaluate": evaluate_command,
     "convert": convert_command,
     "init-config": init_config_command,

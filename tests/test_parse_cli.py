@@ -190,3 +190,13 @@ def test_init_config_preset_still_works(tmp_path):
     from spacy_ray_tpu.config import Config
 
     Config.from_str((tmp_path / "p.cfg").read_text())
+
+
+def test_info_command(trained_model, capsys):
+    assert cli_main(["info"]) == 0
+    out = capsys.readouterr().out
+    assert "spacy-ray-tpu" in out and "jax" in out
+    assert cli_main(["info", str(trained_model)]) == 0
+    out = capsys.readouterr().out
+    assert "components" in out and "tagger" in out
+    assert cli_main(["info", "/nonexistent/model"]) == 1
