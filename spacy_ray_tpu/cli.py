@@ -877,12 +877,102 @@ def info_command(argv: List[str]) -> int:
     return 0
 
 
+def debug_model_command(argv: List[str]) -> int:
+    """Inspect a config's resolved model shapes (spacy's `debug model`
+    role): initialize the pipeline from the training corpus (labels need
+    gold data) and print every parameter path, shape, dtype, and
+    per-component totals."""
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu debug-model")
+    parser.add_argument("config_path", type=Path)
+    parser.add_argument("component", nargs="?", default=None,
+                        help="restrict output to one component")
+    parser.add_argument("--device", type=str, default="cpu",
+                        choices=["tpu", "cpu", "gpu"],
+                        help="default cpu: shape inspection needs no accelerator")
+    parser.add_argument("--code", type=Path, default=None)
+    # split dotted overrides out BEFORE argparse: the optional positional
+    # `component` would otherwise swallow an override's value
+    override_args: List[str] = []
+    rest: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--") and "." in a.split("=", 1)[0]:
+            override_args.append(a)
+            if "=" not in a and i + 1 < len(argv):
+                override_args.append(argv[i + 1])
+                i += 1
+        else:
+            rest.append(a)
+        i += 1
+    args = parser.parse_args(rest)
+    extra = override_args
+    _setup_device(args.device)
+
+    import numpy as np
+
+    from .config import load_config, parse_cli_overrides
+    from .pipeline.language import Pipeline
+    from .registry import import_code, registry
+    from .training.loop import resolve_dot_name, resolve_training
+
+    import_code(str(args.code) if args.code else None)
+    config = load_config(args.config_path, parse_cli_overrides(extra),
+                         interpolate=False).interpolate()
+    T = resolve_training(config)
+    resolved_corpora = {
+        name: registry.resolve(block)
+        for name, block in config.get("corpora", {}).items()
+    }
+    train_corpus = resolve_dot_name(config, resolved_corpora, T["train_corpus"])
+    nlp = Pipeline.from_config(config)
+    nlp.initialize(train_corpus, seed=int(T.get("seed") or 0))
+
+    if args.component is not None and args.component not in nlp.pipe_names:
+        print(
+            f"No component {args.component!r} (have: {', '.join(nlp.pipe_names)})",
+            file=sys.stderr,
+        )
+        return 1
+
+    from .models.core import param_paths
+
+    grand_total = 0
+    for name in nlp.pipe_names:
+        if args.component and name != args.component:
+            continue
+        comp_params = nlp.params.get(name)
+        comp = nlp.components[name]
+        if comp_params is None:
+            print(f"[{name}] (host-side component, no device parameters)")
+            continue
+        print(f"[{name}] labels={len(comp.labels)}")
+        total = 0
+        import jax
+
+        flat = {
+            path: leaf
+            for path, leaf in zip(
+                param_paths(comp_params), jax.tree_util.tree_leaves(comp_params)
+            )
+        }
+        for path, leaf in sorted(flat.items()):
+            n = int(np.prod(leaf.shape)) if leaf.shape else 1
+            total += n
+            print(f"  {path:48s} {str(tuple(leaf.shape)):20s} {leaf.dtype} {n:,}")
+        grand_total += total
+        print(f"  [{name}] total: {total:,} params")
+    print(f"TOTAL: {grand_total:,} params")
+    return 0
+
+
 COMMANDS = {
     "train": train_command,
     "pretrain": pretrain_command,
     "parse": parse_command,
     "find-threshold": find_threshold_command,
     "info": info_command,
+    "debug-model": debug_model_command,
     "evaluate": evaluate_command,
     "convert": convert_command,
     "init-config": init_config_command,

@@ -200,3 +200,30 @@ def test_info_command(trained_model, capsys):
     out = capsys.readouterr().out
     assert "components" in out and "tagger" in out
     assert cli_main(["info", "/nonexistent/model"]) == 1
+
+
+def test_debug_model_prints_shapes(tmp_path, capsys):
+    cfg_path = tmp_path / "dm.cfg"
+    assert cli_main(["init-config", str(cfg_path), "--pipeline", "tagger,entity_ruler"]) == 0
+    write_synth_jsonl(tmp_path / "t.jsonl", 30, kind="tagger", seed=0)
+    rc = cli_main([
+        "debug-model", str(cfg_path),
+        "--paths.train", str(tmp_path / "t.jsonl"),
+        "--paths.dev", str(tmp_path / "t.jsonl"),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "[tok2vec]" in out and "[tagger]" in out
+    assert "host-side component" in out  # entity_ruler has no device params
+    assert "TOTAL:" in out
+    # component filter + unknown component
+    assert cli_main([
+        "debug-model", str(cfg_path), "tagger",
+        "--paths.train", str(tmp_path / "t.jsonl"),
+        "--paths.dev", str(tmp_path / "t.jsonl"),
+    ]) == 0
+    assert cli_main([
+        "debug-model", str(cfg_path), "nope",
+        "--paths.train", str(tmp_path / "t.jsonl"),
+        "--paths.dev", str(tmp_path / "t.jsonl"),
+    ]) == 1
