@@ -966,6 +966,56 @@ def debug_model_command(argv: List[str]) -> int:
     return 0
 
 
+def fill_config_command(argv: List[str]) -> int:
+    """Complete a partial config with every [training] default and validate
+    the result (spacy's `init fill-config` role): the written file shows
+    explicitly what a bare config would train with — seed, dropout,
+    patience, eval_frequency, batcher, optimizer, logger — instead of
+    relying on invisible defaults."""
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu fill-config")
+    parser.add_argument("base_path", type=Path, help="partial config")
+    parser.add_argument("output_path", type=Path, help="filled config")
+    args, extra = parser.parse_known_args(argv)
+
+    from .config import Config, load_config, parse_cli_overrides
+    from .training.loop import (
+        DEFAULT_TRAINING,
+        resolve_training,
+    )
+
+    config = load_config(args.base_path, parse_cli_overrides(extra),
+                         interpolate=False)
+    raw_training = dict(config.get("training", {}))
+    if "paths" not in config:
+        # a partial config may interpolate ${paths.*} without declaring
+        # the section; fill it before validation like `train` overrides do
+        config = config.merge({"paths": {"train": None, "dev": None}})
+    resolve_training(config.interpolate())  # validates keys/types loudly
+    filled_training = dict(DEFAULT_TRAINING)
+    filled_training.update(raw_training)
+    # registry sub-blocks every run resolves implicitly when absent
+    filled_training.setdefault("optimizer", {"@optimizers": "Adam.v1",
+                                             "learn_rate": 0.001})
+    filled_training.setdefault(
+        "batcher",
+        {"@batchers": "spacy.batch_by_words.v1", "size": 1000,
+         "tolerance": 0.2},
+    )
+    filled_training.setdefault(
+        "logger", {"@loggers": "spacy_ray_tpu.ConsoleLogger.v1"}
+    )
+    merged = dict(config)
+    merged["training"] = filled_training
+    merged.setdefault("paths", {"train": None, "dev": None})
+    out_cfg = Config(merged)
+    Config.from_str(out_cfg.to_str())  # round-trip = validate serialization
+    args.output_path.write_text(out_cfg.to_str(), encoding="utf8")
+    added = sorted(set(filled_training) - set(raw_training))
+    print(f"Filled {args.base_path} -> {args.output_path} "
+          f"(added: {', '.join(added) if added else 'nothing'})")
+    return 0
+
+
 COMMANDS = {
     "train": train_command,
     "pretrain": pretrain_command,
@@ -973,6 +1023,7 @@ COMMANDS = {
     "find-threshold": find_threshold_command,
     "info": info_command,
     "debug-model": debug_model_command,
+    "fill-config": fill_config_command,
     "evaluate": evaluate_command,
     "convert": convert_command,
     "init-config": init_config_command,

@@ -227,3 +227,73 @@ def test_debug_model_prints_shapes(tmp_path, capsys):
         "--paths.train", str(tmp_path / "t.jsonl"),
         "--paths.dev", str(tmp_path / "t.jsonl"),
     ]) == 1
+
+
+def test_fill_config_completes_partial(tmp_path, capsys):
+    """fill-config materializes every [training] default into the written
+    file, the filled config trains, and bad keys still fail loudly."""
+    partial = tmp_path / "partial.cfg"
+    partial.write_text("""
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger"]
+
+[components.tok2vec]
+factory = "tok2vec"
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 32
+depth = 1
+embed_size = 128
+[components.tagger]
+factory = "tagger"
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 32
+
+[corpora]
+[corpora.train]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${paths.train}
+[corpora.dev]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${paths.dev}
+
+[training]
+dropout = 0.25
+""")
+    filled = tmp_path / "filled.cfg"
+    assert cli_main(["fill-config", str(partial), str(filled)]) == 0
+    out = capsys.readouterr().out
+    assert "added:" in out
+    from spacy_ray_tpu.config import Config
+
+    cfg = Config.from_str(filled.read_text())
+    t = cfg["training"]
+    assert t["dropout"] == 0.25          # user value preserved
+    assert t["patience"] == 1600         # default materialized
+    assert "optimizer" in t and "batcher" in t and "logger" in t
+    # the filled config actually trains
+    write_synth_jsonl(tmp_path / "t.jsonl", 40, kind="tagger", seed=0)
+    from spacy_ray_tpu.training.loop import train
+
+    cfg2 = cfg.apply_overrides(
+        {
+            "paths.train": str(tmp_path / "t.jsonl"),
+            "paths.dev": str(tmp_path / "t.jsonl"),
+            "training.max_steps": 10,
+            "training.eval_frequency": 5,
+        }
+    )
+    _, result = train(cfg2, n_workers=1, stdout_log=False)
+    assert result.final_step == 10
+
+    # typo'd keys are rejected at fill time, not silently filled around
+    bad = tmp_path / "bad.cfg"
+    bad.write_text(partial.read_text().replace("dropout = 0.25", "dropot = 0.25"))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="dropot"):
+        cli_main(["fill-config", str(bad), str(tmp_path / "x.cfg")])
