@@ -70,3 +70,44 @@ def test_gradients_match_dense():
 def test_vmem_guard():
     assert fa.attention_vmem_ok(512, 128)
     assert not fa.attention_vmem_ok(200_000, 128)
+
+
+def test_reference_attention_matches_torch_sdpa():
+    """External oracle (torch is in-image): our dense masked attention —
+    the semantics the flash kernel and the transformer trunk are tested
+    against — must match torch's scaled_dot_product_attention with a key
+    padding mask. Catches scale/mask-convention drift that self-referential
+    equivalence tests cannot."""
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    import numpy as np
+
+    from spacy_ray_tpu.ops.flash_attention import reference_attention
+
+    B, T, H, Dh = 2, 9, 3, 8
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((B, T, H, Dh)).astype(np.float32)
+    k = rng.standard_normal((B, T, H, Dh)).astype(np.float32)
+    v = rng.standard_normal((B, T, H, Dh)).astype(np.float32)
+    lengths = [9, 5]
+    mask = np.zeros((B, T), bool)
+    for b, n in enumerate(lengths):
+        mask[b, :n] = True
+
+    ours = np.asarray(reference_attention(q, k, v, mask))
+
+    # torch layout [B, H, T, Dh]; attn_mask True = attend
+    tq, tk, tv = (torch.from_numpy(x.transpose(0, 2, 1, 3)) for x in (q, k, v))
+    attn_mask = torch.from_numpy(mask)[:, None, None, :].expand(B, H, T, T)
+    with torch.no_grad():
+        want = torch.nn.functional.scaled_dot_product_attention(
+            tq, tk, tv, attn_mask=attn_mask
+        ).numpy().transpose(0, 2, 1, 3)
+
+    # only query rows inside the valid length are meaningful (padding
+    # queries attend to garbage in both implementations)
+    for b, n in enumerate(lengths):
+        np.testing.assert_allclose(
+            ours[b, :n], want[b, :n], atol=2e-5, rtol=2e-5
+        )
