@@ -329,3 +329,58 @@ def test_docbin_preserves_annotated_empty_ents(tmp_path):
     d1, d2 = list(SD.read_docbin(tmp_path / "x.spacy"))
     assert d1.has_ents_annotation is True
     assert d2.has_ents_annotation is False
+
+
+# ----------------------------------------------------------------------
+# external oracles (sklearn is in-image): pin our implementations to the
+# canonical library, not just to hand-derived goldens
+# ----------------------------------------------------------------------
+
+
+def test_rank_auc_matches_sklearn():
+    import pytest
+
+    sk = pytest.importorskip("sklearn.metrics")
+    import random
+
+    from spacy_ray_tpu.pipeline.scoring import rank_auc
+
+    rng = random.Random(0)
+    for trial in range(20):
+        n = rng.randint(4, 60)
+        gold = [rng.random() < 0.4 for _ in range(n)]
+        # quantized scores force ties — the half-credit convention must
+        # match sklearn's trapezoidal handling
+        scores = [round(rng.random(), 1) for _ in range(n)]
+        ours = rank_auc([int(g) for g in gold], scores)
+        if len(set(gold)) < 2:
+            assert ours is None
+            continue
+        want = sk.roc_auc_score(gold, scores)
+        assert ours == pytest.approx(want, abs=1e-9), (trial, gold, scores)
+
+
+def test_prf_matches_sklearn():
+    import pytest
+
+    sk = pytest.importorskip("sklearn.metrics")
+    import random
+
+    from spacy_ray_tpu.pipeline.scoring import PRF
+
+    rng = random.Random(1)
+    for trial in range(20):
+        n = rng.randint(5, 80)
+        universe = list(range(n))
+        pred = {i for i in universe if rng.random() < 0.5}
+        gold = {i for i in universe if rng.random() < 0.5}
+        prf = PRF()
+        prf.score_sets(pred, gold)
+        y_true = [i in gold for i in universe]
+        y_pred = [i in pred for i in universe]
+        p, r, f, _ = sk.precision_recall_fscore_support(
+            y_true, y_pred, average="binary", zero_division=0
+        )
+        assert prf.precision == pytest.approx(p, abs=1e-9)
+        assert prf.recall == pytest.approx(r, abs=1e-9)
+        assert prf.fscore == pytest.approx(f, abs=1e-9)
