@@ -99,11 +99,19 @@ class TrainCheckpoint:
         best_step: int,
         extra: Optional[Dict[str, Any]] = None,
     ) -> None:
+        """Crash-safe write: array files are generation-stamped by step and
+        the meta file — written LAST via atomic os.replace — names the
+        generation it points at. A crash at ANY point leaves the previous
+        complete generation loadable (a torn write of un-stamped files
+        could pair an old meta with new params: silently wrong resume)."""
+        import os
+
         path = Path(path)
         path.mkdir(parents=True, exist_ok=True)
-        save_params(path / "params.npz", params)
+        stamp = int(step)
+        save_params(path / f"params-{stamp}.npz", params)
         host_opt = gather_to_host(opt_state)
-        with open(path / "opt_state.pkl", "wb") as f:
+        with open(path / f"opt_state-{stamp}.pkl", "wb") as f:
             pickle.dump(host_opt, f)
         meta = {
             "step": int(step),
@@ -112,8 +120,19 @@ class TrainCheckpoint:
             "best_score": float(best_score),
             "best_step": int(best_step),
             "extra": extra or {},
+            "stamp": stamp,
         }
-        (path / "train_meta.json").write_text(json.dumps(meta, indent=2), encoding="utf8")
+        tmp = path / "train_meta.json.tmp"
+        tmp.write_text(json.dumps(meta, indent=2), encoding="utf8")
+        os.replace(tmp, path / "train_meta.json")
+        # previous generations are garbage once the meta points past them;
+        # a crash before this cleanup only leaves extra files behind
+        for old in path.glob("params-*.npz"):
+            if old.name != f"params-{stamp}.npz":
+                old.unlink(missing_ok=True)
+        for old in path.glob("opt_state-*.pkl"):
+            if old.name != f"opt_state-{stamp}.pkl":
+                old.unlink(missing_ok=True)
 
     @staticmethod
     def load(path) -> Optional[Dict[str, Any]]:
@@ -123,8 +142,15 @@ class TrainCheckpoint:
         import jax.numpy as jnp
 
         meta = json.loads((path / "train_meta.json").read_text(encoding="utf8"))
-        params = load_params(path / "params.npz")
-        with open(path / "opt_state.pkl", "rb") as f:
+        stamp = meta.get("stamp")
+        if stamp is not None:
+            params_file = path / f"params-{int(stamp)}.npz"
+            opt_file = path / f"opt_state-{int(stamp)}.pkl"
+        else:  # pre-stamping checkpoints (round <= 4 layouts)
+            params_file = path / "params.npz"
+            opt_file = path / "opt_state.pkl"
+        params = load_params(params_file)
+        with open(opt_file, "rb") as f:
             opt_state = pickle.load(f)
         opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
         return {

@@ -222,3 +222,39 @@ def test_profile_flag_writes_trace(tagger_config_text, data_dir, tmp_path):
     assert any(p.is_file() for p in produced), (
         f"no profiler artifacts under {tmp_path/'trace'}: {produced}"
     )
+
+
+def test_checkpoint_save_is_crash_safe(tmp_path):
+    """A crash mid-save must leave the previous complete generation
+    loadable: array files are generation-stamped and the meta (written
+    last, atomically) names the generation it points at."""
+    import numpy as np
+
+    from spacy_ray_tpu.training.checkpoint import TrainCheckpoint
+
+    params = {"c": {"w": np.ones((2, 2), np.float32)}}
+    opt = {"m": np.zeros((2, 2), np.float32)}
+    import jax
+
+    rng = jax.random.PRNGKey(0)
+    TrainCheckpoint.save(
+        tmp_path, params=params, opt_state=opt, step=1, epoch=0, rng=rng,
+        best_score=0.5, best_step=1,
+    )
+    # simulate a crash DURING the next save: new stamped params written
+    # (corrupt!) but the meta replace never happened
+    (tmp_path / "params-2.npz").write_bytes(b"truncated garbage")
+    ck = TrainCheckpoint.load(tmp_path)
+    assert ck is not None and ck["step"] == 1
+    assert np.array_equal(np.asarray(ck["params"]["c"]["w"]), np.ones((2, 2)))
+
+    # a completed second save supersedes and cleans the old generation
+    params2 = {"c": {"w": 2 * np.ones((2, 2), np.float32)}}
+    TrainCheckpoint.save(
+        tmp_path, params=params2, opt_state=opt, step=2, epoch=0, rng=rng,
+        best_score=0.6, best_step=2,
+    )
+    ck = TrainCheckpoint.load(tmp_path)
+    assert ck["step"] == 2
+    assert np.array_equal(np.asarray(ck["params"]["c"]["w"]), 2 * np.ones((2, 2)))
+    assert not (tmp_path / "params-1.npz").exists()
