@@ -766,10 +766,12 @@ def find_threshold_command(argv: List[str]) -> int:
         )
         return 1
     comp = nlp.components[args.pipe_name]
-    if not hasattr(comp, args.threshold_key):
+    current = getattr(comp, args.threshold_key, None)
+    if not isinstance(current, (int, float)) or isinstance(current, bool):
         print(
-            f"[components.{args.pipe_name}] has no attribute "
-            f"{args.threshold_key!r} to sweep", file=sys.stderr,
+            f"[components.{args.pipe_name}] has no numeric attribute "
+            f"{args.threshold_key!r} to sweep "
+            f"(found: {type(current).__name__})", file=sys.stderr,
         )
         return 1
     scores_key = args.scores_key
@@ -791,13 +793,34 @@ def find_threshold_command(argv: List[str]) -> int:
         print(f"No documents in {args.data_path}", file=sys.stderr)
         return 1
 
+    # forward ONCE: the swept attribute is consumed host-side in
+    # set_annotations/score, so device outputs are identical across
+    # trials — only re-annotate + re-score per threshold. Consequence:
+    # scores_key must be produced by the swept component itself.
+    docs = [eg.reference.copy_shell() for eg in examples]
+    chunks = list(
+        nlp.predict_chunks(docs, batch_size=128, only=[args.pipe_name])
+    )
+    for eg, doc in zip(examples, docs):
+        eg.predicted = doc
+
     n = max(int(args.n_trials), 2)
     best = (None, -1.0)
     for i in range(n):
         t = i / (n - 1)
         setattr(comp, args.threshold_key, t)
-        scores = nlp.evaluate(examples)
+        for chunk, lengths, outputs in chunks:
+            comp.set_annotations(chunk, outputs.get(args.pipe_name), lengths)
+        scores = comp.score(examples)
         value = scores.get(scores_key)
+        if value is None and i == 0 and scores_key not in scores:
+            print(
+                f"{scores_key!r} is not produced by "
+                f"[components.{args.pipe_name}] (its scores: "
+                f"{', '.join(sorted(scores))}) — find-threshold sweeps one "
+                "component's own metric", file=sys.stderr,
+            )
+            return 1
         shown = f"{value:.4f}" if value is not None else "-"
         print(f"threshold={t:.3f}  {scores_key}={shown}")
         if value is not None and value > best[1]:

@@ -475,6 +475,20 @@ class Pipeline:
                 self.make_forward_fn(only=decode_sig[1])
             )
         forward = self._jit_forward[decode_sig]
+        for chunk, lengths, outputs in self._forward_chunks(
+            docs, params, forward, batch_size, shard_eval, n_data, mesh
+        ):
+            for name in self.head_names():
+                if annotate is not None and name not in annotate:
+                    continue
+                self.components[name].set_annotations(
+                    chunk, outputs.get(name), lengths
+                )
+        return docs
+
+    def _forward_chunks(
+        self, docs, params, forward, batch_size, shard_eval, n_data, mesh
+    ):
         for start in range(0, len(docs), batch_size):
             chunk = docs[start : start + batch_size]
             examples = [Example.from_gold(d) for d in chunk]
@@ -490,13 +504,25 @@ class Pipeline:
                 tokens = batch["tokens"]
             outputs = forward(params, tokens)
             lengths = [min(len(d), batch["tokens"].seq_len) for d in chunk]
-            for name in self.head_names():
-                if annotate is not None and name not in annotate:
-                    continue
-                self.components[name].set_annotations(
-                    chunk, outputs.get(name), lengths
-                )
-        return docs
+            yield chunk, lengths, outputs
+
+    def predict_chunks(
+        self,
+        docs: List[Doc],
+        params: Optional[Params] = None,
+        batch_size: int = 128,
+        only: Optional[List[str]] = None,
+    ):
+        """Forward WITHOUT annotating: yields (chunk, lengths, outputs)
+        per batch. Callers that sweep host-side decode settings (the
+        find-threshold CLI) forward ONCE and re-run set_annotations many
+        times — the device outputs don't depend on the swept attribute."""
+        params = params if params is not None else self.params
+        assert params is not None, "Pipeline not initialized"
+        forward = jax.jit(self.make_forward_fn(only=only))
+        yield from self._forward_chunks(
+            docs, params, forward, batch_size, False, 1, None
+        )
 
     def __call__(self, text: str) -> Doc:
         doc = self.tokenizer(text)

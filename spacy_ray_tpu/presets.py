@@ -402,6 +402,12 @@ def compose_pipeline_config(
         )
     if not pipeline:
         raise ValueError("pipeline must name at least one component")
+    dupes = sorted({c for c in pipeline if pipeline.count(c) > 1})
+    if dupes:
+        raise ValueError(
+            f"duplicate component name(s) in --pipeline: {', '.join(dupes)} "
+            "(each composable component can appear once)"
+        )
     width = width or (96 if trunk == "cnn" else 768)
     trunk_name = "tok2vec" if trunk == "cnn" else "transformer"
     needs_trunk = any(c not in _HOST_ONLY for c in pipeline)

@@ -109,10 +109,23 @@ class TrainCheckpoint:
         path = Path(path)
         path.mkdir(parents=True, exist_ok=True)
         stamp = int(step)
-        save_params(path / f"params-{stamp}.npz", params)
+        # tmp + os.replace even for the stamped files: a restart WITHOUT
+        # --resume can checkpoint at the same step the live meta already
+        # points at, and an in-place rewrite of that file would reopen
+        # the torn-write hole for exactly that generation
+        params_tmp = path / f"params-{stamp}.npz.tmp"
+        save_params(params_tmp, params)
+        # np.savez appends .npz when the suffix differs — normalize
+        written = (
+            params_tmp if params_tmp.exists()
+            else params_tmp.with_suffix(params_tmp.suffix + ".npz")
+        )
+        os.replace(written, path / f"params-{stamp}.npz")
         host_opt = gather_to_host(opt_state)
-        with open(path / f"opt_state-{stamp}.pkl", "wb") as f:
+        opt_tmp = path / f"opt_state-{stamp}.pkl.tmp"
+        with open(opt_tmp, "wb") as f:
             pickle.dump(host_opt, f)
+        os.replace(opt_tmp, path / f"opt_state-{stamp}.pkl")
         meta = {
             "step": int(step),
             "epoch": int(epoch),

@@ -297,3 +297,19 @@ dropout = 0.25
 
     with _pytest.raises(ValueError, match="dropot"):
         cli_main(["fill-config", str(bad), str(tmp_path / "x.cfg")])
+
+
+def test_find_threshold_rejects_non_numeric_attr(trained_model, tmp_path):
+    write_synth_jsonl(tmp_path / "dev.jsonl", 10, kind="tagger", seed=1)
+    rc = cli_main([
+        "find-threshold", str(trained_model), str(tmp_path / "dev.jsonl"),
+        "tagger", "--threshold-key", "score", "--device", "cpu",
+    ])
+    assert rc == 1  # bound method, not a numeric attribute
+
+
+def test_init_config_pipeline_rejects_duplicates(tmp_path):
+    rc = cli_main([
+        "init-config", str(tmp_path / "d.cfg"), "--pipeline", "tagger,tagger",
+    ])
+    assert rc == 1
