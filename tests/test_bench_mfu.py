@@ -72,3 +72,22 @@ def test_append_session_jsonl_and_tpu_merge(tmp_path, monkeypatch):
     assert set(by_name) == {"cnn_tagger", "trf"}
     assert by_name["trf"]["value"] == 3.0  # latest record wins
     assert len((tmp_path / "session.jsonl").read_text().splitlines()) == 4
+
+
+def test_tpu_only_campaign_exits_without_cpu_fallback(monkeypatch, capsys):
+    """--tpu-only: a campaign whose accelerator never serves must exit
+    without spawning the CPU suite (it would contend with the driver's
+    own final bench run)."""
+    spawned = []
+    monkeypatch.setattr(bench, "_accelerator_reachable", lambda *a, **k: False)
+    monkeypatch.setattr(
+        bench, "_run_spec_subprocess",
+        lambda *a, **k: spawned.append(a) or 0,
+    )
+    monkeypatch.setattr(
+        sys, "argv", ["bench.py", "--wait-tpu", "0.001", "--tpu-only"]
+    )
+    bench.main()
+    out = capsys.readouterr().out
+    assert "exiting without the CPU fallback" in out
+    assert spawned == []
