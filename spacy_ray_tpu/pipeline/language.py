@@ -583,8 +583,14 @@ class Pipeline:
     # Serialization (the nlp.to_disk path, reference worker.py:219-222)
     # ------------------------------------------------------------------
     def meta(self) -> Dict[str, Any]:
+        from .. import __version__
+
+        nlp_cfg = self.config.get("nlp", {}) if self.config else {}
         return {
             "lang": self.lang,
+            "name": nlp_cfg.get("name", "pipeline"),
+            "version": nlp_cfg.get("version", "0.0.0"),
+            "spacy_ray_tpu_version": __version__,
             "pipeline": self.pipe_names,
             "labels": {name: self.components[name].labels for name in self.pipe_names},
         }
