@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
@@ -67,6 +68,13 @@ TPU_PEAK_BF16 = [
 ]
 
 WARMUP = 3
+
+# Statistical defensibility (VERDICT r4 next #2): every config is timed
+# N_REPS independent times; the record's value/mfu are the MEDIANS and
+# min/max ride along. A post-run matmul re-probe below CONTENTION_RATIO
+# of the cached host peak stamps the record "contended".
+N_REPS = 3
+CONTENTION_RATIO = 0.75
 
 # Persistent XLA compilation cache: a relay restart mid-suite must not
 # recompile the (expensive) trf programs from zero (VERDICT r2 next #1b).
@@ -119,6 +127,22 @@ def _measure_matmul_peak(platform: str) -> float:
     return best
 
 
+def _write_peak_cache(platform: str, kind: str, value: float) -> None:
+    """Store one measured peak under the shared ``platform:kind`` key."""
+    try:
+        cache = json.loads(PEAK_CACHE_FILE.read_text(encoding="utf8"))
+    except Exception:
+        cache = {}
+    if not isinstance(cache, dict):
+        cache = {}
+    cache[f"{platform}:{kind}"] = value
+    try:
+        PEAK_CACHE_FILE.write_text(json.dumps(cache, indent=2) + "\n",
+                                   encoding="utf8")
+    except Exception:
+        pass  # cache is an optimization; re-measuring is fine
+
+
 def _peak_flops_per_chip(platform: str) -> (float, str):
     """(peak FLOP/s for one chip, provenance string)."""
     import jax
@@ -138,11 +162,7 @@ def _peak_flops_per_chip(platform: str) -> (float, str):
         cache = {}
     if cache_key not in cache:
         cache[cache_key] = _measure_matmul_peak(platform)
-        try:
-            PEAK_CACHE_FILE.write_text(json.dumps(cache, indent=2) + "\n",
-                                       encoding="utf8")
-        except Exception:
-            pass  # cache is an optimization; re-measuring is fine
+        _write_peak_cache(platform, kind, cache[cache_key])
     dt = "f32" if platform == "cpu" else "bf16"
     return float(cache[cache_key]), f"measured matmul {dt} ({kind})"
 
@@ -174,6 +194,11 @@ def _append_session(rec: Dict[str, Any], platform: str) -> None:
     stamped["recorded_at"] = datetime.datetime.now(
         datetime.timezone.utc
     ).isoformat(timespec="seconds").replace("+00:00", "Z")
+    # run attribution: the parent stamps its children so the headline
+    # summary can tell this run's records from a concurrent campaign's
+    run_id = os.environ.get("SRT_BENCH_RUN_ID")
+    if run_id:
+        stamped["run_id"] = run_id
     try:
         with open(SESSION_FILE, "a", encoding="utf8") as f:
             f.write(json.dumps(stamped) + "\n")
@@ -247,7 +272,7 @@ def _configs(platform: str) -> List[Dict[str, Any]]:
 
     cpu = platform == "cpu"
     cnn = CNN_TAGGER_CFG.format(width=96, depth=4, embed_size=2000)
-    return [
+    specs = [
         dict(
             name="cnn_tagger",
             metric="train_words_per_sec_per_chip (CNN tok2vec tagger, fwd+bwd+Adam)",
@@ -275,7 +300,7 @@ def _configs(platform: str) -> List[Dict[str, Any]]:
             metric="train_words_per_sec_per_chip (spancat + textcat_multilabel, large batch)",
             cfg=INIT_PRESETS["spancat"], kinds=["spancat", "textcat"],
             B=64 if cpu else 512, T=32 if cpu else 64,
-            steps=5 if cpu else 15,
+            steps=10 if cpu else 15,
         ),
         # trf-family configs LAST: their compiles are by far the largest
         # programs here, and on a relay-attached accelerator a compile-server
@@ -286,21 +311,41 @@ def _configs(platform: str) -> List[Dict[str, Any]]:
             metric="train_words_per_sec_per_chip (trf RoBERTa-base shape + tagger)",
             cfg=TRF_TAGGER_CFG, kinds=["tagger"],
             B=4 if cpu else 16, T=32 if cpu else 128,
-            steps=3 if cpu else 10, warmup=1 if cpu else 3,
+            # >=10 timed steps even on CPU (VERDICT r4 next #2: 3-step
+            # timings at these shapes swung 2.6x between sessions)
+            steps=10, warmup=2 if cpu else 3,
             # ascending-size staged compiles (VERDICT r2 next #1a): a
             # compile-server crash localizes to a stage, and the persistent
             # cache keeps completed stages across a relay restart
             stages=None if cpu else [(4, 32), (8, 64)],
             attention=True,
+            timeout=3600.0,  # 30 timed CPU steps at ~20-60s/step need >1800s
         ),
         dict(
             name="trf",
             metric="train_words_per_sec_per_chip (trf RoBERTa-base shape + tagger/parser/NER)",
             cfg=INIT_PRESETS["trf"], kinds=["parser", "ner"],
             B=4 if cpu else 16, T=32 if cpu else 128,
-            steps=3 if cpu else 10, warmup=1 if cpu else 3,
+            steps=10, warmup=2 if cpu else 3,
             stages=None if cpu else [(4, 32), (8, 64)],
             attention=True,
+            timeout=3600.0,
+        ),
+        # hardware-shaped flagship (VERDICT r4 next #6): batch_by_words-scale
+        # work per step (B*T = 8192 tokens/step vs trf's 2048) so the first
+        # relay window measures something comparable to BASELINE.json's
+        # north star instead of toy shapes. Accelerator-only: at RoBERTa-base
+        # size this shape is ~2 min/step on the CPU host (the staged-compile
+        # path is still CPU-verified by tests/test_bench_specs.py).
+        dict(
+            name="trf_realistic",
+            metric="train_words_per_sec_per_chip (trf RoBERTa-base, hardware-shaped B=32/T=256)",
+            cfg=INIT_PRESETS["trf"], kinds=["parser", "ner"],
+            B=32, T=256, steps=10, warmup=3,
+            stages=[(4, 32), (8, 64), (16, 128)],
+            attention=True,
+            accel_only=True,
+            timeout=3600.0,
         ),
         # long-sequence A/B: same transformer, T=2048, flash attention
         # auto-enabled (probe) vs forced off — the pallas kernel's win is
@@ -312,7 +357,7 @@ def _configs(platform: str) -> List[Dict[str, Any]]:
             cfg=LONGSEQ_CFG_CPU if cpu else LONGSEQ_CFG, kinds=["tagger"],
             B=2 if cpu else 4, T=256 if cpu else 2048,
             doc_len=256 if cpu else 2048,
-            steps=2 if cpu else 8, warmup=1 if cpu else 2,
+            steps=10 if cpu else 8, warmup=2,
             stages=None if cpu else [(4, 512)],
             attention=True,
         ),
@@ -322,12 +367,15 @@ def _configs(platform: str) -> List[Dict[str, Any]]:
             cfg=LONGSEQ_CFG_CPU if cpu else LONGSEQ_CFG, kinds=["tagger"],
             B=2 if cpu else 4, T=256 if cpu else 2048,
             doc_len=256 if cpu else 2048,
-            steps=2 if cpu else 8, warmup=1 if cpu else 2,
+            steps=10 if cpu else 8, warmup=2,
             stages=None if cpu else [(4, 512)],
             env={"SRT_PALLAS_ATTN": "0"},
             attention=True,
         ),
     ]
+    # accelerator-gated specs (hardware-shaped flagship): at these shapes a
+    # CPU run would take hours for a number nobody compares against
+    return [s for s in specs if not (cpu and s.get("accel_only"))]
 
 
 TRF_TAGGER_CFG = """
@@ -559,6 +607,11 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
             params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
             return loss, fixed_words
 
+    # Dispersion accounting (VERDICT r4 next #2): N independent timed
+    # repetitions, median as the headline, min/max recorded so every
+    # record self-describes its noise. Single-shot timings proved
+    # indefensible (r4: same config 2.6x apart across two sessions).
+    n_reps = int(spec.get("n_reps", N_REPS))
     try:
         t_compile = time.perf_counter()
         loss, _ = step_fn(0)  # first full-shape step: the compile
@@ -568,23 +621,48 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
             loss, _ = step_fn(i)
         jax.block_until_ready(loss)
 
-        total_words = 0
-        t0 = time.perf_counter()
-        for i in range(steps):
-            loss, words = step_fn(i)
-            total_words += words
-        jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
+        load_before = os.getloadavg()[0]
+        rep_wps: List[float] = []
+        rep_step_seconds: List[float] = []
+        for _rep in range(n_reps):
+            total_words = 0
+            t0 = time.perf_counter()
+            for i in range(steps):
+                loss, words = step_fn(i)
+                total_words += words
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            rep_wps.append(total_words / dt / n_chips)
+            rep_step_seconds.append(dt / steps)
+        load_after = os.getloadavg()[0]
     finally:
         if cleanup is not None:
             cleanup()  # a failed spec must not leak its producer thread
 
-    wps_chip = total_words / dt / n_chips
     loss_val = float(loss)
     if not np.isfinite(loss_val):
         print(f"# {spec['name']}: non-finite loss {loss_val}, discarding", flush=True)
         return None
-    step_seconds = dt / steps
+
+    # Contention stamp (VERDICT r4 next #2): on CPU, re-run the matmul
+    # microbench AFTER the timed window and compare against the cached
+    # peak. A clean host reproduces its peak (ratio ~1); a contended one
+    # doesn't — and a contended record must say so instead of posing as a
+    # clean measurement. If the re-probe BEATS the cached peak, the cache
+    # was the contended run: adopt the higher value (the MFU denominator
+    # must be the host's true peak) and write it back.
+    reprobe_ratio: Optional[float] = None
+    if platform == "cpu":
+        reprobe = _measure_matmul_peak(platform)
+        if reprobe > peak:
+            _write_peak_cache(platform, jax.devices()[0].device_kind, reprobe)
+            peak = reprobe
+        reprobe_ratio = reprobe / peak
+    contended = reprobe_ratio is not None and reprobe_ratio < CONTENTION_RATIO
+
+    wps_chip = float(np.median(rep_wps))
+    step_seconds = float(np.median(rep_step_seconds))
+    rep_mfu = [flops_per_step / s / (peak * n_chips) for s in rep_step_seconds]
     mfu = flops_per_step / step_seconds / (peak * n_chips)
     rec = {
         "metric": spec["metric"],
@@ -606,6 +684,20 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
         "peak_tflops_per_chip": round(peak / 1e12, 2),
         "peak_kind": peak_kind,
         "n_params": n_params,
+        # dispersion + contention self-description (VERDICT r4 next #2):
+        # value/mfu are MEDIANS over n_reps independent repetitions
+        "n_reps": n_reps,
+        "steps_per_rep": steps,
+        "wps_reps": [round(w, 1) for w in rep_wps],
+        "wps_min": round(min(rep_wps), 1),
+        "wps_max": round(max(rep_wps), 1),
+        "mfu_min": round(min(rep_mfu), 5),
+        "mfu_max": round(max(rep_mfu), 5),
+        "load_avg_1m": [round(load_before, 2), round(load_after, 2)],
+        "peak_reprobe_ratio": (
+            round(reprobe_ratio, 3) if reprobe_ratio is not None else None
+        ),
+        "contended": contended,
     }
     if spec.get("attention"):
         # self-describing kernel provenance: a CPU fallback can't pose as a
@@ -647,8 +739,19 @@ def _accelerator_reachable(timeout: float = 180.0) -> bool:
 
 PER_CONFIG_TIMEOUT = 1800.0  # seconds; remote compiles can be very slow
 
+# Child exit code for "parent expected an accelerator, child resolved to
+# CPU": the child refuses to run (a CPU record mislabeled as part of a TPU
+# suite is worse than no record) and the parent handles the fallback.
+CHILD_RC_NO_ACCEL = 4
 
-def _run_spec_subprocess(name: str, cpu: bool = False, env: Optional[Dict[str, str]] = None) -> int:
+
+def _run_spec_subprocess(
+    name: str,
+    cpu: bool = False,
+    env: Optional[Dict[str, str]] = None,
+    timeout: Optional[float] = None,
+    expect_accel: bool = False,
+) -> int:
     """Run ONE benchmark config in a child process (``--configs name``).
 
     Crash/hang isolation: a compile-server crash or a wedged relay inside
@@ -658,18 +761,20 @@ def _run_spec_subprocess(name: str, cpu: bool = False, env: Optional[Dict[str, s
     SIGKILL on a process holding the relay client wedges the relay.
     Child stdout passes through, so its JSON lines reach the caller.
     """
-    import os
     import subprocess
     import sys
 
+    timeout = timeout or PER_CONFIG_TIMEOUT
     cmd = [sys.executable, __file__, "--configs", name]
     if cpu:
         cmd.append("--cpu")
+    if expect_accel:
+        cmd.append("--expect-accel")
     p = subprocess.Popen(cmd, env={**os.environ, **(env or {})})
     try:
-        return p.wait(timeout=PER_CONFIG_TIMEOUT)
+        return p.wait(timeout=timeout)
     except subprocess.TimeoutExpired:
-        print(f"# {name}: timed out after {PER_CONFIG_TIMEOUT:.0f}s; terminated",
+        print(f"# {name}: timed out after {timeout:.0f}s; terminated",
               flush=True)
         p.terminate()
         try:
@@ -677,6 +782,62 @@ def _run_spec_subprocess(name: str, cpu: bool = False, env: Optional[Dict[str, s
         except subprocess.TimeoutExpired:
             pass  # left to die on its own — never SIGKILL a relay client
         return -1
+
+
+# Which config is THE headline, in preference order (VERDICT r4 next #7:
+# the driver records the LAST JSON line on stdout as the round's "parsed"
+# number, so the suite must end with the flagship, not whichever config
+# happens to run last).
+HEADLINE_ORDER = ["trf_realistic", "trf", "cnn_tagger"]
+
+
+def _print_headline_summary(
+    session_mark: int, platforms: List[str], run_id: Optional[str] = None
+) -> None:
+    """Re-print the flagship record as the suite's LAST stdout JSON line.
+
+    Reads the records this run appended to BENCH_SESSION.jsonl (everything
+    past ``session_mark`` bytes) and re-emits the highest-priority headline
+    config as a summary record, so the driver's "parsed" field captures the
+    number that matters rather than trf_longseq_noflash (which runs last
+    for crash-isolation reasons). ``platforms`` is this run's preference
+    order (e.g. ["tpu", "cpu"] after a mid-suite relay loss). The session
+    file is shared with any concurrent ``--tpu-only`` background campaign,
+    so foreign records must never be re-labeled as this run's headline:
+    records are matched on the parent's ``run_id`` stamp (when given) in
+    addition to platform, and unparseable lines (torn concurrent writes)
+    are skipped rather than aborting the summary.
+    """
+    records: List[Dict[str, Any]] = []
+    try:
+        with open(SESSION_FILE, "r", encoding="utf8") as f:
+            f.seek(session_mark)
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn write from a concurrent appender
+                if run_id is not None and rec.get("run_id") != run_id:
+                    continue  # a concurrent run's record, not ours
+                if rec.get("platform") in platforms:
+                    records.append(rec)
+    except Exception as e:
+        print(f"# headline summary unavailable: {e}", flush=True)
+        return
+    by_key = {(r.get("platform"), r.get("name")): r for r in records}
+    for platform in platforms:
+        for name in HEADLINE_ORDER:
+            rec = by_key.get((platform, name))
+            if rec is not None:
+                rec = dict(rec)
+                rec["name"] = "headline_summary"
+                rec["headline_of"] = name
+                rec["metric"] = f"HEADLINE {rec['metric']}"
+                print(json.dumps(rec), flush=True)
+                return
+    print("# headline summary: no headline-eligible record this run", flush=True)
 
 
 def _print_recorded_tpu_results() -> None:
@@ -725,14 +886,18 @@ def main() -> None:
         "start the moment the accelerator comes back",
     )
     parser.add_argument(
+        "--expect-accel", action="store_true",
+        help="child mode: the parent believes an accelerator is up; if this "
+        "child nevertheless resolves to CPU, exit with code 4 instead of "
+        "running (the parent re-probes and re-dispatches)",
+    )
+    parser.add_argument(
         "--tpu-only", action="store_true",
         help="parent mode: if the accelerator never serves, exit WITHOUT "
         "the CPU fallback — for a background campaign that must not "
         "contend with a separate CPU bench run at round end",
     )
     args = parser.parse_args()
-
-    import os
 
     if not args.measure_baseline and not args.configs:
         # PARENT mode: run every config in its own child process so a
@@ -764,18 +929,37 @@ def main() -> None:
             print("# accelerator backend unreachable; falling back to CPU",
                   flush=True)
             _print_recorded_tpu_results()
+        session_mark = SESSION_FILE.stat().st_size if SESSION_FILE.exists() else 0
+        platforms_used = ["tpu"] if tpu_ok else ["cpu"]
+        run_id = f"{os.getpid()}-{int(time.time())}"
         for spec in _configs("tpu" if tpu_ok else "cpu"):
+            if not tpu_ok and spec.get("accel_only"):
+                continue  # hardware-shaped spec: no CPU fallback exists
+            child_env = {**(spec.get("env") or {}), "SRT_BENCH_RUN_ID": run_id}
             rc = _run_spec_subprocess(
-                spec["name"], cpu=not tpu_ok, env=spec.get("env")
+                spec["name"], cpu=not tpu_ok, env=child_env,
+                timeout=spec.get("timeout"), expect_accel=tpu_ok,
             )
             if tpu_ok and rc != 0:
-                # the child crashed or timed out against the accelerator —
-                # re-probe before trusting it with the next config
+                # the child crashed, timed out, or refused a silent CPU
+                # fallback (rc 4) — re-probe before trusting the relay with
+                # the next config
                 if not _accelerator_reachable(timeout=60.0):
                     print("# relay lost mid-suite; remaining configs on CPU",
                           flush=True)
                     _print_recorded_tpu_results()
                     tpu_ok = False
+                    platforms_used.append("cpu")
+                if rc == CHILD_RC_NO_ACCEL and (
+                    tpu_ok or not spec.get("accel_only")
+                ):
+                    # the refused child did no work; one re-dispatch on
+                    # whichever platform the parent now believes in
+                    _run_spec_subprocess(
+                        spec["name"], cpu=not tpu_ok, env=child_env,
+                        timeout=spec.get("timeout"), expect_accel=tpu_ok,
+                    )
+        _print_headline_summary(session_mark, platforms_used, run_id)
         return
 
     import jax
@@ -795,6 +979,12 @@ def main() -> None:
         print(f"# backend init failed ({e}); falling back to CPU", flush=True)
         jax.config.update("jax_platforms", "cpu")
     platform = jax.default_backend()
+    if args.expect_accel and platform == "cpu":
+        # the parent believes the relay is up; a silent CPU run here would
+        # both mislabel the suite's platform mix and hide the relay loss
+        print("# parent expected an accelerator but this child resolved to "
+              "CPU; exiting rc=4 for the parent to re-dispatch", flush=True)
+        raise SystemExit(CHILD_RC_NO_ACCEL)
     if platform != "cpu":
         # persistent cache ONLY for accelerator programs (the point is
         # surviving relay restarts mid-suite); CPU compiles are fast and
@@ -807,10 +997,17 @@ def main() -> None:
         baseline = json.loads(BASELINE_FILE.read_text(encoding="utf8"))
 
     only = {n for n in args.configs.split(",") if n}
+    specs = [s for s in _configs(platform) if not only or s["name"] in only]
+    if only and not specs:
+        # e.g. an accel_only config (trf_realistic) whose child fell back to
+        # CPU after the relay died post-probe: exiting 0 with no output
+        # would hide the missing record AND defeat the parent's rc!=0
+        # relay-loss detection — fail loudly instead
+        print(f"# no config matching {sorted(only)} exists on platform "
+              f"{platform}; exiting non-zero", flush=True)
+        raise SystemExit(3)
     results = []
-    for spec in _configs(platform):
-        if only and spec["name"] not in only:
-            continue
+    for spec in specs:
         spec_env = spec.get("env") or {}
         saved_env = {k: os.environ.get(k) for k in spec_env}
         os.environ.update(spec_env)

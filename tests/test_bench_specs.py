@@ -1,0 +1,233 @@
+"""bench.py spec-shape and rigor-machinery tests (VERDICT r4 next #2/#6/#7):
+dispersion fields, the accelerator-gated hardware-shaped trf spec, per-spec
+timeouts, and the headline-summary-last ordering fix."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import bench
+
+
+def _by_name(platform):
+    return {s["name"]: s for s in bench._configs(platform)}
+
+
+def test_trf_realistic_gated_to_accelerators():
+    cpu = _by_name("cpu")
+    tpu = _by_name("tpu")
+    assert "trf_realistic" not in cpu
+    spec = tpu["trf_realistic"]
+    # hardware-shaped: batch_by_words-scale tokens per step (>= 8K)
+    assert spec["B"] * spec["T"] >= 8192
+    # staged compiles ascend strictly in token count up to the full shape
+    sizes = [b * t for b, t in spec["stages"]] + [spec["B"] * spec["T"]]
+    assert sizes == sorted(sizes) and len(set(sizes)) == len(sizes)
+    assert spec["timeout"] >= 3600
+
+
+def test_trf_family_cpu_steps_at_least_10():
+    # r4 weak #1: 3-step CPU timings at toy shapes swung 2.6x between
+    # sessions; every config now times >= 10 steps per repetition
+    for name, spec in _by_name("cpu").items():
+        assert spec["steps"] >= 10, f"{name}: {spec['steps']} timed steps"
+
+
+def test_all_specs_have_rep_defaults():
+    assert bench.N_REPS >= 3
+
+
+def test_headline_summary_prefers_flagship(tmp_path, monkeypatch, capsys):
+    session = tmp_path / "session.jsonl"
+    monkeypatch.setattr(bench, "SESSION_FILE", session)
+    recs = [
+        {"name": "cnn_tagger", "metric": "m1", "value": 1.0, "platform": "cpu"},
+        {"name": "trf", "metric": "m2", "value": 2.0, "platform": "cpu"},
+        {"name": "trf_longseq_noflash", "metric": "m3", "value": 3.0,
+         "platform": "cpu"},
+    ]
+    session.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    bench._print_headline_summary(0, ["cpu"])
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    # trf outranks cnn_tagger; the last-run config (longseq) never wins
+    assert summary["name"] == "headline_summary"
+    assert summary["headline_of"] == "trf"
+    assert summary["value"] == 2.0
+    assert summary["metric"].startswith("HEADLINE")
+
+
+def test_headline_summary_only_reads_past_mark(tmp_path, monkeypatch, capsys):
+    session = tmp_path / "session.jsonl"
+    monkeypatch.setattr(bench, "SESSION_FILE", session)
+    stale = json.dumps(
+        {"name": "trf", "metric": "old", "value": 9.0, "platform": "cpu"}
+    ) + "\n"
+    session.write_text(stale)
+    mark = session.stat().st_size
+    with open(session, "a") as f:
+        f.write(json.dumps(
+            {"name": "cnn_tagger", "metric": "new", "value": 1.0,
+             "platform": "cpu"}
+        ) + "\n")
+    bench._print_headline_summary(mark, ["cpu"])
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # the stale trf record from a previous session must not be the headline
+    assert summary["headline_of"] == "cnn_tagger"
+
+
+def test_headline_summary_ignores_foreign_platform(tmp_path, monkeypatch, capsys):
+    """A concurrent --tpu-only campaign's TPU record appended mid-suite must
+    not become a CPU run's headline; torn half-written lines are skipped."""
+    session = tmp_path / "session.jsonl"
+    monkeypatch.setattr(bench, "SESSION_FILE", session)
+    session.write_text(
+        json.dumps({"name": "trf_realistic", "metric": "m", "value": 99.0,
+                    "platform": "tpu"}) + "\n"
+        + '{"name": "trf", "metric": "torn'  # no newline: torn write
+        + "\n"
+        + json.dumps({"name": "cnn_tagger", "metric": "m", "value": 1.0,
+                      "platform": "cpu"}) + "\n"
+    )
+    bench._print_headline_summary(0, ["cpu"])
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["headline_of"] == "cnn_tagger"
+    assert summary["platform"] == "cpu"
+
+
+def test_headline_summary_mixed_run_prefers_tpu(tmp_path, monkeypatch, capsys):
+    """After a mid-suite relay loss the run is ["tpu", "cpu"]: a TPU flagship
+    record from earlier in THIS run outranks the CPU fallback records."""
+    session = tmp_path / "session.jsonl"
+    monkeypatch.setattr(bench, "SESSION_FILE", session)
+    recs = [
+        {"name": "cnn_tagger", "metric": "m", "value": 50.0, "platform": "tpu"},
+        {"name": "trf", "metric": "m", "value": 2.0, "platform": "cpu"},
+    ]
+    session.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    bench._print_headline_summary(0, ["tpu", "cpu"])
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # cnn_tagger@tpu wins over trf@cpu: platform preference outranks name
+    assert summary["headline_of"] == "cnn_tagger"
+    assert summary["platform"] == "tpu"
+
+
+def test_headline_summary_run_id_filter(tmp_path, monkeypatch, capsys):
+    """A same-platform record from a CONCURRENT campaign (different run_id)
+    must not be re-labeled as this run's headline."""
+    session = tmp_path / "session.jsonl"
+    monkeypatch.setattr(bench, "SESSION_FILE", session)
+    recs = [
+        {"name": "trf", "metric": "m", "value": 9.0, "platform": "tpu",
+         "run_id": "other-123"},
+        {"name": "cnn_tagger", "metric": "m", "value": 1.0, "platform": "tpu",
+         "run_id": "mine-456"},
+    ]
+    session.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    bench._print_headline_summary(0, ["tpu"], run_id="mine-456")
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["headline_of"] == "cnn_tagger"
+    assert summary["run_id"] == "mine-456"
+
+
+def test_parent_fallback_protocol(tmp_path, monkeypatch, capsys):
+    """Parent loop vs a mid-suite relay loss: a child refusing with rc=4 is
+    re-dispatched on CPU, accel_only specs are skipped after the flip, and
+    children are stamped with the parent's run id."""
+    monkeypatch.setattr(bench, "SESSION_FILE", tmp_path / "session.jsonl")
+    monkeypatch.setattr(bench, "TPU_SESSION_FILE", tmp_path / "tpu.json")
+    # conftest pins JAX_PLATFORMS=cpu; the parent must believe an
+    # accelerator env is configured for this scenario (no jax import or
+    # child spawn happens in this test, so the value is inert)
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    probes = iter([True, False])  # initial probe up; mid-suite re-probe down
+    monkeypatch.setattr(
+        bench, "_accelerator_reachable", lambda *a, **k: next(probes)
+    )
+    calls = []
+
+    def fake_child(name, cpu=False, env=None, timeout=None, expect_accel=False):
+        calls.append((name, cpu, expect_accel, (env or {}).get("SRT_BENCH_RUN_ID")))
+        # first dispatch of the first config: refuse (relay died post-probe)
+        return bench.CHILD_RC_NO_ACCEL if len(calls) == 1 else 0
+
+    monkeypatch.setattr(bench, "_run_spec_subprocess", fake_child)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    names = [c[0] for c in calls]
+    first = bench._configs("tpu")[0]["name"]
+    # refused child re-dispatched on CPU with the same run id
+    assert calls[0] == (first, False, True, calls[0][3])
+    assert calls[1] == (first, True, False, calls[0][3])
+    assert calls[0][3]  # run id was stamped
+    # the accel_only hardware spec is never spawned after the flip
+    assert "trf_realistic" not in names
+    # every remaining config ran on CPU
+    assert all(cpu for (_, cpu, _, _) in calls[2:])
+    assert len(set(c[3] for c in calls)) == 1  # one run id throughout
+
+
+def test_headline_summary_no_records(tmp_path, monkeypatch, capsys):
+    session = tmp_path / "session.jsonl"
+    session.write_text("")
+    monkeypatch.setattr(bench, "SESSION_FILE", session)
+    bench._print_headline_summary(0, ["cpu"])
+    assert "no headline-eligible record" in capsys.readouterr().out
+
+
+def test_child_zero_config_match_exits_nonzero(monkeypatch):
+    """An accel_only spec whose child fell back to CPU matches nothing in
+    _configs('cpu'): the child must exit non-zero so the parent's relay-loss
+    re-probe fires instead of silently losing the flagship record."""
+    monkeypatch.setattr(
+        sys, "argv", ["bench.py", "--configs", "trf_realistic", "--cpu"]
+    )
+    try:
+        bench.main()
+    except SystemExit as e:
+        assert e.code == 3
+    else:
+        raise AssertionError("expected SystemExit(3)")
+
+
+@pytest.mark.slow
+def test_trf_realistic_first_stage_compiles_on_cpu():
+    """The accelerator-gated hardware-shaped spec must not be dead code: its
+    pipeline builds and its smallest compile stage (B=4, T=32) runs one real
+    update on the CPU host (VERDICT r4 next #6 'compiles in the dryrun-sized
+    stage on CPU')."""
+    import jax
+
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.pipeline.language import Pipeline
+    from spacy_ray_tpu.parallel.mesh import build_mesh
+    from spacy_ray_tpu.parallel.step import (
+        make_train_step,
+        place_batch,
+        place_replicated,
+        shard_opt_state,
+    )
+    from spacy_ray_tpu.registry import registry
+
+    spec = _by_name("tpu")["trf_realistic"]
+    sb, st = spec["stages"][0]
+    nlp = Pipeline.from_config(Config.from_str(spec["cfg"]))
+    examples = bench._corpus(spec["kinds"], max(2 * sb, 16))
+    nlp.initialize(lambda: iter(examples), seed=0)
+    mesh = build_mesh(n_data=1)
+    tx = registry.get("optimizers", "Adam.v1")(learn_rate=0.001)
+    params = place_replicated(nlp.params, mesh)
+    opt_state = shard_opt_state(tx.init(params), mesh, zero1=False)
+    update = make_train_step(nlp.make_loss_fn(), tx, mesh,
+                             opt_state_template=opt_state)
+    batch = nlp.collate(examples[:sb], pad_batch_to=sb, pad_len_to=st)
+    tokens = place_batch(batch["tokens"], mesh)
+    targets = place_batch(batch["targets"], mesh)
+    params, opt_state, loss, _ = update(
+        params, opt_state, tokens, targets, jax.random.PRNGKey(0)
+    )
+    assert float(jax.block_until_ready(loss)) > 0
