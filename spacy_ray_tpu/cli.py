@@ -686,51 +686,106 @@ def parse_command(argv: List[str]) -> int:
 
     nlp = Pipeline.from_disk(args.model_path)
 
-    # ---- load input as bare (unannotated) docs ----
+    # ---- stream input as bare (unannotated) docs ----
+    # read/predict/write chunk-by-chunk: a genuinely bulk corpus — the
+    # command's whole purpose — must not be materialized doc-by-doc on the
+    # host (round-4 advisor finding). Only the .spacy writer keeps state
+    # across chunks, and that is packed attribute rows, not Doc objects.
+    import itertools
+    import os as _os
+
     if args.input_path.suffix == ".txt":
-        with open(args.input_path, encoding="utf8") as f:
-            docs = [nlp.tokenizer(line.rstrip("\n")) for line in f if line.strip()]
+
+        def _txt_docs():
+            with open(args.input_path, encoding="utf8") as f:
+                for line in f:
+                    if line.strip():
+                        yield nlp.tokenizer(line.rstrip("\n"))
+
+        doc_iter = _txt_docs()
     else:
         from .training.corpus import _iter_path
 
         # strip any gold annotation: parse writes the MODEL's predictions
-        docs = [d.copy_shell() for d in _iter_path(args.input_path)]
-    if not docs:
-        print(f"No documents in {args.input_path}", file=sys.stderr)
-        return 1
+        doc_iter = (d.copy_shell() for d in _iter_path(args.input_path))
 
+    # count docs BEFORE rank sharding: an empty round-robin slice on a
+    # non-empty corpus (world > n_docs) is a legitimate empty part file,
+    # not the corpus-empty error
+    seen = {"total": 0}
+
+    def _counted(it):
+        for d in it:
+            seen["total"] += 1
+            yield d
+
+    doc_iter = _counted(doc_iter)
     rank, world = jax.process_index(), jax.process_count()
     if world > 1:
-        docs = docs[rank::world]
-
-    mesh = build_mesh(n_data=args.n_workers) if jax.process_count() == 1 else None
-    t0 = time.perf_counter()
-    nlp.predict_docs(docs, batch_size=args.batch_size, mesh=mesh)
-    seconds = time.perf_counter() - t0
-    n_words = sum(len(d) for d in docs)
+        doc_iter = itertools.islice(doc_iter, rank, None, world)
 
     out = args.output_path
     if world > 1:
         out = out.with_name(f"{out.stem}.part{rank}{out.suffix}")
     out.parent.mkdir(parents=True, exist_ok=True)
-    if out.suffix == ".jsonl":
-        import json
+    out_tmp = out.with_name(out.name + ".tmp")
 
-        from .training.corpus import _doc_to_json
+    # one streaming writer per output family: text formats share a handle
+    # (.jsonl plain, .msgdoc gzip lines), .spacy goes through the
+    # incremental DocBinWriter. Everything lands in a .tmp first and is
+    # promoted on success — a mid-corpus failure must not leave a
+    # well-formed-looking truncated artifact at the final path.
+    text_f = docbin_writer = None
+    if out.suffix == ".spacy":
+        from .training.spacy_docbin import DocBinWriter
 
-        with open(out, "w", encoding="utf8") as f:
-            for d in docs:
-                f.write(json.dumps(_doc_to_json(d)) + "\n")
-    elif out.suffix == ".spacy":
-        from .training.spacy_docbin import write_docbin
-
-        write_docbin(out, docs)
+        docbin_writer = DocBinWriter()
+    elif out.suffix == ".jsonl":
+        text_f = open(out_tmp, "w", encoding="utf8")
     else:
-        from .training.corpus import DocBin
+        import gzip
 
-        DocBin(docs).to_disk(out)
+        text_f = gzip.open(out_tmp, "wt", encoding="utf8")
+
+    mesh = build_mesh(n_data=args.n_workers) if jax.process_count() == 1 else None
+    n_docs = n_words = 0
+    seconds = 0.0
+    try:
+        while True:
+            chunk = list(itertools.islice(doc_iter, args.batch_size))
+            if not chunk:
+                break
+            t0 = time.perf_counter()
+            nlp.predict_docs(chunk, batch_size=args.batch_size, mesh=mesh)
+            seconds += time.perf_counter() - t0
+            n_docs += len(chunk)
+            n_words += sum(len(d) for d in chunk)
+            if text_f is not None:
+                import json
+
+                from .training.corpus import _doc_to_json
+
+                for d in chunk:
+                    text_f.write(json.dumps(_doc_to_json(d)) + "\n")
+            else:
+                for d in chunk:
+                    docbin_writer.add(d)
+    except BaseException:
+        if text_f is not None:
+            text_f.close()
+            out_tmp.unlink(missing_ok=True)
+        raise
+    if text_f is not None:
+        text_f.close()
+    if seen["total"] == 0:
+        out_tmp.unlink(missing_ok=True)
+        print(f"No documents in {args.input_path}", file=sys.stderr)
+        return 1
+    if docbin_writer is not None:
+        docbin_writer.finalize(out_tmp)
+    _os.replace(out_tmp, out)
     print(
-        f"Parsed {len(docs)} docs ({n_words} words) in {seconds:.1f}s "
+        f"Parsed {n_docs} docs ({n_words} words) in {seconds:.1f}s "
         f"({n_words / max(seconds, 1e-9):,.0f} words/s) -> {out}"
     )
     return 0
@@ -806,25 +861,31 @@ def find_threshold_command(argv: List[str]) -> int:
 
     n = max(int(args.n_trials), 2)
     best = (None, -1.0)
-    for i in range(n):
-        t = i / (n - 1)
-        setattr(comp, args.threshold_key, t)
-        for chunk, lengths, outputs in chunks:
-            comp.set_annotations(chunk, outputs.get(args.pipe_name), lengths)
-        scores = comp.score(examples)
-        value = scores.get(scores_key)
-        if value is None and i == 0 and scores_key not in scores:
-            print(
-                f"{scores_key!r} is not produced by "
-                f"[components.{args.pipe_name}] (its scores: "
-                f"{', '.join(sorted(scores))}) — find-threshold sweeps one "
-                "component's own metric", file=sys.stderr,
-            )
-            return 1
-        shown = f"{value:.4f}" if value is not None else "-"
-        print(f"threshold={t:.3f}  {scores_key}={shown}")
-        if value is not None and value > best[1]:
-            best = (t, float(value))
+    try:
+        for i in range(n):
+            t = i / (n - 1)
+            setattr(comp, args.threshold_key, t)
+            for chunk, lengths, outputs in chunks:
+                comp.set_annotations(chunk, outputs.get(args.pipe_name), lengths)
+            scores = comp.score(examples)
+            value = scores.get(scores_key)
+            if value is None and i == 0 and scores_key not in scores:
+                print(
+                    f"{scores_key!r} is not produced by "
+                    f"[components.{args.pipe_name}] (its scores: "
+                    f"{', '.join(sorted(scores))}) — find-threshold sweeps one "
+                    "component's own metric", file=sys.stderr,
+                )
+                return 1
+            shown = f"{value:.4f}" if value is not None else "-"
+            print(f"threshold={t:.3f}  {scores_key}={shown}")
+            if value is not None and value > best[1]:
+                best = (t, float(value))
+    finally:
+        # the sweep must not leave the component at its last trial value
+        # (t=1.0): an in-process save after this call would persist an
+        # arbitrary threshold (round-4 advisor finding)
+        setattr(comp, args.threshold_key, current)
     if best[0] is None:
         print(f"{scores_key} was None at every threshold (no gold "
               "annotation for this metric in the dev data?)", file=sys.stderr)

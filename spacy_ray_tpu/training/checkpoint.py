@@ -113,14 +113,16 @@ class TrainCheckpoint:
         # --resume can checkpoint at the same step the live meta already
         # points at, and an in-place rewrite of that file would reopen
         # the torn-write hole for exactly that generation
+        # np.savez ALWAYS appends .npz to a non-.npz name, so the written
+        # file is deterministically params-{stamp}.npz.tmp.npz — never
+        # branch on exists(): a stale literal .tmp left by other tooling
+        # would be promoted over the freshly written file
         params_tmp = path / f"params-{stamp}.npz.tmp"
         save_params(params_tmp, params)
-        # np.savez appends .npz when the suffix differs — normalize
-        written = (
-            params_tmp if params_tmp.exists()
-            else params_tmp.with_suffix(params_tmp.suffix + ".npz")
+        os.replace(
+            params_tmp.with_suffix(params_tmp.suffix + ".npz"),
+            path / f"params-{stamp}.npz",
         )
-        os.replace(written, path / f"params-{stamp}.npz")
         host_opt = gather_to_host(opt_state)
         opt_tmp = path / f"opt_state-{stamp}.pkl.tmp"
         with open(opt_tmp, "wb") as f:

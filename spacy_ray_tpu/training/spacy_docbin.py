@@ -363,32 +363,42 @@ _WRITE_ATTRS = ["ORTH", "LEMMA", "POS", "TAG", "DEP", "ENT_IOB", "ENT_TYPE",
                 "HEAD", "SENT_START", "SPACY"]
 
 
-def write_docbin(path: Union[str, Path], docs: Iterable[Doc]) -> None:
-    """Write docs in the real .spacy byte format (readable by spaCy)."""
-    import msgpack
+class DocBinWriter:
+    """Incremental .spacy writer: ``add`` docs as they are produced,
+    ``finalize`` serializes once. The bulk parse CLI streams predicted
+    chunks through here so the host holds ~100 bytes of packed attribute
+    rows per token instead of every annotated Doc at once (the whole-corpus
+    materialization the round-4 advisor flagged)."""
 
-    docs = list(docs)
-    # ENT_KB_ID and MORPH sit above the fixed enum at 84/85 — the "default
-    # pair" position _resolve_attr_names maps back positionally. A real
-    # spaCy reader resolves IDs against its own enum and may skip these two
-    # columns (see module docstring); the certain-ID columns interoperate.
-    write_ids = {**{_IDS[a]: a for a in _WRITE_ATTRS}, 84: "ENT_KB_ID", 85: "MORPH"}
-    attr_ids = sorted(write_ids)
-    names = [write_ids[a] for a in attr_ids]
-    strings: set = set()
-    rows_all: List[np.ndarray] = []
-    spaces_all: List[np.ndarray] = []
-    lengths: List[int] = []
-    cats: List[dict] = []
-    flags: List[dict] = []
-    span_groups: List[bytes] = []
+    def __init__(self) -> None:
+        import msgpack  # surface a missing dep at construction, not finalize
 
-    for doc in docs:
+        self._msgpack = msgpack
+        # ENT_KB_ID and MORPH sit above the fixed enum at 84/85 — the
+        # "default pair" position _resolve_attr_names maps back
+        # positionally. A real spaCy reader resolves IDs against its own
+        # enum and may skip these two columns (see module docstring); the
+        # certain-ID columns interoperate.
+        write_ids = {
+            **{_IDS[a]: a for a in _WRITE_ATTRS}, 84: "ENT_KB_ID", 85: "MORPH"
+        }
+        self._attr_ids = sorted(write_ids)
+        self._names = [write_ids[a] for a in self._attr_ids]
+        self._strings: set = set()
+        self._rows_all: List[np.ndarray] = []
+        self._spaces_all: List[np.ndarray] = []
+        self._lengths: List[int] = []
+        self._cats: List[dict] = []
+        self._flags: List[dict] = []
+        self._span_groups: List[bytes] = []
+
+    def add(self, doc: Doc) -> None:
+        attr_ids, names, strings = self._attr_ids, self._names, self._strings
         n = len(doc.words)
-        lengths.append(n)
-        cats.append(dict(doc.cats) if doc.cats else {})
-        flags.append({"has_unknown_spaces": doc.spaces is None})
-        span_groups.append(_span_groups_to_bytes(doc, strings))
+        self._lengths.append(n)
+        self._cats.append(dict(doc.cats) if doc.cats else {})
+        self._flags.append({"has_unknown_spaces": doc.spaces is None})
+        self._span_groups.append(_span_groups_to_bytes(doc, strings))
         # unannotated -> ENT_IOB 0 (missing); annotated (even with zero
         # entities, when ents_annotated says so) -> explicit O everywhere.
         # Writing O for missing would fabricate negative NER gold for
@@ -457,25 +467,39 @@ def write_docbin(path: Union[str, Path], docs: Iterable[Doc]) -> None:
             # mask in Python ints: hashes occupy the full uint64 range and
             # HEAD/SENT_START deltas are negative (two's complement)
             arr[:, ci] = np.asarray([int(v) & _M64 for v in vals], dtype="<u8")
-        rows_all.append(arr)
+        self._rows_all.append(arr)
         sp = doc.spaces if doc.spaces is not None else [True] * n
-        spaces_all.append(np.asarray(sp, dtype=bool).reshape(n, 1))
+        self._spaces_all.append(np.asarray(sp, dtype=bool).reshape(n, 1))
 
-    tokens_buf = (
-        np.vstack(rows_all).tobytes("C") if rows_all and sum(lengths) else b""
-    )
-    spaces_buf = (
-        np.vstack(spaces_all).tobytes("C") if spaces_all and sum(lengths) else b""
-    )
-    msg = {
-        "version": "0.1",
-        "attrs": attr_ids,
-        "tokens": tokens_buf,
-        "spaces": spaces_buf,
-        "lengths": np.asarray(lengths, dtype="<i4").tobytes("C"),
-        "strings": sorted(strings),
-        "cats": cats,
-        "flags": flags,
-        "span_groups": span_groups,
-    }
-    Path(path).write_bytes(zlib.compress(msgpack.packb(msg, use_bin_type=True)))
+    def finalize(self, path: Union[str, Path]) -> None:
+        rows_all, spaces_all = self._rows_all, self._spaces_all
+        lengths = self._lengths
+        tokens_buf = (
+            np.vstack(rows_all).tobytes("C") if rows_all and sum(lengths) else b""
+        )
+        spaces_buf = (
+            np.vstack(spaces_all).tobytes("C")
+            if spaces_all and sum(lengths) else b""
+        )
+        msg = {
+            "version": "0.1",
+            "attrs": self._attr_ids,
+            "tokens": tokens_buf,
+            "spaces": spaces_buf,
+            "lengths": np.asarray(lengths, dtype="<i4").tobytes("C"),
+            "strings": sorted(self._strings),
+            "cats": self._cats,
+            "flags": self._flags,
+            "span_groups": self._span_groups,
+        }
+        Path(path).write_bytes(
+            zlib.compress(self._msgpack.packb(msg, use_bin_type=True))
+        )
+
+
+def write_docbin(path: Union[str, Path], docs: Iterable[Doc]) -> None:
+    """Write docs in the real .spacy byte format (readable by spaCy)."""
+    writer = DocBinWriter()
+    for doc in docs:
+        writer.add(doc)
+    writer.finalize(path)

@@ -67,6 +67,52 @@ def test_parse_empty_input_fails_loudly(trained_model, tmp_path):
         "parse", str(trained_model), str(tmp_path / "empty.txt"),
         str(tmp_path / "out.jsonl"), "--device", "cpu",
     ]) == 1
+    assert not (tmp_path / "out.jsonl").exists()  # no empty artifact
+
+
+def test_parse_empty_rank_slice_succeeds(trained_model, tmp_path, monkeypatch):
+    """world > n_docs: a rank whose round-robin slice is empty must still
+    exit 0 and write its (empty) part file — only a genuinely empty CORPUS
+    is an error (the pre-streaming behavior, kept across the rewrite)."""
+    import jax
+
+    (tmp_path / "three.txt").write_text("a b\nc d\ne f\n")
+    monkeypatch.setattr(jax, "process_index", lambda: 3)
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    rc = cli_main([
+        "parse", str(trained_model), str(tmp_path / "three.txt"),
+        str(tmp_path / "out.jsonl"), "--device", "cpu",
+    ])
+    assert rc == 0
+    part = tmp_path / "out.part3.jsonl"
+    assert part.exists() and part.read_text() == ""
+
+
+def test_parse_failure_leaves_no_truncated_artifact(trained_model, tmp_path,
+                                                    monkeypatch):
+    """A mid-corpus prediction failure must not leave a well-formed-looking
+    truncated output at the final path (the .tmp is cleaned up instead)."""
+    from spacy_ray_tpu.pipeline.language import Pipeline
+
+    write_synth_jsonl(tmp_path / "in.jsonl", 40, kind="tagger", seed=3)
+    calls = {"n": 0}
+    real = Pipeline.predict_docs
+
+    def boom(self, docs, **kw):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("synthetic mid-corpus failure")
+        return real(self, docs, **kw)
+
+    monkeypatch.setattr(Pipeline, "predict_docs", boom)
+    with pytest.raises(RuntimeError, match="mid-corpus"):
+        cli_main([
+            "parse", str(trained_model), str(tmp_path / "in.jsonl"),
+            str(tmp_path / "out.jsonl"), "--device", "cpu",
+            "--batch-size", "8",
+        ])
+    assert not (tmp_path / "out.jsonl").exists()
+    assert not (tmp_path / "out.jsonl.tmp").exists()
 
 
 TEXTCAT_CFG = """
@@ -110,12 +156,16 @@ patience = 0
 """
 
 
-def test_find_threshold_sweeps_and_reports_best(tmp_path, capsys):
+def test_find_threshold_sweeps_and_reports_best(tmp_path, capsys, monkeypatch):
     """find-threshold: sweep textcat_multilabel's threshold on dev data,
     report the best value by the component's default positive score key
-    (spaCy's find-threshold surface)."""
+    (spaCy's find-threshold surface) — and leave the component's threshold
+    attribute at its ORIGINAL value afterwards (round-4 advisor: the sweep
+    must not park it at the last trial value, t=1.0, where any future
+    in-process save would persist it)."""
     write_synth_jsonl(tmp_path / "train.jsonl", 120, kind="textcat", seed=0)
     write_synth_jsonl(tmp_path / "dev.jsonl", 40, kind="textcat", seed=1)
+    from spacy_ray_tpu.pipeline.language import Pipeline
     from spacy_ray_tpu.training.loop import train
 
     cfg = Config.from_str(TEXTCAT_CFG).apply_overrides(
@@ -126,6 +176,16 @@ def test_find_threshold_sweeps_and_reports_best(tmp_path, capsys):
     )
     train(cfg, output_path=tmp_path / "out", n_workers=1, stdout_log=False)
 
+    captured = {}
+    real_from_disk = Pipeline.from_disk.__func__
+
+    def spy(cls, path):
+        nlp = real_from_disk(cls, path)
+        comp = nlp.components["textcat_multilabel"]
+        captured["comp"], captured["before"] = comp, comp.threshold
+        return nlp
+
+    monkeypatch.setattr(Pipeline, "from_disk", classmethod(spy))
     rc = cli_main([
         "find-threshold", str(tmp_path / "out" / "best-model"),
         str(tmp_path / "dev.jsonl"), "textcat_multilabel",
@@ -137,6 +197,8 @@ def test_find_threshold_sweeps_and_reports_best(tmp_path, capsys):
     assert out.count("threshold=") >= 5
     assert "Best: threshold=" in out
     assert "cats_score=" in out
+    # the sweep restored the component's original threshold
+    assert captured["comp"].threshold == captured["before"]
 
 
 def test_find_threshold_unknown_pipe_fails(tmp_path, trained_model):
