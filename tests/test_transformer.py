@@ -49,6 +49,43 @@ def test_ring_attention_all_masked_rows_finite():
     assert np.isfinite(np.asarray(out)).all()
 
 
+def test_resolve_compute_dtype():
+    """"auto" is platform-aware (f32 on the CPU backend — bf16 there costs
+    casts and buys no matmul speed, PERF.md §MFU); explicit names pin the
+    dtype; unknown names fail loudly."""
+    from spacy_ray_tpu.models.transformer import _resolve_compute_dtype
+
+    assert _resolve_compute_dtype("bfloat16") is jnp.bfloat16
+    assert _resolve_compute_dtype("float32") is jnp.float32
+    # tests run on the CPU backend (conftest pins it)
+    assert _resolve_compute_dtype("auto") is jnp.float32
+    with pytest.raises(ValueError, match="compute_dtype must be one of"):
+        _resolve_compute_dtype("float16")
+
+
+def test_compute_dtype_config_knob():
+    """The [components.transformer.model] compute_dtype key reaches the
+    layer stack: an explicit bfloat16 run produces bf16-rounded outputs
+    that differ from the CPU-default f32 path but stay close to it."""
+    cfg_bf16 = TRF_CFG.replace(
+        "remat = false", 'remat = false\ncompute_dtype = "bfloat16"'
+    )
+    assert "compute_dtype" in cfg_bf16
+    out = {}
+    for name, text in (("f32", TRF_CFG), ("bf16", cfg_bf16)):
+        nlp = Pipeline.from_config(Config.from_str(text))
+        examples = synth_corpus(16, "tagger", seed=0)
+        nlp.initialize(lambda: iter(examples), seed=0)
+        batch = nlp.collate(examples[:4], with_targets=False)
+        fwd = jax.jit(nlp.make_forward_fn())
+        head_out = fwd(nlp.params, batch["tokens"])["tagger"]
+        out[name] = np.asarray(
+            jax.device_get(jax.tree_util.tree_leaves(head_out)[0])
+        )
+    assert not np.array_equal(out["f32"], out["bf16"])  # knob took effect
+    np.testing.assert_allclose(out["f32"], out["bf16"], atol=0.15)
+
+
 @pytest.fixture(scope="module")
 def trf_nlp():
     nlp = Pipeline.from_config(Config.from_str(TRF_CFG))
