@@ -1100,6 +1100,65 @@ def fill_config_command(argv: List[str]) -> int:
     return 0
 
 
+def benchmark_command(argv: List[str]) -> int:
+    """``benchmark speed`` / ``benchmark accuracy`` — spaCy's `spacy
+    benchmark` surface. `speed` times bulk inference on a corpus with
+    warmup, reporting median words/s over N repetitions with min/max (the
+    same dispersion discipline as bench.py); `accuracy` is `evaluate`
+    under its spaCy-CLI name."""
+    import time
+
+    if argv and argv[0] == "accuracy":
+        return evaluate_command(argv[1:])
+    if not argv or argv[0] != "speed":
+        print("Usage: spacy_ray_tpu benchmark {speed,accuracy} "
+              "<model> <data> ...", file=sys.stderr)
+        return 1
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu benchmark speed")
+    parser.add_argument("model_path", type=Path)
+    parser.add_argument("data_path", type=Path)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--n-reps", type=int, default=3)
+    parser.add_argument("--warmup", type=int, default=1,
+                        help="un-timed full passes first (compile + cache)")
+    parser.add_argument("--device", type=str, default="tpu",
+                        choices=["tpu", "cpu", "gpu"])
+    args = parser.parse_args(argv[1:])
+    _setup_device(args.device)
+
+    from .pipeline.language import Pipeline
+    from .training.corpus import Corpus
+
+    nlp = Pipeline.from_disk(args.model_path)
+    examples = list(Corpus(args.data_path)())
+    if not examples:
+        print(f"No documents in {args.data_path}", file=sys.stderr)
+        return 1
+    n_words = sum(len(eg.reference) for eg in examples)
+
+    def one_pass():
+        docs = [eg.reference.copy_shell() for eg in examples]
+        t0 = time.perf_counter()
+        nlp.predict_docs(docs, batch_size=args.batch_size)
+        return time.perf_counter() - t0
+
+    import statistics
+
+    for _ in range(max(args.warmup, 0)):
+        one_pass()
+    rates = sorted(n_words / one_pass() for _ in range(max(args.n_reps, 1)))
+    median = statistics.median(rates)
+    print(
+        f"Benchmark: {len(examples)} docs, {n_words} words, "
+        f"batch_size={args.batch_size}, reps={len(rates)}"
+    )
+    print(
+        f"words/s: median {median:,.0f}  min {rates[0]:,.0f}  "
+        f"max {rates[-1]:,.0f}"
+    )
+    return 0
+
+
 COMMANDS = {
     "train": train_command,
     "pretrain": pretrain_command,
@@ -1109,6 +1168,7 @@ COMMANDS = {
     "debug-model": debug_model_command,
     "fill-config": fill_config_command,
     "evaluate": evaluate_command,
+    "benchmark": benchmark_command,
     "convert": convert_command,
     "init-config": init_config_command,
     "init-vectors": init_vectors_command,

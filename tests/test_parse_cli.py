@@ -61,6 +61,31 @@ def test_parse_txt_input_docbin_output(trained_model, tmp_path):
     assert all(docs[0].tags), docs[0].tags
 
 
+def test_benchmark_speed_and_accuracy(trained_model, tmp_path, capsys):
+    """`benchmark speed` reports median/min/max words/s over reps;
+    `benchmark accuracy` is the spaCy-CLI name for evaluate."""
+    write_synth_jsonl(tmp_path / "dev.jsonl", 20, kind="tagger", seed=4)
+    rc = cli_main([
+        "benchmark", "speed", str(trained_model), str(tmp_path / "dev.jsonl"),
+        "--device", "cpu", "--n-reps", "2",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "words/s: median" in out and "min" in out and "max" in out
+
+    rc = cli_main([
+        "benchmark", "accuracy", str(trained_model),
+        str(tmp_path / "dev.jsonl"), "--device", "cpu",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "tag_acc" in out
+
+    rc = cli_main(["benchmark", "nope"])
+    assert rc == 1
+    assert "speed,accuracy" in capsys.readouterr().err
+
+
 def test_parse_empty_input_fails_loudly(trained_model, tmp_path):
     (tmp_path / "empty.txt").write_text("")
     assert cli_main([
