@@ -1080,9 +1080,26 @@ def main() -> None:
 
     if args.measure_baseline:
         # merge: a subset run (or a failed config) must not erase the other
-        # configs' previously measured baselines
+        # configs' previously measured baselines. A contended record is a
+        # DEPRESSED denominator that would inflate every future
+        # vs_baseline — keep the existing entry if it was cleaner.
         merged = dict(baseline)
-        merged.update({r["name"]: r for r in results})
+        for r in results:
+            old = merged.get(r["name"])
+            old_ratio = (old or {}).get("peak_reprobe_ratio") or 0.0
+            # unknown ratio counts as dirty (0.0), matching old_ratio's
+            # default — never let an unstamped record pose as clean
+            new_ratio = r.get("peak_reprobe_ratio") or 0.0
+            if r.get("contended") and old is not None and old_ratio >= new_ratio:
+                print(f"# {r['name']}: contended (reprobe {new_ratio}); "
+                      f"keeping previous baseline (reprobe {old_ratio})",
+                      flush=True)
+                continue
+            if r.get("contended"):
+                print(f"# WARNING {r['name']}: baseline recorded from a "
+                      f"contended run (reprobe {new_ratio}) — re-run "
+                      "--measure-baseline on a quiet host", flush=True)
+            merged[r["name"]] = r
         BASELINE_FILE.write_text(
             json.dumps(merged, indent=2) + "\n", encoding="utf8"
         )

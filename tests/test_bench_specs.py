@@ -171,6 +171,39 @@ def test_parent_fallback_protocol(tmp_path, monkeypatch, capsys):
     assert len(set(c[3] for c in calls)) == 1  # one run id throughout
 
 
+def test_measure_baseline_keeps_cleaner_entry(tmp_path, monkeypatch, capsys):
+    """--measure-baseline must not overwrite a clean denominator with a
+    contended (depressed) one — that would inflate every future
+    vs_baseline ratio."""
+    baseline = tmp_path / "base.json"
+    baseline.write_text(json.dumps({
+        "cnn_tagger": {"name": "cnn_tagger", "value": 2800.0,
+                       "peak_reprobe_ratio": 0.99, "contended": False},
+    }))
+    monkeypatch.setattr(bench, "BASELINE_FILE", baseline)
+    monkeypatch.setattr(bench, "SESSION_FILE", tmp_path / "s.jsonl")
+    contended_rec = {"name": "cnn_tagger", "value": 2500.0, "metric": "m",
+                     "peak_reprobe_ratio": 0.85, "contended": True}
+    clean_rec = {"name": "trf", "value": 9.0, "metric": "m",
+                 "peak_reprobe_ratio": 0.98, "contended": False}
+
+    def fake_configs(platform):
+        return [dict(name="cnn_tagger"), dict(name="trf")]
+
+    results = {"cnn_tagger": contended_rec, "trf": clean_rec}
+    monkeypatch.setattr(bench, "_configs", fake_configs)
+    monkeypatch.setattr(
+        bench, "run_one", lambda spec, platform: dict(results[spec["name"]])
+    )
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--measure-baseline"])
+    bench.main()
+    out = capsys.readouterr().out
+    assert "keeping previous baseline" in out
+    merged = json.loads(baseline.read_text())
+    assert merged["cnn_tagger"]["value"] == 2800.0  # clean entry survived
+    assert merged["trf"]["value"] == 9.0  # clean new record written
+
+
 def test_headline_summary_no_records(tmp_path, monkeypatch, capsys):
     session = tmp_path / "session.jsonl"
     session.write_text("")
