@@ -647,17 +647,18 @@ def init_vectors_command(argv: List[str]) -> int:
     return 0
 
 
-def parse_command(argv: List[str]) -> int:
+def parse_command(argv: List[str], prog: str = "parse") -> int:
     """Bulk parallel inference: annotate a corpus with a trained pipeline —
     the ``spacy ray parse`` command the reference advertises as planned
     (reference README.md:15 "we expect to add `spacy ray pretrain` and
-    `spacy ray parse` as well"). Prediction batches shard over the mesh's
+    `spacy ray parse` as well"); also exposed as ``apply`` (spaCy's name
+    for the same operation). Prediction batches shard over the mesh's
     ``data`` axis (every local device busy); under multi-host each process
     parses a round-robin shard of the input and writes its own output
     part, so throughput scales with hosts like the training loop does."""
     import time
 
-    parser = argparse.ArgumentParser(prog="spacy_ray_tpu parse")
+    parser = argparse.ArgumentParser(prog=f"spacy_ray_tpu {prog}")
     parser.add_argument("model_path", type=Path)
     parser.add_argument("input_path", type=Path,
                         help=".jsonl/.conllu/.msgdoc/.spacy corpus, or .txt "
@@ -1100,6 +1101,54 @@ def fill_config_command(argv: List[str]) -> int:
     return 0
 
 
+def debug_profile_command(argv: List[str]) -> int:
+    """spaCy's `debug profile` surface: cProfile bulk inference over a
+    corpus and print the hottest host-side functions. Device compute shows
+    up as opaque `block_until_ready`/execute frames — use
+    `train --profile` (jax.profiler) for the device-side picture; this
+    command is for finding HOST bottlenecks (tokenization, collation,
+    decode, annotation)."""
+    import cProfile
+    import pstats
+
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu debug-profile")
+    parser.add_argument("model_path", type=Path)
+    parser.add_argument("data_path", type=Path)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--n-rows", type=int, default=25,
+                        help="how many rows of the cumtime table to print")
+    parser.add_argument("--device", type=str, default="tpu",
+                        choices=["tpu", "cpu", "gpu"])
+    args = parser.parse_args(argv)
+    _setup_device(args.device)
+
+    from .pipeline.language import Pipeline
+    from .training.corpus import Corpus
+
+    nlp = Pipeline.from_disk(args.model_path)
+    examples = list(Corpus(args.data_path)())
+    if not examples:
+        print(f"No documents in {args.data_path}", file=sys.stderr)
+        return 1
+    docs = [eg.reference.copy_shell() for eg in examples]
+    # un-profiled warmup pass: compile time would otherwise dominate the
+    # table and hide the steady-state host cost
+    nlp.predict_docs([d.copy_shell() for d in docs], batch_size=args.batch_size)
+
+    profiler = cProfile.Profile()
+    profiler.enable()
+    try:
+        nlp.predict_docs(docs, batch_size=args.batch_size)
+    finally:
+        # a raised predict must not leave the process-wide C profiling
+        # hook installed (in-process callers: every later call runs
+        # profiled and a second Profile().enable() raises)
+        profiler.disable()
+    stats = pstats.Stats(profiler, stream=sys.stdout)
+    stats.strip_dirs().sort_stats("cumulative").print_stats(args.n_rows)
+    return 0
+
+
 def benchmark_command(argv: List[str]) -> int:
     """``benchmark speed`` / ``benchmark accuracy`` — spaCy's `spacy
     benchmark` surface. `speed` times bulk inference on a corpus with
@@ -1163,6 +1212,9 @@ COMMANDS = {
     "train": train_command,
     "pretrain": pretrain_command,
     "parse": parse_command,
+    # spaCy's name for bulk annotation; same command, correctly-named help
+    "apply": lambda argv: parse_command(argv, prog="apply"),
+    "debug-profile": debug_profile_command,
     "find-threshold": find_threshold_command,
     "info": info_command,
     "debug-model": debug_model_command,

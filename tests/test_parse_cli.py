@@ -86,6 +86,29 @@ def test_benchmark_speed_and_accuracy(trained_model, tmp_path, capsys):
     assert "speed,accuracy" in capsys.readouterr().err
 
 
+def test_apply_alias_and_debug_profile(trained_model, tmp_path, capsys):
+    """`apply` is spaCy's name for bulk annotation (same command as
+    parse); `debug-profile` prints a host-side cProfile table."""
+    write_synth_jsonl(tmp_path / "in.jsonl", 12, kind="tagger", seed=5)
+    rc = cli_main([
+        "apply", str(trained_model), str(tmp_path / "in.jsonl"),
+        str(tmp_path / "applied.jsonl"), "--device", "cpu",
+    ])
+    assert rc == 0
+    rows = [json.loads(l)
+            for l in (tmp_path / "applied.jsonl").read_text().splitlines()]
+    assert len(rows) == 12 and all(r.get("tags") for r in rows)
+    capsys.readouterr()
+
+    rc = cli_main([
+        "debug-profile", str(trained_model), str(tmp_path / "in.jsonl"),
+        "--device", "cpu", "--n-rows", "10",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "cumtime" in out and "predict_docs" in out
+
+
 def test_parse_empty_input_fails_loudly(trained_model, tmp_path):
     (tmp_path / "empty.txt").write_text("")
     assert cli_main([
