@@ -359,6 +359,23 @@ def _configs(platform: str) -> List[Dict[str, Any]]:
             accel_only=True,
             timeout=3600.0,
         ),
+        # switch-MoE variant of the same trunk: the top-1 expert FFN path
+        # (dispatch one-hot matmuls + capacity dropping) has its own cost
+        # shape and no bench coverage otherwise. Single-chip it measures
+        # MoE compute; on a mesh the experts shard over the model axis.
+        dict(
+            name="trf_moe",
+            metric="train_words_per_sec_per_chip (trf + switch-MoE FFN, 8 experts, B=16/T=128)",
+            cfg=INIT_PRESETS["trf"].replace(
+                "remat = true", "remat = true\nn_experts = 8"
+            ),
+            kinds=["parser", "ner"],
+            B=16, T=128, steps=10, warmup=3,
+            stages=[(4, 32), (8, 64)],
+            attention=True,
+            accel_only=True,
+            timeout=3600.0,
+        ),
         # long-sequence A/B: same transformer, T=2048, flash attention
         # auto-enabled (probe) vs forced off — the pallas kernel's win is
         # the delta between these two lines. Attention dominates at this

@@ -227,11 +227,21 @@ def test_child_zero_config_match_exits_nonzero(monkeypatch):
         raise AssertionError("expected SystemExit(3)")
 
 
+def test_trf_moe_spec_shape():
+    tpu = _by_name("tpu")
+    assert "trf_moe" not in _by_name("cpu")
+    spec = tpu["trf_moe"]
+    assert "n_experts = 8" in spec["cfg"]
+    sizes = [b * t for b, t in spec["stages"]] + [spec["B"] * spec["T"]]
+    assert sizes == sorted(sizes) and len(set(sizes)) == len(sizes)
+
+
 @pytest.mark.slow
-def test_trf_realistic_first_stage_compiles_on_cpu():
-    """The accelerator-gated hardware-shaped spec must not be dead code: its
-    pipeline builds and its smallest compile stage (B=4, T=32) runs one real
-    update on the CPU host (VERDICT r4 next #6 'compiles in the dryrun-sized
+@pytest.mark.parametrize("spec_name", ["trf_realistic", "trf_moe"])
+def test_accel_spec_first_stage_compiles_on_cpu(spec_name):
+    """The accelerator-gated specs must not be dead code: their pipelines
+    build and the smallest compile stage (B=4, T=32) runs one real update
+    on the CPU host (VERDICT r4 next #6 'compiles in the dryrun-sized
     stage on CPU')."""
     import jax
 
@@ -246,7 +256,7 @@ def test_trf_realistic_first_stage_compiles_on_cpu():
     )
     from spacy_ray_tpu.registry import registry
 
-    spec = _by_name("tpu")["trf_realistic"]
+    spec = _by_name("tpu")[spec_name]
     sb, st = spec["stages"][0]
     nlp = Pipeline.from_config(Config.from_str(spec["cfg"]))
     examples = bench._corpus(spec["kinds"], max(2 * sb, 16))
