@@ -1101,6 +1101,84 @@ def fill_config_command(argv: List[str]) -> int:
     return 0
 
 
+def init_labels_command(argv: List[str]) -> int:
+    """spaCy's `init labels` surface: collect every trainable component's
+    label set from the training corpus ONCE and write one JSON file per
+    component. Point the config at them via
+    ``[initialize.components.<name>] labels = "<dir>/<name>.json"`` —
+    later runs skip corpus label collection and the class ORDER is frozen
+    (a grown corpus can no longer silently renumber classes between
+    train/resume)."""
+    import json
+
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu init-labels")
+    parser.add_argument("config_path", type=Path)
+    parser.add_argument("output_dir", type=Path)
+    parser.add_argument("--code", type=Path, default=None)
+    parser.add_argument("--device", type=str, default="cpu",
+                        choices=["tpu", "cpu", "gpu"],
+                        help="label collection is host-side; cpu default")
+    args, extra = parser.parse_known_args(argv)
+    _setup_device(args.device)
+
+    from .config import load_config, parse_cli_overrides
+    from .registry import import_code, registry
+    from .training.loop import resolve_dot_name, resolve_training
+
+    import_code(str(args.code) if args.code else None)
+    config = load_config(args.config_path, parse_cli_overrides(extra),
+                         interpolate=False).interpolate()
+    T = resolve_training(config)
+    corpora_cfg = config.get("corpora", {})
+    resolved = {n: registry.resolve(b) for n, b in corpora_cfg.items()}
+    train_corpus = resolve_dot_name(config, resolved, T["train_corpus"])
+
+    from .pipeline.language import LABEL_SAMPLE_LIMIT, Pipeline
+
+    nlp = Pipeline.from_config(config)
+    sample = []
+    for i, eg in enumerate(train_corpus()):
+        if i >= LABEL_SAMPLE_LIMIT:  # Pipeline.initialize's cap, shared
+            break
+        sample.append(eg)
+    if not sample:
+        print("Training corpus is empty — no labels to collect",
+              file=sys.stderr)
+        return 1
+    args.output_dir.mkdir(parents=True, exist_ok=True)
+    written = []
+    for name in nlp.pipe_names:
+        if name in nlp.sourced_components:
+            # initialize ignores a labels override for sourced components
+            # (their labels came with the saved model) — writing a file
+            # here would advertise a pin that can never take effect
+            print(f"[components.{name}] sourced: labels come with the "
+                  "saved component; skipped")
+            continue
+        comp = nlp.components[name]
+        comp.add_labels_from(sample)
+        comp.finish_labels()
+        if not comp.labels:
+            continue  # host-only / label-free components have nothing to pin
+        out = args.output_dir / f"{name}.json"
+        out.write_text(json.dumps(comp.labels, indent=2) + "\n",
+                       encoding="utf8")
+        print(f"[components.{name}] {len(comp.labels)} labels -> {out}")
+        written.append(name)
+    if written:
+        print(
+            "Use in the config:\n"
+            + "".join(
+                f'[initialize.components.{name}]\nlabels = '
+                f'"{args.output_dir / (name + ".json")}"\n'
+                for name in written
+            )
+        )
+    else:
+        print("No component produced labels from this corpus")
+    return 0
+
+
 def debug_profile_command(argv: List[str]) -> int:
     """spaCy's `debug profile` surface: cProfile bulk inference over a
     corpus and print the hottest host-side functions. Device compute shows
@@ -1223,6 +1301,7 @@ COMMANDS = {
     "benchmark": benchmark_command,
     "convert": convert_command,
     "init-config": init_config_command,
+    "init-labels": init_labels_command,
     "init-vectors": init_vectors_command,
     "assemble": assemble_command,
     "debug-data": debug_data_command,

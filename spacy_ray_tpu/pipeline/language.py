@@ -37,6 +37,10 @@ from .tokenizer import Tokenizer
 from .vectors import Vectors, use_vectors
 from .vocab import Vocab
 
+# Cap on gold examples scanned for label collection; the init-labels CLI
+# must use the SAME cap so its files reproduce initialize's collection.
+LABEL_SAMPLE_LIMIT = 10000
+
 
 class Pipeline:
     def __init__(
@@ -167,13 +171,15 @@ class Pipeline:
         get_examples: Optional[Callable[[], Iterable[Example]]] = None,
         *,
         seed: int = 0,
-        label_sample_limit: int = 10000,
+        label_sample_limit: int = LABEL_SAMPLE_LIMIT,
     ) -> Params:
         """Collect labels from gold data, build models, init params.
 
         The equivalent of spacy's ``init_nlp`` run per-worker at reference
         worker.py:91 (here it runs once; params are replicated by sharding).
         """
+        init_cfg = self.config.get("initialize", {}) if self.config else {}
+        init_components = init_cfg.get("components", {}) or {}
         if get_examples is not None:
             sample: List[Example] = []
             for i, eg in enumerate(get_examples()):
@@ -184,11 +190,46 @@ class Pipeline:
                 if name in self.sourced_components:
                     continue  # sourced: labels came with the saved component
                 comp = self.components[name]
+                labels_path = (init_components.get(name) or {}).get("labels")
+                if labels_path:
+                    # [initialize.components.<name>] labels = "<path>.json":
+                    # precomputed label set (the `init-labels` CLI output,
+                    # spaCy's `init labels` surface) — skips data collection
+                    # and freezes the label ORDER, so e.g. resuming against
+                    # a grown corpus can't silently renumber classes
+                    loaded = json.loads(
+                        Path(labels_path).read_text(encoding="utf8")
+                    )
+                    if (
+                        not isinstance(loaded, list)
+                        or not loaded
+                        or not all(isinstance(l, str) for l in loaded)
+                    ):
+                        raise ValueError(
+                            f"[initialize.components.{name}] labels file "
+                            f"{labels_path!r} must hold a non-empty JSON "
+                            "list of strings (write it with the "
+                            "init-labels command)"
+                        )
+                    if len(set(loaded)) != len(loaded):
+                        dupes = sorted(
+                            {l for l in loaded if loaded.count(l) > 1}
+                        )
+                        raise ValueError(
+                            f"[initialize.components.{name}] labels file "
+                            f"{labels_path!r} contains duplicates {dupes}: "
+                            "the head would be sized by the padded count "
+                            "while classes silently collapse"
+                        )
+                    # saved labels are already in final (finished) order;
+                    # finish_labels is NOT re-run — e.g. the edit-tree
+                    # lemmatizer keeps its identity label first
+                    comp.labels = list(loaded)
+                    continue
                 comp.add_labels_from(sample)
                 comp.finish_labels()
         # vectors asset ([initialize] vectors = "path.npz", spaCy semantics);
         # an explicit config path WINS over vectors adopted from a source
-        init_cfg = self.config.get("initialize", {}) if self.config else {}
         vectors_path = init_cfg.get("vectors")
         if vectors_path:
             self.vectors = Vectors.from_disk(vectors_path)

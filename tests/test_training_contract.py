@@ -237,6 +237,99 @@ def test_before_update_without_callback_ref_rejected(tagger_config_text, tmp_pat
 
 
 # ----------------------------------------------------------------------
+# [initialize.components.<name>] labels — the `init labels` contract
+# ----------------------------------------------------------------------
+
+
+def test_init_labels_cli_writes_and_pins_label_order(tagger_config_text, tmp_path):
+    """init-labels writes per-component JSON label files, and a config
+    pointing [initialize.components.<name>] labels at one SKIPS corpus
+    collection and freezes the label order exactly as saved (no re-sort:
+    a grown corpus must not silently renumber classes)."""
+    import json
+
+    from spacy_ray_tpu.cli import main as cli_main
+    from spacy_ray_tpu.util import write_synth_jsonl
+
+    write_synth_jsonl(tmp_path / "t.jsonl", 30, kind="tagger", seed=0)
+    cfg_path = tmp_path / "cfg.cfg"
+    cfg_path.write_text(tagger_config_text)
+    rc = cli_main([
+        "init-labels", str(cfg_path), str(tmp_path / "labels"),
+        "--paths.train", str(tmp_path / "t.jsonl"),
+        "--paths.dev", str(tmp_path / "t.jsonl"),
+    ])
+    assert rc == 0
+    labels_file = tmp_path / "labels" / "tagger.json"
+    collected = json.loads(labels_file.read_text())
+    assert collected == sorted(collected) and len(collected) > 1
+
+    # write a DIFFERENT order + an extra label: initialize must take the
+    # file verbatim (frozen order, superset allowed) and size the head by it
+    custom = list(reversed(collected)) + ["XTRA"]
+    labels_file.write_text(json.dumps(custom))
+    cfg = Config.from_str(tagger_config_text).apply_overrides(
+        {
+            "paths.train": str(tmp_path / "t.jsonl"),
+            "paths.dev": str(tmp_path / "t.jsonl"),
+            "initialize.components.tagger.labels": str(labels_file),
+        }
+    )
+    nlp = Pipeline.from_config(cfg.interpolate())
+    from spacy_ray_tpu.training.corpus import Corpus
+
+    examples = list(Corpus(tmp_path / "t.jsonl")())
+    nlp.initialize(lambda: iter(examples), seed=0)
+    assert nlp.components["tagger"].labels == custom  # not re-sorted
+    # the model head was sized by the pinned label set
+    w = [v for k, v in _flatten_params(nlp.params["tagger"]).items()
+         if k.endswith("/W") or k.endswith("W")]
+    assert any(arr.shape[-1] == len(custom) for arr in w), (
+        [a.shape for a in w]
+    )
+
+
+def _flatten_params(tree, prefix=""):
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten_params(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+@pytest.mark.parametrize(
+    "content,match",
+    [
+        ('{"not": "a list"}', "JSON list of strings"),
+        ("[]", "non-empty JSON list"),
+        ('["A", "B", "A"]', "duplicates"),
+    ],
+)
+def test_init_labels_bad_file_rejected(tagger_config_text, tmp_path, content,
+                                       match):
+    from spacy_ray_tpu.training.corpus import Corpus
+    from spacy_ray_tpu.util import write_synth_jsonl
+
+    write_synth_jsonl(tmp_path / "t.jsonl", 10, kind="tagger", seed=0)
+    bad = tmp_path / "bad.json"
+    bad.write_text(content)
+    cfg = Config.from_str(tagger_config_text).apply_overrides(
+        {
+            "paths.train": str(tmp_path / "t.jsonl"),
+            "paths.dev": str(tmp_path / "t.jsonl"),
+            "initialize.components.tagger.labels": str(bad),
+        }
+    )
+    nlp = Pipeline.from_config(cfg.interpolate())
+    examples = list(Corpus(tmp_path / "t.jsonl")())
+    with pytest.raises(ValueError, match=match):
+        nlp.initialize(lambda: iter(examples), seed=0)
+
+
+# ----------------------------------------------------------------------
 # annotating_components: downstream trains on upstream predictions
 # ----------------------------------------------------------------------
 
