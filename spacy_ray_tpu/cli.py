@@ -23,7 +23,7 @@ import argparse
 import logging
 import sys
 from pathlib import Path
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 logger = logging.getLogger("spacy_ray_tpu")
 
@@ -1065,6 +1065,7 @@ def fill_config_command(argv: List[str]) -> int:
     from .config import Config, load_config, parse_cli_overrides
     from .training.loop import (
         DEFAULT_TRAINING,
+        DEFAULT_TRAINING_BLOCKS,
         resolve_training,
     )
 
@@ -1079,16 +1080,8 @@ def fill_config_command(argv: List[str]) -> int:
     filled_training = dict(DEFAULT_TRAINING)
     filled_training.update(raw_training)
     # registry sub-blocks every run resolves implicitly when absent
-    filled_training.setdefault("optimizer", {"@optimizers": "Adam.v1",
-                                             "learn_rate": 0.001})
-    filled_training.setdefault(
-        "batcher",
-        {"@batchers": "spacy.batch_by_words.v1", "size": 1000,
-         "tolerance": 0.2},
-    )
-    filled_training.setdefault(
-        "logger", {"@loggers": "spacy_ray_tpu.ConsoleLogger.v1"}
-    )
+    for key, block in DEFAULT_TRAINING_BLOCKS.items():
+        filled_training.setdefault(key, dict(block))
     merged = dict(config)
     merged["training"] = filled_training
     merged.setdefault("paths", {"train": None, "dev": None})
@@ -1098,6 +1091,56 @@ def fill_config_command(argv: List[str]) -> int:
     added = sorted(set(filled_training) - set(raw_training))
     print(f"Filled {args.base_path} -> {args.output_path} "
           f"(added: {', '.join(added) if added else 'nothing'})")
+    return 0
+
+
+def debug_diff_command(argv: List[str]) -> int:
+    """spaCy's `debug diff-config` role: classify every [training] key of
+    a config against the defaults a bare config trains with (the same
+    table fill-config writes) — customized / redundant restatement of a
+    default / implicit default — so a reviewer sees at a glance what a
+    config actually changes."""
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu debug-diff-config")
+    parser.add_argument("config_path", type=Path)
+    args, extra = parser.parse_known_args(argv)
+
+    from .config import load_config, parse_cli_overrides
+    from .training.loop import (
+        DEFAULT_TRAINING,
+        DEFAULT_TRAINING_BLOCKS,
+        resolve_training,
+    )
+
+    config = load_config(args.config_path, parse_cli_overrides(extra),
+                         interpolate=False)
+    if "paths" not in config:
+        config = config.merge({"paths": {"train": None, "dev": None}})
+    interpolated = config.interpolate()
+    resolve_training(interpolated)  # loud validation first
+    # classify INTERPOLATED values: `dropout = ${vars.drop}` must compare
+    # by what it resolves to, not the template string
+    raw = dict(interpolated.get("training", {}))
+    defaults: Dict[str, Any] = {**DEFAULT_TRAINING, **DEFAULT_TRAINING_BLOCKS}
+    rows = []
+    for key in sorted(set(raw) | set(defaults)):
+        if key in raw and key not in defaults:
+            rows.append((key, "customized", raw[key], "-"))
+        elif key in raw and raw[key] != defaults[key]:
+            rows.append((key, "customized", raw[key], defaults[key]))
+        elif key in raw:
+            rows.append((key, "redundant (= default)", raw[key], defaults[key]))
+        else:
+            rows.append((key, "implicit default", "-", defaults[key]))
+    width = max(len(r[0]) for r in rows)
+    print(f"{'[training] key':{width}s}  {'status':22s} value (default)")
+    for key, status, value, default in rows:
+        shown = value if value != "-" else default
+        suffix = f" (default: {default})" if status == "customized" and default != "-" else ""
+        print(f"{key:{width}s}  {status:22s} {shown}{suffix}")
+    n_custom = sum(1 for r in rows if r[1] == "customized")
+    n_redund = sum(1 for r in rows if r[1].startswith("redundant"))
+    print(f"\n{n_custom} customized, {n_redund} redundant, "
+          f"{len(rows) - n_custom - n_redund} implicit defaults")
     return 0
 
 
@@ -1306,6 +1349,7 @@ COMMANDS = {
     "assemble": assemble_command,
     "debug-data": debug_data_command,
     "debug-config": debug_config_command,
+    "debug-diff-config": debug_diff_command,
     "package": package_command,
 }
 

@@ -71,6 +71,16 @@ DEFAULT_TRAINING = {
 # registry.resolve(config["training"], schema=ConfigSchemaTraining)).
 _TRAINING_BLOCK_KEYS = {"optimizer", "batcher", "logger", "before_update"}
 
+# What each registry sub-block resolves to when the config omits it — the
+# single source for fill-config (writes them out) and debug-diff-config
+# (classifies against them).
+DEFAULT_TRAINING_BLOCKS = {
+    "optimizer": {"@optimizers": "Adam.v1", "learn_rate": 0.001},
+    "batcher": {"@batchers": "spacy.batch_by_words.v1", "size": 1000,
+                "tolerance": 0.2},
+    "logger": {"@loggers": "spacy_ray_tpu.ConsoleLogger.v1"},
+}
+
 # value validators: (predicate, description) — intentionally permissive
 # (ints where floats are fine etc.), strict on category errors
 _TRAINING_TYPES: Dict[str, Tuple[Callable[[Any], bool], str]] = {

@@ -86,6 +86,32 @@ def test_benchmark_speed_and_accuracy(trained_model, tmp_path, capsys):
     assert "speed,accuracy" in capsys.readouterr().err
 
 
+def test_debug_diff_config(tmp_path, tagger_config_text, capsys):
+    """debug-diff-config classifies [training] keys: customized vs
+    redundant restatements vs implicit defaults."""
+    cfg = tmp_path / "cfg.cfg"
+    # the fixture already covers all three classes: patience = 0 is
+    # customized (default 1600), dropout = 0.1 restates the default, and
+    # untouched keys (e.g. logger) are implicit defaults
+    text = tagger_config_text
+    cfg.write_text(text)
+    rc = cli_main(["debug-diff-config", str(cfg)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "customized" in out
+    assert "implicit default" in out
+    lines = {l.split()[0]: l for l in out.splitlines() if l.strip()}
+    assert "redundant" in lines.get("dropout", "")  # 0.1 IS the default
+
+    # an invalid config still fails loudly before any diffing
+    bad = tmp_path / "bad.cfg"
+    bad.write_text(text.replace("patience = 0", "patiance = 0"))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="patiance"):
+        cli_main(["debug-diff-config", str(bad)])
+
+
 def test_apply_alias_and_debug_profile(trained_model, tmp_path, capsys):
     """`apply` is spaCy's name for bulk annotation (same command as
     parse); `debug-profile` prints a host-side cProfile table."""
