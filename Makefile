@@ -1,0 +1,24 @@
+# Convenience targets; the canonical commands live in README.md / PERF.md.
+
+.PHONY: test test-fast test-slow bench baseline profile dryrun
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -q -m "not slow"
+
+test-slow:
+	python -m pytest tests/ -q -m slow
+
+bench:
+	python bench.py
+
+baseline:
+	python bench.py --measure-baseline
+
+profile:
+	python bin/profile_trf.py --sweep
+
+dryrun:
+	python __graft_entry__.py
