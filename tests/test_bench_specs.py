@@ -237,6 +237,28 @@ def test_trf_moe_spec_shape():
 
 
 @pytest.mark.slow
+def test_run_one_scales_reps_to_min_seconds(monkeypatch):
+    """A config whose nominal step count finishes in well under
+    MIN_REP_SECONDS gets its per-rep step count scaled up (sub-second
+    timing windows showed the worst run-to-run drift — PERF.md)."""
+    from spacy_ray_tpu.presets import CNN_TAGGER_CFG
+
+    spec = dict(
+        name="tiny_probe",
+        metric="m",
+        cfg=CNN_TAGGER_CFG.format(width=32, depth=1, embed_size=200),
+        kinds=["tagger"],
+        B=8, T=16, steps=2, warmup=1, n_reps=1,
+    )
+    rec = bench.run_one(spec, "cpu")
+    assert rec is not None
+    assert rec["steps_per_rep"] > 2, rec["steps_per_rep"]
+    # each rep must have measured at least ~MIN_REP_SECONDS of work
+    # (within the one-probe-step estimate's slack)
+    assert rec["steps_per_rep"] * rec["value"] > 0
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("spec_name", ["trf_realistic", "trf_moe"])
 def test_accel_spec_first_stage_compiles_on_cpu(spec_name):
     """The accelerator-gated specs must not be dead code: their pipelines
