@@ -1329,6 +1329,14 @@ def benchmark_command(argv: List[str]) -> int:
     return 0
 
 
+def _project_command(argv: List[str]) -> int:
+    """spaCy-projects-style workflow runner (`project run` / `project
+    document`); implementation in project.py."""
+    from .project import main as project_main
+
+    return project_main(argv)
+
+
 COMMANDS = {
     "train": train_command,
     "pretrain": pretrain_command,
@@ -1350,6 +1358,7 @@ COMMANDS = {
     "debug-data": debug_data_command,
     "debug-config": debug_config_command,
     "debug-diff-config": debug_diff_command,
+    "project": _project_command,
     "package": package_command,
 }
 

@@ -1,0 +1,196 @@
+"""Minimal spaCy-projects-style workflow runner (`project run`).
+
+spaCy users orchestrate convert/train/evaluate chains with a
+``project.yml`` of named commands and workflows; the reference repo's
+README assumes that ecosystem around `spacy ray train`. This module
+covers the core surface:
+
+* ``project.yml`` with ``vars``, ``commands`` (name / script / deps /
+  outputs / help) and ``workflows`` (name -> list of command names).
+* ``${vars.x}`` interpolation in scripts/deps/outputs.
+* make-style short-circuit: a command is SKIPPED when every declared
+  output exists and is at least as new as every declared dep (spaCy
+  skips on its own lockfile hashes; mtime is the dependency-tracking
+  equivalent that needs no state file).
+* ``--force`` reruns regardless; a failing script aborts the chain.
+
+Assets/remote storage are intentionally absent (zero-egress image);
+`deps` on local files cover the in-image need.
+"""
+
+from __future__ import annotations
+
+import re
+import subprocess
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+_VAR = re.compile(r"\$\{vars\.([A-Za-z0-9_]+)\}")
+
+
+class ProjectError(ValueError):
+    pass
+
+
+def _interp(value: str, variables: Dict[str, Any]) -> str:
+    def sub(m: "re.Match[str]") -> str:
+        key = m.group(1)
+        if key not in variables:
+            raise ProjectError(
+                f"undefined ${{vars.{key}}} (defined: {sorted(variables)})"
+            )
+        return str(variables[key])
+
+    return _VAR.sub(sub, value)
+
+
+def _str_list(raw: Dict[str, Any], key: str, name: str,
+              variables: Dict[str, Any]) -> List[str]:
+    """Interpolated list-of-strings field; a YAML scalar (a common slip,
+    `script: echo hi`) must error, not be iterated character by character."""
+    value = raw.get(key) or []
+    if not isinstance(value, list) or not all(
+        isinstance(s, str) for s in value
+    ):
+        raise ProjectError(
+            f"command {name!r}: {key} must be a list of strings, "
+            f"got {value!r}"
+        )
+    return [_interp(s, variables) for s in value]
+
+
+def load_project(project_dir: Path) -> Dict[str, Any]:
+    try:
+        import yaml
+    except ImportError as e:  # pragma: no cover - present in dev images
+        raise ProjectError(
+            "the project command needs PyYAML (declared in pyproject; "
+            f"import failed: {e})"
+        )
+
+    path = project_dir / "project.yml"
+    if not path.exists():
+        raise ProjectError(f"no project.yml in {project_dir}")
+    try:
+        data = yaml.safe_load(path.read_text(encoding="utf8")) or {}
+    except yaml.YAMLError as e:
+        raise ProjectError(f"{path} is not valid YAML: {e}")
+    if not isinstance(data, dict):
+        raise ProjectError(f"{path} must hold a mapping")
+    variables = data.get("vars") or {}
+    commands: Dict[str, Dict[str, Any]] = {}
+    for raw in data.get("commands") or []:
+        if not isinstance(raw, dict) or "name" not in raw:
+            raise ProjectError(f"command entries need a name: {raw!r}")
+        name = raw["name"]
+        if name in commands:
+            raise ProjectError(f"duplicate command name {name!r}")
+        commands[name] = {
+            "name": name,
+            "help": raw.get("help", ""),
+            "script": _str_list(raw, "script", name, variables),
+            "deps": _str_list(raw, "deps", name, variables),
+            "outputs": _str_list(raw, "outputs", name, variables),
+        }
+    workflows: Dict[str, List[str]] = {}
+    for wf_name, steps in (data.get("workflows") or {}).items():
+        steps = list(steps or [])
+        unknown = [s for s in steps if s not in commands]
+        if unknown:
+            raise ProjectError(
+                f"workflow {wf_name!r} references unknown commands {unknown} "
+                f"(have: {sorted(commands)})"
+            )
+        workflows[wf_name] = steps
+    return {"vars": variables, "commands": commands, "workflows": workflows}
+
+
+def _up_to_date(cmd: Dict[str, Any], project_dir: Path) -> bool:
+    outputs = [project_dir / o for o in cmd["outputs"]]
+    if not outputs or not all(o.exists() for o in outputs):
+        return False
+    deps = [project_dir / d for d in cmd["deps"]]
+    missing = [d for d in deps if not d.exists()]
+    if missing:
+        raise ProjectError(
+            f"command {cmd['name']!r} depends on missing file(s): "
+            f"{[str(m) for m in missing]}"
+        )
+    newest_dep = max((d.stat().st_mtime for d in deps), default=0.0)
+    oldest_out = min(o.stat().st_mtime for o in outputs)
+    return oldest_out >= newest_dep
+
+
+def run_command(cmd: Dict[str, Any], project_dir: Path,
+                force: bool = False) -> bool:
+    """Run one command's script lines. Returns True if executed, False if
+    skipped as up-to-date."""
+    if not force and _up_to_date(cmd, project_dir):
+        print(f"[{cmd['name']}] up to date (outputs newer than deps); skipped")
+        return False
+    for line in cmd["script"]:
+        # a leading `python` token means THIS interpreter (spaCy's runner
+        # does the same): python3-only hosts have no `python` shim, and a
+        # PATH interpreter may not be the venv this package lives in
+        if line == "python" or line.startswith("python "):
+            line = sys.executable + line[len("python"):]
+        print(f"[{cmd['name']}] $ {line}", flush=True)
+        proc = subprocess.run(line, shell=True, cwd=str(project_dir))
+        if proc.returncode != 0:
+            raise ProjectError(
+                f"command {cmd['name']!r} failed (exit {proc.returncode}) "
+                f"on: {line}"
+            )
+    return True
+
+
+def project_run(project_dir: Path, target: str, force: bool = False) -> int:
+    """Run a named command or workflow. Returns count of commands executed."""
+    project = load_project(project_dir)
+    if target in project["workflows"]:
+        names = project["workflows"][target]
+    elif target in project["commands"]:
+        names = [target]
+    else:
+        available = sorted(project["workflows"]) + sorted(project["commands"])
+        raise ProjectError(
+            f"no workflow or command {target!r} (available: {available})"
+        )
+    ran = 0
+    for name in names:
+        if run_command(project["commands"][name], project_dir, force=force):
+            ran += 1
+    return ran
+
+
+def main(argv: List[str]) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu project")
+    sub = parser.add_subparsers(dest="subcommand", required=True)
+    run_p = sub.add_parser("run", help="run a named command or workflow")
+    run_p.add_argument("target")
+    run_p.add_argument("project_dir", type=Path, nargs="?", default=Path("."))
+    run_p.add_argument("--force", action="store_true",
+                       help="rerun even when outputs are up to date")
+    doc_p = sub.add_parser("document", help="print commands and workflows")
+    doc_p.add_argument("project_dir", type=Path, nargs="?", default=Path("."))
+    args = parser.parse_args(argv)
+
+    try:
+        if args.subcommand == "document":
+            project = load_project(args.project_dir)
+            print("Commands:")
+            for name, cmd in project["commands"].items():
+                print(f"  {name:20s} {cmd['help']}")
+            print("Workflows:")
+            for name, steps in project["workflows"].items():
+                print(f"  {name:20s} {' -> '.join(steps)}")
+            return 0
+        ran = project_run(args.project_dir, args.target, force=args.force)
+        print(f"Done: {ran} command(s) executed")
+        return 0
+    except ProjectError as e:
+        print(f"project error: {e}", file=sys.stderr)
+        return 1

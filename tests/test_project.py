@@ -1,0 +1,129 @@
+"""spaCy-projects-style runner (`project run` / `project document`):
+workflow ordering, ${vars.*} interpolation, make-style up-to-date
+skipping, --force, missing-dep and failure propagation."""
+
+import time
+
+import pytest
+
+from spacy_ray_tpu.cli import main as cli_main
+from spacy_ray_tpu.project import ProjectError, load_project, project_run
+
+PROJECT_YML = """
+vars:
+  corpus: data.txt
+  n: 3
+
+commands:
+  - name: prepare
+    help: write the corpus
+    script:
+      - "python -c \\"open('${vars.corpus}','w').write('x'*${vars.n})\\""
+    outputs:
+      - ${vars.corpus}
+  - name: count
+    help: count the corpus
+    script:
+      - "python -c \\"print(len(open('${vars.corpus}').read()))\\" > count.txt"
+    deps:
+      - ${vars.corpus}
+    outputs:
+      - count.txt
+
+workflows:
+  all:
+    - prepare
+    - count
+"""
+
+
+@pytest.fixture()
+def project_dir(tmp_path):
+    (tmp_path / "project.yml").write_text(PROJECT_YML)
+    return tmp_path
+
+
+def test_workflow_runs_in_order_and_interpolates(project_dir):
+    ran = project_run(project_dir, "all")
+    assert ran == 2
+    assert (project_dir / "data.txt").read_text() == "xxx"
+    assert (project_dir / "count.txt").read_text().strip() == "3"
+
+
+def test_up_to_date_skip_and_force(project_dir, capsys):
+    import os
+
+    assert project_run(project_dir, "all") == 2
+    # second run: outputs newer than deps -> everything skipped
+    assert project_run(project_dir, "all") == 0
+    assert "up to date" in capsys.readouterr().out
+    # aging the dep past the output invalidates only the downstream
+    # command (explicit future mtime: coarse-granularity filesystems
+    # would make sleep+touch flaky)
+    future = time.time() + 60
+    os.utime(project_dir / "data.txt", (future, future))
+    assert project_run(project_dir, "count") == 1
+    # --force semantics rerun everything
+    assert project_run(project_dir, "all", force=True) == 2
+
+
+def test_single_command_target(project_dir):
+    assert project_run(project_dir, "prepare") == 1
+
+
+def test_unknown_target_and_missing_dep(project_dir):
+    with pytest.raises(ProjectError, match="no workflow or command"):
+        project_run(project_dir, "nope")
+    # dep missing and outputs absent -> the command RUNS (and fails only
+    # if its script does); dep missing with outputs present -> loud error
+    (project_dir / "count.txt").write_text("stale")
+    with pytest.raises(ProjectError, match="missing file"):
+        project_run(project_dir, "count")
+
+
+def test_failing_script_aborts(project_dir):
+    yml = PROJECT_YML.replace(
+        "commands:",
+        "commands:\n  - name: fail\n    script:\n      - \"exit 3\"\n",
+    )
+    (project_dir / "project.yml").write_text(yml)
+    with pytest.raises(ProjectError, match="exit 3"):
+        project_run(project_dir, "fail")
+
+
+def test_scalar_script_rejected(project_dir):
+    # `script: echo hi` (YAML scalar) must error loudly, not run per-char
+    yml = PROJECT_YML.replace(
+        "commands:",
+        "commands:\n  - name: bad\n    script: echo hi\n",
+    )
+    (project_dir / "project.yml").write_text(yml)
+    with pytest.raises(ProjectError, match="list of strings"):
+        load_project(project_dir)
+
+
+def test_invalid_yaml_reported_as_project_error(project_dir):
+    (project_dir / "project.yml").write_text("commands:\n\t- bad tab indent")
+    with pytest.raises(ProjectError, match="not valid YAML"):
+        load_project(project_dir)
+
+
+def test_workflow_validates_command_names(project_dir):
+    yml = PROJECT_YML + "  broken:\n    - prepare\n    - missing_cmd\n"
+    (project_dir / "project.yml").write_text(yml)
+    with pytest.raises(ProjectError, match="unknown commands"):
+        load_project(project_dir)
+
+
+def test_cli_document_and_run(project_dir, capsys):
+    rc = cli_main(["project", "document", str(project_dir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "prepare" in out and "all" in out and "->" in out
+    rc = cli_main(["project", "run", "all", str(project_dir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "2 command(s) executed" in out
+    rc = cli_main(["project", "run", "nope", str(project_dir)])
+    assert rc == 1
+    assert "no workflow or command" in capsys.readouterr().err
