@@ -20,6 +20,7 @@ Assets/remote storage are intentionally absent (zero-egress image);
 
 from __future__ import annotations
 
+import os
 import re
 import subprocess
 import sys
@@ -129,6 +130,14 @@ def run_command(cmd: Dict[str, Any], project_dir: Path,
     if not force and _up_to_date(cmd, project_dir):
         print(f"[{cmd['name']}] up to date (outputs newer than deps); skipped")
         return False
+    # scripts invoking `python -m spacy_ray_tpu ...` must resolve to THIS
+    # library even when it is not pip-installed (repo checkout run from an
+    # arbitrary project_dir): export the package root on PYTHONPATH
+    pkg_root = str(Path(__file__).parent.parent)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        pkg_root + (os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    )
     for line in cmd["script"]:
         # a leading `python` token means THIS interpreter (spaCy's runner
         # does the same): python3-only hosts have no `python` shim, and a
@@ -136,7 +145,7 @@ def run_command(cmd: Dict[str, Any], project_dir: Path,
         if line == "python" or line.startswith("python "):
             line = sys.executable + line[len("python"):]
         print(f"[{cmd['name']}] $ {line}", flush=True)
-        proc = subprocess.run(line, shell=True, cwd=str(project_dir))
+        proc = subprocess.run(line, shell=True, cwd=str(project_dir), env=env)
         if proc.returncode != 0:
             raise ProjectError(
                 f"command {cmd['name']!r} failed (exit {proc.returncode}) "

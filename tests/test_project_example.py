@@ -1,0 +1,34 @@
+"""The shipped example project (examples/project/project.yml) runs the
+REAL CLI chain end-to-end through the project runner: synth data ->
+convert to .spacy -> train -> evaluate, then skips everything as
+up-to-date on a second pass."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from spacy_ray_tpu.project import project_run
+
+pytestmark = pytest.mark.slow
+
+EXAMPLE = Path(__file__).parent.parent / "examples" / "project"
+
+
+def test_example_project_end_to_end(tmp_path):
+    proj = tmp_path / "project"
+    proj.mkdir()
+    # the example references ../../bin and ../../configs relative to its
+    # location; mirror that layout around the copy
+    yml = (EXAMPLE / "project.yml").read_text()
+    yml = yml.replace("../../bin/", str(EXAMPLE.parent.parent / "bin") + "/")
+    yml = yml.replace("../../configs/", str(EXAMPLE.parent.parent / "configs") + "/")
+    (proj / "project.yml").write_text(yml)
+
+    assert project_run(proj, "all") == 4
+    metrics = json.loads((proj / "metrics.json").read_text())
+    assert metrics["tag_acc"] > 0.95  # synthetic tagger converges
+    assert (proj / "out" / "best-model" / "params.npz").exists()
+
+    # second pass: everything newer than its deps -> all skipped
+    assert project_run(proj, "all") == 0
