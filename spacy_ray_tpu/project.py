@@ -124,12 +124,17 @@ def _up_to_date(cmd: Dict[str, Any], project_dir: Path) -> bool:
 
 
 def run_command(cmd: Dict[str, Any], project_dir: Path,
-                force: bool = False) -> bool:
+                force: bool = False, dry: bool = False) -> bool:
     """Run one command's script lines. Returns True if executed, False if
-    skipped as up-to-date."""
+    skipped as up-to-date. ``dry`` prints what WOULD run (after the same
+    skip logic) without executing anything — spaCy's `project run --dry`."""
     if not force and _up_to_date(cmd, project_dir):
         print(f"[{cmd['name']}] up to date (outputs newer than deps); skipped")
         return False
+    if dry:
+        for line in cmd["script"]:
+            print(f"[{cmd['name']}] (dry) $ {line}")
+        return True
     # scripts invoking `python -m spacy_ray_tpu ...` must resolve to THIS
     # library even when it is not pip-installed (repo checkout run from an
     # arbitrary project_dir): export the package root on PYTHONPATH
@@ -154,8 +159,10 @@ def run_command(cmd: Dict[str, Any], project_dir: Path,
     return True
 
 
-def project_run(project_dir: Path, target: str, force: bool = False) -> int:
-    """Run a named command or workflow. Returns count of commands executed."""
+def project_run(project_dir: Path, target: str, force: bool = False,
+                dry: bool = False) -> int:
+    """Run a named command or workflow. Returns count of commands executed
+    (or, under ``dry``, that would have executed)."""
     project = load_project(project_dir)
     if target in project["workflows"]:
         names = project["workflows"][target]
@@ -168,7 +175,8 @@ def project_run(project_dir: Path, target: str, force: bool = False) -> int:
         )
     ran = 0
     for name in names:
-        if run_command(project["commands"][name], project_dir, force=force):
+        if run_command(project["commands"][name], project_dir, force=force,
+                       dry=dry):
             ran += 1
     return ran
 
@@ -183,6 +191,8 @@ def main(argv: List[str]) -> int:
     run_p.add_argument("project_dir", type=Path, nargs="?", default=Path("."))
     run_p.add_argument("--force", action="store_true",
                        help="rerun even when outputs are up to date")
+    run_p.add_argument("--dry", action="store_true",
+                       help="print what would run without executing")
     doc_p = sub.add_parser("document", help="print commands and workflows")
     doc_p.add_argument("project_dir", type=Path, nargs="?", default=Path("."))
     args = parser.parse_args(argv)
@@ -197,8 +207,10 @@ def main(argv: List[str]) -> int:
             for name, steps in project["workflows"].items():
                 print(f"  {name:20s} {' -> '.join(steps)}")
             return 0
-        ran = project_run(args.project_dir, args.target, force=args.force)
-        print(f"Done: {ran} command(s) executed")
+        ran = project_run(args.project_dir, args.target, force=args.force,
+                          dry=args.dry)
+        verb = "would execute" if args.dry else "executed"
+        print(f"Done: {ran} command(s) {verb}")
         return 0
     except ProjectError as e:
         print(f"project error: {e}", file=sys.stderr)

@@ -71,6 +71,18 @@ def test_single_command_target(project_dir):
     assert project_run(project_dir, "prepare") == 1
 
 
+def test_dry_run_executes_nothing(project_dir, capsys):
+    assert project_run(project_dir, "all", dry=True) == 2
+    out = capsys.readouterr().out
+    assert "(dry)" in out
+    assert not (project_dir / "data.txt").exists()  # nothing actually ran
+    # CLI spelling
+    rc = cli_main(["project", "run", "all", str(project_dir), "--dry"])
+    assert rc == 0
+    assert "would execute" in capsys.readouterr().out
+    assert not (project_dir / "data.txt").exists()
+
+
 def test_unknown_target_and_missing_dep(project_dir):
     with pytest.raises(ProjectError, match="no workflow or command"):
         project_run(project_dir, "nope")
