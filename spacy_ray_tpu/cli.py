@@ -912,6 +912,11 @@ def info_command(argv: List[str]) -> int:
         "--probe", action="store_true",
         help="probe accelerator reachability (subprocess, 60s timeout)",
     )
+    parser.add_argument(
+        "--markdown", action="store_true",
+        help="print the environment block as a markdown table "
+        "(spaCy's issue-report format)",
+    )
     parser.add_argument("model_path", nargs="?", type=Path, default=None,
                         help="optional: show a saved pipeline's metadata")
     args = parser.parse_args(argv)
@@ -920,11 +925,21 @@ def info_command(argv: List[str]) -> int:
 
     import jax
 
-    print(f"spacy-ray-tpu    {__version__}")
-    print(f"python           {_platform.python_version()} ({_platform.system()})")
-    print(f"jax              {jax.__version__}")
-    print(f"JAX_PLATFORMS    {os.environ.get('JAX_PLATFORMS', '(unset)')}")
-    print(f"XLA_FLAGS        {os.environ.get('XLA_FLAGS', '(unset)')}")
+    rows = [
+        ("spacy-ray-tpu", __version__),
+        ("python", f"{_platform.python_version()} ({_platform.system()})"),
+        ("jax", jax.__version__),
+        ("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "(unset)")),
+        ("XLA_FLAGS", os.environ.get("XLA_FLAGS", "(unset)")),
+    ]
+    if args.markdown:
+        print("| field | value |")
+        print("|---|---|")
+        for key, value in rows:
+            print(f"| {key} | {value} |")
+    else:
+        for key, value in rows:
+            print(f"{key:16s} {value}")
     if args.probe:
         import subprocess
 
