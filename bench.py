@@ -746,6 +746,208 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
     return rec
 
 
+# ----------------------------------------------------------------------
+# Input-pipeline benchmark (--input-pipeline): pure host-side rate
+# ----------------------------------------------------------------------
+
+# Below this reprobe ratio a record may not serve as a round headline when
+# a cleaner record for the same config exists in the session (see
+# _print_headline_summary); matches the PERF.md cross-run comparison rule.
+CLEAN_REPROBE_RATIO = 0.94
+
+
+def _tpu_step_rate(name: str) -> Optional[float]:
+    """Recorded real-TPU compiled-step words/s/chip for ``name`` (PERF.md
+    "Real-TPU results") — the denominator-free headroom reference the
+    input-pipeline records compare against."""
+    try:
+        data = json.loads(TPU_SESSION_FILE.read_text(encoding="utf8"))
+        for rec in data.get("results", []):
+            if rec.get("name") == name and rec.get("value"):
+                return float(rec["value"])
+    except Exception:
+        pass
+    return None
+
+
+def _measure_input_pipeline(
+    nlp, mesh, chunks, B: int, T: int, *, workers: int, cache_mb: int,
+    cold: bool, n_reps: int = N_REPS,
+) -> Dict[str, Any]:
+    """Time the host-side pipeline (read -> collate -> transfer) with NO
+    compiled step: the rate the input layer could feed a device at.
+
+    ``cold=True`` clears every per-Example feature cache before each pass
+    and runs with the collation cache off — the first-epoch rate.
+    ``cold=False`` fills the collation cache with one untimed warm-up
+    pass and times steady-state epochs.
+    """
+    import jax
+
+    from spacy_ray_tpu.parallel.step import place_batch
+    from spacy_ray_tpu.training.collate_pool import (
+        CollateCache,
+        PipelineStats,
+        cached_collate,
+        ordered_map,
+    )
+
+    cache = CollateCache(cache_mb << 20) if (cache_mb and not cold) else None
+    stats = PipelineStats()
+    stats.workers = max(int(workers), 1)
+    stats.cache_enabled = cache is not None
+
+    def collate_fn(chunk):
+        t0 = time.perf_counter()
+        c = cached_collate(
+            cache,
+            chunk,
+            B,
+            T,
+            lambda b_, B_, T_: nlp.collate(
+                b_, pad_batch_to=B_, pad_len_to=T_, host=True
+            ),
+            stats,
+        )
+        stats.add("collate", time.perf_counter() - t0)
+        return c
+
+    def one_pass() -> int:
+        if cold:
+            # true first-epoch work: drop EVERY per-Example memo (feature
+            # keys, tagger/lemmatizer target ids, parser oracle — all end
+            # in "_cache") so each pass re-tokenizes, re-hashes and
+            # re-builds targets from scratch
+            for chunk in chunks:
+                for eg in chunk:
+                    for attr in [
+                        a for a in vars(eg) if a.endswith("_cache")
+                    ]:
+                        delattr(eg, attr)
+
+        def read_iter():
+            t0 = time.perf_counter()
+            for chunk in chunks:
+                stats.add("read", time.perf_counter() - t0)
+                yield chunk
+                t0 = time.perf_counter()
+
+        it = ordered_map(read_iter(), collate_fn, workers=workers)
+        words = 0
+        try:
+            for c in it:
+                t0 = time.perf_counter()
+                placed = place_batch(c["tokens"], mesh)
+                jax.block_until_ready(placed)
+                stats.add("transfer", time.perf_counter() - t0)
+                words += int(c["n_words"])
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+        return words
+
+    if not cold:
+        one_pass()  # fill the collation cache (untimed)
+    # adaptive rep length: every repetition measures >= MIN_REP_SECONDS of
+    # work (same rationale as the train-step benches)
+    t0 = time.perf_counter()
+    probe_words = one_pass()
+    probe_dt = time.perf_counter() - t0
+    passes = max(1, min(200, int(np.ceil(MIN_REP_SECONDS / max(probe_dt, 1e-6)))))
+    rep_wps: List[float] = []
+    for _rep in range(n_reps):
+        total = 0
+        t0 = time.perf_counter()
+        for _ in range(passes):
+            total += one_pass()
+        rep_wps.append(total / (time.perf_counter() - t0))
+    rec = {
+        "value": round(float(np.median(rep_wps)), 1),
+        "unit": "words/s",
+        "B": B,
+        "T": T,
+        "collate_workers": int(workers),
+        "collate_cache_mb": int(cache_mb if cache is not None else 0),
+        "cold": cold,
+        "n_reps": n_reps,
+        "passes_per_rep": passes,
+        "words_per_pass": probe_words,
+        "wps_reps": [round(w, 1) for w in rep_wps],
+        "wps_min": round(min(rep_wps), 1),
+        "wps_max": round(max(rep_wps), 1),
+        # per-stage seconds across the whole measurement (collate seconds
+        # sum over worker threads, so they can exceed wall time by design)
+        "stages": stats.snapshot(),
+    }
+    if cache is not None:
+        rec["cache_entries"] = len(cache)
+        rec["cache_nbytes"] = cache.nbytes
+        rec["cache_evictions"] = cache.evictions
+    return rec
+
+
+def run_input_pipeline(platform: str, workers: int, cache_mb: int) -> None:
+    """``--input-pipeline``: measure the host-side data-preparation rate
+    (read / tokenize+collate / transfer, NO compiled step) cold vs warm,
+    and state the headroom ratio against the recorded real-TPU compiled
+    step rate. Runs fine on CPU-only CI — that is the point: the input
+    pipeline must be proven faster than the chip BEFORE the chip serves.
+    """
+    import jax
+
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.parallel.mesh import build_mesh
+    from spacy_ray_tpu.pipeline.language import Pipeline
+    from spacy_ray_tpu.presets import CNN_TAGGER_CFG
+
+    B, T = 256, 64  # the cnn_tagger bench shape (cnn-family flagship)
+    cfg = CNN_TAGGER_CFG.format(width=96, depth=4, embed_size=2000)
+    nlp = Pipeline.from_config(Config.from_str(cfg))
+    examples = _corpus(["tagger"], max(4 * B, 1024))
+    nlp.initialize(lambda: iter(examples), seed=0)
+    mesh = build_mesh(n_data=len(jax.devices()))
+    # fixed chunk objects: epoch N re-collates the IDENTICAL Example lists,
+    # exactly like the training loop over a cached corpus
+    chunks = [examples[i : i + B] for i in range(0, len(examples) - B + 1, B)]
+
+    tpu_wps = _tpu_step_rate("cnn_tagger")
+    specs = [
+        ("input_pipeline_cnn_cold_w1", dict(workers=1, cache_mb=0, cold=True)),
+        (
+            f"input_pipeline_cnn_warm_w{workers}",
+            dict(workers=workers, cache_mb=cache_mb, cold=False),
+        ),
+    ]
+    cold_wps: Optional[float] = None
+    for name, kwargs in specs:
+        rec = _measure_input_pipeline(nlp, mesh, chunks, B, T, **kwargs)
+        rec["name"] = name
+        rec["metric"] = (
+            "input_pipeline_words_per_sec (host read+collate+transfer, "
+            "no compiled step; "
+            + ("cold: 1 worker, no cache" if kwargs["cold"]
+               else f"warm: {kwargs['workers']} workers, "
+               + ("cache hot" if kwargs["cache_mb"] else "no cache"))
+            + ")"
+        )
+        rec["platform"] = platform
+        rec["devices"] = len(jax.devices())
+        if kwargs["cold"]:
+            cold_wps = rec["value"]
+        elif cold_wps:
+            rec["single_thread_cold_wps"] = cold_wps
+            rec["speedup_vs_cold"] = round(rec["value"] / cold_wps, 2)
+        if tpu_wps:
+            # >1: the host pipeline outruns the recorded TPU compiled step
+            # (input-bound risk retired at this batch shape); <1: the chip
+            # would starve by this factor
+            rec["tpu_step_wps_per_chip"] = tpu_wps
+            rec["headroom_vs_tpu_step"] = round(rec["value"] / tpu_wps, 3)
+        print(json.dumps(rec), flush=True)
+        _append_session(rec, platform)
+
+
 def _accelerator_reachable(timeout: float = 180.0) -> bool:
     """Probe the default (accelerator) backend in a THROWAWAY subprocess.
 
@@ -831,6 +1033,14 @@ def _run_spec_subprocess(
 HEADLINE_ORDER = ["trf_realistic", "trf", "cnn_tagger"]
 
 
+def _record_is_clean(rec: Dict[str, Any]) -> bool:
+    """A record whose post-run matmul re-probe shows an uncontended host
+    (or that has no re-probe at all — TPU records, where the contention
+    stamp doesn't apply)."""
+    ratio = rec.get("peak_reprobe_ratio")
+    return ratio is None or ratio >= CLEAN_REPROBE_RATIO
+
+
 def _print_headline_summary(
     session_mark: int, platforms: List[str], run_id: Optional[str] = None
 ) -> None:
@@ -847,22 +1057,38 @@ def _print_headline_summary(
     records are matched on the parent's ``run_id`` stamp (when given) in
     addition to platform, and unparseable lines (torn concurrent writes)
     are skipped rather than aborting the summary.
+
+    Contention guard (VERDICT r5 next #1): when this run's flagship record
+    is CONTENDED (post-run matmul re-probe < 0.94), the whole session file
+    is searched for the latest CLEAN record of the same config, and that
+    one becomes the headline instead — a contended window can depress a
+    measurement 5-16%, and the round artifact must not stamp that as the
+    repo's rate when a clean measurement of the same config exists. The
+    substitution is self-describing (``headline_note`` + both values).
     """
-    records: List[Dict[str, Any]] = []
+    records: List[Dict[str, Any]] = []  # this run's records
+    session_records: List[Dict[str, Any]] = []  # every parseable record
     try:
-        with open(SESSION_FILE, "r", encoding="utf8") as f:
-            f.seek(session_mark)
-            for line in f:
-                if not line.strip():
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue  # torn write from a concurrent appender
-                if run_id is not None and rec.get("run_id") != run_id:
-                    continue  # a concurrent run's record, not ours
-                if rec.get("platform") in platforms:
-                    records.append(rec)
+        raw = SESSION_FILE.read_bytes()
+        offset = 0
+        for line in raw.splitlines(keepends=True):
+            line_start = offset
+            offset += len(line)
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn write from a concurrent appender
+            if rec.get("skipped") or rec.get("value") is None:
+                continue  # a skip marker is not a measurement
+            if rec.get("platform") not in platforms:
+                continue
+            session_records.append(rec)
+            if line_start >= session_mark and (
+                run_id is None or rec.get("run_id") == run_id
+            ):
+                records.append(rec)
     except Exception as e:
         print(f"# headline summary unavailable: {e}", flush=True)
         return
@@ -870,13 +1096,33 @@ def _print_headline_summary(
     for platform in platforms:
         for name in HEADLINE_ORDER:
             rec = by_key.get((platform, name))
-            if rec is not None:
-                rec = dict(rec)
-                rec["name"] = "headline_summary"
-                rec["headline_of"] = name
-                rec["metric"] = f"HEADLINE {rec['metric']}"
-                print(json.dumps(rec), flush=True)
-                return
+            if rec is None:
+                continue
+            if not _record_is_clean(rec):
+                clean = [
+                    r
+                    for r in session_records
+                    if r.get("platform") == platform
+                    and r.get("name") == name
+                    and _record_is_clean(r)
+                ]
+                if clean:
+                    substitute = dict(clean[-1])  # latest clean measurement
+                    substitute["headline_note"] = (
+                        "this run's record was contended (reprobe "
+                        f"{rec.get('peak_reprobe_ratio')}, value "
+                        f"{rec.get('value')}); re-printing the session's "
+                        "latest clean record "
+                        f"(recorded_at {substitute.get('recorded_at')})"
+                    )
+                    substitute["contended_run_value"] = rec.get("value")
+                    rec = substitute
+            rec = dict(rec)
+            rec["name"] = "headline_summary"
+            rec["headline_of"] = name
+            rec["metric"] = f"HEADLINE {rec['metric']}"
+            print(json.dumps(rec), flush=True)
+            return
     print("# headline summary: no headline-eligible record this run", flush=True)
 
 
@@ -932,12 +1178,51 @@ def main() -> None:
         "running (the parent re-probes and re-dispatches)",
     )
     parser.add_argument(
+        "--input-pipeline", action="store_true",
+        help="measure the host-side input pipeline (read/collate/transfer, "
+        "no compiled step): single-thread cold vs multi-worker warm-cache "
+        "rates + headroom vs the recorded TPU step rate",
+    )
+    parser.add_argument(
+        "--collate-workers", type=int, default=4,
+        help="worker threads for the --input-pipeline warm measurement",
+    )
+    parser.add_argument(
+        "--collate-cache-mb", type=int, default=256,
+        help="collation-cache byte budget (MB) for the --input-pipeline "
+        "warm measurement",
+    )
+    parser.add_argument(
         "--tpu-only", action="store_true",
         help="parent mode: if the accelerator never serves, exit WITHOUT "
         "the CPU fallback — for a background campaign that must not "
         "contend with a separate CPU bench run at round end",
     )
     args = parser.parse_args()
+
+    if args.input_pipeline:
+        # host-side-only mode: no subprocess fan-out needed (no compile
+        # server involved); resolve the backend exactly like a child would
+        import jax
+
+        if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+            pass  # CPU explicitly requested
+        elif not _accelerator_reachable():
+            print("# accelerator backend unreachable; input-pipeline on CPU",
+                  flush=True)
+            jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.devices()
+        except RuntimeError as e:
+            print(f"# backend init failed ({e}); falling back to CPU",
+                  flush=True)
+            jax.config.update("jax_platforms", "cpu")
+        run_input_pipeline(
+            jax.default_backend(),
+            workers=int(args.collate_workers),
+            cache_mb=int(args.collate_cache_mb),
+        )
+        return
 
     if not args.measure_baseline and not args.configs:
         # PARENT mode: run every config in its own child process so a
@@ -1001,10 +1286,52 @@ def main() -> None:
                 ):
                     # the refused child did no work; one re-dispatch on
                     # whichever platform the parent now believes in
-                    _run_spec_subprocess(
+                    rc2 = _run_spec_subprocess(
                         spec["name"], cpu=not tpu_ok, env=child_env,
                         timeout=spec.get("timeout"), expect_accel=tpu_ok,
                     )
+                    if rc2 == CHILD_RC_NO_ACCEL:
+                        # the RETRY also resolved to CPU while the parent
+                        # believed in the accelerator — a relay flapping
+                        # between the parent's probe and child init. The
+                        # spec must not be silently dropped (ADVICE r5 #1):
+                        # re-probe, then either finish it on CPU or record
+                        # it as skipped.
+                        if tpu_ok and not _accelerator_reachable(timeout=60.0):
+                            print("# relay lost (retry rc=4); remaining "
+                                  "configs on CPU", flush=True)
+                            _print_recorded_tpu_results()
+                            tpu_ok = False
+                            if "cpu" not in platforms_used:
+                                platforms_used.append("cpu")
+                        if not spec.get("accel_only"):
+                            # this spec's record lands as platform="cpu"
+                            # even when the relay re-probe succeeded — the
+                            # headline summary must be able to see it
+                            if "cpu" not in platforms_used:
+                                platforms_used.append("cpu")
+                            _run_spec_subprocess(
+                                spec["name"], cpu=True, env=child_env,
+                                timeout=spec.get("timeout"), expect_accel=False,
+                            )
+                        else:
+                            print(f"# {spec['name']}: skipped — child "
+                                  "resolved to CPU twice (rc=4) and the "
+                                  "spec is accel_only", flush=True)
+                            _append_session(
+                                {
+                                    "name": spec["name"],
+                                    "metric": spec["metric"],
+                                    "value": None,
+                                    "unit": None,
+                                    "platform": "tpu",
+                                    "skipped": True,
+                                    "reason": "child resolved to CPU twice "
+                                    "(rc=4); accel_only spec has no CPU "
+                                    "fallback",
+                                },
+                                platform="none",
+                            )
         _print_headline_summary(session_mark, platforms_used, run_id)
         return
 

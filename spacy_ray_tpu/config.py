@@ -71,12 +71,27 @@ def _format_value(value: Any) -> str:
 
 
 class Config(dict):
-    """Nested-dict config with parse/serialize/interpolate/override support."""
+    """Nested-dict config with parse/serialize/interpolate/override support.
+
+    ``origin_path`` records the file this config was loaded from (set by
+    :meth:`from_disk`, carried through interpolate/override/merge): the
+    anchor for resolving RELATIVE paths inside the config — e.g.
+    ``[initialize.components.<name>] labels`` — against the config's own
+    directory instead of whatever CWD the process was launched from.
+    """
+
+    origin_path: Optional[Path] = None
 
     def __init__(self, data: Optional[Dict[str, Any]] = None):
         super().__init__()
         if data:
             self.update(copy.deepcopy(dict(data)))
+        if isinstance(data, Config):
+            self.origin_path = data.origin_path
+
+    def _carry_origin(self, out: "Config") -> "Config":
+        out.origin_path = self.origin_path
+        return out
 
     # ------------------------------------------------------------------
     # Parsing / serialization
@@ -136,7 +151,9 @@ class Config(dict):
 
     @classmethod
     def from_disk(cls, path: Union[str, Path]) -> "Config":
-        return cls.from_str(Path(path).read_text(encoding="utf8"))
+        config = cls.from_str(Path(path).read_text(encoding="utf8"))
+        config.origin_path = Path(path)
+        return config
 
     def to_str(self) -> str:
         lines: List[str] = []
@@ -203,7 +220,7 @@ class Config(dict):
 
         # Iterate until fixpoint over the whole tree (vars may reference vars).
         out = interp(resolved)
-        return Config(out)
+        return self._carry_origin(Config(out))
 
     # ------------------------------------------------------------------
     # Overrides / merge
@@ -230,7 +247,7 @@ class Config(dict):
                     out[k] = copy.deepcopy(v)
             return out
 
-        return Config(deep_merge(dict(self), dict(other)))
+        return self._carry_origin(Config(deep_merge(dict(self), dict(other))))
 
     # ------------------------------------------------------------------
     def walk_sections(self) -> Iterator[Tuple[Tuple[str, ...], Dict[str, Any]]]:

@@ -42,6 +42,24 @@ from .vocab import Vocab
 LABEL_SAMPLE_LIMIT = 10000
 
 
+def resolve_config_path(config: Optional[Config], raw: Any) -> Path:
+    """Resolve a path found INSIDE a config. Relative paths anchor to the
+    config file's own directory (``Config.origin_path``) — a config
+    written next to its assets (labels files, vectors, source model dirs,
+    pretrained trunk weights) must work from any CWD. CWD-relative stays
+    as a fallback so pre-existing setups that relied on it keep
+    resolving."""
+    p = Path(raw)
+    if p.is_absolute():
+        return p
+    origin = getattr(config, "origin_path", None) if config is not None else None
+    if origin is not None:
+        anchored = Path(origin).parent / p
+        if anchored.exists() or not p.exists():
+            return anchored
+    return p
+
+
 class Pipeline:
     def __init__(
         self,
@@ -93,7 +111,9 @@ class Pipeline:
                         "overridden; drop `source` or the extra keys"
                     )
                 if source not in src_cache:
-                    src_cache[source] = cls.from_disk(source)
+                    src_cache[source] = cls.from_disk(
+                        resolve_config_path(config, source)
+                    )
                 src_nlp = src_cache[source]
                 if name not in src_nlp.components:
                     raise ValueError(
@@ -163,6 +183,9 @@ class Pipeline:
         t2v = self.tok2vec_name
         return [n for n in self.pipe_names if n != t2v]
 
+    def _resolve_config_path(self, raw: Any) -> Path:
+        return resolve_config_path(self.config, raw)
+
     # ------------------------------------------------------------------
     # Initialization
     # ------------------------------------------------------------------
@@ -198,7 +221,9 @@ class Pipeline:
                     # and freezes the label ORDER, so e.g. resuming against
                     # a grown corpus can't silently renumber classes
                     loaded = json.loads(
-                        Path(labels_path).read_text(encoding="utf8")
+                        self._resolve_config_path(labels_path).read_text(
+                            encoding="utf8"
+                        )
                     )
                     if (
                         not isinstance(loaded, list)
@@ -232,7 +257,9 @@ class Pipeline:
         # an explicit config path WINS over vectors adopted from a source
         vectors_path = init_cfg.get("vectors")
         if vectors_path:
-            self.vectors = Vectors.from_disk(vectors_path)
+            self.vectors = Vectors.from_disk(
+                self._resolve_config_path(vectors_path)
+            )
         rng = jax.random.PRNGKey(seed)
         params: Dict[str, Any] = {}
         with use_vectors(self.vectors):
@@ -261,7 +288,7 @@ class Pipeline:
                 )
             from ..training.checkpoint import _flatten, load_params
 
-            loaded = load_params(init_t2v)
+            loaded = load_params(self._resolve_config_path(init_t2v))
             have = {k: tuple(v.shape) for k, v in _flatten(params[t2v_name]).items()}
             got = {k: tuple(v.shape) for k, v in _flatten(loaded).items()}
             if have != got:
@@ -310,7 +337,16 @@ class Pipeline:
         with_targets: bool = True,
         pad_batch_to: Optional[int] = None,
         pad_len_to: Optional[int] = None,
+        host: bool = False,
     ) -> Dict[str, Any]:
+        """Lower ragged Examples into a statically-shaped padded batch.
+
+        ``host=True`` keeps every leaf a NUMPY array (no ``jnp.asarray``,
+        which on CPU already commits the data to a jax buffer): the
+        parallel collation pool runs this on worker threads and the
+        consumer thread alone performs the ``device_put`` (see
+        training/collate_pool.py for the threading contract)."""
+        as_array = np.asarray if host else jnp.asarray
         lengths = [len(eg) for eg in examples]
         max_len = max(lengths) if lengths else 1
         T = pad_len_to or bucket_length(max_len, self.length_buckets)
@@ -350,9 +386,9 @@ class Pipeline:
                 )
         batch: Dict[str, Any] = {
             "tokens": TokenBatch(
-                attr_keys=jnp.asarray(attr_keys),
-                mask=jnp.asarray(mask),
-                vector_rows=jnp.asarray(vec_rows) if vec_rows is not None else None,
+                attr_keys=as_array(attr_keys),
+                mask=as_array(mask),
+                vector_rows=as_array(vec_rows) if vec_rows is not None else None,
             ),
             "n_words": int(sum(min(l, T) for l in lengths)),
             "lengths": lengths,
@@ -363,7 +399,7 @@ class Pipeline:
                 comp = self.components[name]
                 t = comp.make_targets(examples, B, T)
                 if t:
-                    targets[name] = {k: jnp.asarray(v) for k, v in t.items()}
+                    targets[name] = {k: as_array(v) for k, v in t.items()}
             batch["targets"] = targets
         return batch
 
@@ -673,7 +709,10 @@ class Pipeline:
         from ..training import checkpoint
 
         path = Path(path)
-        config = Config.from_str((path / "config.cfg").read_text(encoding="utf8"))
+        # from_disk (not from_str): origin_path makes relative in-config
+        # paths (source / labels / vectors) resolve against the saved
+        # model directory from any CWD
+        config = Config.from_disk(path / "config.cfg")
         config = config.interpolate()
         nlp = cls.from_config(config)
         meta = json.loads((path / "meta.json").read_text(encoding="utf8"))

@@ -144,11 +144,14 @@ def run_command(cmd: Dict[str, Any], project_dir: Path,
         pkg_root + (os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     )
     for line in cmd["script"]:
-        # a leading `python` token means THIS interpreter (spaCy's runner
-        # does the same): python3-only hosts have no `python` shim, and a
-        # PATH interpreter may not be the venv this package lives in
-        if line == "python" or line.startswith("python "):
-            line = sys.executable + line[len("python"):]
+        # a leading `python`/`python3` token means THIS interpreter
+        # (spaCy's runner does the same): python3-only hosts have no
+        # `python` shim — and `python3` is the more common spelling there —
+        # and a PATH interpreter may not be the venv this package lives in
+        for token in ("python3", "python"):
+            if line == token or line.startswith(token + " "):
+                line = sys.executable + line[len(token):]
+                break
         print(f"[{cmd['name']}] $ {line}", flush=True)
         proc = subprocess.run(line, shell=True, cwd=str(project_dir), env=env)
         if proc.returncode != 0:

@@ -250,6 +250,23 @@ class Corpus:
         self._epoch = 0  # parser's per-Example oracle memo); cache=false
         # streams from disk every epoch for larger-than-RAM corpora
 
+    @property
+    def augmented(self) -> bool:
+        """True when epochs yield FRESH Example copies (an augmenter is
+        active). The loop's collation cache keys on Example identity, so
+        augmented streams can never hit it — the cache auto-bypasses on
+        this flag (training/collate_pool.py)."""
+        return self.augmenter is not None
+
+    @property
+    def stable_identity(self) -> bool:
+        """True when steady-state epochs re-yield the SAME Example
+        objects in the SAME batches (materialized cache, no augmenter, no
+        shuffle — shuffling reshapes batch membership every epoch) — the
+        precondition for the identity-keyed collation cache to ever hit.
+        The loop disables the cache when this is False."""
+        return self.cache and self.augmenter is None and not self.shuffle
+
     def _split(self, doc: Doc) -> Iterator[Doc]:
         if self.max_length <= 0 or len(doc) <= self.max_length:
             yield doc

@@ -122,7 +122,7 @@ def jsonl_logger(path: Optional[str] = None):
                 k: info.get(k)
                 for k in (
                     "epoch", "step", "words", "wps", "eval_seconds",
-                    "score", "losses", "other_scores",
+                    "score", "losses", "other_scores", "input_pipeline",
                 )
             }
             line = json.dumps(rec, default=float)

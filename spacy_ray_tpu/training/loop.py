@@ -62,6 +62,15 @@ DEFAULT_TRAINING = {
     # batches collated + transferred ahead on a background thread (single-
     # process only; 0/1 disables). Overlaps host work with the device step.
     "prefetch_batches": 2,
+    # host-side collation fanned out over N worker threads (single-process,
+    # non-annotating runs only; 0/1 keeps the inline path). Batch ORDER is
+    # preserved and device_put stays on one thread — see collate_pool.py.
+    "collate_workers": 0,
+    # byte budget (in MB) for the epoch-level collation cache; 0 disables.
+    # Auto-bypassed when augmentation is active (fresh Example copies every
+    # epoch can never hit an identity-keyed cache) and in annotating mode
+    # (targets depend on per-step predictions).
+    "collate_cache_mb": 0,
 }
 
 # Sub-blocks resolved through the registry rather than read as plain values.
@@ -119,6 +128,14 @@ _TRAINING_TYPES: Dict[str, Tuple[Callable[[Any], bool], str]] = {
     "zero1": (lambda v: isinstance(v, bool), "a bool"),
     "mesh": (lambda v: isinstance(v, dict), "a mapping of mesh axis sizes"),
     "prefetch_batches": (
+        lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 0,
+        "an int >= 0",
+    ),
+    "collate_workers": (
+        lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 0,
+        "an int >= 0",
+    ),
+    "collate_cache_mb": (
         lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 0,
         "an int >= 0",
     ),
@@ -510,17 +527,56 @@ def train(
                     loss_accum[key[5:]] = loss_accum.get(key[5:], 0.0) + float(value)
         pending_metrics.clear()
 
-    def device_groups() -> Iterator[Dict[str, Any]]:
-        """Produce one update's worth of data, collated and ON DEVICE.
+    # ---- staged input pipeline (read -> collate -> transfer) ----
+    # Stage split exists so collation can fan out over worker threads while
+    # the read stage (corpus/batcher state) and the transfer stage
+    # (device_put + all multi-host collectives) each stay on ONE thread —
+    # the ordering constraint documented in prefetch.py / collate_pool.py.
+    from .collate_pool import (
+        CollateCache,
+        PipelineStats,
+        cached_collate,
+        ordered_map,
+    )
+
+    pipe_stats = PipelineStats()
+    collate_workers = int(T.get("collate_workers", 0) or 0)
+    collate_cache_mb = int(T.get("collate_cache_mb", 0) or 0)
+    # the pool runs only where the prefetch thread may: single-process,
+    # non-annotating (annotation must predict with the step's own params)
+    use_pool = collate_workers >= 2 and process_count == 1 and not annotating
+    pipe_stats.workers = collate_workers if use_pool else 1
+    # identity-keyed cache: only meaningful when epochs re-yield the SAME
+    # Example objects in the SAME batches. Auto-bypass when the corpus says
+    # batches can't recur (augmentation = fresh copies per epoch; shuffle =
+    # different batch membership per epoch; Corpus.stable_identity) and in
+    # annotating mode (targets depend on per-step predictions). Readers
+    # that don't declare either flag get the cache as configured — the
+    # byte-capped LRU bounds the damage if their batches never recur.
+    corpus_augmented = bool(getattr(train_corpus, "augmented", False))
+    cache_stable = bool(
+        getattr(train_corpus, "stable_identity", not corpus_augmented)
+    )
+    collate_cache: Optional[CollateCache] = None
+    if collate_cache_mb > 0 and not annotating and cache_stable:
+        collate_cache = CollateCache(collate_cache_mb * 1024 * 1024)
+        pipe_stats.cache_enabled = True
+
+    def gather_groups() -> Iterator[Dict[str, Any]]:
+        """Read stage: one update's worth of RAW batches + position tags.
 
         Each record carries its own data-position tags (batches_in_epoch /
         corpus_epoch snapshots) so the consumer checkpoints the position of
         the group it actually trained on — exact resume stays exact even
-        when this generator runs ahead on the prefetch thread.
+        when this generator runs ahead on the prefetch thread or the
+        collation pool. Multi-host shape/termination allgathers live here,
+        on the one thread that iterates this generator (the pool never
+        wraps the multi-host path).
         """
         batch_iter = batches_forever()
         while True:
             # gather `accum` raw batches (stacked microbatches per update)
+            t_read = time.perf_counter()
             raw_batches: List[List[Example]] = []
             cur_epoch = epoch
             try:
@@ -532,6 +588,7 @@ def train(
                 # end of data: an incomplete accumulation group would under-
                 # scale the mean gradient (scan still divides by `accum`)
                 have_group = False
+            pipe_stats.add("read", time.perf_counter() - t_read)
             if process_count > 1:
                 # loop termination must be COLLECTIVE: if any host ran out
                 # of data, all hosts stop this step, else the continuing
@@ -592,13 +649,16 @@ def train(
                     )
                     for eg, shell in zip(b, shells):
                         eg.predicted = shell
-            # collate to the same (B, T) bucket so stacking works
+            # bucketed padded shapes, computed in the read stage: the
+            # multi-host shape sync below is a collective and must stay on
+            # this (single) thread, never inside a pool worker
             max_len = max(max(len(eg) for eg in b) for b in raw_batches)
             max_b = max(len(b) for b in raw_batches)
             T_pad = bucket_length(max_len, nlp.length_buckets)
             # B must divide evenly over the mesh data axis for P("data")
             B_pad = max(bucket_batch_size(max_b), n_data)
             B_pad = ((B_pad + n_data - 1) // n_data) * n_data
+            n_words: Optional[int] = None  # single-process: counted at collate
             if process_count > 1:
                 # multi-controller SPMD: every host must launch the same
                 # program — sync padded shapes to the all-host max. The same
@@ -615,33 +675,88 @@ def train(
                 T_pad = int(dims[:, 0].max())
                 B_pad = int(dims[:, 1].max())
                 n_words = int(dims[:, 2].sum())
-            collated = [
-                nlp.collate(b, pad_batch_to=B_pad, pad_len_to=T_pad)
-                for b in raw_batches
-            ]
-            if process_count == 1:
-                n_words = sum(c["n_words"] for c in collated)
-            if accum == 1:
-                tokens, targets = collated[0]["tokens"], collated[0]["targets"]
-            else:
-                # multi-host place_batch re-assembles leaves on the host, so
-                # stack there directly instead of device-stacking and paying
-                # a device->host->device round trip per step
-                stack = np.stack if process_count > 1 else jnp.stack
-                tokens = jax.tree_util.tree_map(
-                    lambda *xs: stack(xs), *[c["tokens"] for c in collated]
-                )
-                targets = jax.tree_util.tree_map(
-                    lambda *xs: stack(xs), *[c["targets"] for c in collated]
-                )
             yield {
-                "tokens": place_batch(tokens, mesh, accum=accum > 1),
-                "targets": place_batch(targets, mesh, accum=accum > 1),
+                "raw_batches": raw_batches,
+                "B_pad": B_pad,
+                "T_pad": T_pad,
                 "n_words": n_words,
                 "cur_epoch": cur_epoch,
                 "batches_in_epoch": batches_in_epoch,
                 "corpus_epoch": stream_corpus_epoch,
             }
+
+    def collate_group(item: Dict[str, Any]) -> Dict[str, Any]:
+        """Tokenize+hash+collate stage: raw batches -> stacked HOST arrays.
+
+        Pure host work (no device_put, no collectives) so the pool may run
+        it on any worker thread. Collated host batches are cached per
+        (batch identity, bucket shape) when the cache is enabled — a
+        steady-state epoch then reduces to cache lookups + device_put.
+        """
+        t_collate = time.perf_counter()
+        raw_batches = item["raw_batches"]
+        B_pad, T_pad = item["B_pad"], item["T_pad"]
+        collated = [
+            cached_collate(
+                collate_cache,
+                b,
+                B_pad,
+                T_pad,
+                lambda b_, B_, T_: nlp.collate(
+                    b_, pad_batch_to=B_, pad_len_to=T_, host=True
+                ),
+                pipe_stats,
+            )
+            for b in raw_batches
+        ]
+        n_words = item["n_words"]
+        if n_words is None:  # single-process: no dims allgather happened
+            n_words = sum(c["n_words"] for c in collated)
+        if accum == 1:
+            tokens, targets = collated[0]["tokens"], collated[0]["targets"]
+        else:
+            # host-side stack: one contiguous array per leaf so the transfer
+            # stage pays a single device_put (multi-host place_batch
+            # re-assembles on the host anyway)
+            tokens = jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *[c["tokens"] for c in collated]
+            )
+            targets = jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *[c["targets"] for c in collated]
+            )
+        pipe_stats.add("collate", time.perf_counter() - t_collate)
+        return {
+            "tokens": tokens,
+            "targets": targets,
+            "n_words": n_words,
+            "cur_epoch": item["cur_epoch"],
+            "batches_in_epoch": item["batches_in_epoch"],
+            "corpus_epoch": item["corpus_epoch"],
+        }
+
+    def device_groups() -> Iterator[Dict[str, Any]]:
+        """Consumer composition: read -> (pooled) collate -> transfer.
+
+        Whatever single thread iterates THIS generator (the main loop, or
+        the prefetch producer) is the only thread that calls device_put —
+        pool workers stop at host arrays.
+        """
+        collated_iter = ordered_map(
+            gather_groups(),
+            collate_group,
+            workers=collate_workers if use_pool else 1,
+        )
+        try:
+            for group in collated_iter:
+                t_put = time.perf_counter()
+                group["tokens"] = place_batch(group["tokens"], mesh, accum=accum > 1)
+                group["targets"] = place_batch(group["targets"], mesh, accum=accum > 1)
+                pipe_stats.add("transfer", time.perf_counter() - t_put)
+                yield group
+        finally:
+            close = getattr(collated_iter, "close", None)
+            if close is not None:
+                close()
 
     last_consumed_epoch = epoch
     params_cell = {"params": params}  # read by the annotation pass
@@ -660,10 +775,17 @@ def train(
 
     try:
         while not stop:
+            # queue-wait: how long the consumer stalled for its next group.
+            # With prefetch/pool active this is the residual the input
+            # pipeline failed to hide; inline it equals the whole host-side
+            # pipeline time (read+collate+transfer happen in this call).
+            t_wait = time.perf_counter()
             try:
                 group = next(groups)
             except StopIteration:
                 break
+            finally:
+                pipe_stats.add("queue_wait", time.perf_counter() - t_wait)
             tokens, targets = group["tokens"], group["targets"]
             n_words = group["n_words"]
             cur_epoch = last_consumed_epoch = group["cur_epoch"]
@@ -747,6 +869,11 @@ def train(
                     "score": score,
                     "wps": wps,
                     "eval_seconds": eval_seconds,
+                    # cumulative per-stage input-pipeline seconds + cache
+                    # counters (read / tokenize+collate / transfer /
+                    # queue-wait) — the host-side account of where batch
+                    # preparation time went (collate_pool.py)
+                    "input_pipeline": pipe_stats.snapshot(),
                 }
                 result.history.append(info)
                 loss_accum = {}

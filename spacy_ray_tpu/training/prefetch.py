@@ -13,6 +13,11 @@ training keeps the inline path — its per-group allgathers (shape/termination
 sync, training/loop.py) must stay ordered with the update collectives on one
 thread per process, or two hosts can interleave collective launches
 differently and deadlock.
+
+The same one-thread rule governs the collation worker pool layered under
+this (training/collate_pool.py): pool workers do pure host collation only;
+``device_put`` and every collective run on the single thread that consumes
+the pool — which under prefetch is THIS producer thread.
 """
 
 from __future__ import annotations
@@ -101,7 +106,10 @@ class _Prefetcher:
         """Stop the producer and drop any buffered (possibly on-device)
         batches. Join BEFORE draining — a producer mid-put could otherwise
         slip one item into the just-drained queue and keep it referenced
-        after close. Idempotent."""
+        after close. Once the producer thread is confirmed dead, close the
+        underlying iterator too: a generator source may hold resources in
+        its ``finally`` (e.g. the collation worker pool — see
+        training/collate_pool.py) that must not wait for GC. Idempotent."""
         self._stopped.set()
         self._thread.join(timeout=5.0)
         try:
@@ -109,6 +117,13 @@ class _Prefetcher:
                 self._q.get_nowait()
         except queue.Empty:
             pass
+        if not self._thread.is_alive():
+            close = getattr(self._it, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass  # releasing resources is best-effort on teardown
 
     def __del__(self):  # abandoned without close(): still release the thread
         self.close()

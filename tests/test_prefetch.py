@@ -1,10 +1,21 @@
-"""Background batch prefetch (training/prefetch.py)."""
+"""Background batch prefetch (training/prefetch.py) and the parallel
+input pipeline built on top of it (training/collate_pool.py): ordered
+multi-worker collation, the epoch-level collation cache, and the
+training-loop integration (augmentation bypass, exact resume through the
+pool)."""
 
 import threading
 import time
 
+import numpy as np
 import pytest
 
+from spacy_ray_tpu.training.collate_pool import (
+    CollateCache,
+    OrderedPool,
+    PipelineStats,
+    ordered_map,
+)
 from spacy_ray_tpu.training.prefetch import prefetch_iter
 
 
@@ -45,3 +56,360 @@ def test_producer_runs_ahead_bounded():
     assert 2 <= len(produced) <= 3  # size in queue (+1 in-flight at the put)
     # …and the consumer still sees the full ordered stream
     assert list(out) == list(range(10))
+
+
+# ----------------------------------------------------------------------
+# OrderedPool: multi-worker collation with preserved order
+# ----------------------------------------------------------------------
+
+
+def test_ordered_pool_preserves_order_under_uneven_work():
+    # every third item is SLOW: fast items finish first on other workers
+    # but must still be yielded in submission order
+    def fn(i):
+        if i % 3 == 0:
+            time.sleep(0.02)
+        return i * 2
+
+    out = list(ordered_map(iter(range(40)), fn, workers=4))
+    assert out == [i * 2 for i in range(40)]
+
+
+def test_ordered_pool_below_two_workers_is_inline():
+    threads = []
+
+    def fn(i):
+        threads.append(threading.current_thread())
+        return i
+
+    assert list(ordered_map(iter(range(5)), fn, workers=1)) == list(range(5))
+    assert all(t is threading.current_thread() for t in threads)
+
+
+def test_ordered_pool_fn_exception_propagates_in_order():
+    def fn(i):
+        if i == 3:
+            raise ValueError("boom3")
+        return i
+
+    it = ordered_map(iter(range(10)), fn, workers=4)
+    assert [next(it) for _ in range(3)] == [0, 1, 2]
+    with pytest.raises(ValueError, match="boom3"):
+        next(it)
+    with pytest.raises(StopIteration):  # pool closed after the error
+        next(it)
+
+
+def test_ordered_pool_source_exception_propagates():
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("src boom")
+
+    it = ordered_map(gen(), lambda x: x, workers=2)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(RuntimeError, match="src boom"):
+        next(it)
+
+
+def test_ordered_pool_close_stops_feeder_and_closes_source():
+    source_closed = []
+
+    def gen():
+        try:
+            for i in range(100000):
+                yield i
+        finally:
+            source_closed.append(True)
+
+    pool = OrderedPool(gen(), lambda x: x, workers=2)
+    assert next(pool) == 0
+    pool.close()
+    deadline = time.time() + 5.0
+    while pool._feeder.is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not pool._feeder.is_alive()
+    assert source_closed == [True]
+    with pytest.raises(StopIteration):
+        next(pool)
+    pool.close()  # idempotent
+
+
+def test_ordered_pool_runs_ahead_bounded():
+    produced = []
+
+    def gen():
+        for i in range(100):
+            produced.append(i)
+            yield i
+
+    pool = OrderedPool(gen(), lambda x: x, workers=2)
+    deadline = time.time() + 5.0
+    while len(produced) < 4 and time.time() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.1)
+    # bounded run-ahead: queue size (2*workers) + workers in flight + one
+    # blocked at the put — never the whole epoch
+    assert 4 <= len(produced) <= 8
+    assert list(pool) == list(range(100))
+
+
+# ----------------------------------------------------------------------
+# CollateCache: identity-keyed, byte-capped LRU
+# ----------------------------------------------------------------------
+
+
+class _Eg:  # stand-in Example: the cache only uses identity
+    pass
+
+
+def test_collate_cache_hit_miss_and_identity():
+    cache = CollateCache(1 << 20)
+    egs = [_Eg(), _Eg()]
+    value = {"x": np.ones(10)}
+    assert cache.get(egs, 8, 16) is None  # cold miss
+    cache.put(egs, 8, 16, value)
+    assert cache.get(egs, 8, 16) is value  # hit: same objects, same bucket
+    assert cache.get(egs, 8, 32) is None  # different bucket shape
+    assert cache.get(egs[:1], 8, 16) is None  # different batch
+    assert cache.hits == 1 and cache.misses == 3
+
+
+def test_collate_cache_byte_budget_evicts_lru():
+    cache = CollateCache(3000)
+    batches = [[_Eg()] for _ in range(4)]
+    for b in batches:
+        cache.put(b, 1, 1, {"a": np.zeros(1000, np.uint8)})
+    # 4000 bytes > 3000 budget: the oldest entry was evicted
+    assert cache.evictions == 1
+    assert cache.get(batches[0], 1, 1) is None
+    assert cache.get(batches[3], 1, 1) is not None
+    assert cache.nbytes <= 3000
+
+
+def test_collate_cache_oversized_entry_rejected():
+    cache = CollateCache(100)
+    b = [_Eg()]
+    cache.put(b, 1, 1, {"a": np.zeros(1000, np.uint8)})
+    assert len(cache) == 0  # one oversized batch must not flush the cache
+    assert cache.get(b, 1, 1) is None
+
+
+def test_collate_cache_thread_safety_smoke():
+    cache = CollateCache(1 << 16)
+    batches = [[_Eg()] for _ in range(16)]
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(200):
+                for b in batches:
+                    if cache.get(b, 4, 8) is None:
+                        cache.put(b, 4, 8, {"a": np.zeros(128, np.uint8)})
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert cache.nbytes <= 1 << 16
+
+
+def test_pipeline_stats_snapshot_shape():
+    stats = PipelineStats()
+    with stats.timer("collate"):
+        pass
+    stats.add("read", 0.5)
+    stats.hit()
+    stats.miss()
+    snap = stats.snapshot()
+    assert set(snap["stage_seconds"]) == {"read", "collate", "transfer",
+                                          "queue_wait"}
+    assert snap["stage_counts"]["read"] == 1
+    assert snap["cache"] == {"enabled": False, "hits": 1, "misses": 1}
+
+
+# ----------------------------------------------------------------------
+# Training-loop integration: pool + cache + exact resume
+# ----------------------------------------------------------------------
+
+POOL_CFG = """
+[paths]
+train = null
+dev = null
+
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger"]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 32
+depth = 1
+embed_size = 128
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 32
+
+[corpora.train]
+@readers = "spacy.Corpus.v1"
+path = ${paths.train}
+shuffle = true
+seed = 3
+
+[corpora.dev]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${paths.dev}
+
+[training]
+seed = 0
+patience = 0
+max_steps = 16
+eval_frequency = 4
+collate_workers = 3
+collate_cache_mb = 32
+
+[training.optimizer]
+@optimizers = "Adam.v1"
+learn_rate = 0.01
+
+[training.batcher]
+@batchers = "spacy.batch_by_words.v1"
+size = 150
+tolerance = 0.2
+"""
+
+
+def _pool_cfg(tmp_path, **over):
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.util import write_synth_jsonl
+
+    train_path = tmp_path / "train.jsonl"
+    if not train_path.exists():
+        write_synth_jsonl(train_path, 40, kind="tagger", seed=0)
+        write_synth_jsonl(tmp_path / "dev.jsonl", 12, kind="tagger", seed=1)
+    return Config.from_str(POOL_CFG).apply_overrides(
+        {
+            "paths.train": str(train_path),
+            "paths.dev": str(tmp_path / "dev.jsonl"),
+            **over,
+        }
+    )
+
+
+def test_pooled_cached_training_matches_inline_exactly(tmp_path):
+    """collate_workers + collate_cache must be pure plumbing: identical
+    params to the single-threaded uncached path, batch for batch."""
+    import jax
+
+    from spacy_ray_tpu.training.loop import train
+
+    # shuffle OFF: epochs repeat the same batches, so the identity-keyed
+    # cache actually hits (under shuffle the batch composition changes
+    # every epoch and the cache only churns — see docs/TUNING.md)
+    stable = {"corpora.train.shuffle": False}
+    nlp_pool, res_pool = train(
+        _pool_cfg(tmp_path, **stable), n_workers=1, stdout_log=False
+    )
+    snap = res_pool.history[-1]["input_pipeline"]
+    assert snap["workers"] == 3
+    assert snap["cache"]["enabled"] is True
+    assert snap["cache"]["hits"] > 0  # epoch 2+ re-collations hit
+    nlp_inline, _ = train(
+        _pool_cfg(
+            tmp_path,
+            **{
+                "training.collate_workers": 0,
+                "training.collate_cache_mb": 0,
+                **stable,
+            },
+        ),
+        n_workers=1,
+        stdout_log=False,
+    )
+    la = jax.tree_util.tree_leaves(nlp_pool.params)
+    lb = jax.tree_util.tree_leaves(nlp_inline.params)
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_exact_through_pool_and_cache(tmp_path):
+    """Data-position tags (batches_in_epoch / corpus_epoch) must survive
+    the pool: straight-through vs checkpoint+resume end bit-identical
+    with collate_workers + cache enabled and a shuffled corpus."""
+    import jax
+
+    from spacy_ray_tpu.training.loop import train
+
+    nlp_a, _ = train(
+        _pool_cfg(tmp_path),
+        output_path=tmp_path / "a",
+        n_workers=1,
+        stdout_log=False,
+    )
+    _, rb1 = train(
+        _pool_cfg(tmp_path, **{"training.max_steps": 8}),
+        output_path=tmp_path / "b",
+        n_workers=1,
+        stdout_log=False,
+    )
+    assert rb1.final_step == 8
+    nlp_b, rb2 = train(
+        _pool_cfg(tmp_path),
+        output_path=tmp_path / "b",
+        n_workers=1,
+        resume=True,
+        stdout_log=False,
+    )
+    assert rb2.final_step == 16
+    la = jax.tree_util.tree_leaves(nlp_a.params)
+    lb = jax.tree_util.tree_leaves(nlp_b.params)
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_augmentation_bypasses_collate_cache(tmp_path):
+    """An active augmenter yields FRESH Example copies per epoch — the
+    identity-keyed cache can never hit, so the loop must disable it."""
+    from spacy_ray_tpu.training.loop import train
+
+    cfg = _pool_cfg(tmp_path, **{"training.max_steps": 8})
+    cfg["corpora"]["train"]["augmenter"] = {
+        "@augmenters": "spacy.lower_case.v1",
+        "level": 0.5,
+    }
+    _, res = train(cfg, n_workers=1, stdout_log=False)
+    snap = res.history[-1]["input_pipeline"]
+    assert snap["cache"]["enabled"] is False
+    assert snap["cache"]["hits"] == 0 and snap["cache"]["misses"] == 0
+
+
+def test_shuffle_bypasses_collate_cache(tmp_path):
+    """POOL_CFG shuffles the corpus: batch membership changes every epoch,
+    so the identity-keyed cache could never hit — the loop must disable
+    it (Corpus.stable_identity) rather than churn the LRU."""
+    from spacy_ray_tpu.training.loop import train
+
+    _, res = train(
+        _pool_cfg(tmp_path, **{"training.max_steps": 8}),
+        n_workers=1,
+        stdout_log=False,
+    )
+    snap = res.history[-1]["input_pipeline"]
+    assert snap["cache"]["enabled"] is False
+    assert snap["cache"]["hits"] == 0 and snap["cache"]["misses"] == 0

@@ -139,3 +139,24 @@ def test_cli_document_and_run(project_dir, capsys):
     rc = cli_main(["project", "run", "nope", str(project_dir)])
     assert rc == 1
     assert "no workflow or command" in capsys.readouterr().err
+
+
+def test_python3_token_rewritten_to_sys_executable(tmp_path, capsys):
+    """A leading `python3` (the common spelling on python3-only hosts)
+    must resolve to THIS interpreter, exactly like `python` (ADVICE r5
+    #3) — the printed command line shows the rewrite."""
+    import sys
+
+    (tmp_path / "project.yml").write_text(
+        """
+commands:
+  - name: p3
+    script:
+      - "python3 -c \\"open('p3.txt','w').write('ok')\\""
+"""
+    )
+    assert project_run(tmp_path, "p3") == 1
+    assert (tmp_path / "p3.txt").read_text() == "ok"
+    out = capsys.readouterr().out
+    assert f"$ {sys.executable} -c" in out
+    assert "$ python3" not in out

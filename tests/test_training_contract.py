@@ -570,3 +570,54 @@ def test_default_score_weights_spancat_key():
 
     comp = SpanCatComponent("sc", {}, spans_key="mykey")
     assert comp.default_score_weights["spans_mykey_f"] == 1.0
+
+
+def test_init_labels_path_relative_to_config_dir(tagger_config_text, tmp_path,
+                                                 monkeypatch):
+    """A RELATIVE [initialize.components.<name>] labels path resolves
+    against the config FILE's directory, not the process CWD (ADVICE r5
+    #4) — a config checked in next to its labels/ dir must train from any
+    launch directory."""
+    import json
+
+    from spacy_ray_tpu.config import load_config
+    from spacy_ray_tpu.training.corpus import Corpus
+    from spacy_ray_tpu.util import write_synth_jsonl
+
+    project = tmp_path / "project"
+    project.mkdir()
+    write_synth_jsonl(project / "t.jsonl", 20, kind="tagger", seed=0)
+    labels = ["A", "B", "C"]
+    (project / "labels").mkdir()
+    (project / "labels" / "tagger.json").write_text(json.dumps(labels))
+    cfg_path = project / "cfg.cfg"
+    cfg_path.write_text(tagger_config_text)
+
+    elsewhere = tmp_path / "elsewhere"
+    elsewhere.mkdir()
+    monkeypatch.chdir(elsewhere)  # CWD-relative would fail to resolve
+    cfg = load_config(
+        cfg_path,
+        overrides={
+            "paths.train": str(project / "t.jsonl"),
+            "paths.dev": str(project / "t.jsonl"),
+            "initialize.components.tagger.labels": "labels/tagger.json",
+        },
+    ).interpolate()
+    nlp = Pipeline.from_config(cfg)
+    examples = list(Corpus(project / "t.jsonl")())
+    nlp.initialize(lambda: iter(examples), seed=0)
+    assert nlp.components["tagger"].labels == labels
+
+
+@pytest.mark.parametrize(
+    "key,value",
+    [
+        ("collate_workers", -1),
+        ("collate_workers", True),
+        ("collate_cache_mb", "256"),
+    ],
+)
+def test_mistyped_input_pipeline_knobs_rejected(key, value):
+    with pytest.raises(ValueError, match=f"\\[training\\] {key}"):
+        validate_training({key: value})
