@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow bench baseline profile dryrun
+.PHONY: test test-fast test-slow resilience bench baseline profile dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -10,6 +10,10 @@ test-fast:
 
 test-slow:
 	python -m pytest tests/ -q -m slow
+
+# fault-injection / checkpoint-fallback / watchdog suite (docs/RESILIENCE.md)
+resilience:
+	python -m pytest tests/test_resilience.py tests/test_checkpoint_fallback.py -q
 
 bench:
 	python bench.py

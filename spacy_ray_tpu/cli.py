@@ -82,9 +82,48 @@ def _init_distributed(coordinator: Optional[str], num_processes: Optional[int], 
         )
 
 
+# grace period before a relayed/probe shutdown escalates SIGTERM → SIGKILL
+SHUTDOWN_GRACE_S = 10.0
+
+
+def _supervise_train(argv: List[str], max_restarts: int) -> int:
+    """``train --max-restarts N``: run training as a child process and
+    relaunch it on nonzero exit (crash, watchdog kill, injected fault),
+    resuming from the last intact checkpoint generation. Signals to the
+    supervisor relay to the child with SIGTERM → SIGKILL escalation after
+    a grace period — the same helper the relay probe uses."""
+    from .training.resilience import Supervisor
+
+    child_args: List[str] = []
+    skip_next = False
+    for a in argv:
+        if skip_next:
+            skip_next = False
+            continue
+        if a == "--max-restarts":
+            skip_next = True
+            continue
+        if a.startswith("--max-restarts="):
+            continue
+        child_args.append(a)
+
+    def build_cmd(attempt: int) -> List[str]:
+        cmd = [sys.executable, "-m", "spacy_ray_tpu", "train"] + child_args
+        if attempt > 0 and "--resume" not in cmd:
+            cmd.append("--resume")  # recover from the last intact checkpoint
+        return cmd
+
+    return Supervisor(build_cmd, max_restarts, grace_s=SHUTDOWN_GRACE_S).run()
+
+
 def train_command(argv: List[str]) -> int:
+    # allow_abbrev=False: an abbreviated --max-restart would parse as
+    # supervisor mode yet escape the exact-spelling strip in
+    # _supervise_train, so every child would re-supervise a grandchild
+    # with the same argv — an unbounded supervisor chain
     parser = argparse.ArgumentParser(
-        prog="spacy_ray_tpu train", description="Train a pipeline from a config."
+        prog="spacy_ray_tpu train", description="Train a pipeline from a config.",
+        allow_abbrev=False,
     )
     parser.add_argument("config_path", type=Path)
     parser.add_argument("--n-workers", type=int, default=None, dest="n_workers")
@@ -96,12 +135,28 @@ def train_command(argv: List[str]) -> int:
     parser.add_argument("--code", type=Path, default=None)
     parser.add_argument("--output", "-o", type=Path, default=None)
     parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--max-restarts", type=int, default=0, dest="max_restarts",
+                        help="supervisor mode: relaunch the training child up "
+                        "to N times on nonzero exit, resuming from the last "
+                        "intact checkpoint (0 = train in-process)")
     parser.add_argument("--profile", type=Path, default=None,
                         help="write a jax.profiler trace of steps 5-15 here")
     parser.add_argument("--verbose", "-V", action="store_true")
     args, extra = parser.parse_known_args(argv)
 
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.ERROR)
+    # resilience events (resume anomalies, retries, preemption, checkpoint
+    # fallback) must reach the operator even without -V — they used to be
+    # bare prints; now they flow through this logger (+ the jsonl logger)
+    logging.getLogger("spacy_ray_tpu.training").setLevel(
+        logging.INFO if args.verbose else logging.WARNING
+    )
+
+    if args.max_restarts > 0:
+        # supervisor mode: this process never touches jax — it only spawns,
+        # relays signals to, and relaunches the training child
+        return _supervise_train(argv, args.max_restarts)
+
     _setup_device(args.device)
     _init_distributed(args.coordinator, args.num_processes, args.process_id)
 
@@ -121,6 +176,20 @@ def train_command(argv: List[str]) -> int:
         resume=args.resume,
         profile_dir=args.profile,
     )
+    if result.interrupted:
+        from .training.resilience import RC_PREEMPTED
+
+        if args.output is not None:
+            print(
+                f"Interrupted at step {result.final_step} — checkpoint "
+                f"written; rerun with --resume to continue (exit {RC_PREEMPTED})"
+            )
+        else:
+            print(
+                f"Interrupted at step {result.final_step} — NO checkpoint "
+                f"(no --output given); progress is lost (exit {RC_PREEMPTED})"
+            )
+        return RC_PREEMPTED
     print(
         f"Done. steps={result.final_step} best_score={result.best_score:.4f} "
         f"(step {result.best_step}) words/sec={result.wps:,.0f}"
@@ -956,11 +1025,13 @@ def info_command(argv: List[str]) -> int:
             else:
                 print("accelerator      UNREACHABLE (backend init failed)")
         except subprocess.TimeoutExpired:
-            p.terminate()  # SIGTERM only: SIGKILL wedges relay clients
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                pass
+            # SIGTERM first (relay clients get a chance to detach cleanly),
+            # but a child wedged in backend init can ignore it forever —
+            # escalate to SIGKILL after the grace period instead of
+            # hanging the probe (the same helper the supervisor uses)
+            from .training.resilience import terminate_with_grace
+
+            terminate_with_grace(p, grace_s=SHUTDOWN_GRACE_S)
             print("accelerator      UNREACHABLE (backend init hung >60s)")
     if args.model_path is not None:
         import json

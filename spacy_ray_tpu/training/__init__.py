@@ -1,5 +1,7 @@
-"""Training subsystem: loop, batching, corpora, optimizers, checkpointing."""
+"""Training subsystem: loop, batching, corpora, optimizers, checkpointing,
+and the resilience layer (preemption, watchdog, retries, fault injection)."""
 
+from . import resilience  # noqa: F401  (shutdown/watchdog/retry/faults)
 from . import corpus  # noqa: F401  (registers readers)
 from . import batcher  # noqa: F401  (registers batchers/schedules)
 from . import optimizers  # noqa: F401  (registers optimizers/schedules)

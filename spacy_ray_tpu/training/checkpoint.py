@@ -12,17 +12,65 @@ resume at all (SURVEY.md §5.4). Here both are first-class:
 
 Arrays are gathered to host before writing; restore re-shards by whatever
 shardings the caller puts them under.
+
+Integrity + history (the resilience subsystem's torn-checkpoint story):
+every generation's files are SHA-256-stamped in its meta, the last
+``keep`` generations are retained, and ``load()`` verifies digests and
+falls back generation-by-generation to the newest INTACT one — a torn,
+truncated, or missing file is a warning and an older generation, never a
+crash and never a silently-wrong resume (with ZeRO-1-sharded opt state a
+desynced params/opt_state pair is undetectable downstream, cf.
+arXiv:2004.13336). All corrupt/partial paths raise one typed
+:class:`CheckpointCorrupt`, which the fallback logic catches.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import pickle
 from pathlib import Path
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+from .resilience import log_event, maybe_fail, retry_io
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint generation is torn, truncated, or missing pieces.
+
+    The ONE error type every corrupt/partial-checkpoint path raises —
+    including pre-stamping layouts whose ``opt_state.pkl`` vanished (which
+    used to surface as an opaque KeyError/pickle error) — so fallback and
+    resume logic can catch exactly "this generation is bad" and nothing
+    else."""
+
+
+def _sha256_file(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class _HashingWriter:
+    """File tee that hashes bytes as they are written — valid ONLY for
+    sequential writers (pickle). Zip-based writers (np.savez) seek back to
+    patch entry headers, which would desync digest from file bytes; the
+    .npz digest therefore comes from a read-back of the written file."""
+
+    __slots__ = ("_f", "_h")
+
+    def __init__(self, f, h):
+        self._f = f
+        self._h = h
+
+    def write(self, b):
+        self._h.update(b)
+        return self._f.write(b)
 
 
 def gather_to_host(tree: Any) -> Any:
@@ -77,13 +125,28 @@ def load_params(path) -> Dict[str, Any]:
     return jax.tree_util.tree_map(jnp.asarray, _unflatten(flat))
 
 
-class TrainCheckpoint:
-    """Full training-state checkpoint directory.
+def _gen_stamp(meta_path: Path) -> Optional[int]:
+    """Stamp encoded in a per-generation meta filename, or None."""
+    name = meta_path.name
+    if not (name.startswith("train_meta-") and name.endswith(".json")):
+        return None
+    try:
+        return int(name[len("train_meta-"):-len(".json")])
+    except ValueError:
+        return None
 
-    Layout: state.pkl (opt_state pytree via pickle of host numpy),
-    params.npz, meta.json. The opt_state is pickled because optax states are
-    nested namedtuples whose structure the restore side reconstructs anyway;
-    arrays inside are converted to numpy first.
+
+class TrainCheckpoint:
+    """Full training-state checkpoint directory with generation history.
+
+    Layout per generation ``stamp`` (= the step it was written at):
+    ``params-{stamp}.npz``, ``opt_state-{stamp}.pkl`` (optax states are
+    nested namedtuples whose structure the restore side reconstructs, so
+    pickle of host numpy), and ``train_meta-{stamp}.json`` carrying the
+    loop state plus SHA-256 digests of the two array files. The un-stamped
+    ``train_meta.json`` — written LAST via atomic os.replace — is the
+    pointer to the newest generation; the last ``keep`` generations are
+    retained so a corrupt newest generation falls back, not crashes.
     """
 
     @staticmethod
@@ -98,36 +161,23 @@ class TrainCheckpoint:
         best_score: float,
         best_step: int,
         extra: Optional[Dict[str, Any]] = None,
+        keep: int = 2,
     ) -> None:
         """Crash-safe write: array files are generation-stamped by step and
-        the meta file — written LAST via atomic os.replace — names the
+        the pointer meta — written LAST via atomic os.replace — names the
         generation it points at. A crash at ANY point leaves the previous
-        complete generation loadable (a torn write of un-stamped files
-        could pair an old meta with new params: silently wrong resume)."""
+        complete generations loadable (a torn write of un-stamped files
+        could pair an old meta with new params: silently wrong resume).
+
+        Gathers/serialization happen once; only the file writes sit inside
+        the transient-I/O retry (tmp + os.replace makes them idempotent).
+        """
         import os
 
         path = Path(path)
-        path.mkdir(parents=True, exist_ok=True)
+        keep = max(int(keep), 1)
         stamp = int(step)
-        # tmp + os.replace even for the stamped files: a restart WITHOUT
-        # --resume can checkpoint at the same step the live meta already
-        # points at, and an in-place rewrite of that file would reopen
-        # the torn-write hole for exactly that generation
-        # np.savez ALWAYS appends .npz to a non-.npz name, so the written
-        # file is deterministically params-{stamp}.npz.tmp.npz — never
-        # branch on exists(): a stale literal .tmp left by other tooling
-        # would be promoted over the freshly written file
-        params_tmp = path / f"params-{stamp}.npz.tmp"
-        save_params(params_tmp, params)
-        os.replace(
-            params_tmp.with_suffix(params_tmp.suffix + ".npz"),
-            path / f"params-{stamp}.npz",
-        )
         host_opt = gather_to_host(opt_state)
-        opt_tmp = path / f"opt_state-{stamp}.pkl.tmp"
-        with open(opt_tmp, "wb") as f:
-            pickle.dump(host_opt, f)
-        os.replace(opt_tmp, path / f"opt_state-{stamp}.pkl")
         meta = {
             "step": int(step),
             "epoch": int(epoch),
@@ -137,44 +187,211 @@ class TrainCheckpoint:
             "extra": extra or {},
             "stamp": stamp,
         }
-        tmp = path / "train_meta.json.tmp"
-        tmp.write_text(json.dumps(meta, indent=2), encoding="utf8")
-        os.replace(tmp, path / "train_meta.json")
-        # previous generations are garbage once the meta points past them;
-        # a crash before this cleanup only leaves extra files behind
-        for old in path.glob("params-*.npz"):
-            if old.name != f"params-{stamp}.npz":
-                old.unlink(missing_ok=True)
-        for old in path.glob("opt_state-*.pkl"):
-            if old.name != f"opt_state-{stamp}.pkl":
-                old.unlink(missing_ok=True)
+
+        def write_files() -> None:
+            maybe_fail("checkpoint-write")
+            path.mkdir(parents=True, exist_ok=True)
+            # tmp + os.replace even for the stamped files: a restart WITHOUT
+            # --resume can checkpoint at the same step the live meta already
+            # points at, and an in-place rewrite of that file would reopen
+            # the torn-write hole for exactly that generation
+            # np.savez ALWAYS appends .npz to a non-.npz name, so the written
+            # file is deterministically params-{stamp}.npz.tmp.npz — never
+            # branch on exists(): a stale literal .tmp left by other tooling
+            # would be promoted over the freshly written file
+            params_tmp = path / f"params-{stamp}.npz.tmp"
+            save_params(params_tmp, params)
+            os.replace(
+                params_tmp.with_suffix(params_tmp.suffix + ".npz"),
+                path / f"params-{stamp}.npz",
+            )
+            opt_tmp = path / f"opt_state-{stamp}.pkl.tmp"
+            opt_hash = hashlib.sha256()
+            with open(opt_tmp, "wb") as f:
+                # the opt state is the big file under ZeRO-1 — hash it
+                # while writing instead of a second full read
+                pickle.dump(host_opt, _HashingWriter(f, opt_hash))
+            os.replace(opt_tmp, path / f"opt_state-{stamp}.pkl")
+            # load() re-hashes exactly what it is about to read, so any
+            # torn/truncated byte shows up
+            meta["digests"] = {
+                f"params-{stamp}.npz": _sha256_file(path / f"params-{stamp}.npz"),
+                f"opt_state-{stamp}.pkl": opt_hash.hexdigest(),
+            }
+            text = json.dumps(meta, indent=2)
+            # per-generation meta first (enables fallback), pointer last
+            # (atomic commit of "this is the newest generation")
+            gen_tmp = path / f"train_meta-{stamp}.json.tmp"
+            gen_tmp.write_text(text, encoding="utf8")
+            os.replace(gen_tmp, path / f"train_meta-{stamp}.json")
+            tmp = path / "train_meta.json.tmp"
+            tmp.write_text(text, encoding="utf8")
+            os.replace(tmp, path / "train_meta.json")
+
+        retry_io("checkpoint-write", write_files)
+        # retention: the generation just written plus the newest keep-1
+        # committed generations BELOW it. Stamps ABOVE the one just
+        # written are an abandoned lineage (a restart WITHOUT --resume
+        # re-counts steps from 0 into the same directory) — retaining
+        # them would let load()'s newest-stamp-first fallback silently
+        # resume the abandoned run's state, so they are deleted. A crash
+        # before this cleanup only leaves extra files behind.
+        committed = sorted(
+            s
+            for s in (_gen_stamp(p) for p in path.glob("train_meta-*.json"))
+            if s is not None and s < stamp
+        )
+        retained = set(committed[-(keep - 1):]) if keep > 1 else set()
+        retained.add(stamp)
+        for pattern, suffix in (
+            ("params-*.npz", ".npz"),
+            ("opt_state-*.pkl", ".pkl"),
+            ("train_meta-*.json", ".json"),
+        ):
+            prefix = pattern.split("*", 1)[0]
+            for old in path.glob(pattern):
+                try:
+                    old_stamp = int(old.name[len(prefix):-len(suffix)])
+                except ValueError:
+                    continue
+                if old_stamp not in retained:
+                    old.unlink(missing_ok=True)
+        # tmp stragglers from crashed earlier saves (params-*.npz.tmp.npz,
+        # *.pkl.tmp, *.json.tmp): this save's own tmps were all promoted
+        # above, so anything still wearing a tmp suffix is garbage — on a
+        # crash-looping fleet these are full-size params/opt_state copies
+        for pattern in ("*.tmp", "*.tmp.npz"):
+            for stray in path.glob(pattern):
+                stray.unlink(missing_ok=True)
+
+    # -- loading ------------------------------------------------------
 
     @staticmethod
-    def load(path) -> Optional[Dict[str, Any]]:
-        path = Path(path)
-        if not (path / "train_meta.json").exists():
-            return None
+    def _read_meta(meta_path: Path) -> Dict[str, Any]:
+        try:
+            meta = json.loads(meta_path.read_text(encoding="utf8"))
+        except (OSError, ValueError) as e:
+            raise CheckpointCorrupt(
+                f"unreadable checkpoint meta {meta_path}: {e}"
+            ) from e
+        if not isinstance(meta, dict) or "step" not in meta:
+            raise CheckpointCorrupt(
+                f"malformed checkpoint meta {meta_path}: not a train_meta dict"
+            )
+        return meta
+
+    @staticmethod
+    def _load_generation(path: Path, meta: Dict[str, Any]) -> Dict[str, Any]:
+        """Load one generation described by ``meta``; verify digests when
+        present. EVERY failure mode — missing file, torn npz/pickle, digest
+        mismatch, missing meta key — raises :class:`CheckpointCorrupt`."""
         import jax.numpy as jnp
 
-        meta = json.loads((path / "train_meta.json").read_text(encoding="utf8"))
         stamp = meta.get("stamp")
         if stamp is not None:
             params_file = path / f"params-{int(stamp)}.npz"
             opt_file = path / f"opt_state-{int(stamp)}.pkl"
-        else:  # pre-stamping checkpoints (round <= 4 layouts)
+        else:  # pre-stamping checkpoints (round <= 4 layouts): no digests
             params_file = path / "params.npz"
             opt_file = path / "opt_state.pkl"
-        params = load_params(params_file)
-        with open(opt_file, "rb") as f:
-            opt_state = pickle.load(f)
-        opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
-        return {
-            "params": params,
-            "opt_state": opt_state,
-            "step": meta["step"],
-            "epoch": meta["epoch"],
-            "rng": jnp.asarray(np.array(meta["rng"], dtype=np.uint32)),
-            "best_score": meta["best_score"],
-            "best_step": meta["best_step"],
-            "extra": meta.get("extra", {}),
-        }
+        for f in (params_file, opt_file):
+            if not f.exists():
+                raise CheckpointCorrupt(f"checkpoint file missing: {f}")
+        digests = meta.get("digests") or {}
+        for f in (params_file, opt_file):
+            expect = digests.get(f.name)
+            if expect is not None and _sha256_file(f) != expect:
+                raise CheckpointCorrupt(
+                    f"checkpoint digest mismatch: {f} (torn or tampered write)"
+                )
+        try:
+            params = load_params(params_file)
+            with open(opt_file, "rb") as fh:
+                opt_state = pickle.load(fh)
+            opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
+            return {
+                "params": params,
+                "opt_state": opt_state,
+                "step": meta["step"],
+                "epoch": meta["epoch"],
+                "rng": jnp.asarray(np.array(meta["rng"], dtype=np.uint32)),
+                "best_score": meta["best_score"],
+                "best_step": meta["best_step"],
+                "extra": meta.get("extra", {}),
+            }
+        except CheckpointCorrupt:
+            raise
+        except Exception as e:
+            # torn zip, truncated pickle, missing meta key, bad rng shape —
+            # one typed error for every partial-checkpoint shape
+            raise CheckpointCorrupt(
+                f"corrupt checkpoint generation "
+                f"{'stamp ' + str(stamp) if stamp is not None else '(pre-stamping)'} "
+                f"in {path}: {type(e).__name__}: {e}"
+            ) from e
+
+    @staticmethod
+    def load(path) -> Optional[Dict[str, Any]]:
+        """Load the newest INTACT generation.
+
+        Candidates are the pointer meta plus every per-generation meta,
+        newest first; a corrupt generation logs a warning and falls back to
+        the next. Returns None when the directory holds no checkpoint at
+        all (fresh start); raises :class:`CheckpointCorrupt` only when
+        every present generation is corrupt.
+        """
+        path = Path(path)
+        candidates: List[Tuple[int, Path]] = []
+        for meta_path in path.glob("train_meta-*.json"):
+            stamp = _gen_stamp(meta_path)
+            if stamp is not None:
+                candidates.append((stamp, meta_path))
+        candidates.sort(key=lambda c: c[0], reverse=True)
+        pointer = path / "train_meta.json"
+        if pointer.exists():
+            # pointer first: it names the generation the last completed
+            # save committed (and is the ONLY meta in pre-history layouts)
+            candidates.insert(0, (-1, pointer))
+        elif candidates:
+            # generations exist but the pointer vanished: still loadable
+            # via the stamped metas, but something deleted files out from
+            # under us — say so rather than silently resuming older state
+            log_event(
+                "checkpoint-fallback",
+                f"pointer meta train_meta.json missing in {path}; scanning "
+                "generation metas",
+                path=str(path),
+            )
+        if not candidates:
+            return None
+        tried: set = set()
+        last_err: Optional[CheckpointCorrupt] = None
+        for _, meta_path in candidates:
+            try:
+                meta = TrainCheckpoint._read_meta(meta_path)
+                stamp = meta.get("stamp")
+                if stamp in tried:
+                    continue
+                tried.add(stamp)
+                state = TrainCheckpoint._load_generation(path, meta)
+            except CheckpointCorrupt as e:
+                last_err = e
+                log_event(
+                    "checkpoint-fallback",
+                    f"{e} — trying the previous generation",
+                    path=str(path),
+                )
+                continue
+            if last_err is not None:
+                log_event(
+                    "checkpoint-fallback",
+                    f"recovered from generation stamp {meta.get('stamp')} "
+                    f"(step {state['step']}) in {path}",
+                    path=str(path),
+                    step=int(state["step"]),
+                )
+            return state
+        raise CheckpointCorrupt(
+            f"no intact checkpoint generation in {path} "
+            f"(last error: {last_err})"
+        )

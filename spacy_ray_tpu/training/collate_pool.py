@@ -41,6 +41,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from .resilience import maybe_fail
+
 __all__ = [
     "OrderedPool",
     "CollateCache",
@@ -234,7 +236,13 @@ def cached_collate(
     benchmark measures the exact pipeline training runs (cache semantics
     can't drift between the two). ``cache=None`` degrades to a plain
     ``collate`` call; stats (when given) count hits/misses only while a
-    cache is active."""
+    cache is active.
+
+    Also the ``collate`` fault-injection site (training/resilience.py):
+    living here, an injected collation failure exercises the SAME path —
+    including pool-worker → consumer re-raise — for the loop and the
+    bench."""
+    maybe_fail("collate")
     value = cache.get(examples, B, T) if cache is not None else None
     if value is None:
         value = collate(examples, B, T)

@@ -29,8 +29,23 @@ from typing import Callable, Iterable, Iterator, List, Optional, Union
 
 from ..registry import registry
 from ..pipeline.doc import Doc, Example, Span
+from .resilience import maybe_fail, retry_io
 
 CorpusReader = Callable[[], Iterator[Example]]
+
+
+def _open_corpus_file(opener: Callable, path, *args, **kwargs):
+    """Open a corpus/DocBin file through the resilience layer: the
+    ``corpus-read`` fault-injection site plus transient-I/O retry with
+    backoff + jitter (fleet filesystems flake on open far more often than
+    mid-read; a failed open is also the only retry that is trivially
+    idempotent for a streaming reader)."""
+
+    def attempt():
+        maybe_fail("corpus-read")
+        return opener(path, *args, **kwargs)
+
+    return retry_io("corpus-read", attempt)
 
 
 _raw_text_tokenizer = None
@@ -112,7 +127,7 @@ def _doc_to_json(doc: Doc) -> dict:
 
 
 def read_jsonl_docs(path: Union[str, Path]) -> Iterator[Doc]:
-    with open(path, "r", encoding="utf8") as f:
+    with _open_corpus_file(open, path, "r", encoding="utf8") as f:
         for line in f:
             line = line.strip()
             if line:
@@ -135,7 +150,7 @@ def read_conllu_docs(path: Union[str, Path]) -> Iterator[Doc]:
         words, tags, pos, heads, deps, morphs = [], [], [], [], [], []
         return doc
 
-    with open(path, "r", encoding="utf8") as f:
+    with _open_corpus_file(open, path, "r", encoding="utf8") as f:
         for line in f:
             line = line.rstrip("\n")
             if not line:
@@ -178,7 +193,7 @@ class DocBin:
     @classmethod
     def from_disk(cls, path: Union[str, Path]) -> "DocBin":
         docs = []
-        with gzip.open(path, "rt", encoding="utf8") as f:
+        with _open_corpus_file(gzip.open, path, "rt", encoding="utf8") as f:
             for line in f:
                 line = line.strip()
                 if line:
@@ -202,7 +217,7 @@ def _iter_path(path: Path) -> Iterator[Doc]:
     elif suffix == ".spacy":
         # real spaCy DocBin (zlib-wrapped msgpack); legacy files from this
         # repo's earlier .spacy spelling were gzip text — sniff the magic
-        with open(path, "rb") as f:
+        with _open_corpus_file(open, path, "rb") as f:
             magic = f.read(2)
         if magic == b"\x1f\x8b":
             yield from DocBin.from_disk(path).docs

@@ -113,6 +113,8 @@ def jsonl_logger(path: Optional[str] = None):
     import json
 
     def setup(nlp, stdout: IO = sys.stdout, stderr: IO = sys.stderr):
+        from .resilience import drain_events
+
         handle = open(path, "a", encoding="utf8") if path else None
 
         def log_step(info: Optional[Dict[str, Any]]) -> None:
@@ -125,6 +127,12 @@ def jsonl_logger(path: Optional[str] = None):
                     "score", "losses", "other_scores", "input_pipeline",
                 )
             }
+            # resilience events since the last row (resume anomalies,
+            # retries, checkpoint fallbacks, preemption) — jsonl is the
+            # machine-readable record, so anomalies must land here too
+            events = drain_events()
+            if events:
+                rec["events"] = events
             line = json.dumps(rec, default=float)
             if handle:
                 handle.write(line + "\n")
@@ -133,6 +141,16 @@ def jsonl_logger(path: Optional[str] = None):
                 stdout.write(line + "\n")
 
         def finalize() -> None:
+            # events queued AFTER the last row (the `preempted` record and
+            # any final-checkpoint retries live exactly there) still land
+            # in the jsonl file as a trailing events-only record
+            events = drain_events()
+            if events:
+                line = json.dumps({"events": events}, default=float)
+                if handle:
+                    handle.write(line + "\n")
+                else:
+                    stdout.write(line + "\n")
             if handle:
                 handle.close()
 

@@ -38,7 +38,9 @@ from ..parallel.mesh import build_mesh
 from ..parallel.step import make_train_step, place_batch, place_replicated, shard_opt_state
 from .batcher import bucket_batch_size, bucket_length, shard_stream
 from . import checkpoint as checkpoint_mod
-from .checkpoint import TrainCheckpoint
+from . import resilience
+from .checkpoint import CheckpointCorrupt, TrainCheckpoint
+from .resilience import ShutdownCoordinator, Watchdog, log_event, maybe_fail
 from . import corpus as _corpus  # noqa: F401  (registers readers)
 from . import optimizers as _optimizers  # noqa: F401  (registers optimizers)
 from . import loggers as _loggers  # noqa: F401  (registers loggers)
@@ -71,6 +73,20 @@ DEFAULT_TRAINING = {
     # epoch can never hit an identity-keyed cache) and in annotating mode
     # (targets depend on per-step predictions).
     "collate_cache_mb": 0,
+    # checkpoint generations retained under last-model/ — load() falls back
+    # generation-by-generation to the newest INTACT one when a file is
+    # torn/truncated/missing (training/checkpoint.py)
+    "keep_checkpoints": 2,
+    # hung-step watchdog: no completed step/eval within this many seconds
+    # dumps all thread stacks + pipeline stats and hard-exits RC_WATCHDOG
+    # (a desynced multi-host collective wedges forever otherwise). 0 = off;
+    # must comfortably exceed first-step compile time when enabled.
+    "watchdog_timeout_s": 0,
+    # transient-I/O retry (corpus/DocBin opens, checkpoint writes):
+    # attempts beyond the first, and the backoff base (doubles per retry,
+    # jittered — training/resilience.py)
+    "io_retries": 3,
+    "io_retry_base_s": 0.5,
 }
 
 # Sub-blocks resolved through the registry rather than read as plain values.
@@ -139,6 +155,22 @@ _TRAINING_TYPES: Dict[str, Tuple[Callable[[Any], bool], str]] = {
         lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 0,
         "an int >= 0",
     ),
+    "keep_checkpoints": (
+        lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 1,
+        "an int >= 1",
+    ),
+    "watchdog_timeout_s": (
+        lambda v: isinstance(v, (int, float)) and not isinstance(v, bool) and v >= 0,
+        "a number of seconds >= 0 (0 disables the watchdog)",
+    ),
+    "io_retries": (
+        lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 0,
+        "an int >= 0",
+    ),
+    "io_retry_base_s": (
+        lambda v: isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0,
+        "a number of seconds > 0",
+    ),
 }
 
 
@@ -206,6 +238,11 @@ class TrainResult:
         self.history: List[Dict[str, Any]] = []
         self.words_seen: int = 0
         self.seconds: float = 0.0
+        # True when the run stopped on a shutdown signal (preemption):
+        # a step-boundary checkpoint was written and the CLI exits with
+        # resilience.RC_PREEMPTED so supervisors can tell "resume me"
+        # from "done"
+        self.interrupted: bool = False
 
     @property
     def wps(self) -> float:
@@ -276,6 +313,26 @@ def train(
     random.seed(seed)
     np.random.seed(seed)
 
+    # ---- resilience setup ----
+    # fault plan from the environment (a supervisor-relaunched child reads
+    # its own copy), transient-I/O retry policy from the config, and the
+    # SIGTERM/SIGINT flag the loop polls at step boundaries
+    resilience.activate_env_fault_plan()
+    # a previous run in this process may have queued events no logger
+    # drained (console logger path) — they must not leak into THIS run's
+    # first jsonl row
+    resilience.drain_events()
+    resilience.set_default_retry_policy(
+        resilience.RetryPolicy(
+            max_retries=int(T.get("io_retries", 3) or 0),
+            base_delay=float(T.get("io_retry_base_s", 0.5) or 0.5),
+        )
+    )
+    # created now, installed right before the main loop (whose finally is
+    # the only place that restores handlers — a setup-phase failure must
+    # not leak a handler pointing at an abandoned run)
+    shutdown = ShutdownCoordinator()
+
     # ---- corpora ----
     corpora_cfg = config.get("corpora", {})
     resolved_corpora = {name: registry.resolve(block) for name, block in corpora_cfg.items()}
@@ -339,7 +396,36 @@ def train(
     # ---- resume ----
     resume_skip = 0  # batches already consumed in the checkpointed epoch
     if resume and output_path is not None:
-        ckpt = TrainCheckpoint.load(Path(output_path) / "last-model")
+        try:
+            ckpt = TrainCheckpoint.load(Path(output_path) / "last-model")
+        except CheckpointCorrupt as e:
+            # every retained generation is torn: warn and train from
+            # scratch rather than crash — the data survives, the run
+            # restarts (and log_event lands the anomaly in jsonl logs)
+            log_event(
+                "resume-failed",
+                f"--resume found no intact checkpoint generation ({e}); "
+                "starting from scratch",
+            )
+            ckpt = None
+        if jax.process_count() > 1:
+            # generation fallback is a PER-RANK decision over possibly-flaky
+            # shared storage: if one rank fell back to an older generation
+            # (or to scratch) while the others resumed the newest, the ranks
+            # hold different step counters and every later collective
+            # desyncs — fail loudly at startup instead of wedging the pod
+            from jax.experimental import multihost_utils
+
+            steps = multihost_utils.process_allgather(
+                np.array([ckpt["step"] if ckpt is not None else -1], np.int64)
+            )
+            if int(np.min(steps)) != int(np.max(steps)):
+                raise RuntimeError(
+                    "--resume loaded different checkpoint generations across "
+                    f"hosts (per-rank steps: {steps.ravel().tolist()}); fix or "
+                    "remove the torn generation so every rank resumes the "
+                    "same state"
+                )
         if ckpt is not None:
             params = place_replicated(ckpt["params"], mesh)
             opt_state = shard_opt_state(ckpt["opt_state"], mesh, zero1)
@@ -366,15 +452,33 @@ def train(
                     resume_skip = int(my_skip)
                     corpus_epoch = int(my_corpus_epoch)
                 else:
-                    print(
-                        f"[resume] checkpoint was written by {len(per_rank)} "
+                    log_event(
+                        "resume-rank-mismatch",
+                        f"checkpoint was written by {len(per_rank)} "
                         f"processes but this run has {jax.process_count()}; "
                         "data position restored from rank 0's scalars "
                         "(approximate — the stream sharding changed)",
-                        flush=True,
+                        checkpoint_processes=len(per_rank),
+                        run_processes=jax.process_count(),
                     )
             if corpus_epoch is not None and hasattr(train_corpus, "_epoch"):
                 train_corpus._epoch = int(corpus_epoch)
+            import logging as _logging
+
+            log_event(
+                "resume",
+                f"resumed from checkpoint step {step} (epoch {epoch}, "
+                f"best {best_score:.4f} @ step {best_step})",
+                level=_logging.INFO,
+                step=step,
+                epoch=epoch,
+            )
+        else:
+            log_event(
+                "resume-empty",
+                f"--resume requested but {Path(output_path) / 'last-model'} "
+                "holds no checkpoint; starting from scratch",
+            )
 
     # [training] annotating_components: validated against the pipeline, then
     # each batch is annotated with the CURRENT model's predictions before
@@ -758,6 +862,76 @@ def train(
             if close is not None:
                 close()
 
+    # ---- resilience wiring: watchdog + step-boundary checkpoint ----
+    watchdog_timeout = float(T.get("watchdog_timeout_s", 0) or 0)
+    watchdog: Optional[Watchdog] = None
+    if watchdog_timeout > 0:
+        watchdog = Watchdog(watchdog_timeout, stats_fn=pipe_stats.snapshot)
+    keep_checkpoints = int(T.get("keep_checkpoints", 2) or 1)
+    last_saved_step = -1
+
+    def save_last(group: Dict[str, Any]) -> None:
+        """Write the full-resume checkpoint for the CONSUMED group's step.
+
+        Shared by the eval path and the preemption path so both write the
+        identical state shape. The opt-state gather and the data-position
+        allgather are COLLECTIVES on multi-host — every rank runs them at
+        the same step boundary (rank 0 then writes the files), which is
+        why the shutdown flag itself is allgathered first.
+        """
+        nonlocal last_saved_step
+        if output_path is None or step == last_saved_step:
+            return
+        host_opt = checkpoint_mod.gather_to_host(opt_state)
+        # every rank's data position, gathered on EVERY process (a
+        # collective — all ranks reach this in lockstep); saved by rank 0
+        # so each rank can fast-forward to its own exact position on resume
+        per_rank_pos = None
+        if process_count > 1:
+            from jax.experimental import multihost_utils
+
+            per_rank_pos = (
+                multihost_utils.process_allgather(
+                    np.array(
+                        [
+                            group["cur_epoch"],
+                            group["batches_in_epoch"],
+                            group["corpus_epoch"],
+                        ],
+                        np.int64,
+                    )
+                )
+                .reshape(-1, 3)
+                .tolist()
+            )
+        if jax.process_index() == 0:
+            TrainCheckpoint.save(
+                Path(output_path) / "last-model",
+                params=jax.device_get(params),  # raw (not averaged): resume state
+                opt_state=host_opt,
+                step=step,
+                epoch=group["cur_epoch"],
+                # post-split rng, NOT this step's subkey: resume must
+                # continue the exact rng chain the uninterrupted run
+                # would have used
+                rng=rng,
+                best_score=best_score,
+                best_step=best_step,
+                extra={
+                    # the CONSUMED group's position tags, not the (possibly
+                    # prefetched-ahead) producer counters
+                    "batches_in_epoch": group["batches_in_epoch"],
+                    "corpus_epoch": group["corpus_epoch"],
+                    **(
+                        {"per_rank_positions": per_rank_pos}
+                        if per_rank_pos is not None
+                        else {}
+                    ),
+                },
+                keep=keep_checkpoints,
+            )
+        last_saved_step = step  # on every rank: the skip must stay aligned
+
     last_consumed_epoch = epoch
     params_cell = {"params": params}  # read by the annotation pass
     groups: Iterator[Dict[str, Any]] = device_groups()
@@ -773,6 +947,12 @@ def train(
 
         groups = prefetch_iter(groups, prefetch_n)
 
+    # armed HERE, torn down in the finally below — the watchdog's first
+    # window covers the first step's compile, so its timeout must exceed
+    # compile time (documented at the knob)
+    shutdown.install()
+    if watchdog is not None:
+        watchdog.start()
     try:
         while not stop:
             # queue-wait: how long the consumer stalled for its next group.
@@ -794,6 +974,10 @@ def train(
                 profile_active = True
             if before_update is not None:
                 before_update(nlp, {"step": step, "epoch": cur_epoch})
+            # fault-injection site "step": a `sigterm` rule here exercises
+            # the preemption path at an exact step; an error rule, the
+            # supervisor's crash/restart path
+            maybe_fail("step")
             rng, sub = jax.random.split(rng)
             params, opt_state, loss, metrics = update(params, opt_state, tokens, targets, sub)
             params_cell["params"] = params
@@ -822,36 +1006,6 @@ def train(
                 # tree to host every eval (then re-uploading it per dev chunk)
                 # costs two full-model transfers for nothing.
                 eval_src = avg_params if use_averages else params
-                # gather_to_host on the (possibly cross-host-sharded) opt state is
-                # a COLLECTIVE on multi-host — must run on every process, not just
-                # rank 0, or the pod deadlocks
-                host_opt = (
-                    checkpoint_mod.gather_to_host(opt_state)
-                    if output_path is not None
-                    else None
-                )
-                # every rank's data position, gathered on EVERY process (a
-                # collective — all hosts reach this block in lockstep, step
-                # counters are global); saved by rank 0 so each rank can
-                # fast-forward to its own exact position on resume
-                per_rank_pos = None
-                if output_path is not None and process_count > 1:
-                    from jax.experimental import multihost_utils
-
-                    per_rank_pos = (
-                        multihost_utils.process_allgather(
-                            np.array(
-                                [
-                                    group["cur_epoch"],
-                                    group["batches_in_epoch"],
-                                    group["corpus_epoch"],
-                                ],
-                                np.int64,
-                            )
-                        )
-                        .reshape(-1, 3)
-                        .tolist()
-                    )
                 eval_t0 = time.perf_counter()
                 scores = nlp.evaluate(dev_examples, eval_src, mesh=mesh)
                 eval_seconds = time.perf_counter() - eval_t0
@@ -883,36 +1037,29 @@ def train(
                     if output_path is not None and jax.process_index() == 0:
                         nlp.params = jax.device_get(eval_src)
                         nlp.to_disk(Path(output_path) / "best-model")
-                if output_path is not None and jax.process_index() == 0:
-                    TrainCheckpoint.save(
-                        Path(output_path) / "last-model",
-                        params=jax.device_get(params),  # raw (not averaged): resume state
-                        opt_state=host_opt,
-                        step=step,
-                        epoch=cur_epoch,
-                        # post-split rng, NOT this step's subkey: resume must
-                        # continue the exact rng chain the uninterrupted run
-                        # would have used
-                        rng=rng,
-                        best_score=best_score,
-                        best_step=best_step,
-                        extra={
-                            # the CONSUMED group's position tags, not the (possibly
-                            # prefetched-ahead) producer counters
-                            "batches_in_epoch": group["batches_in_epoch"],
-                            "corpus_epoch": group["corpus_epoch"],
-                            **(
-                                {"per_rank_positions": per_rank_pos}
-                                if per_rank_pos is not None
-                                else {}
-                            ),
-                        },
-                    )
+                save_last(group)
             log_step(info)
+            if watchdog is not None:
+                watchdog.beat()
 
             if max_steps and step >= max_steps:
                 stop = True
             if patience and best_step >= 0 and (step - best_step) >= patience:
+                stop = True
+            # preemption poll, AFTER the step completed: on multi-host the
+            # flag is allgathered so every rank agrees to checkpoint THIS
+            # step (stop conditions above are replica-identical, so the
+            # poll itself stays collective-aligned)
+            if not stop and shutdown.coordinated_stop(process_count):
+                drain_metrics()
+                save_last(group)
+                result.interrupted = True
+                log_event(
+                    "preempted",
+                    f"shutdown signal at step {step} — checkpoint written at "
+                    "the step boundary; resume with --resume",
+                    step=step,
+                )
                 stop = True
 
     finally:
@@ -921,6 +1068,9 @@ def train(
         # again in the same process
         if hasattr(groups, "close"):
             groups.close()
+        if watchdog is not None:
+            watchdog.stop()
+        shutdown.restore()
     if profile_active:  # loop ended inside the window: still write the trace
         jax.profiler.stop_trace()
         profile_active = False

@@ -1,0 +1,366 @@
+"""Checkpoint integrity, generation history + fallback, and the
+preemption path end-to-end: a SIGTERM mid-run (driven deterministically by
+the fault-injection harness) checkpoints at a step boundary and resumes
+bit-exactly; torn/truncated/missing checkpoint files fall back
+generation-by-generation instead of crashing."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.training import resilience
+from spacy_ray_tpu.training.checkpoint import (
+    CheckpointCorrupt,
+    TrainCheckpoint,
+    save_params,
+)
+from spacy_ray_tpu.training.loop import train
+from spacy_ray_tpu.training.resilience import FaultInjected, FaultPlan, RetryPolicy
+from spacy_ray_tpu.util import write_synth_jsonl
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    prev = resilience.set_fault_plan(None)
+    resilience.drain_events()
+    yield
+    resilience.set_fault_plan(prev)
+    resilience.drain_events()
+
+
+# ----------------------------------------------------------------------
+# Torn-generation matrix (pure checkpoint layer)
+# ----------------------------------------------------------------------
+
+
+def _write_generation(path, step, fill):
+    params = {"c": {"w": np.full((2, 2), fill, np.float32)}}
+    opt = {"m": np.full((2, 2), fill * 10.0, np.float32)}
+    TrainCheckpoint.save(
+        path, params=params, opt_state=opt, step=step, epoch=0,
+        rng=jax.random.PRNGKey(0), best_score=0.1 * step, best_step=step,
+        keep=2,
+    )
+
+
+def _two_generations(path):
+    _write_generation(path, 1, 1.0)
+    _write_generation(path, 2, 2.0)
+    return path
+
+
+@pytest.mark.parametrize("victim", ["params", "opt_state", "meta"])
+@pytest.mark.parametrize("mode", ["truncate", "delete", "garbage"])
+def test_torn_newest_generation_falls_back_exactly(tmp_path, victim, mode):
+    """Each file of the newest generation, torn/deleted/corrupted in turn:
+    load() lands on the PREVIOUS generation with exactly its state.
+
+    Generation 2's meta exists as two identical copies (the stamped file
+    and the un-stamped pointer), so the "meta" victim hits both — a torn
+    pointer ALONE is covered by its own test below."""
+    _two_generations(tmp_path)
+    files = {
+        "params": [tmp_path / "params-2.npz"],
+        "opt_state": [tmp_path / "opt_state-2.pkl"],
+        "meta": [tmp_path / "train_meta-2.json", tmp_path / "train_meta.json"],
+    }[victim]
+    for f in files:
+        if mode == "truncate":
+            f.write_bytes(f.read_bytes()[: max(len(f.read_bytes()) // 2, 1)])
+        elif mode == "delete":
+            f.unlink()
+        else:
+            f.write_bytes(b"not a checkpoint file")
+    ck = TrainCheckpoint.load(tmp_path)
+    assert ck["step"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(ck["params"]["c"]["w"]), np.ones((2, 2))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ck["opt_state"]["m"]), 10.0 * np.ones((2, 2))
+    )
+    events = resilience.drain_events()
+    assert any(e["event"] == "checkpoint-fallback" for e in events)
+
+
+def test_torn_pointer_meta_still_loads_newest_generation(tmp_path):
+    """The un-stamped train_meta.json is only a pointer: losing or tearing
+    it costs nothing while the per-generation meta survives."""
+    _two_generations(tmp_path)
+    (tmp_path / "train_meta.json").unlink()
+    assert TrainCheckpoint.load(tmp_path)["step"] == 2
+    _two_generations(tmp_path)
+    (tmp_path / "train_meta.json").write_text('{"step": ')  # torn json
+    assert TrainCheckpoint.load(tmp_path)["step"] == 2
+
+
+def test_every_file_of_newest_generation_corrupt_loads_previous(tmp_path):
+    """Acceptance: with keep_checkpoints=2, corrupting EVERY file of the
+    newest generation still loads the previous one with a warning."""
+    _two_generations(tmp_path)
+    for name in (
+        "params-2.npz", "opt_state-2.pkl", "train_meta-2.json",
+        "train_meta.json",
+    ):
+        (tmp_path / name).write_bytes(b"torn")
+    ck = TrainCheckpoint.load(tmp_path)
+    assert ck["step"] == 1 and ck["best_step"] == 1
+    assert any(
+        e["event"] == "checkpoint-fallback" for e in resilience.drain_events()
+    )
+
+
+def test_all_generations_corrupt_raises_typed_error(tmp_path):
+    _two_generations(tmp_path)
+    for f in tmp_path.iterdir():
+        f.write_bytes(b"torn")
+    with pytest.raises(CheckpointCorrupt):
+        TrainCheckpoint.load(tmp_path)
+
+
+def test_empty_dir_is_fresh_start_not_error(tmp_path):
+    assert TrainCheckpoint.load(tmp_path) is None
+    assert TrainCheckpoint.load(tmp_path / "never-created") is None
+
+
+def test_prestamping_layout_missing_optstate_is_typed(tmp_path):
+    """A round<=4 layout with a vanished opt_state.pkl used to surface as
+    an opaque KeyError/pickle error; now it's CheckpointCorrupt."""
+    import json
+
+    save_params(tmp_path / "params.npz", {"w": np.ones(2, np.float32)})
+    (tmp_path / "train_meta.json").write_text(
+        json.dumps({
+            "step": 5, "epoch": 0, "rng": [0, 0], "best_score": 0.0,
+            "best_step": -1,
+        })
+    )
+    with pytest.raises(CheckpointCorrupt, match="missing"):
+        TrainCheckpoint.load(tmp_path)
+
+
+def test_retention_keeps_last_k_generations(tmp_path):
+    for step, fill in ((1, 1.0), (2, 2.0), (3, 3.0)):
+        _write_generation(tmp_path, step, fill)
+    names = {p.name for p in tmp_path.iterdir()}
+    assert "params-3.npz" in names and "params-2.npz" in names
+    assert "params-1.npz" not in names  # beyond keep=2
+    assert "opt_state-1.pkl" not in names and "train_meta-1.json" not in names
+
+
+def test_restart_without_resume_purges_stale_lineage(tmp_path):
+    """A restart WITHOUT --resume re-counts steps from 0 into the same
+    directory: the abandoned run's high-stamp generations must be deleted,
+    or load()'s newest-stamp-first fallback could silently resume the
+    abandoned run's state."""
+    _write_generation(tmp_path, 100, 9.0)
+    _write_generation(tmp_path, 200, 8.0)
+    _write_generation(tmp_path, 5, 1.0)  # fresh run's first checkpoint
+    names = {p.name for p in tmp_path.iterdir()}
+    assert "params-5.npz" in names
+    assert not any("100" in n or "200" in n for n in names), names
+    ck = TrainCheckpoint.load(tmp_path)
+    assert ck["step"] == 5
+    np.testing.assert_array_equal(
+        np.asarray(ck["params"]["c"]["w"]), np.ones((2, 2))
+    )
+
+
+def test_crashed_save_tmp_stragglers_are_cleaned(tmp_path):
+    """Full-size tmp files left by a crash mid-save are swept by the next
+    completed save (on a crash-looping fleet they'd otherwise accumulate
+    unboundedly)."""
+    _write_generation(tmp_path, 1, 1.0)
+    for straggler in (
+        "params-2.npz.tmp.npz", "opt_state-2.pkl.tmp",
+        "train_meta-2.json.tmp", "train_meta.json.tmp",
+    ):
+        (tmp_path / straggler).write_bytes(b"crashed mid-save")
+    _write_generation(tmp_path, 2, 2.0)
+    assert not any(".tmp" in p.name for p in tmp_path.iterdir())
+    assert TrainCheckpoint.load(tmp_path)["step"] == 2
+
+
+def test_checkpoint_write_fault_is_retried(tmp_path):
+    prev = resilience.set_default_retry_policy(
+        RetryPolicy(max_retries=2, sleep=lambda s: None)
+    )
+    resilience.set_fault_plan(FaultPlan.parse("checkpoint-write:1:oserror"))
+    try:
+        _write_generation(tmp_path, 1, 1.0)
+    finally:
+        resilience.set_default_retry_policy(prev)
+    assert TrainCheckpoint.load(tmp_path)["step"] == 1
+    assert any(
+        e["event"] == "io-retry" for e in resilience.drain_events()
+    )
+
+
+# ----------------------------------------------------------------------
+# Training-loop integration (CPU, tiny runs)
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("resilience_data")
+    write_synth_jsonl(d / "train.jsonl", 100, kind="tagger", seed=0)
+    write_synth_jsonl(d / "dev.jsonl", 20, kind="tagger", seed=1)
+    return d
+
+
+def _config(tagger_config_text, data_dir, **over):
+    cfg = Config.from_str(tagger_config_text)
+    return cfg.apply_overrides(
+        {
+            "paths.train": str(data_dir / "train.jsonl"),
+            "paths.dev": str(data_dir / "dev.jsonl"),
+            "training.max_steps": 18,
+            "training.eval_frequency": 6,
+            "training.io_retry_base_s": 0.001,
+            **over,
+        }
+    )
+
+
+def test_sigterm_checkpoint_and_resume_is_bit_exact(
+    tagger_config_text, data_dir, tmp_path
+):
+    """Acceptance: SIGTERM during a CPU run (injected at an exact step via
+    the fault harness) produces an intact step-boundary checkpoint, and a
+    --resume run is bit-exact with an uninterrupted run."""
+    over = {"corpora.train.shuffle": True, "corpora.train.seed": 3}
+    nlp_a, _ = train(
+        _config(tagger_config_text, data_dir, **over),
+        output_path=tmp_path / "a", n_workers=1, stdout_log=False,
+    )
+
+    resilience.set_fault_plan(FaultPlan.parse("step:10:sigterm"))
+    _, rb = train(
+        _config(tagger_config_text, data_dir, **over),
+        output_path=tmp_path / "b", n_workers=1, stdout_log=False,
+    )
+    resilience.set_fault_plan(None)
+    assert rb.interrupted and rb.final_step == 10
+    # the shutdown checkpoint is a normal, intact, digest-verified generation
+    ck = TrainCheckpoint.load(tmp_path / "b" / "last-model")
+    assert ck is not None and ck["step"] == 10
+
+    nlp_b, rb2 = train(
+        _config(tagger_config_text, data_dir, **over),
+        output_path=tmp_path / "b", n_workers=1, resume=True, stdout_log=False,
+    )
+    assert not rb2.interrupted and rb2.final_step == 18
+    la = jax.tree_util.tree_leaves(nlp_a.params)
+    lb = jax.tree_util.tree_leaves(nlp_b.params)
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_survives_fully_torn_checkpoint_dir(
+    tagger_config_text, data_dir, tmp_path
+):
+    """Acceptance: no code path crashes on a torn checkpoint — when every
+    generation is corrupt, --resume warns and trains from scratch."""
+    cfg = _config(tagger_config_text, data_dir, **{"training.max_steps": 6})
+    _, _ = train(cfg, output_path=tmp_path / "out", n_workers=1, stdout_log=False)
+    last = tmp_path / "out" / "last-model"
+    for f in last.glob("params-*.npz"):
+        f.write_bytes(b"torn")
+    for f in last.glob("opt_state-*.pkl"):
+        f.write_bytes(b"torn")
+    _, r = train(
+        cfg, output_path=tmp_path / "out", n_workers=1, resume=True,
+        stdout_log=False,
+    )
+    assert r.final_step == 6  # fresh start, not a crash
+
+
+def test_corrupt_newest_generation_resumes_from_previous(
+    tagger_config_text, data_dir, tmp_path
+):
+    """End-to-end: two checkpoint generations from a real run; newest torn;
+    --resume continues from the previous generation's step."""
+    cfg = _config(tagger_config_text, data_dir, **{"training.max_steps": 12})
+    _, _ = train(cfg, output_path=tmp_path / "out", n_workers=1, stdout_log=False)
+    last = tmp_path / "out" / "last-model"
+    assert (last / "params-12.npz").exists() and (last / "params-6.npz").exists()
+    (last / "params-12.npz").write_bytes(b"torn")
+    cfg2 = _config(tagger_config_text, data_dir, **{"training.max_steps": 14})
+    _, r = train(
+        cfg2, output_path=tmp_path / "out", n_workers=1, resume=True,
+        stdout_log=False,
+    )
+    # resumed from the intact step-6 generation, ran 6..14
+    assert r.final_step == 14
+
+
+def test_injected_step_fault_crashes_cleanly(
+    tagger_config_text, data_dir, tmp_path
+):
+    """A non-retryable fault at the step site propagates (this is what the
+    supervisor's restart path consumes) and leaves the last checkpoint
+    intact."""
+    resilience.set_fault_plan(FaultPlan.parse("step:8:runtime"))
+    with pytest.raises(FaultInjected):
+        train(
+            _config(tagger_config_text, data_dir),
+            output_path=tmp_path / "out", n_workers=1, stdout_log=False,
+        )
+    resilience.set_fault_plan(None)
+    ck = TrainCheckpoint.load(tmp_path / "out" / "last-model")
+    assert ck is not None and ck["step"] == 6  # the last eval checkpoint
+
+
+def test_collate_fault_propagates_through_worker_pool(
+    tagger_config_text, data_dir, tmp_path
+):
+    """The collate site lives in cached_collate, so an injected failure
+    exercises the pool-worker → consumer re-raise path when collation is
+    fanned out."""
+    resilience.set_fault_plan(FaultPlan.parse("collate:2:runtime"))
+    with pytest.raises(FaultInjected):
+        train(
+            _config(
+                tagger_config_text, data_dir,
+                **{"training.collate_workers": 2},
+            ),
+            n_workers=1, stdout_log=False,
+        )
+
+
+def test_transient_corpus_fault_during_training_is_retried(
+    tagger_config_text, data_dir, tmp_path
+):
+    """An injected transient corpus-read failure is absorbed by the retry
+    layer: training completes and the retry lands in the event log."""
+    resilience.set_fault_plan(FaultPlan.parse("corpus-read:1:oserror"))
+    _, r = train(
+        _config(tagger_config_text, data_dir, **{"training.max_steps": 6}),
+        n_workers=1, stdout_log=False,
+    )
+    assert r.final_step == 6
+    assert any(
+        e["event"] == "io-retry" for e in resilience.drain_events()
+    )
+
+
+def test_watchdog_runs_quietly_during_training(
+    tagger_config_text, data_dir, tmp_path
+):
+    """watchdog_timeout_s wires a live watchdog thread through a real run
+    without firing (heartbeats arrive every step) and tears it down."""
+    import threading
+
+    _, r = train(
+        _config(
+            tagger_config_text, data_dir,
+            **{"training.max_steps": 6, "training.watchdog_timeout_s": 120},
+        ),
+        n_workers=1, stdout_log=False,
+    )
+    assert r.final_step == 6
+    assert "train-watchdog" not in {t.name for t in threading.enumerate()}

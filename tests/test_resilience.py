@@ -1,0 +1,419 @@
+"""Resilience primitives: fault plan, retry/backoff, watchdog, shutdown
+coordination, graceful termination, supervisor — all deterministic (fake
+clock/sleep/rng, no wall-clock waits in the fault/backoff paths)."""
+
+import io
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from spacy_ray_tpu.training import resilience
+from spacy_ray_tpu.training.resilience import (
+    RC_PREEMPTED,
+    RC_WATCHDOG,
+    FaultInjected,
+    FaultPlan,
+    RetryPolicy,
+    ShutdownCoordinator,
+    Supervisor,
+    Watchdog,
+    drain_events,
+    log_event,
+    retry_io,
+    terminate_with_grace,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    prev = resilience.set_fault_plan(None)
+    drain_events()
+    yield
+    resilience.set_fault_plan(prev)
+    drain_events()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+# ----------------------------------------------------------------------
+# FaultPlan
+# ----------------------------------------------------------------------
+
+
+def test_fault_plan_parse_and_trigger():
+    plan = FaultPlan.parse("collate:2:runtime, corpus-read:1:oserror")
+    resilience.set_fault_plan(plan)
+    resilience.maybe_fail("collate")  # call 1: no fault
+    with pytest.raises(FaultInjected):
+        resilience.maybe_fail("collate")  # call 2: scheduled
+    resilience.maybe_fail("collate")  # call 3: counters move on
+    with pytest.raises(OSError):
+        resilience.maybe_fail("corpus-read")
+
+
+def test_fault_plan_rejects_bad_specs():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultPlan.parse("nope:1:runtime")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.parse("step:1:explode")
+    with pytest.raises(ValueError, match="site:call:kind"):
+        FaultPlan.parse("step:1")
+    with pytest.raises(ValueError, match="not an int"):
+        FaultPlan.parse("step:one:runtime")
+    with pytest.raises(ValueError, match=">= 1"):
+        FaultPlan.parse("step:0:runtime")
+
+
+def test_env_fault_plan_activation(monkeypatch):
+    monkeypatch.setenv(resilience.FAULT_PLAN_ENV, "step:3:runtime")
+    plan = resilience.activate_env_fault_plan()
+    assert plan is not None and plan.rules == [("step", 3, "runtime")]
+    # empty env leaves the active plan alone
+    monkeypatch.setenv(resilience.FAULT_PLAN_ENV, "")
+    assert resilience.activate_env_fault_plan() is plan
+
+
+def test_maybe_fail_is_noop_without_plan():
+    for site in resilience.FAULT_SITES:
+        resilience.maybe_fail(site)  # must not raise
+
+
+# ----------------------------------------------------------------------
+# Retry with backoff + jitter
+# ----------------------------------------------------------------------
+
+
+def test_retry_policy_backoff_is_exponential_with_jitter():
+    sleeps = []
+
+    class Rng:
+        def random(self):
+            return 1.0  # max jitter
+
+    pol = RetryPolicy(
+        max_retries=4, base_delay=1.0, max_delay=6.0, jitter=0.5,
+        sleep=sleeps.append, rng=Rng(),
+    )
+    # delay(n) = min(6, 1 * 2**(n-1)) * 1.5
+    assert [pol.delay(n) for n in (1, 2, 3, 4)] == [1.5, 3.0, 6.0, 9.0]
+
+
+def test_retry_io_recovers_after_transient_failures():
+    sleeps = []
+    pol = RetryPolicy(max_retries=3, base_delay=0.1, sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("transient blip")
+        return "ok"
+
+    assert retry_io("corpus-read", flaky, policy=pol) == "ok"
+    assert calls["n"] == 3 and len(sleeps) == 2
+    assert sleeps[1] > sleeps[0]  # backoff grew
+    events = drain_events()
+    assert [e["event"] for e in events] == ["io-retry", "io-retry"]
+    assert events[0]["site"] == "corpus-read"
+
+
+def test_retry_io_gives_up_and_skips_non_transient():
+    pol = RetryPolicy(max_retries=2, sleep=lambda s: None)
+    with pytest.raises(OSError):
+        retry_io("checkpoint-write", lambda: (_ for _ in ()).throw(OSError("x")),
+                 policy=pol)
+    calls = {"n": 0}
+
+    def logic_error():
+        calls["n"] += 1
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        retry_io("corpus-read", logic_error, policy=pol)
+    assert calls["n"] == 1  # never retried
+
+
+def test_retry_io_does_not_retry_deterministic_path_errors(tmp_path):
+    """A typo'd path wears an OSError but is a config error, not a
+    transient flake: it must surface immediately, not after io_retries
+    rounds of backoff."""
+    calls = {"n": 0}
+
+    def missing():
+        calls["n"] += 1
+        open(tmp_path / "does-not-exist.jsonl")
+
+    pol = RetryPolicy(max_retries=3, sleep=lambda s: None)
+    with pytest.raises(FileNotFoundError):
+        retry_io("corpus-read", missing, policy=pol)
+    assert calls["n"] == 1
+    assert drain_events() == []  # no io-retry noise either
+
+
+def test_corpus_read_retries_through_fault_plan(tmp_path):
+    """The corpus-read site really is wrapped: an injected open failure is
+    retried with backoff and the read succeeds."""
+    from spacy_ray_tpu.training.corpus import read_jsonl_docs
+
+    f = tmp_path / "c.jsonl"
+    f.write_text('{"tokens": ["a", "b"], "tags": ["X", "Y"]}\n')
+    resilience.set_fault_plan(FaultPlan.parse("corpus-read:1:oserror"))
+    prev = resilience.set_default_retry_policy(
+        RetryPolicy(max_retries=2, sleep=lambda s: None)
+    )
+    try:
+        docs = list(read_jsonl_docs(f))
+    finally:
+        resilience.set_default_retry_policy(prev)
+    assert len(docs) == 1 and docs[0].words == ["a", "b"]
+
+
+# ----------------------------------------------------------------------
+# Watchdog
+# ----------------------------------------------------------------------
+
+
+def test_watchdog_fires_only_after_timeout_and_dumps_state():
+    clk = FakeClock()
+    fired = []
+    err = io.StringIO()
+    wd = Watchdog(
+        10.0,
+        stats_fn=lambda: {"stage_seconds": {"read": 1.0}},
+        clock=clk,
+        sleep=clk.sleep,
+        exit_fn=fired.append,
+        stream=err,
+    )
+    assert wd.check() is False
+    clk.t = 9.0
+    assert wd.check() is False
+    wd.beat()  # heartbeat resets the window
+    clk.t = 18.0
+    assert wd.check() is False
+    clk.t = 30.0
+    assert wd.check() is True
+    assert fired == [RC_WATCHDOG]
+    dump = err.getvalue()
+    assert "no step heartbeat" in dump
+    assert "thread" in dump and "test_watchdog" in dump  # this frame's stack
+    assert "stage_seconds" in dump  # PipelineStats snapshot included
+
+
+def test_watchdog_thread_fires_with_fake_clock():
+    clk = FakeClock()
+    fired = threading.Event()
+    wd = Watchdog(
+        5.0, clock=clk, sleep=clk.sleep,
+        exit_fn=lambda rc: fired.set(), stream=io.StringIO(),
+    )
+    wd.start()
+    assert fired.wait(timeout=5.0)  # fake sleep advances the fake clock
+    wd.stop()
+
+
+def test_watchdog_rejects_nonpositive_timeout():
+    with pytest.raises(ValueError):
+        Watchdog(0)
+
+
+# ----------------------------------------------------------------------
+# Shutdown coordination
+# ----------------------------------------------------------------------
+
+
+def test_shutdown_coordinator_catches_sigterm():
+    sc = ShutdownCoordinator().install()
+    try:
+        assert not sc.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(100):  # delivery is at a bytecode boundary
+            if sc.requested:
+                break
+        assert sc.requested and sc.signum == signal.SIGTERM
+        assert sc.coordinated_stop(process_count=1)
+    finally:
+        sc.restore()
+    # restored: a fresh coordinator is independent
+    assert not ShutdownCoordinator().requested
+
+
+def test_shutdown_second_sigint_escalates():
+    sc = ShutdownCoordinator()
+    sc._handle(signal.SIGINT, None)
+    assert sc.requested
+    with pytest.raises(KeyboardInterrupt):
+        sc._handle(signal.SIGINT, None)
+
+
+# ----------------------------------------------------------------------
+# Graceful termination + supervisor
+# ----------------------------------------------------------------------
+
+
+def test_terminate_with_grace_plain_child():
+    p = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+    rc = terminate_with_grace(p, grace_s=10.0)
+    assert rc == -signal.SIGTERM
+
+
+def test_terminate_with_grace_escalates_to_sigkill():
+    p = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "import signal, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "print('ready', flush=True)\n"
+            "time.sleep(60)",
+        ],
+        stdout=subprocess.PIPE,
+    )
+    p.stdout.readline()  # SIGTERM must not beat the SIG_IGN installation
+    rc = terminate_with_grace(p, grace_s=0.3)
+    assert rc == -signal.SIGKILL
+    events = drain_events()
+    assert any(e["event"] == "shutdown-escalated" for e in events)
+
+
+def test_supervisor_restarts_until_success(tmp_path):
+    """Child fails twice, then succeeds: the supervisor relaunches with a
+    bumped attempt number and reports rc 0."""
+    marker = tmp_path / "attempts"
+
+    def build_cmd(attempt):
+        return [
+            sys.executable,
+            "-c",
+            "import pathlib, sys\n"
+            f"p = pathlib.Path({str(marker)!r})\n"
+            "n = int(p.read_text()) if p.exists() else 0\n"
+            "p.write_text(str(n + 1))\n"
+            "sys.exit(0 if n >= 2 else 1)",
+        ]
+
+    sup = Supervisor(build_cmd, max_restarts=5, restart_delay_s=0.0)
+    assert sup.run() == 0
+    assert sup.restarts_used == 2
+    assert int(marker.read_text()) == 3
+    events = [e["event"] for e in drain_events()]
+    assert events.count("supervisor-restart") == 2
+
+
+def test_supervisor_gives_up_past_max_restarts():
+    def build_cmd(attempt):
+        return [sys.executable, "-c", "import sys; sys.exit(7)"]
+
+    sup = Supervisor(build_cmd, max_restarts=1, restart_delay_s=0.0)
+    assert sup.run() == 7
+    assert sup.restarts_used == 1
+    assert "supervisor-giving-up" in [e["event"] for e in drain_events()]
+
+
+def test_supervisor_shutdown_before_spawn_launches_nothing():
+    """A signal that lands between children (e.g. during the restart
+    delay) must not launch a fresh child."""
+    calls = []
+
+    def build_cmd(attempt):
+        calls.append(attempt)
+        return ["never-run"]
+
+    sup = Supervisor(build_cmd, max_restarts=3, restart_delay_s=0.0)
+    sup._shutdown.set()
+    assert sup.run() == RC_PREEMPTED
+    assert calls == []
+
+
+def test_supervisor_relayed_kill_reports_preempted_not_negative_rc():
+    """A child SIGKILLed by the relayed-shutdown escalation exits with a
+    negative waitpid code; the supervisor reports the tree's outcome —
+    RC_PREEMPTED — not a meaningless 128+N shell status."""
+    sup = Supervisor(lambda a: ["child"], max_restarts=3, restart_delay_s=0.0)
+
+    class FakeProc:
+        def wait(self):
+            sup._shutdown.set()  # signal arrived while the child ran
+            return -signal.SIGKILL
+
+        def poll(self):
+            return -signal.SIGKILL
+
+    sup.popen = lambda cmd: FakeProc()
+    assert sup.run() == RC_PREEMPTED
+
+
+def test_jsonl_logger_flushes_trailing_events_at_finalize(tmp_path):
+    """Events queued after the last row (the `preempted` record lives
+    exactly there) land in the jsonl file as a trailing record."""
+    import json
+
+    from spacy_ray_tpu.registry import registry
+
+    setup = registry.get("loggers", "spacy_ray_tpu.JsonlLogger.v1")(
+        path=str(tmp_path / "log.jsonl")
+    )
+    log_step, finalize = setup(None)
+    log_event("preempted", "shutdown at step 3", step=3)
+    finalize()
+    lines = [
+        json.loads(l)
+        for l in (tmp_path / "log.jsonl").read_text().splitlines()
+    ]
+    assert lines[-1]["events"][0]["event"] == "preempted"
+    assert lines[-1]["events"][0]["step"] == 3
+
+
+def test_cli_supervisor_strips_max_restarts_and_appends_resume(monkeypatch):
+    """--max-restarts never leaks into the child argv (it would fork-bomb
+    supervisors-of-supervisors) and relaunches resume."""
+    from spacy_ray_tpu import cli as cli_mod
+
+    captured = {}
+
+    class FakeSupervisor:
+        def __init__(self, build_cmd, max_restarts, **kw):
+            captured["build_cmd"] = build_cmd
+            captured["max_restarts"] = max_restarts
+
+        def run(self):
+            return 0
+
+    monkeypatch.setattr(
+        "spacy_ray_tpu.training.resilience.Supervisor", FakeSupervisor
+    )
+    rc = cli_mod._supervise_train(
+        ["cfg.cfg", "--max-restarts", "3", "--output", "out"], 3
+    )
+    assert rc == 0 and captured["max_restarts"] == 3
+    first = captured["build_cmd"](0)
+    relaunch = captured["build_cmd"](1)
+    assert "--max-restarts" not in first and "3" not in first[first.index("cfg.cfg"):]
+    assert "--resume" not in first
+    assert relaunch[-1] == "--resume"
+
+
+def test_log_event_queues_structured_record():
+    rec = log_event("test-event", "hello", foo=1)
+    assert rec["event"] == "test-event" and rec["foo"] == 1
+    drained = drain_events()
+    assert drained and drained[-1]["event"] == "test-event"
+    assert drain_events() == []  # drained means drained
+
+
+def test_exit_codes_are_distinct():
+    assert RC_PREEMPTED != RC_WATCHDOG
+    assert RC_PREEMPTED not in (0, 1) and RC_WATCHDOG not in (0, 1)

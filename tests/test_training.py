@@ -248,7 +248,8 @@ def test_checkpoint_save_is_crash_safe(tmp_path):
     assert ck is not None and ck["step"] == 1
     assert np.array_equal(np.asarray(ck["params"]["c"]["w"]), np.ones((2, 2)))
 
-    # a completed second save supersedes and cleans the old generation
+    # a completed second save supersedes; the previous generation is
+    # RETAINED (keep=2 default) so a torn newest generation can fall back
     params2 = {"c": {"w": 2 * np.ones((2, 2), np.float32)}}
     TrainCheckpoint.save(
         tmp_path, params=params2, opt_state=opt, step=2, epoch=0, rng=rng,
@@ -257,4 +258,11 @@ def test_checkpoint_save_is_crash_safe(tmp_path):
     ck = TrainCheckpoint.load(tmp_path)
     assert ck["step"] == 2
     assert np.array_equal(np.asarray(ck["params"]["c"]["w"]), 2 * np.ones((2, 2)))
+    assert (tmp_path / "params-1.npz").exists()  # history, not garbage
+    # ... and a third save rotates generation 1 out (beyond keep=2)
+    TrainCheckpoint.save(
+        tmp_path, params=params2, opt_state=opt, step=3, epoch=0, rng=rng,
+        best_score=0.6, best_step=2,
+    )
     assert not (tmp_path / "params-1.npz").exists()
+    assert (tmp_path / "params-2.npz").exists()
