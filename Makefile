@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience bench baseline profile dryrun
+.PHONY: test test-fast test-slow resilience telemetry bench baseline profile dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -14,6 +14,11 @@ test-slow:
 # fault-injection / checkpoint-fallback / watchdog suite (docs/RESILIENCE.md)
 resilience:
 	python -m pytest tests/test_resilience.py tests/test_checkpoint_fallback.py -q
+
+# telemetry suite: trace validity, registry thread-safety, anomaly
+# detectors, the telemetry-enabled smoke train (docs/OBSERVABILITY.md)
+telemetry:
+	python -m pytest tests/test_telemetry.py -q
 
 bench:
 	python bench.py

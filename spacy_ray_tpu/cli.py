@@ -140,7 +140,13 @@ def train_command(argv: List[str]) -> int:
                         "to N times on nonzero exit, resuming from the last "
                         "intact checkpoint (0 = train in-process)")
     parser.add_argument("--profile", type=Path, default=None,
-                        help="write a jax.profiler trace of steps 5-15 here")
+                        help="write a jax.profiler trace of the [training] "
+                        "profile_window steps (default 5-15) here")
+    parser.add_argument("--metrics-dir", type=Path, default=None,
+                        dest="metrics_dir",
+                        help="enable telemetry: metrics.jsonl + Chrome trace "
+                        "+ anomaly detectors land here (overrides "
+                        "[training] metrics_dir; see docs/OBSERVABILITY.md)")
     parser.add_argument("--verbose", "-V", action="store_true")
     args, extra = parser.parse_known_args(argv)
 
@@ -175,6 +181,7 @@ def train_command(argv: List[str]) -> int:
         n_workers=args.n_workers,
         resume=args.resume,
         profile_dir=args.profile,
+        metrics_dir=args.metrics_dir,
     )
     if result.interrupted:
         from .training.resilience import RC_PREEMPTED
@@ -1415,6 +1422,35 @@ def benchmark_command(argv: List[str]) -> int:
     return 0
 
 
+def telemetry_command(argv: List[str]) -> int:
+    """``telemetry summarize <metrics.jsonl>`` — offline digest of a
+    telemetry run: per-stage time breakdown, step-time percentiles,
+    device gauges (HBM / compile count), anomaly digest. Reads only the
+    file — no jax, no accelerator, safe on any host."""
+    if not argv or argv[0] != "summarize":
+        print("Usage: spacy_ray_tpu telemetry summarize <metrics.jsonl>",
+              file=sys.stderr)
+        return 1
+    parser = argparse.ArgumentParser(prog="spacy_ray_tpu telemetry summarize")
+    parser.add_argument("metrics_path", type=Path,
+                        help="metrics.jsonl written by a [training] "
+                        "metrics_dir / train --metrics-dir run")
+    args = parser.parse_args(argv[1:])
+
+    from .training.telemetry import summarize_metrics
+
+    try:
+        print(summarize_metrics(args.metrics_path))
+    except OSError as e:
+        # FileNotFound, IsADirectory (passing the metrics DIR), permissions
+        print(f"Cannot read {args.metrics_path}: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    return 0
+
+
 def _project_command(argv: List[str]) -> int:
     """spaCy-projects-style workflow runner (`project run` / `project
     document`); implementation in project.py."""
@@ -1430,6 +1466,7 @@ COMMANDS = {
     # spaCy's name for bulk annotation; same command, correctly-named help
     "apply": lambda argv: parse_command(argv, prog="apply"),
     "debug-profile": debug_profile_command,
+    "telemetry": telemetry_command,
     "find-threshold": find_threshold_command,
     "info": info_command,
     "debug-model": debug_model_command,

@@ -75,11 +75,27 @@ class PipelineStats:
         self.cache_misses = 0
         self.cache_enabled = False
         self.workers = 1
+        # optional span emitter (training/telemetry.py TraceBuffer): when
+        # attached, every stage timing that carries its start stamp also
+        # lands as a Chrome-trace span. One emitter serves the pooled AND
+        # the inline path identically — a collate_workers = 0 run traces
+        # the same read/collate/transfer stages as a pooled one, just on
+        # one track (the satellite fix: single-threaded runs must be
+        # comparable in traces).
+        self._trace: Optional[Any] = None
 
-    def add(self, stage: str, seconds: float, n: int = 1) -> None:
+    def attach_trace(self, trace: Any) -> None:
+        self._trace = trace
+
+    def add(
+        self, stage: str, seconds: float, n: int = 1, t0: Optional[float] = None
+    ) -> None:
         with self._lock:
             self.seconds[stage] = self.seconds.get(stage, 0.0) + seconds
             self.counts[stage] = self.counts.get(stage, 0) + n
+        trace = self._trace
+        if trace is not None and t0 is not None:
+            trace.add_span(stage, t0, seconds, cat="pipeline")
 
     class _Timer:
         __slots__ = ("_stats", "_stage", "_t0")
@@ -93,7 +109,9 @@ class PipelineStats:
             return self
 
         def __exit__(self, *exc: Any) -> None:
-            self._stats.add(self._stage, time.perf_counter() - self._t0)
+            self._stats.add(
+                self._stage, time.perf_counter() - self._t0, t0=self._t0
+            )
 
     def timer(self, stage: str) -> "PipelineStats._Timer":
         return PipelineStats._Timer(self, stage)

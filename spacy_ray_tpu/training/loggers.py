@@ -49,7 +49,15 @@ def console_logger(progress_bar: bool = False):
             ]
         loss_cols = [f"Loss {n}" for n in pipe_names]
         score_cols = score_keys
-        header = ["T", "E", "#", "W"] + loss_cols + score_cols + ["WPS", "EvalS", "Score"]
+        # Stp50/Stp95: rolling step-time percentiles in ms, populated when
+        # [training] metrics_dir enables telemetry (blank otherwise) —
+        # SURVEY §5.5's step-time-as-first-class-metric column
+        header = (
+            ["T", "E", "#", "W"]
+            + loss_cols
+            + score_cols
+            + ["Stp50", "Stp95", "WPS", "EvalS", "Score"]
+        )
         widths = [max(len(h), 8) for h in header]
         stdout.write(" ".join(h.rjust(w) for h, w in zip(header, widths)) + "\n")
         stdout.write(" ".join("-" * w for w in widths) + "\n")
@@ -88,6 +96,12 @@ def console_logger(progress_bar: bool = False):
                 val = scores.get(key)
                 col = widths[4 + len(pipe_names) + j]
                 row.append(_fmt(float(val) * 100, col) if val is not None else " " * col)
+            for j, key in enumerate(("step_ms_p50", "step_ms_p95")):
+                val = info.get(key)
+                col = widths[-5 + j]
+                row.append(
+                    _fmt(float(val), col, 1) if val is not None else " " * col
+                )
             row.append(_fmt(float(info.get("wps", 0.0)), widths[-3], 0))
             row.append(_fmt(float(info.get("eval_seconds", 0.0)), widths[-2]))
             score = info.get("score")
@@ -114,6 +128,7 @@ def jsonl_logger(path: Optional[str] = None):
 
     def setup(nlp, stdout: IO = sys.stdout, stderr: IO = sys.stderr):
         from .resilience import drain_events
+        from .telemetry import sanitize_json
 
         handle = open(path, "a", encoding="utf8") if path else None
 
@@ -125,15 +140,22 @@ def jsonl_logger(path: Optional[str] = None):
                 for k in (
                     "epoch", "step", "words", "wps", "eval_seconds",
                     "score", "losses", "other_scores", "input_pipeline",
+                    # telemetry gauge snapshot (step-time p50/p95, HBM,
+                    # compile count, MFU) when [training] metrics_dir is on
+                    "telemetry",
                 )
             }
+            if rec.get("telemetry") is None:
+                rec.pop("telemetry", None)
             # resilience events since the last row (resume anomalies,
             # retries, checkpoint fallbacks, preemption) — jsonl is the
             # machine-readable record, so anomalies must land here too
             events = drain_events()
             if events:
                 rec["events"] = events
-            line = json.dumps(rec, default=float)
+            # sanitize: a NaN loss/score must not emit a bare `NaN` token
+            # (invalid JSON) in the machine-readable log
+            line = json.dumps(sanitize_json(rec), default=float)
             if handle:
                 handle.write(line + "\n")
                 handle.flush()

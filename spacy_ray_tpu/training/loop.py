@@ -87,6 +87,22 @@ DEFAULT_TRAINING = {
     # jittered — training/resilience.py)
     "io_retries": 3,
     "io_retry_base_s": 0.5,
+    # jax.profiler capture window [start, stop) in steps RUN THIS PROCESS
+    # (steps_run, not global step — resume-safe), active only when
+    # train --profile / profile_dir is given
+    "profile_window": [5, 15],
+    # telemetry (training/telemetry.py): directory for metrics.jsonl +
+    # trace.json; "" disables the whole subsystem (the hot loop then
+    # makes zero telemetry calls). Written by process 0 only.
+    "metrics_dir": "",
+    # Chrome-trace span window [start, stop) in steps_run: host-stage and
+    # step spans are recorded only inside it (eval/checkpoint/anomaly
+    # spans always record) — bounds trace size on long runs
+    "trace_steps": [0, 50],
+    # NaN/Inf-loss, loss-spike, step-time-regression, recompile-storm
+    # detectors (only active when telemetry is on); they emit through
+    # log_event so anomalies land in jsonl logger rows too
+    "anomaly_detection": True,
 }
 
 # Sub-blocks resolved through the registry rather than read as plain values.
@@ -171,7 +187,34 @@ _TRAINING_TYPES: Dict[str, Tuple[Callable[[Any], bool], str]] = {
         lambda v: isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0,
         "a number of seconds > 0",
     ),
+    "profile_window": (
+        lambda v: _is_step_window(v),
+        "a [start, stop] pair of ints with 0 <= start <= stop",
+    ),
+    "metrics_dir": (
+        lambda v: isinstance(v, str),
+        "a directory path string (empty string disables telemetry)",
+    ),
+    "trace_steps": (
+        lambda v: _is_step_window(v),
+        "a [start, stop] pair of ints with 0 <= start <= stop",
+    ),
+    "anomaly_detection": (lambda v: isinstance(v, bool), "a bool"),
 }
+
+
+def _is_step_window(v: Any) -> bool:
+    return (
+        isinstance(v, (list, tuple))
+        and len(v) == 2
+        and all(isinstance(x, int) and not isinstance(x, bool) for x in v)
+        and 0 <= v[0] <= v[1]
+    )
+
+
+def _ms(seconds: Optional[float]) -> Optional[float]:
+    """Seconds -> rounded milliseconds (None passes through)."""
+    return round(seconds * 1000.0, 3) if seconds is not None else None
 
 
 def _unknown_name_error(what: str, name: str, allowed) -> ValueError:
@@ -298,14 +341,20 @@ def train(
     max_steps_override: Optional[int] = None,
     stdout_log: bool = True,
     profile_dir: Optional[Path] = None,
+    metrics_dir: Optional[Path] = None,
 ) -> Tuple[Pipeline, TrainResult]:
     """Run config-driven training. Returns (pipeline, result).
 
     ``n_workers`` maps to the mesh's data-axis size (the reference's
     ``--n-workers`` actor count, train_cli.py:27); default = all devices.
 
-    ``profile_dir``: capture a jax.profiler trace of steps 5-15 (first-class
+    ``profile_dir``: capture a jax.profiler trace of the
+    ``[training] profile_window`` steps (default 5-15; first-class
     tracing — the reference's Timer scaffolding is unwired, SURVEY.md §5.1).
+
+    ``metrics_dir``: override for ``[training] metrics_dir`` — enables the
+    telemetry subsystem (metrics.jsonl + Chrome trace + anomaly
+    detectors, training/telemetry.py).
     """
     config = config.interpolate()
     T = resolve_training(config)
@@ -332,6 +381,33 @@ def train(
     # the only place that restores handlers — a setup-phase failure must
     # not leak a handler pointing at an abandoned run)
     shutdown = ShutdownCoordinator()
+
+    # ---- telemetry (training/telemetry.py) ----
+    # Process 0 owns the files (every rank's loop is replica-identical, so
+    # rank 0's timeline IS the pod's); disabled = `tel is None` and the
+    # hot loop makes ZERO telemetry calls — every use below is guarded.
+    from contextlib import nullcontext
+
+    tel = None
+    tel_dir = str(metrics_dir) if metrics_dir is not None else str(
+        T.get("metrics_dir") or ""
+    )
+    if tel_dir and jax.process_index() == 0:
+        from .telemetry import Telemetry, program_flops
+
+        trace_steps = T.get("trace_steps") or [0, 50]
+        tel = Telemetry(
+            Path(tel_dir),
+            trace_steps=(int(trace_steps[0]), int(trace_steps[1])),
+            anomaly_detection=bool(T.get("anomaly_detection", True)),
+            process_index=jax.process_index(),
+        )
+
+    def _tspan(name: str, **args: Any):
+        """Span context when telemetry is on, else a free nullcontext."""
+        if tel is None:
+            return nullcontext()
+        return tel.trace.span(name, cat="loop", **args)
 
     # ---- corpora ----
     corpora_cfg = config.get("corpora", {})
@@ -397,7 +473,8 @@ def train(
     resume_skip = 0  # batches already consumed in the checkpointed epoch
     if resume and output_path is not None:
         try:
-            ckpt = TrainCheckpoint.load(Path(output_path) / "last-model")
+            with _tspan("checkpoint_load"):
+                ckpt = TrainCheckpoint.load(Path(output_path) / "last-model")
         except CheckpointCorrupt as e:
             # every retained generation is torn: warn and train from
             # scratch rather than crash — the data survives, the run
@@ -615,20 +692,31 @@ def train(
 
     start_time = time.perf_counter()
     loss_accum: Dict[str, float] = {}
-    pending_metrics: List[Dict[str, Any]] = []
+    pending_metrics: List[Tuple[Dict[str, Any], bool]] = []
     words_since_log = 0
     last_log_time = start_time
     stop = False
     steps_run = 0  # steps executed THIS run (profiling window is resume-safe)
     profile_active = False
+    # configurable jax.profiler window (was hardcoded 5-15): counted in
+    # steps_run, not global step, so a resumed run still profiles its own
+    # warm steps rather than an arbitrary slice of the step counter
+    profile_window = T.get("profile_window") or [5, 15]
+    profile_start, profile_stop = int(profile_window[0]), int(profile_window[1])
 
     def drain_metrics() -> None:
-        """Materialize queued device metrics into loss_accum (sync point)."""
-        for m in pending_metrics:
+        """Materialize queued device metrics into loss_accum (sync point).
+
+        A step poisoned by a ``nan`` fault rule gets its loss overwritten
+        HERE, on the host — poisoning on device would dispatch fresh XLA
+        ops whose compile the recompile-storm detector would (correctly,
+        but spuriously for the drill) flag."""
+        for m, poisoned in pending_metrics:
             host = jax.device_get(m)
             for key, value in host.items():
                 if key.startswith("loss_"):
-                    loss_accum[key[5:]] = loss_accum.get(key[5:], 0.0) + float(value)
+                    v = float("nan") if poisoned else float(value)
+                    loss_accum[key[5:]] = loss_accum.get(key[5:], 0.0) + v
         pending_metrics.clear()
 
     # ---- staged input pipeline (read -> collate -> transfer) ----
@@ -644,6 +732,11 @@ def train(
     )
 
     pipe_stats = PipelineStats()
+    if tel is not None:
+        # stage timings double as Chrome-trace spans — emitted identically
+        # whether collation runs inline or on pool workers (each worker
+        # thread gets its own trace track)
+        pipe_stats.attach_trace(tel.trace)
     collate_workers = int(T.get("collate_workers", 0) or 0)
     collate_cache_mb = int(T.get("collate_cache_mb", 0) or 0)
     # the pool runs only where the prefetch thread may: single-process,
@@ -692,7 +785,7 @@ def train(
                 # end of data: an incomplete accumulation group would under-
                 # scale the mean gradient (scan still divides by `accum`)
                 have_group = False
-            pipe_stats.add("read", time.perf_counter() - t_read)
+            pipe_stats.add("read", time.perf_counter() - t_read, t0=t_read)
             if process_count > 1:
                 # loop termination must be COLLECTIVE: if any host ran out
                 # of data, all hosts stop this step, else the continuing
@@ -828,7 +921,9 @@ def train(
             targets = jax.tree_util.tree_map(
                 lambda *xs: np.stack(xs), *[c["targets"] for c in collated]
             )
-        pipe_stats.add("collate", time.perf_counter() - t_collate)
+        pipe_stats.add(
+            "collate", time.perf_counter() - t_collate, t0=t_collate
+        )
         return {
             "tokens": tokens,
             "targets": targets,
@@ -855,7 +950,9 @@ def train(
                 t_put = time.perf_counter()
                 group["tokens"] = place_batch(group["tokens"], mesh, accum=accum > 1)
                 group["targets"] = place_batch(group["targets"], mesh, accum=accum > 1)
-                pipe_stats.add("transfer", time.perf_counter() - t_put)
+                pipe_stats.add(
+                    "transfer", time.perf_counter() - t_put, t0=t_put
+                )
                 yield group
         finally:
             close = getattr(collated_iter, "close", None)
@@ -866,7 +963,15 @@ def train(
     watchdog_timeout = float(T.get("watchdog_timeout_s", 0) or 0)
     watchdog: Optional[Watchdog] = None
     if watchdog_timeout > 0:
-        watchdog = Watchdog(watchdog_timeout, stats_fn=pipe_stats.snapshot)
+        watchdog_stats = pipe_stats.snapshot
+        if tel is not None:
+            def watchdog_stats():
+                # the watchdog hard-exits (os._exit) right after the dump:
+                # flush the metric rows + trace buffer NOW so the wedged
+                # run's timeline survives for the post-mortem
+                tel.emergency_flush()
+                return pipe_stats.snapshot()
+        watchdog = Watchdog(watchdog_timeout, stats_fn=watchdog_stats)
     keep_checkpoints = int(T.get("keep_checkpoints", 2) or 1)
     last_saved_step = -1
 
@@ -953,6 +1058,8 @@ def train(
     shutdown.install()
     if watchdog is not None:
         watchdog.start()
+    if tel is not None:
+        tel.loop_start()
     try:
         while not stop:
             # queue-wait: how long the consumer stalled for its next group.
@@ -965,25 +1072,34 @@ def train(
             except StopIteration:
                 break
             finally:
-                pipe_stats.add("queue_wait", time.perf_counter() - t_wait)
+                pipe_stats.add(
+                    "queue_wait", time.perf_counter() - t_wait, t0=t_wait
+                )
             tokens, targets = group["tokens"], group["targets"]
             n_words = group["n_words"]
             cur_epoch = last_consumed_epoch = group["cur_epoch"]
-            if profile_dir is not None and not profile_active and steps_run == 5:
+            if (
+                profile_dir is not None
+                and not profile_active
+                and profile_start < profile_stop  # [start, stop): empty = off
+                and steps_run == profile_start
+            ):
                 jax.profiler.start_trace(str(profile_dir))
                 profile_active = True
             if before_update is not None:
                 before_update(nlp, {"step": step, "epoch": cur_epoch})
             # fault-injection site "step": a `sigterm` rule here exercises
             # the preemption path at an exact step; an error rule, the
-            # supervisor's crash/restart path
+            # supervisor's crash/restart path; a `nan` rule poisons this
+            # step's reported loss (telemetry NaN-detector drill)
             maybe_fail("step")
+            poisoned = resilience.consume_poison("step")
             rng, sub = jax.random.split(rng)
             params, opt_state, loss, metrics = update(params, opt_state, tokens, targets, sub)
             params_cell["params"] = params
             step += 1
             steps_run += 1
-            if profile_active and steps_run >= 15:
+            if profile_active and steps_run >= profile_stop:
                 jax.block_until_ready(loss)
                 jax.profiler.stop_trace()
                 profile_active = False
@@ -996,7 +1112,15 @@ def train(
             # keep metrics as device arrays — float() here would synchronize the
             # host with the device EVERY step and kill host/device overlap; the
             # accumulated scalars are only materialized at eval/log time
-            pending_metrics.append(metrics)
+            # (tagged with this step's nan-poison flag for drain_metrics)
+            pending_metrics.append((metrics, poisoned))
+            if tel is not None:
+                # ONE clock stamp per step: step-time histogram + step span
+                # + buffered metrics row + step-time regression check
+                tel.step_boundary(
+                    step=step, epoch=cur_epoch, n_words=n_words,
+                    steps_run=steps_run,
+                )
 
             info: Optional[Dict[str, Any]] = None
             if step % eval_frequency == 0:
@@ -1029,6 +1153,32 @@ def train(
                     # preparation time went (collate_pool.py)
                     "input_pipeline": pipe_stats.snapshot(),
                 }
+                if tel is not None:
+                    tel.trace.add_span(
+                        "eval", eval_t0, eval_seconds, cat="loop",
+                        args={"step": step}, force=True,
+                    )
+                    info["telemetry"] = tel.eval_boundary(
+                        step=step,
+                        epoch=cur_epoch,
+                        steps_run=steps_run,
+                        losses=dict(loss_accum),
+                        score=score,
+                        eval_seconds=eval_seconds,
+                        input_pipeline=info["input_pipeline"],
+                        # one-shot XLA cost analysis (a trace, not a
+                        # compile) — bench.py's MFU numerator path
+                        flops_fn=lambda: program_flops(
+                            update, params, opt_state, tokens, targets, sub
+                        ),
+                        wps=wps,
+                    )
+                    info["step_ms_p50"] = _ms(
+                        info["telemetry"]["step_seconds_p50"]
+                    )
+                    info["step_ms_p95"] = _ms(
+                        info["telemetry"]["step_seconds_p95"]
+                    )
                 result.history.append(info)
                 loss_accum = {}
                 if score > best_score:
@@ -1036,8 +1186,14 @@ def train(
                     best_step = step
                     if output_path is not None and jax.process_index() == 0:
                         nlp.params = jax.device_get(eval_src)
-                        nlp.to_disk(Path(output_path) / "best-model")
-                save_last(group)
+                        with _tspan("checkpoint_save", kind="best", step=step):
+                            nlp.to_disk(Path(output_path) / "best-model")
+                with _tspan("checkpoint_save", kind="last", step=step):
+                    save_last(group)
+                if tel is not None:
+                    # eval + checkpoint time must not count against the
+                    # NEXT step's measured step time
+                    tel.rearm_step_clock()
             log_step(info)
             if watchdog is not None:
                 watchdog.beat()
@@ -1051,8 +1207,9 @@ def train(
             # step (stop conditions above are replica-identical, so the
             # poll itself stays collective-aligned)
             if not stop and shutdown.coordinated_stop(process_count):
-                drain_metrics()
-                save_last(group)
+                with _tspan("preemption_drain", step=step):
+                    drain_metrics()
+                    save_last(group)
                 result.interrupted = True
                 log_event(
                     "preempted",
@@ -1071,6 +1228,10 @@ def train(
         if watchdog is not None:
             watchdog.stop()
         shutdown.restore()
+        if tel is not None:
+            # flush metric rows + trace even when a step/eval raised — a
+            # crashed run's timeline is exactly the one worth reading
+            tel.finalize()
     if profile_active:  # loop ended inside the window: still write the trace
         jax.profiler.stop_trace()
         profile_active = False

@@ -67,6 +67,7 @@ __all__ = [
     "get_fault_plan",
     "activate_env_fault_plan",
     "maybe_fail",
+    "consume_poison",
     "terminate_with_grace",
     "Supervisor",
     "log_event",
@@ -421,7 +422,7 @@ def retry_io(
 FAULT_SITES = ("corpus-read", "collate", "checkpoint-write", "step")
 FAULT_PLAN_ENV = "SPACY_RAY_TPU_FAULT_PLAN"
 
-_FAULT_KINDS = ("oserror", "runtime", "sigterm")
+_FAULT_KINDS = ("oserror", "runtime", "sigterm", "nan")
 
 
 class FaultInjected(RuntimeError):
@@ -443,8 +444,12 @@ class FaultPlan:
     ``oserror`` raises OSError (the retryable family — exercises backoff),
     ``runtime`` raises :class:`FaultInjected` (non-retryable — exercises
     crash/restart), ``sigterm`` sends SIGTERM to this process (exercises
-    the preemption path at an exact step). Counters are per-site and
-    per-plan; activating a plan resets them.
+    the preemption path at an exact step), ``nan`` raises nothing but
+    marks the site POISONED — the training loop polls
+    :func:`consume_poison` after ``maybe_fail("step")`` and turns that
+    step's reported loss into NaN, driving the telemetry NaN-loss
+    anomaly detector end-to-end without corrupting real training math.
+    Counters are per-site and per-plan; activating a plan resets them.
     """
 
     def __init__(self, rules: Sequence[Tuple[str, int, str]]) -> None:
@@ -459,8 +464,18 @@ class FaultPlan:
                 )
             if call < 1:
                 raise ValueError(f"fault call number must be >= 1, got {call}")
+            if kind == "nan" and site != "step":
+                # only the training loop's step site polls consume_poison;
+                # a nan rule anywhere else would be a silent no-op — the
+                # operator would conclude the NaN detector works (or is
+                # broken) from a drill that never ran
+                raise ValueError(
+                    f"fault kind 'nan' is only wired at the 'step' site "
+                    f"(got {site!r}): the loop polls consume_poison there"
+                )
         self.rules = list(rules)
         self._counts: Dict[str, int] = {}
+        self._poisoned: set = set()
         self._lock = threading.Lock()
 
     @classmethod
@@ -505,6 +520,17 @@ class FaultPlan:
             raise FaultInjected(f"injected fault: {site} call {call}")
         if kind == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
+        if kind == "nan":
+            with self._lock:
+                self._poisoned.add(site)
+
+    def consume_poison(self, site: str) -> bool:
+        """True exactly once per triggered ``nan`` rule at ``site``."""
+        with self._lock:
+            if site in self._poisoned:
+                self._poisoned.discard(site)
+                return True
+        return False
 
 
 _ACTIVE_PLAN: Optional[FaultPlan] = None
@@ -540,6 +566,16 @@ def maybe_fail(site: str) -> None:
     plan = _ACTIVE_PLAN
     if plan is not None:
         plan.check(site)
+
+
+def consume_poison(site: str) -> bool:
+    """Did a ``nan`` rule trigger at ``site`` since the last poll? Free
+    when no plan is active (one global read) — the training loop polls
+    this every step right after ``maybe_fail("step")``."""
+    plan = _ACTIVE_PLAN
+    if plan is not None:
+        return plan.consume_poison(site)
+    return False
 
 
 # ----------------------------------------------------------------------

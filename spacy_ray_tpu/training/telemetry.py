@@ -1,0 +1,1032 @@
+"""Unified telemetry: metrics registry, Chrome-trace span emitter, device
+sampling, and anomaly detection for the training loop.
+
+The reference's whole value proposition is keeping N workers productively
+busy, which cannot be verified without per-stage visibility — SURVEY.md
+§5.5 calls for step-time and words/sec/chip metrics as first-class
+citizens, and Ray (Moritz et al., arXiv:1712.05889) ships system-wide
+timeline tracing as a core primitive precisely because distributed
+training stalls are invisible in aggregate throughput numbers. This
+module is the one layer a dashboard, a bench run, or a post-mortem
+consumes; every later perf PR reports through it.
+
+Four pieces, individually inert and composable:
+
+* :class:`MetricsRegistry` — thread-safe counters / gauges / histograms
+  with explicit clock injection. The hot loop takes ONE wall-clock stamp
+  per step (``Telemetry.step_boundary``); everything else derives from
+  stamps the loop already takes. When telemetry is disabled the loop
+  holds no registry at all — the disabled path makes zero registry calls
+  (guarded by a test).
+* :class:`TraceBuffer` — bounded Chrome trace-event buffer
+  (Perfetto-loadable JSON): host stages (read / collate / transfer /
+  queue-wait, emitted through :class:`~.collate_pool.PipelineStats`),
+  eval, checkpoint save/load, preemption drains, and device-step
+  boundaries. ``bench.py --input-pipeline`` attaches the same emitter —
+  bench spans and training spans can never drift apart.
+* device sampling (:func:`sample_device_telemetry`) at eval boundaries:
+  HBM usage via ``device.memory_stats()`` (None off-TPU), live-buffer
+  counts, and a cumulative compile counter fed by a ``jax.monitoring``
+  listener (:func:`install_compile_hook`) — the recompilation-storm
+  signal. :func:`program_flops` is the XLA cost-analysis path bench.py's
+  MFU accounting reuses.
+* :class:`AnomalyDetectors` — NaN/Inf loss, loss spike vs rolling
+  median, step-time regression vs rolling p50, recompile-after-warmup.
+  Every firing goes through ``resilience.log_event`` (so it lands in the
+  jsonl training log) AND a ``kind: "anomaly"`` row in ``metrics.jsonl``
+  (so ``telemetry summarize`` digests it offline).
+
+``metrics.jsonl`` row kinds: ``step`` (per-step step-time + words),
+``eval`` (gauges: HBM, compile count, live buffers, step-time p50/p95,
+MFU estimate, per-stage seconds), ``anomaly``. Rows buffer in memory and
+flush at eval boundaries / finalize / watchdog fire — never per-step
+file I/O in the hot loop.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any, Callable, Dict, IO, List, Optional, Tuple
+
+__all__ = [
+    "MetricsRegistry",
+    "TraceBuffer",
+    "AnomalyDetectors",
+    "Telemetry",
+    "TPU_PEAK_BF16",
+    "install_compile_hook",
+    "compile_count",
+    "sample_device_telemetry",
+    "program_flops",
+    "device_peak_flops",
+    "sanitize_json",
+    "summarize_metrics",
+]
+
+
+# ----------------------------------------------------------------------
+# Metrics registry
+# ----------------------------------------------------------------------
+
+
+def _nearest_rank(sorted_samples: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile over an ascending list (None when empty) —
+    the ONE percentile convention, shared by the online histogram and the
+    offline ``summarize_metrics`` so their p50/p95 can never diverge."""
+    if not sorted_samples:
+        return None
+    idx = min(int(q * len(sorted_samples)), len(sorted_samples) - 1)
+    return sorted_samples[idx]
+
+
+def sanitize_json(obj: Any) -> Any:
+    """Replace non-finite floats with their string names ("nan"/"inf") —
+    ``json.dumps`` would otherwise emit bare ``NaN`` tokens, which are
+    invalid JSON and break every non-Python consumer of the
+    'machine-readable' jsonl files exactly when the NaN anomaly the files
+    exist to capture occurs."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else str(obj)
+    if isinstance(obj, dict):
+        return {k: sanitize_json(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [sanitize_json(v) for v in obj]
+    return obj
+
+
+class _Counter:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+
+class _Gauge:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value: Optional[float] = None
+
+    def set(self, v: Optional[float]) -> None:
+        with self._lock:
+            self.value = v
+
+
+class _Histogram:
+    """Running count/sum plus a bounded sample ring for percentiles.
+
+    The ring doubles as the ROLLING window (rolling p50 for the
+    step-time regression detector): percentiles describe the last
+    ``max_samples`` observations, count/sum describe the whole run.
+    """
+
+    __slots__ = ("_lock", "_samples", "count", "sum", "max", "min")
+
+    def __init__(self, lock: threading.Lock, max_samples: int = 512):
+        self._lock = lock
+        self._samples: "deque[float]" = deque(maxlen=max_samples)
+        self.count = 0
+        self.sum = 0.0
+        self.max: Optional[float] = None
+        self.min: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._samples.append(v)
+            self.count += 1
+            self.sum += v
+            self.max = v if self.max is None else max(self.max, v)
+            self.min = v if self.min is None else min(self.min, v)
+
+    def percentile(self, q: float) -> Optional[float]:
+        """q in [0, 1] over the rolling sample window (nearest-rank)."""
+        with self._lock:
+            samples = sorted(self._samples)
+        return _nearest_rank(samples, q)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            samples = sorted(self._samples)
+            count, total = self.count, self.sum
+            mx, mn = self.max, self.min
+        return {
+            "count": count,
+            "sum": round(total, 6),
+            "min": mn,
+            "max": mx,
+            "p50": _nearest_rank(samples, 0.5),
+            "p95": _nearest_rank(samples, 0.95),
+        }
+
+
+class MetricsRegistry:
+    """Named counters/gauges/histograms behind one lock.
+
+    Get-or-create by name; hold instrument references on the hot path
+    (the per-step cost is then one lock acquire per observation, and
+    nothing at all when telemetry is disabled — the loop simply has no
+    registry to call).
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._counters: Dict[str, _Counter] = {}
+        self._gauges: Dict[str, _Gauge] = {}
+        self._histograms: Dict[str, _Histogram] = {}
+
+    def counter(self, name: str) -> _Counter:
+        with self._lock:
+            if name not in self._counters:
+                self._counters[name] = _Counter(self._lock)
+            return self._counters[name]
+
+    def gauge(self, name: str) -> _Gauge:
+        with self._lock:
+            if name not in self._gauges:
+                self._gauges[name] = _Gauge(self._lock)
+            return self._gauges[name]
+
+    def histogram(self, name: str, max_samples: int = 512) -> _Histogram:
+        with self._lock:
+            if name not in self._histograms:
+                self._histograms[name] = _Histogram(self._lock, max_samples)
+            return self._histograms[name]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {k: c.value for k, c in counters.items()},
+            "gauges": {k: g.value for k, g in gauges.items()},
+            "histograms": {k: h.snapshot() for k, h in histograms.items()},
+        }
+
+
+# ----------------------------------------------------------------------
+# Chrome trace-event span emitter
+# ----------------------------------------------------------------------
+
+
+class TraceBuffer:
+    """Bounded, thread-safe Chrome trace-event buffer.
+
+    Events use the complete-event form (``ph: "X"``) with microsecond
+    timestamps relative to the buffer's construction; ``flush()`` writes
+    a ``{"traceEvents": [...]}`` JSON object that chrome://tracing and
+    ui.perfetto.dev load directly. Worker threads get their own ``tid``
+    (with ``thread_name`` metadata rows) so pooled collation spans render
+    as parallel tracks.
+
+    ``set_recording(False)`` drops non-forced spans — the training loop
+    gates the per-step/host-stage firehose to the ``trace_steps`` window
+    while rare events (eval, checkpoints, anomalies) pass ``force=True``.
+    ``flush()`` is re-entrant and atomic (tmp + replace): the watchdog
+    flushes mid-run before a hard exit, finalize flushes again.
+    """
+
+    MAX_EVENTS = 200_000
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.perf_counter,
+        pid: int = 0,
+        max_events: int = MAX_EVENTS,
+    ):
+        self._clock = clock
+        self._origin = clock()
+        self._pid = int(pid)
+        self._lock = threading.Lock()
+        self._events: "deque[Dict[str, Any]]" = deque(maxlen=max_events)
+        self._tids: Dict[int, int] = {}
+        self._tid_names: Dict[int, str] = {}
+        self._recording = True
+        self.dropped = 0
+
+    def _tid(self) -> int:
+        t = threading.current_thread()
+        ident = t.ident or 0
+        with self._lock:
+            if ident not in self._tids:
+                self._tids[ident] = len(self._tids)
+                self._tid_names[self._tids[ident]] = t.name
+            return self._tids[ident]
+
+    def set_recording(self, on: bool) -> None:
+        self._recording = bool(on)
+
+    @property
+    def recording(self) -> bool:
+        return self._recording
+
+    def now(self) -> float:
+        """Clock read for callers that stamp their own t0."""
+        return self._clock()
+
+    def add_span(
+        self,
+        name: str,
+        t0: float,
+        dur: float,
+        *,
+        cat: str = "host",
+        args: Optional[Dict[str, Any]] = None,
+        force: bool = False,
+    ) -> None:
+        """One complete span: ``t0`` is a clock() stamp, ``dur`` seconds."""
+        if not self._recording and not force:
+            return
+        ev = {
+            "name": name,
+            "ph": "X",
+            "cat": cat,
+            "ts": round((t0 - self._origin) * 1e6, 1),
+            "dur": round(max(dur, 0.0) * 1e6, 1),
+            "pid": self._pid,
+            "tid": self._tid(),
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(ev)
+
+    def add_instant(
+        self,
+        name: str,
+        *,
+        cat: str = "anomaly",
+        args: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """A point-in-time marker (``ph: "i"``) — anomalies, signals."""
+        ev = {
+            "name": name,
+            "ph": "i",
+            "s": "g",  # global scope: draw the marker across all tracks
+            "cat": cat,
+            "ts": round((self._clock() - self._origin) * 1e6, 1),
+            "pid": self._pid,
+            "tid": self._tid(),
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(ev)
+
+    class _Span:
+        __slots__ = ("_buf", "_name", "_cat", "_args", "_force", "_t0")
+
+        def __init__(self, buf, name, cat, args, force):
+            self._buf, self._name = buf, name
+            self._cat, self._args, self._force = cat, args, force
+
+        def __enter__(self):
+            self._t0 = self._buf._clock()
+            return self
+
+        def __exit__(self, *exc: Any) -> None:
+            self._buf.add_span(
+                self._name,
+                self._t0,
+                self._buf._clock() - self._t0,
+                cat=self._cat,
+                args=self._args,
+                force=self._force,
+            )
+
+    def span(
+        self,
+        name: str,
+        *,
+        cat: str = "host",
+        force: bool = True,
+        **args: Any,
+    ) -> "TraceBuffer._Span":
+        """Context manager emitting one span (forced by default — used for
+        rare events like checkpoints that must outlive the step window)."""
+        return TraceBuffer._Span(self, name, cat, args or None, force)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def flush(self, path: Path) -> int:
+        """Write the buffer as Chrome trace JSON; returns events written."""
+        with self._lock:
+            events = list(self._events)
+            names = dict(self._tid_names)
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": self._pid,
+                "tid": tid,
+                "args": {"name": tname},
+            }
+            for tid, tname in sorted(names.items())
+        ]
+        payload = {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+        }
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(json.dumps(payload), encoding="utf8")
+        tmp.replace(path)
+        return len(events)
+
+
+# ----------------------------------------------------------------------
+# Device-side sampling
+# ----------------------------------------------------------------------
+
+# Dense bf16 peak per chip from public datasheets, substring-matched
+# against device_kind (order matters: v5p before v5). The single source —
+# bench.py imports this table for its MFU denominators.
+TPU_PEAK_BF16 = [
+    ("v6", 918e12),  # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5", 197e12),  # v5e reports device_kind "TPU v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+_COMPILE_LOCK = threading.Lock()
+_COMPILE_COUNT = 0
+_HOOK_INSTALLED = False
+
+
+def install_compile_hook() -> bool:
+    """Register a ``jax.monitoring`` listener counting backend compiles.
+
+    Idempotent (jax offers no deregistration, so exactly one process-wide
+    listener is ever installed). Every XLA compile — including bucket
+    recompiles after warmup, the storm signal — emits a
+    ``/jax/core/compile/backend_compile_duration`` duration event; we
+    count those. Returns False when the monitoring API is unavailable.
+    """
+    global _HOOK_INSTALLED
+    # the lock spans check-and-register: two racing first callers must not
+    # both register (every compile would count twice forever after)
+    with _COMPILE_LOCK:
+        if _HOOK_INSTALLED:
+            return True
+        try:
+            import jax.monitoring
+
+            def _on_duration(name: str, dur: float, **kw: Any) -> None:
+                if name.endswith("backend_compile_duration") or name.endswith(
+                    "backend_compile_time"
+                ):
+                    global _COMPILE_COUNT
+                    with _COMPILE_LOCK:
+                        _COMPILE_COUNT += 1
+
+            jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:
+            return False
+        _HOOK_INSTALLED = True
+    return True
+
+
+def compile_count() -> int:
+    """Cumulative backend compiles observed since the hook was installed."""
+    with _COMPILE_LOCK:
+        return _COMPILE_COUNT
+
+
+def sample_device_telemetry() -> Dict[str, Any]:
+    """One gauge sample of device 0: HBM, live buffers, compile count.
+
+    ``memory_stats()`` is backend-dependent (None on CPU; TPU/GPU report
+    ``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``) — absent
+    keys surface as None rather than fake zeros, so a dashboard can tell
+    "no HBM accounting on this backend" from "zero bytes used".
+    """
+    out: Dict[str, Any] = {
+        "platform": None,
+        "hbm_bytes_in_use": None,
+        "hbm_peak_bytes": None,
+        "hbm_bytes_limit": None,
+        "live_buffers": None,
+        "compile_count": compile_count(),
+    }
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        out["platform"] = dev.platform
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out["hbm_bytes_in_use"] = stats.get("bytes_in_use")
+            out["hbm_peak_bytes"] = stats.get("peak_bytes_in_use")
+            out["hbm_bytes_limit"] = stats.get("bytes_limit")
+        try:
+            out["live_buffers"] = len(jax.live_arrays())
+        except Exception:
+            pass
+    except Exception:
+        pass
+    return out
+
+
+def program_flops(
+    jit_fn: Any,
+    *args: Any,
+    on_error: Optional[Callable[[str], None]] = None,
+) -> Optional[float]:
+    """FLOPs of one compiled step from XLA cost analysis of the lowered
+    program (a trace, not a compile). None when the backend can't say —
+    callers (bench.py's ``_program_flops``, the eval-boundary MFU gauge)
+    choose their own fallback/labeling; ``on_error`` receives the failure
+    reason so a missing-MFU record stays debuggable."""
+    try:
+        cost = jit_fn.lower(*args).cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception as e:
+        if on_error is not None:
+            on_error(f"{type(e).__name__}: {e}")
+        return None
+
+
+def device_peak_flops() -> Tuple[Optional[float], str]:
+    """(datasheet peak FLOP/s per chip, provenance) — None off-TPU.
+
+    Deliberately datasheet-only: the training loop must never run
+    bench.py's matmul microbench mid-run (it would steal the very step
+    time being measured). Without a datasheet number the MFU gauge stays
+    None — an honest absence, not a made-up denominator.
+    """
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return None, f"no datasheet peak for {dev.platform}"
+        lk = dev.device_kind.lower()
+        for sub, peak in TPU_PEAK_BF16:
+            if sub in lk:
+                return peak, f"datasheet bf16 ({dev.device_kind})"
+        return None, f"unknown TPU kind {dev.device_kind!r}"
+    except Exception as e:
+        return None, f"device query failed: {type(e).__name__}"
+
+
+# ----------------------------------------------------------------------
+# Anomaly detection
+# ----------------------------------------------------------------------
+
+
+def _is_bad(v: float) -> bool:
+    return math.isnan(v) or math.isinf(v)
+
+
+class AnomalyDetectors:
+    """Rolling-statistic anomaly checks over host-side scalars.
+
+    Pure host arithmetic on values the loop already materializes (drained
+    losses at eval boundaries, the per-step boundary stamp) — never a
+    device sync. Each firing calls ``emit(event, message, **fields)``
+    once; the default emit path is wired by :class:`Telemetry` to
+    ``resilience.log_event`` + a metrics.jsonl anomaly row + a trace
+    instant, so one firing is visible in all three surfaces.
+
+    Thresholds and the clock are injectable; tests drive every detector
+    deterministically with synthetic series and a fake clock.
+    """
+
+    def __init__(
+        self,
+        emit: Callable[..., Any],
+        *,
+        clock: Callable[[], float] = time.perf_counter,
+        spike_factor: float = 4.0,
+        spike_min_history: int = 3,
+        loss_window: int = 32,
+        step_factor: float = 2.5,
+        step_warmup: int = 20,
+        step_window: int = 128,
+        recompile_warmup_steps: int = 50,
+    ):
+        self.emit = emit
+        self.clock = clock
+        self.spike_factor = float(spike_factor)
+        self.spike_min_history = int(spike_min_history)
+        self.step_factor = float(step_factor)
+        self.step_warmup = int(step_warmup)
+        self.recompile_warmup_steps = int(recompile_warmup_steps)
+        self._loss_history: "deque[float]" = deque(maxlen=int(loss_window))
+        self._step_times: "deque[float]" = deque(maxlen=int(step_window))
+        self._steps_observed = 0
+        self._last_compile_count: Optional[int] = None
+        self.fired: Dict[str, int] = {}
+
+    def _fire(self, event: str, message: str, **fields: Any) -> None:
+        self.fired[event] = self.fired.get(event, 0) + 1
+        fields.setdefault("t", round(self.clock(), 6))
+        self.emit(event, message, **fields)
+
+    # -- loss ---------------------------------------------------------
+    def check_loss(self, step: int, loss: float) -> None:
+        """NaN/Inf, then spike vs the rolling median of finite history."""
+        loss = float(loss)
+        if _is_bad(loss):
+            self._fire(
+                "nan-loss",
+                f"non-finite loss {loss!r} at step {step}",
+                step=step,
+                loss=str(loss),
+            )
+            return  # a NaN must not enter (and poison) the history
+        history = sorted(self._loss_history)
+        if len(history) >= self.spike_min_history:
+            median = history[len(history) // 2]
+            if median > 0 and loss > self.spike_factor * median:
+                self._fire(
+                    "loss-spike",
+                    f"loss {loss:.4g} at step {step} is "
+                    f"{loss / median:.1f}x the rolling median {median:.4g}",
+                    step=step,
+                    loss=loss,
+                    median=median,
+                )
+        self._loss_history.append(loss)
+
+    # -- step time ----------------------------------------------------
+    def check_step_time(self, step: int, seconds: float) -> None:
+        """Regression vs rolling p50, after a warmup (compiles dominate
+        the first steps by design and must not count as regressions)."""
+        seconds = float(seconds)
+        self._steps_observed += 1
+        if self._steps_observed > self.step_warmup and self._step_times:
+            samples = sorted(self._step_times)
+            p50 = samples[len(samples) // 2]
+            if p50 > 0 and seconds > self.step_factor * p50:
+                self._fire(
+                    "step-time-regression",
+                    f"step {step} took {seconds * 1e3:.1f}ms — "
+                    f"{seconds / p50:.1f}x the rolling p50 "
+                    f"{p50 * 1e3:.1f}ms",
+                    step=step,
+                    seconds=seconds,
+                    p50=p50,
+                )
+        self._step_times.append(seconds)
+
+    # -- recompiles ---------------------------------------------------
+    def check_compiles(self, steps_run: int, count: int) -> None:
+        """Fire when the cumulative compile count grows after warmup —
+        steady state must reuse cached programs; late compiles mean a
+        shape leak (an unbucketed batch dimension) or a storm."""
+        prev = self._last_compile_count
+        self._last_compile_count = int(count)
+        if prev is None:
+            return
+        if count > prev and steps_run > self.recompile_warmup_steps:
+            self._fire(
+                "recompile-after-warmup",
+                f"{count - prev} new XLA compile(s) after step "
+                f"{steps_run} (cumulative {count}) — check shape "
+                "bucketing",
+                steps_run=steps_run,
+                new_compiles=count - prev,
+                compile_count=count,
+            )
+
+
+# ----------------------------------------------------------------------
+# Telemetry facade (what the training loop holds)
+# ----------------------------------------------------------------------
+
+
+class Telemetry:
+    """Everything the training loop needs behind one nullable handle.
+
+    The loop guards every call with ``if tel is not None`` — the
+    disabled path constructs nothing and calls nothing (asserted by a
+    test that makes construction raise). One wall-clock stamp per step
+    (``step_boundary``); device sampling, percentile math, anomaly
+    checks, and file I/O all happen at eval boundaries.
+    """
+
+    def __init__(
+        self,
+        metrics_dir: Path,
+        *,
+        trace_steps: Tuple[int, int] = (0, 50),
+        anomaly_detection: bool = True,
+        clock: Callable[[], float] = time.perf_counter,
+        process_index: int = 0,
+        detector_kwargs: Optional[Dict[str, Any]] = None,
+    ):
+        self.metrics_dir = Path(metrics_dir)
+        self.metrics_dir.mkdir(parents=True, exist_ok=True)
+        self.metrics_path = self.metrics_dir / "metrics.jsonl"
+        self.trace_path = self.metrics_dir / "trace.json"
+        self.clock = clock
+        self.trace_steps = (int(trace_steps[0]), int(trace_steps[1]))
+        self.registry = MetricsRegistry(clock=clock)
+        self.trace = TraceBuffer(clock=clock, pid=int(process_index))
+        self.detectors: Optional[AnomalyDetectors] = None
+        if anomaly_detection:
+            self.detectors = AnomalyDetectors(
+                self._emit_anomaly, clock=clock, **(detector_kwargs or {})
+            )
+        install_compile_hook()
+        self._compiles_at_start = compile_count()
+        # hot-path instruments, resolved once
+        self._step_hist = self.registry.histogram("step_seconds")
+        self._words = self.registry.counter("words")
+        self._steps = self.registry.counter("steps")
+        self._rows: List[Dict[str, Any]] = []
+        self._rows_lock = threading.Lock()
+        self._last_boundary: Optional[float] = None
+        self._t0 = clock()
+        self.flops_per_step: Optional[float] = None
+        self._flops_probed = False
+        self._peak: Optional[float] = None
+        self._peak_kind: Optional[str] = None
+        self._handle: Optional[IO[str]] = None
+        self._finalized = False
+
+    # -- emit plumbing -------------------------------------------------
+    def _emit_anomaly(self, event: str, message: str, **fields: Any) -> None:
+        from .resilience import log_event
+
+        log_event(event, message, **fields)
+        with self._rows_lock:
+            self._rows.append(
+                {"kind": "anomaly", "anomaly": event, "message": message, **fields}
+            )
+        self.trace.add_instant(event, args={"message": message})
+
+    def _append_row(self, row: Dict[str, Any]) -> None:
+        with self._rows_lock:
+            self._rows.append(row)
+
+    def _flush_rows(self) -> None:
+        with self._rows_lock:
+            rows, self._rows = self._rows, []
+        if not rows:
+            return
+        if self._handle is None:
+            self._handle = open(self.metrics_path, "a", encoding="utf8")
+        for row in rows:
+            # sanitize_json: a NaN loss row must stay VALID json (the NaN
+            # anomaly is exactly when these files get read by tooling)
+            self._handle.write(
+                json.dumps(sanitize_json(row), default=float) + "\n"
+            )
+        self._handle.flush()
+
+    # -- loop hooks ----------------------------------------------------
+    def loop_start(self) -> None:
+        """Arm the per-step stamp right before the first iteration."""
+        self._last_boundary = self.clock()
+        self.trace.set_recording(self.trace_steps[0] <= 0 < self.trace_steps[1])
+
+    def step_boundary(
+        self, *, step: int, epoch: int, n_words: int, steps_run: int
+    ) -> None:
+        """THE one hot-path hook: a single clock stamp, one histogram
+        observation, one buffered row, and the trace-window gate."""
+        now = self.clock()
+        prev = self._last_boundary
+        self._last_boundary = now
+        self._steps.inc()
+        self._words.inc(n_words)
+        if prev is not None:
+            dur = now - prev
+            self._step_hist.observe(dur)
+            self.trace.add_span(
+                "step",
+                prev,
+                dur,
+                cat="step",
+                args={"step": step, "words": n_words},
+            )
+            self._append_row(
+                {
+                    "kind": "step",
+                    "step": step,
+                    "epoch": epoch,
+                    "t": round(now - self._t0, 6),
+                    "step_seconds": round(dur, 6),
+                    "words": n_words,
+                }
+            )
+            if self.detectors is not None:
+                self.detectors.check_step_time(step, dur)
+        # gate the span firehose to the configured step window (rare
+        # events — eval/checkpoint/anomaly — bypass with force=True).
+        # Ordering matters: the step span ABOVE was gated by the flag set
+        # at the PREVIOUS boundary — i.e. by the completed step's own
+        # index — so [start, stop) captures exactly step indices
+        # start..stop-1; this set_recording gates the NEXT step (index
+        # == the incremented steps_run).
+        start, stop = self.trace_steps
+        self.trace.set_recording(start <= steps_run < stop)
+
+    def eval_boundary(
+        self,
+        *,
+        step: int,
+        epoch: int,
+        steps_run: int,
+        losses: Dict[str, float],
+        score: Optional[float],
+        eval_seconds: float,
+        input_pipeline: Optional[Dict[str, Any]] = None,
+        flops_fn: Optional[Callable[[], Optional[float]]] = None,
+        wps: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Sample gauges, run detectors, flush rows; returns the snapshot
+        the logger embeds in its row."""
+        device = sample_device_telemetry()
+        reg = self.registry
+        if device["hbm_peak_bytes"] is not None:
+            reg.gauge("hbm_peak_bytes").set(device["hbm_peak_bytes"])
+        if device["hbm_bytes_in_use"] is not None:
+            reg.gauge("hbm_bytes_in_use").set(device["hbm_bytes_in_use"])
+        if device["live_buffers"] is not None:
+            reg.gauge("live_buffers").set(device["live_buffers"])
+        compiles = device["compile_count"] - self._compiles_at_start
+        reg.gauge("compile_count").set(compiles)
+        # one-shot cost model: lowering is a trace (no compile), but not
+        # free — probe on the first eval only
+        if not self._flops_probed and flops_fn is not None:
+            self._flops_probed = True
+            try:
+                self.flops_per_step = flops_fn()
+            except Exception:
+                self.flops_per_step = None
+            self._peak, self._peak_kind = device_peak_flops()
+        hist = self._step_hist
+        p50 = hist.percentile(0.5)
+        p95 = hist.percentile(0.95)
+        mfu = None
+        if self.flops_per_step and self._peak and p50:
+            try:
+                import jax
+
+                n_chips = len(jax.devices())
+            except Exception:
+                n_chips = 1
+            # e2e MFU: the denominator is wall step time (host work
+            # included) — chip utilization of the whole pipeline, same
+            # convention as bench.py's e2e records
+            mfu = self.flops_per_step / p50 / (self._peak * n_chips)
+        loss_total = sum(float(v) for v in losses.values()) if losses else None
+        if self.detectors is not None:
+            if loss_total is not None:
+                self.detectors.check_loss(step, loss_total)
+            if score is not None and _is_bad(float(score)):
+                self.detectors._fire(
+                    "nan-score",
+                    f"non-finite eval score {score!r} at step {step}",
+                    step=step,
+                )
+            self.detectors.check_compiles(steps_run, compiles)
+        row: Dict[str, Any] = {
+            "kind": "eval",
+            "step": step,
+            "epoch": epoch,
+            "t": round(self.clock() - self._t0, 6),
+            "loss_total": loss_total,
+            "losses": dict(losses),
+            "score": score,
+            "eval_seconds": round(eval_seconds, 6),
+            "wps": wps,
+            "step_seconds_p50": p50,
+            "step_seconds_p95": p95,
+            "hbm_bytes_in_use": device["hbm_bytes_in_use"],
+            "hbm_peak_bytes": device["hbm_peak_bytes"],
+            "hbm_bytes_limit": device["hbm_bytes_limit"],
+            "live_buffers": device["live_buffers"],
+            "compile_count": compiles,
+            "flops_per_step": self.flops_per_step,
+            "mfu": round(mfu, 5) if mfu is not None else None,
+            "platform": device["platform"],
+        }
+        if input_pipeline is not None:
+            row["input_pipeline"] = input_pipeline
+        self._append_row(row)
+        self._flush_rows()
+        snapshot = {
+            "step_seconds_p50": p50,
+            "step_seconds_p95": p95,
+            "hbm_peak_bytes": device["hbm_peak_bytes"],
+            "live_buffers": device["live_buffers"],
+            "compile_count": compiles,
+            "mfu": row["mfu"],
+            "trace_events": len(self.trace),
+        }
+        return snapshot
+
+    def rearm_step_clock(self) -> None:
+        """Re-stamp the step boundary after off-step work (eval +
+        checkpoint save) — without this, the step AFTER every eval would
+        absorb the whole eval duration into its measured step time,
+        skewing p95 and firing a spurious step-time regression at every
+        eval boundary."""
+        self._last_boundary = self.clock()
+
+    # -- flush / teardown ---------------------------------------------
+    def emergency_flush(self) -> None:
+        """Best-effort full flush for hard-exit paths (the watchdog fires
+        ``os._exit`` — no finally blocks will run after this)."""
+        try:
+            self._flush_rows()
+        except Exception:
+            pass
+        try:
+            self.trace.flush(self.trace_path)
+        except Exception:
+            pass
+
+    def finalize(self) -> None:
+        if self._finalized:
+            return
+        self._finalized = True
+        self._flush_rows()
+        self.trace.flush(self.trace_path)
+        if self._handle is not None:
+            self._handle.close()
+            self._handle = None
+
+
+# ----------------------------------------------------------------------
+# Offline summary (`telemetry summarize metrics.jsonl`)
+# ----------------------------------------------------------------------
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def summarize_metrics(path: Path) -> str:
+    """Digest a ``metrics.jsonl``: per-stage time breakdown, step-time
+    percentiles, device gauges, anomaly digest. Pure file-in/text-out so
+    the CLI subcommand and the round-trip test share one implementation.
+
+    Raises ValueError when the file holds no telemetry rows (a wrong
+    path must not print an empty-but-plausible report)."""
+    path = Path(path)
+    steps: List[Dict[str, Any]] = []
+    evals: List[Dict[str, Any]] = []
+    anomalies: List[Dict[str, Any]] = []
+    with open(path, encoding="utf8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # torn concurrent write: skip, don't abort
+            kind = row.get("kind")
+            if kind == "step":
+                steps.append(row)
+            elif kind == "eval":
+                evals.append(row)
+            elif kind == "anomaly":
+                anomalies.append(row)
+    if not steps and not evals and not anomalies:
+        raise ValueError(f"{path} contains no telemetry rows")
+
+    lines: List[str] = [f"telemetry summary: {path}"]
+    if steps:
+        durs = sorted(float(s["step_seconds"]) for s in steps)
+        words = sum(int(s.get("words") or 0) for s in steps)
+        total = sum(durs)
+        line = (
+            f"steps: {len(durs)}  words: {words:,}  "
+            f"step-time p50 {_nearest_rank(durs, 0.5) * 1e3:.1f}ms  "
+            f"p95 {_nearest_rank(durs, 0.95) * 1e3:.1f}ms  "
+            f"max {durs[-1] * 1e3:.1f}ms"
+        )
+        if total > 0:
+            line += f"  ({words / total:,.0f} words/s overall)"
+        lines.append(line)
+    if evals:
+        last = evals[-1]
+        stages = (last.get("input_pipeline") or {}).get("stage_seconds") or {}
+        if stages:
+            stage_total = sum(stages.values()) or 1.0
+            lines.append("host input-pipeline breakdown (cumulative seconds):")
+            for stage, seconds in stages.items():
+                lines.append(
+                    f"  {stage:12s} {seconds:10.3f}s  "
+                    f"{100 * seconds / stage_total:5.1f}%"
+                )
+        lines.append(
+            f"device: platform={last.get('platform')}  "
+            f"hbm_peak={_fmt_bytes(last.get('hbm_peak_bytes'))}  "
+            f"live_buffers={last.get('live_buffers')}  "
+            f"compiles={last.get('compile_count')}"
+        )
+        if isinstance(last.get("mfu"), (int, float)):
+            lines.append(f"mfu (e2e, p50 step): {last['mfu']:.4f}")
+        # sanitize_json stores a NaN score as the string "nan" — keep only
+        # finite numerics, or the digest of a NaN run (the headline use
+        # case) would crash on the format specifier
+        scores = [
+            e.get("score")
+            for e in evals
+            if isinstance(e.get("score"), (int, float))
+            and math.isfinite(float(e["score"]))
+        ]
+        if scores:
+            lines.append(
+                f"evals: {len(evals)}  last score {scores[-1]:.4f}  "
+                f"best {max(scores):.4f}"
+            )
+    by_kind: Dict[str, List[Dict[str, Any]]] = {}
+    for a in anomalies:
+        by_kind.setdefault(str(a.get("anomaly")), []).append(a)
+    if by_kind:
+        lines.append(f"anomalies: {len(anomalies)}")
+        for name in sorted(by_kind):
+            rows = by_kind[name]
+            anom_steps = [r.get("step") for r in rows if r.get("step") is not None]
+            where = (
+                f" (steps {min(anom_steps)}..{max(anom_steps)})"
+                if anom_steps
+                else ""
+            )
+            lines.append(f"  {name:24s} x{len(rows)}{where}")
+    else:
+        lines.append("anomalies: none")
+    return "\n".join(lines)

@@ -256,6 +256,31 @@ def test_run_one_scales_reps_to_min_seconds(monkeypatch):
     # each rep must have measured at least ~MIN_REP_SECONDS of work
     # (within the one-probe-step estimate's slack)
     assert rec["steps_per_rep"] * rec["value"] > 0
+    # every record carries its telemetry block: compile delta (this spec
+    # compiled at least the full-shape step), HBM + live-buffer gauges
+    tel = rec["telemetry"]
+    assert tel["compile_count"] > 0
+    assert "hbm_peak_bytes" in tel and "live_buffers" in tel
+
+
+def test_run_one_e2e_records_stage_seconds(monkeypatch):
+    """The e2e variant's record includes per-stage host seconds from the
+    training loop's own PipelineStats — the bench trajectory captures
+    where batch-preparation time went, not just the rate."""
+    from spacy_ray_tpu.presets import CNN_TAGGER_CFG
+
+    spec = dict(
+        name="tiny_e2e_probe",
+        metric="m",
+        cfg=CNN_TAGGER_CFG.format(width=32, depth=1, embed_size=200),
+        kinds=["tagger"],
+        B=8, T=16, steps=2, warmup=1, n_reps=1, e2e=True,
+    )
+    monkeypatch.setattr(bench, "MIN_REP_SECONDS", 0.2)  # keep the probe fast
+    rec = bench.run_one(spec, "cpu")
+    assert rec is not None
+    stages = rec["telemetry"]["input_pipeline"]["stage_seconds"]
+    assert stages["collate"] > 0 and stages["transfer"] > 0
 
 
 @pytest.mark.slow
