@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry bench baseline profile dryrun
+.PHONY: test test-fast test-slow resilience telemetry serving bench baseline profile dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -19,6 +19,12 @@ resilience:
 # detectors, the telemetry-enabled smoke train (docs/OBSERVABILITY.md)
 telemetry:
 	python -m pytest tests/test_telemetry.py -q
+
+# online-serving suite: batcher/engine/HTTP correctness under load,
+# SIGTERM graceful drain, SLO telemetry, bench records (docs/SERVING.md);
+# the heavy open-loop load variant is slow-marked and excluded here
+serving:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q -m "not slow"
 
 bench:
 	python bench.py

@@ -1451,6 +1451,110 @@ def telemetry_command(argv: List[str]) -> int:
     return 0
 
 
+def serve_command(argv: List[str]) -> int:
+    """``serve`` — online inference over HTTP with dynamic micro-batching
+    (docs/SERVING.md): load a saved pipeline, warm the (B, T) bucket
+    programs, then serve ``/v1/parse`` until SIGTERM, which triggers a
+    graceful drain (stop admitting, finish in-flight batches, exit 0)."""
+    from .serving.engine import SERVING_DEFAULTS
+
+    parser = argparse.ArgumentParser(
+        prog="spacy_ray_tpu serve",
+        description="Serve a saved pipeline as a JSON HTTP API "
+        "(/v1/parse, /healthz, /metrics) with dynamic micro-batching.",
+    )
+    parser.add_argument("model_path", type=Path)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8080,
+                        help="0 = ephemeral; the bound port is printed in "
+                        "the 'serving on http://...' banner")
+    parser.add_argument("--device", type=str, default="tpu",
+                        choices=["tpu", "cpu", "gpu"])
+    parser.add_argument("--max-batch", type=int,
+                        default=SERVING_DEFAULTS["max_batch_docs"],
+                        help="max docs coalesced into one device batch")
+    parser.add_argument("--max-wait-ms", type=float,
+                        default=SERVING_DEFAULTS["max_wait_s"] * 1e3,
+                        help="coalescing window from the first queued "
+                        "request (added latency bound)")
+    parser.add_argument("--queue-size", type=int,
+                        default=SERVING_DEFAULTS["max_queue_docs"],
+                        help="bounded admission queue (docs); beyond it "
+                        "requests are rejected 429")
+    parser.add_argument("--timeout-ms", type=float,
+                        default=SERVING_DEFAULTS["timeout_s"] * 1e3,
+                        help="default per-request deadline (clients may "
+                        "lower it per call via timeout_ms)")
+    parser.add_argument("--max-doc-len", type=int,
+                        default=SERVING_DEFAULTS["max_doc_len"],
+                        help="longest admissible doc in tokens (the warmed "
+                        "shape cap; longer docs are rejected 413)")
+    parser.add_argument("--drain-timeout-s", type=float, default=30.0)
+    parser.add_argument("--no-warmup", action="store_true",
+                        help="skip the bucket compile sweep (first requests "
+                        "then pay compiles — testing only)")
+    parser.add_argument("--no-telemetry", action="store_true",
+                        help="disable the SLO metrics/trace surface "
+                        "entirely (zero telemetry calls; /metrics reports "
+                        "disabled)")
+    parser.add_argument("--metrics-dir", type=Path, default=None,
+                        help="write serving_trace.json + a final metrics "
+                        "snapshot here on shutdown")
+    parser.add_argument("--verbose", "-V", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.ERROR)
+    logging.getLogger("spacy_ray_tpu.training").setLevel(
+        logging.INFO if args.verbose else logging.WARNING
+    )
+    _setup_device(args.device)
+
+    from .pipeline.language import Pipeline
+    from .serving.engine import InferenceEngine, ServingTelemetry
+    from .serving.server import Server
+
+    nlp = Pipeline.from_disk(args.model_path)
+    tel = None if args.no_telemetry else ServingTelemetry()
+    engine = InferenceEngine(
+        nlp,
+        max_batch_docs=args.max_batch,
+        max_wait_s=max(args.max_wait_ms, 0.0) / 1e3,
+        max_queue_docs=args.queue_size,
+        timeout_s=max(args.timeout_ms, 1.0) / 1e3,
+        max_doc_len=args.max_doc_len,
+        telemetry=tel,
+    )
+    engine.start(warmup=not args.no_warmup)
+    if engine.warmed:
+        print(f"warmed {len(engine.warmed)} (B, T) bucket programs "
+              f"(up to B={args.max_batch}, T≈{args.max_doc_len})", flush=True)
+    server = Server(
+        engine, args.host, args.port,
+        telemetry=tel, drain_timeout_s=args.drain_timeout_s,
+    )
+    rc = server.run()
+    if tel is not None and args.metrics_dir is not None:
+        import json
+
+        args.metrics_dir.mkdir(parents=True, exist_ok=True)
+        tel.trace.flush(args.metrics_dir / "serving_trace.json")
+        from .training.telemetry import sanitize_json
+
+        (args.metrics_dir / "serving_metrics.json").write_text(
+            json.dumps(sanitize_json(tel.snapshot()), indent=2) + "\n",
+            encoding="utf8",
+        )
+        print(f"serving telemetry written to {args.metrics_dir}", flush=True)
+    if rc == 0:
+        print("drained; exiting 0", flush=True)
+    else:
+        # the failure path must not carry the success word: in-flight
+        # work was abandoned at the drain timeout
+        print(f"drain timed out after {args.drain_timeout_s:.0f}s — "
+              f"in-flight work abandoned; exiting {rc}", flush=True)
+    return rc
+
+
 def _project_command(argv: List[str]) -> int:
     """spaCy-projects-style workflow runner (`project run` / `project
     document`); implementation in project.py."""
@@ -1466,6 +1570,7 @@ COMMANDS = {
     # spaCy's name for bulk annotation; same command, correctly-named help
     "apply": lambda argv: parse_command(argv, prog="apply"),
     "debug-profile": debug_profile_command,
+    "serve": serve_command,
     "telemetry": telemetry_command,
     "find-threshold": find_threshold_command,
     "info": info_command,

@@ -504,6 +504,8 @@ class Pipeline:
         batch_size: int = 128,
         mesh=None,
         annotate: Optional[List[str]] = None,
+        pad_batch_to: Optional[int] = None,
+        pad_len_to: Optional[int] = None,
     ) -> List[Doc]:
         """Batched prediction. With ``mesh`` (single-process), eval batches
         are sharded over the ``data`` axis so prediction uses every device
@@ -514,7 +516,12 @@ class Pipeline:
         (the training loop's ``[training] annotating_components`` path —
         reference worker.py:187 passes the list into
         ``train_while_improving`` so downstream components train against
-        upstream predictions). ``None`` annotates with every component."""
+        upstream predictions). ``None`` annotates with every component.
+
+        ``pad_batch_to``/``pad_len_to``: pin the padded (B, T) instead of
+        deriving it from the chunk — the serving engine dispatches with
+        the coalesced bucket pinned so a live request can only ever hit a
+        shape its warmup sweep already compiled."""
         params = params if params is not None else self.params
         assert params is not None, "Pipeline not initialized"
         shard_eval = (
@@ -553,7 +560,8 @@ class Pipeline:
             )
         forward = self._jit_forward[decode_sig]
         for chunk, lengths, outputs in self._forward_chunks(
-            docs, params, forward, batch_size, shard_eval, n_data, mesh
+            docs, params, forward, batch_size, shard_eval, n_data, mesh,
+            pad_batch_to=pad_batch_to, pad_len_to=pad_len_to,
         ):
             for name in self.head_names():
                 if annotate is not None and name not in annotate:
@@ -564,20 +572,27 @@ class Pipeline:
         return docs
 
     def _forward_chunks(
-        self, docs, params, forward, batch_size, shard_eval, n_data, mesh
+        self, docs, params, forward, batch_size, shard_eval, n_data, mesh,
+        pad_batch_to=None, pad_len_to=None,
     ):
         for start in range(0, len(docs), batch_size):
             chunk = docs[start : start + batch_size]
             examples = [Example.from_gold(d) for d in chunk]
             if shard_eval:
-                B = bucket_batch_size(len(examples))
+                B = pad_batch_to or bucket_batch_size(len(examples))
                 B = ((B + n_data - 1) // n_data) * n_data
-                batch = self.collate(examples, with_targets=False, pad_batch_to=B)
+                batch = self.collate(
+                    examples, with_targets=False, pad_batch_to=B,
+                    pad_len_to=pad_len_to,
+                )
                 from ..parallel.step import place_batch
 
                 tokens = place_batch(batch["tokens"], mesh)
             else:
-                batch = self.collate(examples, with_targets=False)
+                batch = self.collate(
+                    examples, with_targets=False,
+                    pad_batch_to=pad_batch_to, pad_len_to=pad_len_to,
+                )
                 tokens = batch["tokens"]
             outputs = forward(params, tokens)
             lengths = [min(len(d), batch["tokens"].seq_len) for d in chunk]

@@ -1,0 +1,46 @@
+"""Online serving subsystem: dynamic batching engine + HTTP front-end.
+
+Three layers (see each module's docstring for the contracts):
+
+* :mod:`.batcher` — bounded admission queue, size-or-deadline
+  micro-batch coalescing, typed rejects;
+* :mod:`.engine` — device-resident params, (B, T) bucket warmup sweep,
+  the single dispatch thread, SLO telemetry facade;
+* :mod:`.server` — stdlib HTTP JSON API (``/v1/parse``, ``/healthz``,
+  ``/metrics``) and SIGTERM graceful drain.
+
+Entry point: ``spacy-ray-tpu serve <model_dir>`` (cli.py).
+"""
+
+from .batcher import (
+    DeadlineExceeded,
+    Draining,
+    DynamicBatcher,
+    QueueFull,
+    RequestTooLarge,
+    ServeRequest,
+    ServingError,
+)
+from .engine import (
+    InferenceEngine,
+    SERVING_DEFAULTS,
+    ServingTelemetry,
+    warmup_buckets,
+)
+from .server import Server, ServingHTTPServer
+
+__all__ = [
+    "ServingError",
+    "QueueFull",
+    "Draining",
+    "DeadlineExceeded",
+    "RequestTooLarge",
+    "ServeRequest",
+    "DynamicBatcher",
+    "InferenceEngine",
+    "ServingTelemetry",
+    "SERVING_DEFAULTS",
+    "warmup_buckets",
+    "Server",
+    "ServingHTTPServer",
+]

@@ -1,0 +1,285 @@
+"""Dynamic micro-batching for online serving: a bounded request queue
+that coalesces concurrent requests into bucket-shaped device batches.
+
+The reference's serving substrate is Ray's task/actor queue (Moritz et
+al., arXiv:1712.05889 — serving and training share one scheduling
+fabric); the TPU-native equivalent separates ADMISSION (this module,
+pure host-side, lock-and-condvar) from DISPATCH (serving/engine.py, one
+thread driving the compiled program), the decoupling the adaptive-
+placement line of work (PAPERS.md) shows is what keeps devices busy
+under bursty load.
+
+Contract:
+
+* ``submit`` is called from many HTTP handler threads; it either admits
+  the request (bounded queue — backpressure, not unbounded memory) or
+  raises a TYPED error the server maps to an HTTP status. A full queue
+  or a draining server rejects instantly; nobody's latency degrades
+  because someone else's request sat behind an unserviceable backlog.
+* ``next_batch`` is called by the single dispatch thread: it blocks for
+  the first request, then coalesces follow-ups until ``max_batch_docs``
+  are in hand or ``max_wait_s`` has elapsed since the first arrival —
+  the classic size-or-deadline micro-batching rule. Requests whose
+  deadline already passed are completed with ``DeadlineExceeded``
+  *here*, before they waste a device dispatch.
+* Per-request deadlines are absolute clock() stamps. The clock is
+  injectable; tests drive every timing path with a fake clock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+__all__ = [
+    "ServingError",
+    "QueueFull",
+    "Draining",
+    "DeadlineExceeded",
+    "RequestTooLarge",
+    "ServeRequest",
+    "DynamicBatcher",
+]
+
+
+class ServingError(Exception):
+    """Base of the typed admission/serving errors; ``http_status`` is the
+    status code the HTTP front-end maps the error to."""
+
+    http_status = 500
+    code = "internal"
+
+
+class QueueFull(ServingError):
+    """Admission control: the bounded queue is full — shed load now
+    instead of growing a backlog that blows every later deadline."""
+
+    http_status = 429
+    code = "queue_full"
+
+
+class Draining(ServingError):
+    """The server received SIGTERM and stopped admitting; in-flight
+    requests still complete (the graceful-drain contract)."""
+
+    http_status = 503
+    code = "draining"
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before a device batch picked it up."""
+
+    http_status = 504
+    code = "deadline_exceeded"
+
+
+class RequestTooLarge(ServingError):
+    """More docs than ``max_batch_docs`` or a doc longer than the warmed
+    shape cap — an unservable request must fail with a reason, not
+    trigger an unbounded-compile surprise."""
+
+    http_status = 413
+    code = "request_too_large"
+
+
+class ServeRequest:
+    """One admitted request: a list of tokenized docs plus completion
+    plumbing. The HTTP handler thread blocks on ``wait``; the dispatch
+    thread fills ``docs`` (annotated in place) or ``error`` and sets the
+    event."""
+
+    __slots__ = (
+        "docs", "deadline", "enqueued_at", "started_at",
+        "_done", "error", "batch_info",
+    )
+
+    def __init__(self, docs: List[Any], deadline: float, enqueued_at: float):
+        self.docs = docs
+        self.deadline = float(deadline)
+        self.enqueued_at = float(enqueued_at)
+        self.started_at: Optional[float] = None
+        self._done = threading.Event()
+        self.error: Optional[ServingError] = None
+        self.batch_info: Dict[str, Any] = {}
+
+    def complete(self, error: Optional[ServingError] = None) -> None:
+        self.error = error
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class DynamicBatcher:
+    """Bounded queue + size-or-deadline coalescing (docs are the unit:
+    one request may carry several docs, and occupancy accounting is in
+    docs because that is what fills a padded device batch)."""
+
+    def __init__(
+        self,
+        *,
+        max_queue_docs: int = 128,
+        max_batch_docs: int = 16,
+        max_wait_s: float = 0.005,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if max_batch_docs < 1:
+            raise ValueError("max_batch_docs must be >= 1")
+        if max_queue_docs < max_batch_docs:
+            raise ValueError(
+                f"max_queue_docs ({max_queue_docs}) must be >= max_batch_docs "
+                f"({max_batch_docs}) or a full batch could never be admitted"
+            )
+        self.max_queue_docs = int(max_queue_docs)
+        self.max_batch_docs = int(max_batch_docs)
+        self.max_wait_s = float(max_wait_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._queue: Deque[ServeRequest] = deque()
+        self._queued_docs = 0
+        self._draining = False
+        self._closed = False
+        # shed/served accounting the telemetry counters mirror
+        self.rejected_full = 0
+        self.rejected_draining = 0
+        self.expired = 0
+
+    # -- producer side (HTTP handler threads) --------------------------
+    def submit(self, request: ServeRequest) -> None:
+        n = len(request.docs)
+        if n > self.max_batch_docs:
+            raise RequestTooLarge(
+                f"request carries {n} docs; max_batch_docs is "
+                f"{self.max_batch_docs} — split the request"
+            )
+        with self._lock:
+            if self._draining or self._closed:
+                self.rejected_draining += 1
+                raise Draining("server is draining; not admitting requests")
+            if self._queued_docs + n > self.max_queue_docs:
+                self.rejected_full += 1
+                raise QueueFull(
+                    f"queue holds {self._queued_docs} docs "
+                    f"(limit {self.max_queue_docs})"
+                )
+            self._queue.append(request)
+            self._queued_docs += n
+            self._nonempty.notify()
+
+    # -- consumer side (the one dispatch thread) ------------------------
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._queued_docs
+
+    def _pop_ready(self, batch: List[ServeRequest], now: float) -> None:
+        """Move queued requests into ``batch`` up to max_batch_docs,
+        completing already-expired ones with DeadlineExceeded (never
+        spending device time on a response nobody is waiting for).
+        Caller holds the lock."""
+        have = sum(len(r.docs) for r in batch)
+        while self._queue:
+            head = self._queue[0]
+            if head.deadline <= now:
+                self._queue.popleft()
+                self._queued_docs -= len(head.docs)
+                self.expired += 1
+                head.complete(
+                    DeadlineExceeded(
+                        f"deadline passed {now - head.deadline:.3f}s before "
+                        "dispatch (queued "
+                        f"{now - head.enqueued_at:.3f}s)"
+                    )
+                )
+                continue
+            if have + len(head.docs) > self.max_batch_docs:
+                break  # keep whole requests together in one device batch
+            self._queue.popleft()
+            self._queued_docs -= len(head.docs)
+            head.started_at = now
+            batch.append(head)
+            have += len(head.docs)
+
+    def next_batch(self, poll_s: float = 0.05) -> Optional[List[ServeRequest]]:
+        """Block for the next coalesced batch. Returns None when the
+        batcher is closed AND empty (the dispatch thread's exit signal).
+
+        ``poll_s`` bounds each condvar wait so a fake-clock test (or a
+        drain) is never stuck inside a long real-time wait.
+        """
+        with self._lock:
+            while not self._queue:
+                if self._closed:
+                    return None
+                self._nonempty.wait(timeout=poll_s)
+            batch: List[ServeRequest] = []
+            first_at = self.clock()
+            self._pop_ready(batch, first_at)
+            # coalescing window: more requests may land while we wait —
+            # the entire point of dynamic batching. The window is capped
+            # by max_wait_s from the FIRST request (bounded added
+            # latency) and ends early on a full batch.
+            while (
+                sum(len(r.docs) for r in batch) < self.max_batch_docs
+                and not self._closed
+            ):
+                remaining = self.max_wait_s - (self.clock() - first_at)
+                if remaining <= 0:
+                    break
+                self._nonempty.wait(timeout=min(remaining, poll_s))
+                self._pop_ready(batch, self.clock())
+            # deadlines may have passed DURING the window: a requester
+            # that already gave up must get its typed timeout, not a
+            # response nobody reads (and must not occupy the batch)
+            now = self.clock()
+            kept: List[ServeRequest] = []
+            for r in batch:
+                if r.deadline <= now:
+                    self.expired += 1
+                    r.complete(
+                        DeadlineExceeded(
+                            f"deadline passed {now - r.deadline:.3f}s into "
+                            "the coalescing window"
+                        )
+                    )
+                else:
+                    kept.append(r)
+            # kept may be empty (everything expired): caller loops around
+            return kept
+
+    # -- drain / close --------------------------------------------------
+    def begin_drain(self) -> None:
+        """Stop admitting; already-queued requests still dispatch."""
+        with self._lock:
+            self._draining = True
+            self._nonempty.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def close(self) -> None:
+        """Drain + release the dispatch thread once the queue is empty."""
+        with self._lock:
+            self._draining = True
+            self._closed = True
+            self._nonempty.notify_all()
+
+    def fail_all_queued(self, error: ServingError) -> int:
+        """Complete every queued request with ``error`` (hard shutdown
+        path — a non-graceful stop must not leave handler threads
+        blocked forever). Returns how many were failed."""
+        with self._lock:
+            n = 0
+            while self._queue:
+                req = self._queue.popleft()
+                self._queued_docs -= len(req.docs)
+                req.complete(error)
+                n += 1
+            return n

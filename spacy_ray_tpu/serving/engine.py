@@ -1,0 +1,430 @@
+"""Online inference engine: device-resident params, a compiled-program
+warmup sweep over the (B, T) padding buckets, and ONE dispatch thread
+executing coalesced batches through the ``predict_docs`` path.
+
+Why one thread: under jit every distinct (B, T) is one cached XLA
+program; a single dispatcher serializes device access (no interpreter-
+level contention on the params or the jit cache) while the
+ThreadingHTTPServer handler threads do the embarrassingly parallel host
+work (tokenization, JSON). That is the same host/device split the
+training loop uses (collation pool feeds one device thread,
+training/collate_pool.py) — serving reuses the split rather than
+inventing a second concurrency model.
+
+Warmup (:func:`warmup_buckets`) compiles the forward program for every
+bucket shape the admission rules can produce, so steady-state serving
+never pays a compile on a live request — the same reasoning as the
+trainer's shape bucketing (SURVEY.md §7), and the bucket tables are the
+trainer's own (``training/batcher.py``). ``bench.py --serving`` imports
+the same sweep, so load tests exercise exactly the warmed shapes.
+
+Telemetry is a nullable :class:`ServingTelemetry` facade over
+``training/telemetry.py``'s registry + trace buffer: request-latency
+histograms (p50/p95/p99), queue-depth and batch-occupancy gauges,
+reject/timeout counters, per-request and per-batch trace spans. When
+disabled the engine holds None and makes ZERO telemetry calls — the
+contract the training loop enforces, test-enforced here too.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..training.batcher import (
+    DEFAULT_LENGTH_BUCKETS,
+    bucket_batch_size,
+    bucket_length,
+)
+from ..training.resilience import log_event
+from .batcher import (
+    DeadlineExceeded,
+    DynamicBatcher,
+    Draining,
+    RequestTooLarge,
+    ServeRequest,
+    ServingError,
+)
+
+__all__ = [
+    "ServingTelemetry",
+    "InferenceEngine",
+    "warmup_buckets",
+    "SERVING_DEFAULTS",
+]
+
+# One place for the serving knob defaults: the CLI, the bench load specs,
+# and the tests read these — a bench that "agrees with serve" must not
+# restate numbers that can drift.
+SERVING_DEFAULTS: Dict[str, Any] = {
+    "max_batch_docs": 16,
+    "max_wait_s": 0.005,
+    "max_queue_docs": 128,
+    "timeout_s": 10.0,
+    "max_doc_len": 64,
+}
+
+
+def warmup_buckets(
+    max_batch_docs: int,
+    max_doc_len: int,
+    length_buckets: Sequence[int] = DEFAULT_LENGTH_BUCKETS,
+) -> List[Tuple[int, int]]:
+    """The (B, T) grid admission can produce: batch buckets from the
+    trainer's ``bucket_batch_size`` chain up to the padded max batch,
+    and EVERY length bucket ``bucket_length`` can emit for a doc of
+    1..max_doc_len tokens — table buckets up to the cap plus, beyond the
+    table's top, each multiple of the top bucket (that is
+    ``bucket_length``'s overflow rule). Completeness is the contract: a
+    live request must never meet a shape this sweep did not compile.
+    Shared by the engine's warmup sweep and ``bench.py --serving`` so
+    warmup and load tests agree on shapes by construction."""
+    b_cap = bucket_batch_size(int(max_batch_docs))
+    t_cap = bucket_length(int(max_doc_len), length_buckets)
+    bs: List[int] = []
+    b = 1
+    while b <= b_cap:
+        bs.append(bucket_batch_size(b))
+        b = bucket_batch_size(b) + 1
+    top = length_buckets[-1]
+    ts = {b for b in length_buckets if b <= t_cap}
+    m = 2 * top
+    while m <= t_cap:  # overflow region: multiples of the top bucket
+        ts.add(m)
+        m += top
+    ts.add(t_cap)
+    return [(b, t) for b in bs for t in sorted(ts)]
+
+
+class ServingTelemetry:
+    """Serving's SLO surface over the shared registry/trace primitives.
+
+    Instruments (resolved once, observed per request/batch):
+
+    * ``request_latency_seconds`` histogram — admission to completion,
+      the SLO number; p50/p95/p99 come from the shared nearest-rank
+      percentile convention (one implementation, telemetry.py).
+    * ``queue_wait_seconds`` histogram — admission to dispatch pickup.
+    * ``batch_occupancy`` histogram + ``last_batch_occupancy`` gauge —
+      docs per dispatched device batch; occupancy ≈ 1 under load means
+      coalescing is broken (N serial batches of 1).
+    * ``queue_depth`` gauge, ``requests``/``docs``/``batches`` counters,
+      and one counter per typed reject (``rejected_queue_full``,
+      ``rejected_draining``, ``deadline_exceeded``, ``errors``).
+    * trace: one span per batch (cat ``serve``) with occupancy/B/T args,
+      one span per request (admission → completion) on the caller's
+      track, an instant per reject.
+    """
+
+    def __init__(
+        self,
+        *,
+        clock: Callable[[], float] = time.perf_counter,
+        process_index: int = 0,
+        trace_max_events: int = 100_000,
+    ) -> None:
+        from ..training.telemetry import MetricsRegistry, TraceBuffer
+
+        self.registry = MetricsRegistry(clock=clock)
+        self.trace = TraceBuffer(
+            clock=clock, pid=int(process_index), max_events=trace_max_events
+        )
+        self._latency = self.registry.histogram("request_latency_seconds", 2048)
+        self._queue_wait = self.registry.histogram("queue_wait_seconds", 2048)
+        self._occupancy = self.registry.histogram("batch_occupancy", 1024)
+        self._queue_depth = self.registry.gauge("queue_depth")
+        self._last_occ = self.registry.gauge("last_batch_occupancy")
+        self._requests = self.registry.counter("requests")
+        self._docs = self.registry.counter("docs")
+        self._batches = self.registry.counter("batches")
+        self._rej_full = self.registry.counter("rejected_queue_full")
+        self._rej_drain = self.registry.counter("rejected_draining")
+        self._deadline = self.registry.counter("deadline_exceeded")
+        self._errors = self.registry.counter("errors")
+
+    def now(self) -> float:
+        return self.trace.now()
+
+    def request_admitted(self, n_docs: int, queue_depth: int) -> None:
+        self._requests.inc()
+        self._docs.inc(n_docs)
+        self._queue_depth.set(queue_depth)
+
+    def request_rejected(self, error: ServingError) -> None:
+        if isinstance(error, Draining):
+            self._rej_drain.inc()
+        elif isinstance(error, DeadlineExceeded):
+            self._deadline.inc()
+        elif isinstance(error, ServingError) and error.code == "queue_full":
+            self._rej_full.inc()
+        else:
+            self._errors.inc()
+        self.trace.add_instant(
+            f"reject:{error.code}", cat="serve", args={"error": str(error)}
+        )
+
+    def request_completed(
+        self,
+        *,
+        latency_s: float,
+        queue_wait_s: Optional[float],
+        t0: Optional[float],
+        error: Optional[ServingError],
+    ) -> None:
+        if error is not None:
+            self.request_rejected(error)
+        else:
+            self._latency.observe(latency_s)
+            if queue_wait_s is not None:
+                self._queue_wait.observe(queue_wait_s)
+        if t0 is not None:
+            self.trace.add_span(
+                "request",
+                t0,
+                max(self.now() - t0, 0.0),
+                cat="serve",
+                args={"error": error.code if error is not None else None},
+            )
+
+    def batch_span(self, occupancy: int, B: int, T: int):
+        self._batches.inc()
+        self._occupancy.observe(occupancy)
+        self._last_occ.set(occupancy)
+        return self.trace.span(
+            "serve_batch", cat="serve", occupancy=occupancy, B=B, T=T
+        )
+
+    def set_queue_depth(self, depth: int) -> None:
+        self._queue_depth.set(depth)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /metrics payload: registry snapshot + the SLO percentiles
+        (p50/p95/p99 over the rolling latency window, seconds)."""
+        snap = self.registry.snapshot()
+        snap["slo"] = {
+            "request_latency_p50": self._latency.percentile(0.50),
+            "request_latency_p95": self._latency.percentile(0.95),
+            "request_latency_p99": self._latency.percentile(0.99),
+            "batch_occupancy_p50": self._occupancy.percentile(0.50),
+        }
+        return snap
+
+
+class InferenceEngine:
+    """Owns the pipeline + device params and the dispatch thread.
+
+    ``submit_texts``/``submit_docs`` run on caller (HTTP handler)
+    threads: tokenize, admission-check, enqueue, block until the
+    dispatch thread completes the request (or a typed error says why
+    not). The dispatch thread coalesces via :class:`DynamicBatcher` and
+    executes ONE ``predict_docs`` call per coalesced batch with the
+    padded bucket pinned explicitly — exactly a warmed shape.
+    """
+
+    def __init__(
+        self,
+        nlp,
+        *,
+        max_batch_docs: int = SERVING_DEFAULTS["max_batch_docs"],
+        max_wait_s: float = SERVING_DEFAULTS["max_wait_s"],
+        max_queue_docs: int = SERVING_DEFAULTS["max_queue_docs"],
+        timeout_s: float = SERVING_DEFAULTS["timeout_s"],
+        max_doc_len: int = SERVING_DEFAULTS["max_doc_len"],
+        telemetry: Optional[ServingTelemetry] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if nlp.params is None:
+            raise ValueError(
+                "serving needs an initialized/loaded pipeline (params are "
+                "None — load a trained model with Pipeline.from_disk)"
+            )
+        self.nlp = nlp
+        self.max_batch_docs = int(max_batch_docs)
+        self.max_doc_len = int(max_doc_len)
+        self.timeout_s = float(timeout_s)
+        self.tel = telemetry
+        self.clock = clock
+        self.batcher = DynamicBatcher(
+            max_queue_docs=max_queue_docs,
+            max_batch_docs=max_batch_docs,
+            max_wait_s=max_wait_s,
+            clock=clock,
+        )
+        self._thread: Optional[threading.Thread] = None
+        self._state_lock = threading.Lock()
+        self._idle = threading.Condition(self._state_lock)
+        self._active_batches = 0
+        self._started = False
+        self.warmed: List[Tuple[int, int]] = []
+
+    # -- lifecycle ------------------------------------------------------
+    def warmup(self) -> List[Tuple[int, int]]:
+        """Compile the forward program for every admissible bucket shape
+        (synthetic docs, one ``predict_docs`` per (B, T)); returns the
+        swept grid. Runs on the calling thread BEFORE dispatch starts,
+        so the jit cache is never touched concurrently."""
+        from ..pipeline.doc import Doc
+
+        grid = warmup_buckets(
+            self.max_batch_docs, self.max_doc_len, self.nlp.length_buckets
+        )
+        for B, T in grid:
+            docs = [Doc(words=["the"] * T) for _ in range(B)]
+            self.nlp.predict_docs(
+                docs, batch_size=B, pad_batch_to=B, pad_len_to=T
+            )
+        self.warmed = grid
+        return grid
+
+    def start(self, *, warmup: bool = True) -> "InferenceEngine":
+        if self._started:
+            return self
+        if warmup:
+            self.warmup()
+        self._started = True
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="serve-dispatch", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    # -- submission (handler threads) -----------------------------------
+    def submit_texts(
+        self, texts: Sequence[str], timeout_s: Optional[float] = None
+    ) -> ServeRequest:
+        docs = [self.nlp.tokenizer(t) for t in texts]
+        return self.submit_docs(docs, timeout_s=timeout_s)
+
+    def submit_docs(
+        self, docs: List[Any], timeout_s: Optional[float] = None
+    ) -> ServeRequest:
+        timeout = self.timeout_s if timeout_s is None else float(timeout_s)
+        too_long = [i for i, d in enumerate(docs) if len(d) > self.max_doc_len]
+        if too_long:
+            err: ServingError = RequestTooLarge(
+                f"doc(s) {too_long} exceed max_doc_len={self.max_doc_len} "
+                "tokens (the warmed shape cap) — split or truncate"
+            )
+            if self.tel is not None:
+                self.tel.request_rejected(err)
+            raise err
+        now = self.clock()
+        req = ServeRequest(docs, deadline=now + timeout, enqueued_at=now)
+        t0 = self.tel.now() if self.tel is not None else None
+        try:
+            self.batcher.submit(req)
+        except ServingError as e:
+            if self.tel is not None:
+                self.tel.request_rejected(e)
+            raise
+        if self.tel is not None:
+            self.tel.request_admitted(len(docs), self.batcher.queue_depth())
+        # +grace so the dispatch thread (which owns deadline accounting)
+        # is the one that times the request out, not this wait
+        req.wait(timeout + 1.0)
+        latency = self.clock() - req.enqueued_at
+        queue_wait = (
+            req.started_at - req.enqueued_at
+            if req.started_at is not None
+            else None
+        )
+        if not req.done:
+            err = DeadlineExceeded(
+                f"request not completed within {timeout:.3f}s"
+            )
+            if self.tel is not None:
+                self.tel.request_completed(
+                    latency_s=latency, queue_wait_s=queue_wait, t0=t0, error=err
+                )
+            raise err
+        if self.tel is not None:
+            self.tel.request_completed(
+                latency_s=latency,
+                queue_wait_s=queue_wait,
+                t0=t0,
+                error=req.error,
+            )
+        if req.error is not None:
+            raise req.error
+        return req  # docs annotated in place; batch_info says how it ran
+
+    # -- dispatch (one thread) ------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self.batcher.next_batch()
+            if batch is None:
+                return
+            if not batch:
+                continue
+            with self._state_lock:
+                self._active_batches += 1
+            try:
+                self._run_batch(batch)
+            finally:
+                with self._state_lock:
+                    self._active_batches -= 1
+                    self._idle.notify_all()
+
+    def _run_batch(self, requests: List[ServeRequest]) -> None:
+        docs = [d for r in requests for d in r.docs]
+        n = len(docs)
+        B = bucket_batch_size(n)
+        T = bucket_length(
+            max((len(d) for d in docs), default=1), self.nlp.length_buckets
+        )
+        try:
+            if self.tel is not None:
+                with self.tel.batch_span(n, B, T):
+                    self.nlp.predict_docs(
+                        docs, batch_size=n, pad_batch_to=B, pad_len_to=T
+                    )
+                self.tel.set_queue_depth(self.batcher.queue_depth())
+            else:
+                self.nlp.predict_docs(
+                    docs, batch_size=n, pad_batch_to=B, pad_len_to=T
+                )
+        except Exception as e:  # a poisoned batch must not kill the server
+            log_event(
+                "serve-batch-failed",
+                f"dispatch of {n} docs (B={B}, T={T}) failed: "
+                f"{type(e).__name__}: {e}",
+                occupancy=n,
+            )
+            err = ServingError(f"inference failed: {type(e).__name__}: {e}")
+            for r in requests:
+                r.batch_info = {"occupancy": n, "B": B, "T": T}
+                r.complete(err)
+            return
+        for r in requests:
+            r.batch_info = {"occupancy": n, "B": B, "T": T}
+            r.complete()
+
+    # -- drain / stop ----------------------------------------------------
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful drain: stop admitting, finish every queued and
+        in-flight batch, stop the dispatch thread. Returns True when the
+        queue fully drained within the timeout (False = gave up; callers
+        escalate to :meth:`stop`)."""
+        self.batcher.begin_drain()
+        deadline = time.monotonic() + float(timeout_s)
+        with self._idle:
+            while (
+                self.batcher.queue_depth() > 0 or self._active_batches > 0
+            ):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(timeout=min(remaining, 0.1))
+        self.stop()
+        return True
+
+    def stop(self) -> None:
+        """Hard stop: close the batcher (failing anything still queued)
+        and join the dispatch thread."""
+        self.batcher.close()
+        self.batcher.fail_all_queued(Draining("server shut down"))
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._started = False

@@ -1,0 +1,267 @@
+"""HTTP front-end for the serving engine: a stdlib ``ThreadingHTTPServer``
+JSON API plus the graceful-drain orchestration.
+
+Endpoints:
+
+* ``POST /v1/parse`` — body ``{"texts": [...], "timeout_ms": optional}``;
+  response ``{"docs": [...], "batch": {"occupancy", "B", "T"}}`` with
+  docs in the same JSON schema the bulk ``parse`` CLI writes
+  (``training/corpus._doc_to_json`` — one schema for offline and online
+  output). Typed serving errors map to HTTP statuses: 429 queue full,
+  503 draining, 504 deadline, 413 too large, 400 malformed.
+* ``GET /healthz`` — 200 ``{"status": "ok"}`` while serving, 503
+  ``{"status": "draining"}`` once shutdown began (a load balancer's
+  take-me-out signal).
+* ``GET /metrics`` — the :class:`~.engine.ServingTelemetry` snapshot
+  (counters/gauges + latency p50/p95/p99); with telemetry disabled it
+  reports ``{"telemetry": "disabled"}`` and touches nothing.
+
+Graceful drain reuses the trainer's step-boundary-drain semantics
+(``training/resilience.ShutdownCoordinator``): SIGTERM/SIGINT set a flag
+(plus a callback that trips the admission gate immediately), the main
+thread then 1) rejects new admissions, 2) waits for every queued and
+in-flight batch to complete — the serving analog of "finish the step,
+then checkpoint" — and 3) stops the listener and exits 0. A drain that
+exceeds the timeout escalates to a hard stop with a nonzero exit, the
+same honest-failure contract the trainer's escalation path keeps.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from ..training.resilience import ShutdownCoordinator, log_event
+from .batcher import Draining, ServingError
+from .engine import InferenceEngine, ServingTelemetry
+
+__all__ = ["ServingHTTPServer", "Server"]
+
+logger = logging.getLogger("spacy_ray_tpu.serving")
+
+MAX_BODY_BYTES = 8 << 20  # an 8 MiB text payload is an abuse, not a parse
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    """One handler thread per connection; handlers do host-side work
+    (JSON, tokenization) and block in ``engine.submit_*`` — the device
+    never sees more than the one dispatch thread."""
+
+    daemon_threads = True
+
+    def __init__(
+        self,
+        addr: Tuple[str, int],
+        engine: InferenceEngine,
+        telemetry: Optional[ServingTelemetry] = None,
+    ) -> None:
+        super().__init__(addr, _Handler)
+        self.engine = engine
+        self.tel = telemetry
+        self.draining = False
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: ServingHTTPServer
+
+    # stdlib default logs every request to stderr; route to the logger so
+    # production stderr stays signal, not access-log noise
+    def log_message(self, fmt: str, *args: Any) -> None:
+        logger.debug("%s " + fmt, self.address_string(), *args)
+
+    def _reply(self, status: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode("utf8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_error(self, err: ServingError) -> None:
+        self._reply(
+            err.http_status, {"error": err.code, "message": str(err)}
+        )
+
+    # -- GET ------------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler naming)
+        if self.path == "/healthz":
+            if self.server.draining:
+                self._reply(503, {"status": "draining"})
+            else:
+                self._reply(
+                    200,
+                    {
+                        "status": "ok",
+                        "pipeline": list(self.server.engine.nlp.pipe_names),
+                        "warmed_buckets": len(self.server.engine.warmed),
+                        "max_batch_docs": self.server.engine.max_batch_docs,
+                        "max_doc_len": self.server.engine.max_doc_len,
+                    },
+                )
+        elif self.path == "/metrics":
+            tel = self.server.tel
+            if tel is None:
+                self._reply(200, {"telemetry": "disabled"})
+            else:
+                from ..training.telemetry import sanitize_json
+
+                self._reply(200, sanitize_json(tel.snapshot()))
+        else:
+            self._reply(404, {"error": "not_found", "message": self.path})
+
+    # -- POST -----------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = -1
+        if length < 0 or length > MAX_BODY_BYTES:
+            # body not consumed: the connection must close, or its bytes
+            # would be parsed as the next keep-alive request
+            self.close_connection = True
+            self._reply(
+                400,
+                {
+                    "error": "bad_request",
+                    "message": f"Content-Length must be 0..{MAX_BODY_BYTES}",
+                },
+            )
+            return
+        body = self.rfile.read(length)  # consume BEFORE any early reply:
+        # an unread body desyncs every later request on this connection
+        if self.path != "/v1/parse":
+            self._reply(404, {"error": "not_found", "message": self.path})
+            return
+        if self.server.draining:
+            self._reply_error(Draining("server is draining"))
+            return
+        try:
+            payload = json.loads(body or b"{}")
+        except ValueError:
+            self._reply(
+                400, {"error": "bad_request", "message": "body is not JSON"}
+            )
+            return
+        texts = payload.get("texts") if isinstance(payload, dict) else None
+        if (
+            not isinstance(texts, list)
+            or not texts
+            or not all(isinstance(t, str) for t in texts)
+        ):
+            self._reply(
+                400,
+                {
+                    "error": "bad_request",
+                    "message": 'body must be {"texts": [<non-empty list of '
+                    'strings>], "timeout_ms": optional int}',
+                },
+            )
+            return
+        timeout_s: Optional[float] = None
+        if isinstance(payload.get("timeout_ms"), (int, float)):
+            timeout_s = max(float(payload["timeout_ms"]) / 1000.0, 1e-3)
+        from ..training.corpus import _doc_to_json
+
+        try:
+            req = self.server.engine.submit_texts(texts, timeout_s=timeout_s)
+        except ServingError as e:
+            self._reply_error(e)
+            return
+        self._reply(
+            200,
+            {"docs": [_doc_to_json(d) for d in req.docs], "batch": req.batch_info},
+        )
+
+
+class Server:
+    """Lifecycle orchestration: start the listener, wait for a shutdown
+    request (signal or programmatic), drain gracefully, exit.
+
+    ``run()`` is the CLI path (installs SIGTERM/SIGINT handlers);
+    ``start()`` + ``request_shutdown()`` + ``wait()`` is the in-process
+    test path — same drain code either way.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        *,
+        telemetry: Optional[ServingTelemetry] = None,
+        drain_timeout_s: float = 30.0,
+    ) -> None:
+        self.engine = engine
+        self.tel = telemetry
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.httpd = ServingHTTPServer((host, port), engine, telemetry)
+        self._stop = threading.Event()
+        self._serve_thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        host, port = self.httpd.server_address[:2]
+        return str(host), int(port)
+
+    def start(self) -> Tuple[str, int]:
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="serve-http",
+            daemon=True,
+        )
+        self._serve_thread.start()
+        return self.address
+
+    def request_shutdown(self, signum: Optional[int] = None) -> None:
+        """Safe from a signal handler: flag writes and an Event set only
+        — no locks. The batcher's own drain gate (a Condition under a
+        non-reentrant lock) is tripped by ``wait`` on the waiting
+        thread; taking it HERE could self-deadlock if a second signal
+        lands while that thread holds the lock (e.g. k8s re-signalling
+        mid-drain). The HTTP admission gate (``draining``) still flips
+        instantly, so new requests 503 from the first signal on."""
+        self.httpd.draining = True
+        self._stop.set()
+
+    def wait(self) -> int:
+        """Block until shutdown is requested, then drain. Returns the
+        process exit code: 0 for a clean drain, 1 when in-flight work
+        had to be abandoned at the timeout."""
+        self._stop.wait()
+        self.httpd.draining = True
+        self.engine.batcher.begin_drain()
+        log_event(
+            "serve-drain",
+            "shutdown requested — draining "
+            f"{self.engine.batcher.queue_depth()} queued doc(s)",
+            level=logging.INFO,
+        )
+        clean = self.engine.drain(self.drain_timeout_s)
+        if not clean:
+            log_event(
+                "serve-drain-timeout",
+                f"drain exceeded {self.drain_timeout_s:.1f}s — hard stop",
+            )
+            self.engine.stop()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        return 0 if clean else 1
+
+    def run(self, *, banner: bool = True) -> int:
+        coordinator = ShutdownCoordinator()
+        coordinator.add_callback(self.request_shutdown)
+        coordinator.install()
+        try:
+            host, port = self.start()
+            if banner:
+                # exact, parseable line: the drain subprocess test (and
+                # any operator script) reads the bound port from it
+                print(f"serving on http://{host}:{port}", flush=True)
+            return self.wait()
+        finally:
+            coordinator.restore()

@@ -141,11 +141,25 @@ class ShutdownCoordinator:
         self._signum: Optional[int] = None
         self._prev: Dict[int, Any] = {}
         self._installed = False
+        self._callbacks: List[Callable[[Optional[int]], Any]] = []
 
     # -- flag --------------------------------------------------------
+    def add_callback(self, fn: Callable[[Optional[int]], Any]) -> None:
+        """Register a hook fired from :meth:`request` (i.e. from the
+        signal handler) — it must be async-signal-safe in practice: set
+        an Event, flip a flag, never block. The serving front-end uses
+        this to trip its drain gate the instant SIGTERM lands instead of
+        waiting for the next admission poll."""
+        self._callbacks.append(fn)
+
     def request(self, signum: Optional[int] = None) -> None:
         self._signum = signum
         self._flag.set()
+        for cb in self._callbacks:
+            try:
+                cb(signum)
+            except Exception:  # a broken hook must not break the handler
+                pass
 
     @property
     def requested(self) -> bool:

@@ -168,6 +168,9 @@ class _Histogram:
             "max": mx,
             "p50": _nearest_rank(samples, 0.5),
             "p95": _nearest_rank(samples, 0.95),
+            # tail percentile the serving SLO surface reads; same rolling
+            # window and nearest-rank convention as p50/p95
+            "p99": _nearest_rank(samples, 0.99),
         }
 
 

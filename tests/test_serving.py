@@ -1,0 +1,533 @@
+"""Online serving subsystem (spacy_ray_tpu/serving/): dynamic batcher
+admission/coalescing/deadlines, engine warmup + dispatch correctness
+under concurrent load (responses == single-request predict_docs, and
+occupancy > 1 proves coalescing), HTTP API surface, SIGTERM graceful
+drain in a real subprocess, the telemetry-disabled zero-calls contract,
+and the bench.py --serving load spec's session records."""
+
+import json
+import http.client
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))  # for `import bench`
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.pipeline.language import Pipeline
+from spacy_ray_tpu.serving import (
+    DeadlineExceeded,
+    Draining,
+    DynamicBatcher,
+    InferenceEngine,
+    QueueFull,
+    RequestTooLarge,
+    Server,
+    ServeRequest,
+    ServingTelemetry,
+    warmup_buckets,
+)
+from spacy_ray_tpu.util import synth_corpus
+
+SERVE_CFG = """
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger"]
+
+[components]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 64
+depth = 2
+embed_size = 512
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 64
+"""
+
+TEXTS = [
+    "the cat runs fast today",
+    "a dog sleeps near the door",
+    "birds sing loudly in the morning",
+    "the quick brown fox jumps high",
+    "a lazy dog naps all afternoon",
+    "rain falls softly on the roof",
+    "the child reads an old book",
+    "wind moves through the tall trees",
+    "a boat drifts down the river",
+    "stars shine over the quiet town",
+]
+
+
+def _post(host, port, payload, timeout=30.0):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        body = json.dumps(payload).encode("utf8")
+        conn.request(
+            "POST", "/v1/parse", body, {"Content-Type": "application/json"}
+        )
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _get(host, port, path, timeout=10.0):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+# ----------------------------------------------------------------------
+# DynamicBatcher: admission, coalescing, deadlines, drain
+# ----------------------------------------------------------------------
+
+
+def _req(n_docs=1, deadline_in=10.0, clock=time.monotonic):
+    now = clock()
+    return ServeRequest(["d"] * n_docs, deadline=now + deadline_in, enqueued_at=now)
+
+
+def test_batcher_rejects_when_queue_full():
+    b = DynamicBatcher(max_queue_docs=4, max_batch_docs=4, max_wait_s=0.0)
+    b.submit(_req(3))
+    with pytest.raises(QueueFull):
+        b.submit(_req(2))
+    assert b.rejected_full == 1
+    b.submit(_req(1))  # exactly at the limit is admitted
+
+
+def test_batcher_rejects_oversized_request():
+    b = DynamicBatcher(max_queue_docs=8, max_batch_docs=4, max_wait_s=0.0)
+    with pytest.raises(RequestTooLarge):
+        b.submit(_req(5))
+
+
+def test_batcher_drain_rejects_new_but_serves_queued():
+    b = DynamicBatcher(max_queue_docs=8, max_batch_docs=4, max_wait_s=0.0)
+    queued = _req(2)
+    b.submit(queued)
+    b.begin_drain()
+    with pytest.raises(Draining):
+        b.submit(_req(1))
+    assert b.rejected_draining == 1
+    batch = b.next_batch()
+    assert batch == [queued]  # admitted-before-drain still dispatches
+
+
+def test_batcher_expired_request_completed_not_dispatched():
+    b = DynamicBatcher(max_queue_docs=8, max_batch_docs=4, max_wait_s=0.0)
+    dead = _req(1, deadline_in=-0.5)  # already past its deadline
+    live = _req(1)
+    b.submit(dead)
+    b.submit(live)
+    batch = b.next_batch()
+    assert batch == [live]
+    assert dead.done and isinstance(dead.error, DeadlineExceeded)
+    assert b.expired == 1
+
+
+def test_batcher_coalesces_within_window():
+    b = DynamicBatcher(max_queue_docs=32, max_batch_docs=8, max_wait_s=0.25)
+    for _ in range(3):
+        b.submit(_req(2))
+    t0 = time.monotonic()
+    batch = b.next_batch()
+    assert sum(len(r.docs) for r in batch) == 6
+    # full-batch early exit: 6 < 8 so the window ran — but queued
+    # requests were all there at entry, so the first pop got them
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_batcher_full_batch_skips_wait():
+    b = DynamicBatcher(max_queue_docs=32, max_batch_docs=4, max_wait_s=30.0)
+    b.submit(_req(2))
+    b.submit(_req(2))
+    t0 = time.monotonic()
+    batch = b.next_batch()
+    # a full batch must dispatch immediately, not sit out max_wait_s
+    assert time.monotonic() - t0 < 5.0
+    assert sum(len(r.docs) for r in batch) == 4
+
+
+def test_batcher_close_unblocks_dispatcher():
+    b = DynamicBatcher(max_queue_docs=8, max_batch_docs=4, max_wait_s=0.0)
+    got = []
+    th = threading.Thread(target=lambda: got.append(b.next_batch()))
+    th.start()
+    b.close()
+    th.join(timeout=5.0)
+    assert got == [None]
+
+
+def test_warmup_bucket_grid_uses_trainer_tables():
+    grid = warmup_buckets(8, 32, (16, 32, 64))
+    assert grid == [(1, 16), (1, 32), (2, 16), (2, 32), (4, 16), (4, 32),
+                    (8, 16), (8, 32)]
+    # caps round up through the trainer's own bucket functions
+    assert (16, 64) in warmup_buckets(12, 40, (16, 32, 64))
+
+
+def test_warmup_bucket_grid_is_complete_beyond_table_top():
+    """The warmed-shape contract: EVERY length bucket admission can
+    produce for a doc of 1..max_doc_len tokens is in the grid —
+    including the overflow region beyond the table's top bucket, where
+    bucket_length emits multiples of the top. A hole here is a live
+    mid-traffic XLA compile."""
+    from spacy_ray_tpu.training.batcher import bucket_length
+
+    buckets = (16, 32, 64)
+    grid_ts = {t for _, t in warmup_buckets(2, 1500, buckets)}
+    admissible = {bucket_length(n, buckets) for n in range(1, 1501)}
+    assert admissible <= grid_ts, sorted(admissible - grid_ts)
+
+
+# ----------------------------------------------------------------------
+# Engine + HTTP server
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_nlp():
+    nlp = Pipeline.from_config(Config.from_str(SERVE_CFG))
+    egs = synth_corpus(64, "tagger", seed=0)
+    nlp.initialize(lambda: iter(egs), seed=0)
+    return nlp
+
+
+@pytest.fixture(scope="module")
+def served(serve_nlp):
+    tel = ServingTelemetry()
+    engine = InferenceEngine(
+        serve_nlp,
+        max_batch_docs=8,
+        max_wait_s=0.05,
+        max_queue_docs=64,
+        timeout_s=30.0,
+        max_doc_len=32,
+        telemetry=tel,
+    )
+    engine.start(warmup=True)
+    server = Server(engine, "127.0.0.1", 0, telemetry=tel)
+    host, port = server.start()
+    yield engine, tel, host, port
+    server.request_shutdown()
+    assert server.wait() == 0
+
+
+def test_concurrent_load_matches_single_request_and_coalesces(
+    served, serve_nlp
+):
+    """Acceptance: N>=8 concurrent clients through the HTTP API; every
+    response equals the single-request predict_docs output, and recorded
+    occupancy > 1 proves the requests shared device batches instead of
+    running as N serial batches of 1."""
+    engine, tel, host, port = served
+    n_clients = 10
+    barrier = threading.Barrier(n_clients)
+    results = [None] * n_clients
+
+    def client(i):
+        barrier.wait()  # release all clients at once: coalescing window
+        results[i] = _post(host, port, {"texts": [TEXTS[i % len(TEXTS)]]})
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+
+    assert all(r is not None and r[0] == 200 for r in results), results
+    occupancies = [r[1]["batch"]["occupancy"] for r in results]
+    assert max(occupancies) > 1, (
+        f"no coalescing happened: occupancies {occupancies}"
+    )
+    # single-request ground truth, computed after the load so the jit
+    # cache is only ever touched by one thread at a time
+    for i, (status, payload) in enumerate(results):
+        doc = serve_nlp.tokenizer(TEXTS[i % len(TEXTS)])
+        serve_nlp.predict_docs([doc])
+        [got] = payload["docs"]
+        assert got["tokens"] == doc.words
+        assert got["tags"] == doc.tags, (
+            f"batched response diverged from single-request predict for "
+            f"text {i}: {got['tags']} != {doc.tags}"
+        )
+    # the telemetry surface saw the same story
+    occ_hist = tel.registry.histogram("batch_occupancy").snapshot()
+    assert occ_hist["max"] > 1
+    snap = tel.snapshot()
+    assert snap["slo"]["request_latency_p50"] is not None
+    assert snap["counters"]["requests"] >= n_clients
+
+
+def test_healthz_and_metrics_endpoints(served):
+    _, _, host, port = served
+    status, health = _get(host, port, "/healthz")
+    assert status == 200
+    assert health["status"] == "ok"
+    assert health["pipeline"] == ["tok2vec", "tagger"]
+    assert health["warmed_buckets"] == 8  # (1|2|4|8) x (16|32)
+    status, metrics = _get(host, port, "/metrics")
+    assert status == 200
+    assert {"counters", "gauges", "histograms", "slo"} <= set(metrics)
+    assert {"request_latency_p50", "request_latency_p95",
+            "request_latency_p99"} <= set(metrics["slo"])
+    status, _ = _get(host, port, "/nope")
+    assert status == 404
+
+
+def test_bad_requests_get_400(served):
+    _, _, host, port = served
+    assert _post(host, port, {"texts": []})[0] == 400
+    assert _post(host, port, {"texts": "not a list"})[0] == 400
+    assert _post(host, port, {})[0] == 400
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    try:
+        conn.request("POST", "/v1/parse", b"{not json",
+                     {"Content-Type": "application/json"})
+        assert conn.getresponse().status == 400
+    finally:
+        conn.close()
+
+
+def test_too_long_doc_rejected_413(served):
+    _, _, host, port = served
+    status, payload = _post(
+        host, port, {"texts": ["word " * 60]}  # 60 tokens > max_doc_len 32
+    )
+    assert status == 413
+    assert payload["error"] == "request_too_large"
+
+
+def test_request_deadline_maps_to_504(serve_nlp):
+    """A deadline shorter than the coalescing window must come back as a
+    typed 504, not hang: the dispatcher completes expired requests
+    before spending device time."""
+    engine = InferenceEngine(
+        serve_nlp,
+        max_batch_docs=4,
+        max_wait_s=0.3,
+        timeout_s=30.0,
+        max_doc_len=32,
+    )
+    engine.start(warmup=False)  # shapes already compiled by other tests
+    server = Server(engine, "127.0.0.1", 0)
+    host, port = server.start()
+    try:
+        status, payload = _post(
+            host, port, {"texts": ["the cat"], "timeout_ms": 1}
+        )
+        assert status == 504
+        assert payload["error"] == "deadline_exceeded"
+    finally:
+        server.request_shutdown()
+        assert server.wait() == 0
+
+
+def test_draining_server_rejects_with_503(serve_nlp):
+    engine = InferenceEngine(
+        serve_nlp, max_batch_docs=4, max_wait_s=0.0, max_doc_len=32
+    )
+    engine.start(warmup=False)
+    server = Server(engine, "127.0.0.1", 0)
+    host, port = server.start()
+    server.httpd.draining = True  # gate flips before the drain completes
+    status, payload = _post(host, port, {"texts": ["the cat"]})
+    assert status == 503
+    assert payload["error"] == "draining"
+    status, health = _get(host, port, "/healthz")
+    assert status == 503 and health["status"] == "draining"
+    server.request_shutdown()
+    assert server.wait() == 0
+
+
+def test_disabled_telemetry_makes_zero_calls(serve_nlp, monkeypatch):
+    """The training loop's contract, enforced for serving too: with no
+    ServingTelemetry, the engine/server construct NOTHING from
+    telemetry.py — any registry/trace construction raises."""
+    from spacy_ray_tpu.training import telemetry as telemetry_mod
+
+    def _boom(*a, **k):
+        raise AssertionError("telemetry constructed on the disabled path")
+
+    monkeypatch.setattr(telemetry_mod.MetricsRegistry, "__init__", _boom)
+    monkeypatch.setattr(telemetry_mod.TraceBuffer, "__init__", _boom)
+    engine = InferenceEngine(
+        serve_nlp, max_batch_docs=4, max_wait_s=0.01, max_doc_len=32
+    )
+    engine.start(warmup=False)
+    server = Server(engine, "127.0.0.1", 0)
+    host, port = server.start()
+    try:
+        status, payload = _post(host, port, {"texts": [TEXTS[0]]})
+        assert status == 200
+        assert payload["docs"][0]["tags"]
+        status, metrics = _get(host, port, "/metrics")
+        assert status == 200 and metrics == {"telemetry": "disabled"}
+    finally:
+        server.request_shutdown()
+        assert server.wait() == 0
+
+
+# ----------------------------------------------------------------------
+# Graceful drain: SIGTERM against a real `serve` subprocess
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model_dir(serve_nlp, tmp_path_factory):
+    out = tmp_path_factory.mktemp("serve_model") / "model"
+    serve_nlp.to_disk(out)
+    return out
+
+
+def test_sigterm_graceful_drain_subprocess(model_dir):
+    """Acceptance: SIGTERM mid-load completes the in-flight request,
+    rejects new admissions, and the process exits 0. The in-flight
+    request is HELD in the coalescing window (max_wait 600ms) when the
+    signal lands, so the drain provably finishes admitted work."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "spacy_ray_tpu", "serve", str(model_dir),
+            "--device", "cpu", "--port", "0",
+            "--max-batch", "4", "--max-wait-ms", "600",
+            "--max-doc-len", "16", "--drain-timeout-s", "30",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
+    lines = []
+    addr = [None]
+
+    def reader():
+        for line in proc.stdout:
+            lines.append(line)
+            if line.startswith("serving on http://"):
+                hostport = line.strip().rsplit("/", 1)[-1]
+                host, port = hostport.rsplit(":", 1)
+                addr[0] = (host, int(port))
+
+    rt = threading.Thread(target=reader, daemon=True)
+    rt.start()
+    try:
+        deadline = time.monotonic() + 180.0
+        while addr[0] is None and time.monotonic() < deadline:
+            if proc.poll() is not None:
+                pytest.fail(f"serve exited early:\n{''.join(lines)}")
+            time.sleep(0.1)
+        assert addr[0] is not None, f"no banner:\n{''.join(lines)}"
+        host, port = addr[0]
+
+        status, health = _get(host, port, "/healthz", timeout=30.0)
+        assert status == 200 and health["status"] == "ok"
+
+        # in-flight request: sits in the 600ms coalescing window
+        inflight = {}
+
+        def one_request():
+            try:
+                inflight["result"] = _post(
+                    host, port, {"texts": ["the cat runs"]}, timeout=60.0
+                )
+            except Exception as e:  # noqa: BLE001 — recorded for the assert
+                inflight["result"] = e
+
+        t = threading.Thread(target=one_request)
+        t.start()
+        time.sleep(0.2)  # inside the window: admitted, not yet dispatched
+        proc.send_signal(signal.SIGTERM)
+
+        t.join(timeout=60.0)
+        result = inflight.get("result")
+        assert isinstance(result, tuple) and result[0] == 200, (
+            f"in-flight request not completed through the drain: {result!r}"
+        )
+        assert result[1]["docs"][0]["tags"]
+
+        # new admissions after SIGTERM: typed 503 or (post-exit) refused
+        try:
+            status, payload = _post(
+                host, port, {"texts": ["another request"]}, timeout=10.0
+            )
+            assert status == 503, (status, payload)
+        except OSError:
+            pass  # listener already closed — also a rejection
+
+        rc = proc.wait(timeout=60.0)
+        assert rc == 0, f"drain exit {rc}:\n{''.join(lines)}"
+        assert any("drained; exiting 0" in l for l in lines), lines
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+
+# ----------------------------------------------------------------------
+# bench.py --serving session records
+# ----------------------------------------------------------------------
+
+
+def test_bench_serving_appends_session_records(tmp_path, monkeypatch):
+    """Acceptance: --serving appends closed- and open-loop records with
+    req/s, occupancy, and p50/p95/p99 latency to BENCH_SESSION.jsonl."""
+    import bench
+
+    session = tmp_path / "session.jsonl"
+    monkeypatch.setattr(bench, "SESSION_FILE", session)
+    records = bench.run_serving(
+        "cpu", duration_s=0.6, clients=4, max_batch=4, max_wait_ms=3.0
+    )
+    assert [r["name"] for r in records] == ["serving_closed", "serving_open"]
+    on_disk = [json.loads(l) for l in session.read_text().splitlines()]
+    assert [r["name"] for r in on_disk] == ["serving_closed", "serving_open"]
+    for rec in on_disk:
+        assert rec["value"] > 0 and rec["unit"] == "req/s"
+        assert rec["requests_ok"] > 0
+        assert rec["latency_ms_p50"] is not None
+        assert rec["latency_ms_p95"] is not None
+        assert rec["latency_ms_p99"] is not None
+        assert rec["batches"] and rec["occupancy_mean"] is not None
+    closed, open_ = on_disk
+    assert closed["clients"] == 4
+    assert open_["offered_rps"] > 0
+
+
+@pytest.mark.slow
+def test_bench_serving_sustained_load(tmp_path, monkeypatch):
+    """Heavy open/closed-loop variant at the real default shape (16-doc
+    batches, 8 clients, 3s per loop) — the tier-2 version of the smoke
+    above; occupancy must exceed 1 under saturation or dynamic batching
+    is not actually batching."""
+    import bench
+
+    session = tmp_path / "session.jsonl"
+    monkeypatch.setattr(bench, "SESSION_FILE", session)
+    records = bench.run_serving("cpu", duration_s=3.0, clients=8)
+    closed = records[0]
+    assert closed["requests_ok"] >= 8
+    assert closed["occupancy_max"] > 1, closed

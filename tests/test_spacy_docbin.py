@@ -335,3 +335,79 @@ def test_spancat_trains_identically_from_jsonl_and_spacy(tmp_path):
     assert r_spacy.best_score == pytest.approx(r_jsonl.best_score, abs=1e-6), (
         f"jsonl {r_jsonl.best_score} vs .spacy {r_spacy.best_score}"
     )
+
+
+# ----------------------------------------------------------------------
+# Ground-truth fixtures (VERDICT r5 next #5): bytes NOT produced by this
+# repo's writer — an independent serializer (tests/fixtures/
+# make_groundtruth_docbin.py) modeling real-spaCy conventions the writer
+# never emits: high attr IDs at spaCy-3.x-scale positions (452/454/456,
+# not the writer's 84/85), the pre-3.4 legacy 6-field span layout, and
+# has_unknown_spaces with a spaces column still present. The parse is
+# PINNED: the positional attr-ID heuristic (spacy_docbin.py
+# _resolve_attr_names) is now anchored to a committed artifact instead
+# of trusted prose.
+# ----------------------------------------------------------------------
+
+FIXTURES = __import__("pathlib").Path(__file__).parent / "fixtures"
+
+
+def test_groundtruth_fixture_high_pair_pinned():
+    docs = list(SD.read_docbin(FIXTURES / "groundtruth_pair.spacy"))
+    assert len(docs) == 2
+    a, b = docs
+
+    # doc 1: every column, pinned
+    assert a.words == ["Ada", "Lovelace", "wrote", "programs", "."]
+    assert a.spaces == [True, True, True, False, False]
+    assert a.tags == ["NNP", "NNP", "VBD", "NNS", "."]
+    assert a.pos == ["PROPN", "PROPN", "VERB", "NOUN", "PUNCT"]
+    assert a.lemmas == ["Ada", "Lovelace", "write", "program", "."]
+    assert a.deps == ["compound", "nsubj", "ROOT", "dobj", "punct"]
+    assert a.heads == [1, 2, 2, 2, 2]
+    # tri-state SENT_START survives verbatim (-1 = explicitly not a start)
+    assert a.sent_starts == [1, -1, -1, -1, -1]
+    # MORPH resolved positionally from high ID 454 (NOT the writer's 85)
+    assert a.morphs == [
+        "Number=Sing", "Number=Sing", "Tense=Past|VerbForm=Fin",
+        "Number=Plur", "",
+    ]
+    assert a.cats == {"bio": 1.0}
+    [ent] = a.ents
+    assert (ent.start, ent.end, ent.label) == (0, 2, "PERSON")
+    # ENT_KB_ID resolved positionally from high ID 452
+    assert ent.kb_id == "Q7259"
+    assert a.ents_annotated is True
+
+    # doc 2: unknown spaces, missing annotations, legacy span layout
+    assert b.words == ["send", "help", "now"]
+    assert b.spaces is None  # has_unknown_spaces wins over the column
+    assert b.heads is None  # all-self deltas + empty DEP = no parse
+    assert b.ents == [] and b.ents_annotated is False
+    assert set(b.spans) == {"sc", "extra"}
+    sc = [(s.start, s.end, s.label, s.kb_id) for s in b.spans["sc"]]
+    assert sc == [(0, 2, "CMD", ""), (2, 3, "TIME", "")]  # 6-field legacy
+    [extra] = b.spans["extra"]
+    assert (extra.start, extra.end, extra.label, extra.kb_id) == (
+        1, 3, "X", "Q1",
+    )  # 7-field current layout in the same file
+
+
+def test_groundtruth_fixture_three_high_ids_pinned():
+    """Three IDs above the fixed enum resolve by enum order (ENT_KB_ID <
+    MORPH < ENT_ID) even without the default low-ID set present."""
+    [doc] = list(SD.read_docbin(FIXTURES / "groundtruth_3high.spacy"))
+    assert doc.words == ["Turing", "thinks"]
+    assert doc.morphs == ["Number=Sing", "Tense=Pres"]
+    [ent] = doc.ents
+    assert (ent.start, ent.end, ent.label, ent.kb_id) == (
+        0, 1, "PERSON", "Q7251",
+    )
+
+
+def test_groundtruth_fixture_trains_through_corpus(tmp_path):
+    """The fixture is usable end-to-end: Corpus loads it and collation
+    sees the gold (the satellite's 'artifact, not prose' criterion)."""
+    egs = list(Corpus(FIXTURES / "groundtruth_pair.spacy")())
+    assert len(egs) == 2
+    assert egs[0].reference.tags == ["NNP", "NNP", "VBD", "NNS", "."]
