@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry serving bench baseline profile dryrun
+.PHONY: test test-fast test-slow resilience telemetry serving bench baseline profile step-perf dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -34,6 +34,13 @@ baseline:
 
 profile:
 	python bin/profile_trf.py --sweep
+
+# per-step fixed-cost floor (PERF.md round 7): optimizer-update-only bench
+# (naive vs fused) + the MFU-vs-shape profile sweep. Compare two --trace
+# runs with: python bin/profile_trf.py --compare before.json after.json
+step-perf:
+	JAX_PLATFORMS=cpu python bench.py --update-only
+	JAX_PLATFORMS=cpu python bin/profile_trf.py --sweep
 
 dryrun:
 	python __graft_entry__.py

@@ -172,20 +172,17 @@ def _peak_flops_per_chip(platform: str) -> (float, str):
     return float(cache[cache_key]), f"measured matmul {dt} ({kind})"
 
 
-def _program_flops(update, params, opt_state, tokens, targets, rng,
-                   n_params: int, n_tokens: int) -> (Optional[float], str):
+def _program_flops(update, args, n_params: int, n_tokens: int) -> (Optional[float], str):
     """FLOPs of one compiled train step (fwd+bwd+optimizer), from XLA cost
     analysis of the lowered program (the shared telemetry path — the
     training loop's eval-boundary MFU gauge uses the same probe);
     analytical 6·params·tokens fallback (fwd 2ND + bwd 4ND; undercounts
-    attention — labeled as such)."""
+    attention — labeled as such). ``args`` is the update's full argument
+    tuple (it grows a shadow when the bf16-shadow spec is active)."""
     from spacy_ray_tpu.training.telemetry import program_flops
 
     reasons: List[str] = []
-    flops = program_flops(
-        update, params, opt_state, tokens, targets, rng,
-        on_error=reasons.append,
-    )
+    flops = program_flops(update, *args, on_error=reasons.append)
     if flops:
         return flops, "xla_cost_analysis"
     why = reasons[0] if reasons else "cost model reported zero flops"
@@ -368,6 +365,104 @@ def _configs(platform: str) -> List[Dict[str, Any]]:
             B=8, T=64, steps=10, warmup=1,
             attention=True,
             cpu_only=True,
+            timeout=3600.0,
+        ),
+        # Fixed-cost-floor A/B arms (PERF.md round 7): the same trf shapes
+        # with the fused optimizer update (+ bf16 shadow where the trunk
+        # computes in bf16 — on TPU via "auto"; the CPU arms stay f32, so
+        # their delta isolates the fused update). Records carry
+        # "fused_update"/"param_shadow" honest labels.
+        dict(
+            name="trf_fused",
+            metric="train_words_per_sec_per_chip (trf RoBERTa-base + tagger/parser/NER, fused optimizer update)",
+            cfg=INIT_PRESETS["trf"], kinds=["parser", "ner"],
+            B=4 if cpu else 16, T=32 if cpu else 128,
+            steps=10, warmup=2 if cpu else 3,
+            stages=None if cpu else [(4, 32), (8, 64)],
+            attention=True,
+            fused=True,
+            shadow="auto",  # active on a bf16-compute trunk (TPU), CPU: off
+            timeout=3600.0,
+        ),
+        dict(
+            name="trf_realistic_cpu_fused",
+            metric="train_words_per_sec_per_chip (trf RoBERTa-base, CPU-scaled realistic B=8/T=64, fused optimizer update)",
+            cfg=INIT_PRESETS["trf"], kinds=["parser", "ner"],
+            B=8, T=64, steps=10, warmup=1,
+            attention=True,
+            fused=True,
+            cpu_only=True,
+            timeout=3600.0,
+        ),
+        # steps_per_dispatch arms: K=4 compiled steps per host round-trip
+        # (bit-identical to K=1 — the delta is pure dispatch/inter-program
+        # overhead, the round-7 measured CPU win; on TPU it amortizes the
+        # host round-trip that idles the chip between steps)
+        dict(
+            name="trf_k4",
+            metric="train_words_per_sec_per_chip (trf RoBERTa-base + tagger/parser/NER, steps_per_dispatch=4)",
+            cfg=INIT_PRESETS["trf"], kinds=["parser", "ner"],
+            B=4 if cpu else 16, T=32 if cpu else 128,
+            steps=10, warmup=2 if cpu else 3,
+            attention=True,
+            dispatch=4,
+            timeout=3600.0,
+        ),
+        dict(
+            name="trf_realistic_cpu_k4",
+            metric="train_words_per_sec_per_chip (trf RoBERTa-base, CPU-scaled realistic B=8/T=64, steps_per_dispatch=4)",
+            cfg=INIT_PRESETS["trf"], kinds=["parser", "ner"],
+            B=8, T=64, steps=10, warmup=1,
+            attention=True,
+            dispatch=4,
+            cpu_only=True,
+            timeout=3600.0,
+        ),
+        # bf16-shadow CPU A/B pair: both arms PIN compute_dtype="bfloat16"
+        # (the dtype regime where the shadow acts; CPU "auto" is f32), so
+        # the shadow arm's delta isolates the disappearing per-step trunk
+        # cast. manual_only: round-7 evidence arms, run via
+        # --configs trf_bf16,trf_bf16_shadow — not part of every suite.
+        dict(
+            name="trf_bf16",
+            metric="train_words_per_sec_per_chip (trf RoBERTa-base, compute_dtype pinned bf16, cast-per-step)",
+            cfg=INIT_PRESETS["trf"], kinds=["parser", "ner"],
+            B=4, T=32, steps=10, warmup=2,
+            attention=True,
+            compute_dtype="bfloat16",
+            cpu_only=True, manual_only=True,
+            timeout=3600.0,
+        ),
+        dict(
+            name="trf_bf16_shadow",
+            metric="train_words_per_sec_per_chip (trf RoBERTa-base, compute_dtype pinned bf16, bf16 shadow + fused update)",
+            cfg=INIT_PRESETS["trf"], kinds=["parser", "ner"],
+            B=4, T=32, steps=10, warmup=2,
+            attention=True,
+            compute_dtype="bfloat16",
+            fused=True, shadow=True,
+            cpu_only=True, manual_only=True,
+            timeout=3600.0,
+        ),
+        dict(
+            name="trf_bf16_realistic",
+            metric="train_words_per_sec_per_chip (trf RoBERTa-base B=8/T=64, compute_dtype pinned bf16, cast-per-step)",
+            cfg=INIT_PRESETS["trf"], kinds=["parser", "ner"],
+            B=8, T=64, steps=10, warmup=1,
+            attention=True,
+            compute_dtype="bfloat16",
+            cpu_only=True, manual_only=True,
+            timeout=3600.0,
+        ),
+        dict(
+            name="trf_bf16_realistic_shadow",
+            metric="train_words_per_sec_per_chip (trf RoBERTa-base B=8/T=64, compute_dtype pinned bf16, bf16 shadow + fused update)",
+            cfg=INIT_PRESETS["trf"], kinds=["parser", "ner"],
+            B=8, T=64, steps=10, warmup=1,
+            attention=True,
+            compute_dtype="bfloat16",
+            fused=True, shadow=True,
+            cpu_only=True, manual_only=True,
             timeout=3600.0,
         ),
         # switch-MoE variant of the same trunk: the top-1 expert FFN path
@@ -565,6 +660,14 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
     from spacy_ray_tpu.registry import registry
 
     cfg_text = spec["cfg"]
+    if spec.get("compute_dtype"):
+        # pin the trunk's matmul dtype (the bf16-shadow A/B arms pin
+        # "bfloat16" on CPU, where "auto" resolves to f32)
+        anchor = '@architectures = "spacy_ray_tpu.TransformerEncoder.v1"'
+        assert anchor in cfg_text, f"{spec['name']} has no transformer trunk"
+        cfg_text = cfg_text.replace(
+            anchor, f'{anchor}\ncompute_dtype = "{spec["compute_dtype"]}"'
+        )
     n_chips = len(jax.devices())
     B = int(spec["B"])
     B = ((B + n_chips - 1) // n_chips) * n_chips
@@ -580,9 +683,69 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
 
     mesh = build_mesh(n_data=n_chips)
     tx = registry.get("optimizers", "Adam.v1")(learn_rate=0.001)
+    if spec.get("fused"):
+        from spacy_ray_tpu.training.optimizers import fuse_optimizer
+
+        tx = fuse_optimizer(tx)
+        assert tx is not None, "Adam.v1 must be fusable"
     params = place_replicated(nlp.params, mesh)
     opt_state = shard_opt_state(tx.init(params), mesh, zero1=False)
-    update = make_train_step(nlp.make_loss_fn(), tx, mesh, opt_state_template=opt_state)
+    shadow = None
+    if spec.get("shadow"):
+        # True = require a bf16-compute trunk; "auto" = enable where the
+        # trunk computes in bf16 (TPU), silently skip elsewhere (CPU f32)
+        from spacy_ray_tpu.models.transformer import (
+            build_param_shadow,
+            pipeline_shadow_dtype,
+        )
+
+        sdt = pipeline_shadow_dtype(nlp)
+        if sdt is None and spec["shadow"] != "auto":
+            raise AssertionError(
+                f"{spec['name']}: shadow spec needs a bf16-compute trunk "
+                '(pin compute_dtype = "bfloat16")'
+            )
+        if sdt is not None:
+            shadow = build_param_shadow(params, sdt)
+    # steps_per_dispatch arm: K steps per host round-trip (lax.scan over a
+    # K-stacked batch; bit-identical to K singles — tests/test_fused_update)
+    k_disp = max(int(spec.get("dispatch", 1) or 1), 1)
+    assert not (spec.get("e2e") and k_disp > 1), "e2e + dispatch unsupported"
+    update = make_train_step(
+        nlp.make_loss_fn(), tx, mesh, opt_state_template=opt_state,
+        shadow=shadow is not None, multi_dispatch=k_disp > 1,
+    )
+    dev_rng = jax.random.PRNGKey(1)  # multi-dispatch carries rng on device
+
+    def _stack_k(tree):
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(
+            lambda x: jnp.stack([x] * k_disp), tree
+        )
+
+    def do_update(tokens, targets, sub):
+        """One update call (= k_disp train steps), whatever the signature —
+        carries params / opt_state / shadow / device rng through the
+        enclosing scope."""
+        nonlocal params, opt_state, shadow, dev_rng
+        args = (params, opt_state)
+        if shadow is not None:
+            args += (shadow,)
+        args += (tokens, targets)
+        if k_disp > 1:
+            out = update(*args, dev_rng)
+            if shadow is not None:
+                params, opt_state, shadow, dev_rng, losses, _ = out
+            else:
+                params, opt_state, dev_rng, losses, _ = out
+            return losses[-1]
+        out = update(*args, sub)
+        if shadow is not None:
+            params, opt_state, shadow, loss, _ = out
+        else:
+            params, opt_state, loss, _ = out
+        return loss
 
     rng = jax.random.PRNGKey(0)
     cleanup = None
@@ -596,10 +759,21 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
     probe = nlp.collate(examples[:B], pad_batch_to=B, pad_len_to=T)
     p_tokens = place_batch(probe["tokens"], mesh)
     p_targets = place_batch(probe["targets"], mesh)
+    if k_disp > 1:
+        p_tokens, p_targets = _stack_k(p_tokens), _stack_k(p_targets)
     words_per_step = int(probe["n_words"])
-    flops_per_step, flops_kind = _program_flops(
-        update, params, opt_state, p_tokens, p_targets, rng, n_params, B * T
+    flops_args = (
+        (params, opt_state, shadow, p_tokens, p_targets, rng)
+        if shadow is not None
+        else (params, opt_state, p_tokens, p_targets, rng)
     )
+    flops_per_step, flops_kind = _program_flops(
+        update, flops_args, n_params, B * T
+    )
+    if flops_per_step and k_disp > 1:
+        # the lowered program runs k_disp steps; report PER-STEP flops so
+        # mfu stays comparable across dispatch arms
+        flops_per_step /= k_disp
     peak, peak_kind = _peak_flops_per_chip(platform)
 
     # ascending-size staged compiles: run ONE update at each smaller
@@ -612,10 +786,12 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
         sbatch = nlp.collate(examples[:sb], pad_batch_to=sb, pad_len_to=st)
         s_tokens = place_batch(sbatch["tokens"], mesh)
         s_targets = place_batch(sbatch["targets"], mesh)
+        if k_disp > 1:
+            s_tokens, s_targets = _stack_k(s_tokens), _stack_k(s_targets)
         rng, sub = jax.random.split(rng)
         # the update donates params/opt_state buffers: carry the outputs
         # forward (one extra optimizer step is noise for a benchmark)
-        params, opt_state, s_loss, _ = update(params, opt_state, s_tokens, s_targets, sub)
+        s_loss = do_update(s_tokens, s_targets, sub)
         jax.block_until_ready(s_loss)
         print(
             f"# {spec['name']}: stage (B={sb}, T={st}) compiled+ran in "
@@ -655,20 +831,20 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
         cleanup = stream.close  # stop the producer thread when measured
 
         def step_fn(i):
-            nonlocal rng, params, opt_state
+            nonlocal rng
             tokens, targets, n_words = next(stream)
             rng, sub = jax.random.split(rng)
-            params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
+            loss = do_update(tokens, targets, sub)
             return loss, n_words
 
     else:
         tokens, targets = p_tokens, p_targets  # same collation as the probe
-        fixed_words = words_per_step
+        fixed_words = words_per_step * k_disp  # words per CALL (k steps)
 
         def step_fn(i):
-            nonlocal rng, params, opt_state
+            nonlocal rng
             rng, sub = jax.random.split(rng)
-            params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
+            loss = do_update(tokens, targets, sub)
             return loss, fixed_words
 
     # Dispersion accounting (VERDICT r4 next #2): N independent timed
@@ -708,7 +884,8 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
             jax.block_until_ready(loss)
             dt = time.perf_counter() - t0
             rep_wps.append(total_words / dt / n_chips)
-            rep_step_seconds.append(dt / steps)
+            # one step_fn call runs k_disp steps; report per-STEP seconds
+            rep_step_seconds.append(dt / steps / k_disp)
         load_after = os.getloadavg()[0]
     finally:
         if cleanup is not None:
@@ -778,6 +955,17 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
         # self-describing kernel provenance: a CPU fallback can't pose as a
         # flash A/B (VERDICT r2 weak #2 / next #7)
         rec["flash"] = _flash_status(spec.get("env"))
+    # honest optimizer-path labels (same discipline as "flash"): what the
+    # update ACTUALLY ran — "active (pallas)" only when the kernel probe
+    # passed on this backend; the XLA fused fallback says so
+    from spacy_ray_tpu.ops.fused_update import fused_status
+
+    rec["fused_update"] = fused_status(tx, mesh)
+    rec["param_shadow"] = (
+        "active (bf16)" if shadow is not None else "off"
+    )
+    if k_disp > 1:
+        rec["steps_per_dispatch"] = k_disp
     # telemetry snapshot (training/telemetry.py): HBM peak is the real
     # fits-or-not signal at these shapes; the compile delta is this spec's
     # own compile count (stages + full shape), a recompile-storm canary
@@ -1015,6 +1203,133 @@ def run_input_pipeline(
         n = trace.flush(Path(trace_out))
         print(f"# wrote {n} trace events to {trace_out} "
               "(load in ui.perfetto.dev)", flush=True)
+
+
+# ----------------------------------------------------------------------
+# Optimizer-update microbenchmark (--update-only): the fixed floor alone
+# ----------------------------------------------------------------------
+
+
+def run_update_only(platform: str, configs=None) -> None:
+    """``--update-only``: time the jitted optimizer update ALONE — no
+    forward, no backward — for the cnn_tagger and trf param trees, naive
+    optax chain vs fused (ops/fused_update.py). This measures the
+    O(n_params) per-step floor PERF.md Finding 1 identified DIRECTLY, so
+    the round-7 A/B has a clean denominator: the full-step delta can be
+    read against the update's own share of the step. Records land in
+    BENCH_SESSION.jsonl like every other spec."""
+    import jax
+
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.ops.fused_update import fused_status
+    from spacy_ray_tpu.parallel.mesh import build_mesh
+    from spacy_ray_tpu.parallel.step import place_replicated, shard_opt_state
+    from spacy_ray_tpu.pipeline.language import Pipeline
+    from spacy_ray_tpu.presets import CNN_TAGGER_CFG, INIT_PRESETS
+    from spacy_ray_tpu.registry import registry
+    from spacy_ray_tpu.training.optimizers import fuse_optimizer
+
+    peak, _peak_kind = _peak_flops_per_chip(platform)
+    mesh = build_mesh(n_data=len(jax.devices()))
+    if configs is None:
+        configs = [
+            ("cnn_tagger", CNN_TAGGER_CFG.format(width=96, depth=4,
+                                                 embed_size=2000), ["tagger"]),
+            ("trf", INIT_PRESETS["trf"], ["parser", "ner"]),
+        ]
+    for cfg_name, cfg_text, kinds in configs:
+        nlp = Pipeline.from_config(Config.from_str(cfg_text))
+        examples = _corpus(kinds, 512)
+        nlp.initialize(lambda: iter(examples), seed=0)
+        host_params = jax.tree_util.tree_map(np.asarray, nlp.params)
+        n_params = int(sum(int(np.prod(p.shape))
+                           for p in jax.tree_util.tree_leaves(host_params)))
+        # deterministic pseudo-grads, small enough that clip never fires
+        # identically across variants (gnorm is the same either way)
+        host_grads = jax.tree_util.tree_map(
+            lambda p: p * 1e-3 + 1e-4, host_params
+        )
+        for fused in (False, True):
+            import jax.numpy as jnp
+
+            tx = registry.get("optimizers", "Adam.v1")(learn_rate=0.001)
+            if fused:
+                tx = fuse_optimizer(tx)
+            params = place_replicated(
+                jax.tree_util.tree_map(jnp.asarray, host_params), mesh
+            )
+            opt_state = shard_opt_state(tx.init(params), mesh, zero1=False)
+            grads = place_replicated(
+                jax.tree_util.tree_map(jnp.asarray, host_grads), mesh
+            )
+
+            if getattr(tx, "applies_updates", False):
+                def opt_step(p, s, g):
+                    return tx.update(g, s, p)
+            else:
+                import optax
+
+                def opt_step(p, s, g):
+                    u, s = tx.update(g, s, p)
+                    return optax.apply_updates(p, u), s
+
+            step = jax.jit(opt_step, donate_argnums=(0, 1))
+            t0 = time.perf_counter()
+            params, opt_state = step(params, opt_state, grads)
+            jax.block_until_ready(params)
+            compile_seconds = time.perf_counter() - t0
+            # adaptive rep length, same rationale as the train-step benches
+            t0 = time.perf_counter()
+            params, opt_state = step(params, opt_state, grads)
+            jax.block_until_ready(params)
+            probe_dt = time.perf_counter() - t0
+            steps = max(
+                3,
+                min(500, int(np.ceil(MIN_REP_SECONDS / max(probe_dt, 1e-6)))),
+            )
+            rep_secs: List[float] = []
+            for _rep in range(N_REPS):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    params, opt_state = step(params, opt_state, grads)
+                jax.block_until_ready(params)
+                rep_secs.append((time.perf_counter() - t0) / steps)
+            reprobe_ratio = None
+            if platform == "cpu":
+                reprobe = _measure_matmul_peak(platform)
+                if reprobe > peak:
+                    peak = reprobe
+                reprobe_ratio = reprobe / peak
+            update_seconds = float(np.median(rep_secs))
+            rec = {
+                "name": f"update_only_{cfg_name}" + ("_fused" if fused else ""),
+                "metric": (
+                    "optimizer_update_seconds (jitted Adam update alone, no "
+                    "fwd/bwd" + (", fused" if fused else ", optax chain") + ")"
+                ),
+                "value": round(update_seconds, 4),
+                "unit": "seconds/update",
+                "platform": platform,
+                "devices": len(jax.devices()),
+                "n_params": n_params,
+                "updates_per_sec": round(1.0 / update_seconds, 2),
+                "compile_seconds": round(compile_seconds, 2),
+                "n_reps": N_REPS,
+                "steps_per_rep": steps,
+                "update_seconds_min": round(min(rep_secs), 4),
+                "update_seconds_max": round(max(rep_secs), 4),
+                "fused_update": fused_status(tx, mesh),
+                "peak_reprobe_ratio": (
+                    round(reprobe_ratio, 3) if reprobe_ratio is not None
+                    else None
+                ),
+                "contended": (
+                    reprobe_ratio is not None
+                    and reprobe_ratio < CONTENTION_RATIO
+                ),
+            }
+            print(json.dumps(rec), flush=True)
+            _append_session(rec, platform)
 
 
 # ----------------------------------------------------------------------
@@ -1527,6 +1842,12 @@ def main() -> None:
         "Perfetto trace file (the training loop's own span emitter)",
     )
     parser.add_argument(
+        "--update-only", action="store_true",
+        help="time the jitted optimizer update alone (no fwd/bwd) for the "
+        "cnn_tagger and trf param trees, naive vs fused — the O(n_params) "
+        "fixed floor measured directly; records land in BENCH_SESSION.jsonl",
+    )
+    parser.add_argument(
         "--serving", action="store_true",
         help="measure the online serving path (engine+batcher+HTTP): a "
         "closed-loop spec (sustained req/s at client saturation) and an "
@@ -1576,6 +1897,26 @@ def main() -> None:
             clients=int(args.serving_clients),
             open_rate=float(args.serving_rate) or None,
         )
+        return
+
+    if args.update_only:
+        # device-update-only mode: no subprocess fan-out (tiny programs);
+        # resolve the backend like --input-pipeline
+        import jax
+
+        if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+            pass  # CPU explicitly requested
+        elif not _accelerator_reachable():
+            print("# accelerator backend unreachable; update-only bench on "
+                  "CPU", flush=True)
+            jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.devices()
+        except RuntimeError as e:
+            print(f"# backend init failed ({e}); falling back to CPU",
+                  flush=True)
+            jax.config.update("jax_platforms", "cpu")
+        run_update_only(jax.default_backend())
         return
 
     if args.input_pipeline:
@@ -1645,6 +1986,8 @@ def main() -> None:
         for spec in _configs("tpu" if tpu_ok else "cpu"):
             if not tpu_ok and spec.get("accel_only"):
                 continue  # hardware-shaped spec: no CPU fallback exists
+            if spec.get("manual_only"):
+                continue  # evidence arms: run via --configs <name>, not per suite
             child_env = {**(spec.get("env") or {}), "SRT_BENCH_RUN_ID": run_id}
             rc = _run_spec_subprocess(
                 spec["name"], cpu=not tpu_ok, env=child_env,

@@ -34,8 +34,12 @@ sys.path.insert(0, str(Path(__file__).parent.parent))
 import numpy as np
 
 
-def build_step(spec_name: str, B: int, T: int, compute_dtype: str = "auto"):
-    """Build (update, state...) exactly as bench.run_one does."""
+def build_step(spec_name: str, B: int, T: int, compute_dtype: str = "auto",
+               fused: bool = False, shadow: bool = False):
+    """Build (update, state...) exactly as bench.run_one does. ``fused``
+    swaps the optax chain for the fused update (ops/fused_update.py);
+    ``shadow`` enables the bf16 parameter shadow (needs a bf16-compute
+    trunk — pin ``compute_dtype="bfloat16"`` on CPU)."""
     import jax
 
     import bench
@@ -67,10 +71,27 @@ def build_step(spec_name: str, B: int, T: int, compute_dtype: str = "auto"):
     nlp.initialize(lambda: iter(examples), seed=0)
     mesh = build_mesh(n_data=1)
     tx = registry.get("optimizers", "Adam.v1")(learn_rate=0.001)
+    if fused:
+        from spacy_ray_tpu.training.optimizers import fuse_optimizer
+
+        tx = fuse_optimizer(tx)
     params = place_replicated(nlp.params, mesh)
     opt_state = shard_opt_state(tx.init(params), mesh, zero1=False)
+    shadow_tree = None
+    if shadow:
+        from spacy_ray_tpu.models.transformer import (
+            build_param_shadow,
+            pipeline_shadow_dtype,
+        )
+
+        sdt = pipeline_shadow_dtype(nlp)
+        assert sdt is not None, (
+            '--shadow needs a bf16-compute trunk: add --compute-dtype bfloat16'
+        )
+        shadow_tree = build_param_shadow(params, sdt)
     update = make_train_step(
-        nlp.make_loss_fn(), tx, mesh, opt_state_template=opt_state
+        nlp.make_loss_fn(), tx, mesh, opt_state_template=opt_state,
+        shadow=shadow_tree is not None,
     )
     batch = nlp.collate(examples[:B], pad_batch_to=B, pad_len_to=T)
     tokens = place_batch(batch["tokens"], mesh)
@@ -78,27 +99,52 @@ def build_step(spec_name: str, B: int, T: int, compute_dtype: str = "auto"):
     n_params = int(
         sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
     )
-    return update, params, opt_state, tokens, targets, n_params, int(batch["n_words"])
+    return (update, params, opt_state, shadow_tree, tokens, targets, n_params,
+            int(batch["n_words"]))
+
+
+def _make_stepper(update, state):
+    """state = {"params", "opt", "shadow"}; returns step(tokens, targets,
+    sub) -> loss, carrying state through whichever update signature."""
+
+    def step(tokens, targets, sub):
+        if state["shadow"] is not None:
+            (state["params"], state["opt"], state["shadow"], loss, _) = update(
+                state["params"], state["opt"], state["shadow"], tokens,
+                targets, sub,
+            )
+        else:
+            state["params"], state["opt"], loss, _ = update(
+                state["params"], state["opt"], tokens, targets, sub
+            )
+        return loss
+
+    return step
 
 
 def measure(spec_name: str, B: int, T: int, steps: int, reps: int,
-            compute_dtype: str = "auto"):
+            compute_dtype: str = "auto", fused: bool = False,
+            shadow: bool = False):
     import jax
 
     import bench
 
-    update, params, opt_state, tokens, targets, n_params, n_words = build_step(
-        spec_name, B, T, compute_dtype
-    )
+    (update, params, opt_state, shadow_tree, tokens, targets, n_params,
+     n_words) = build_step(spec_name, B, T, compute_dtype, fused, shadow)
     rng = jax.random.PRNGKey(0)
-    flops, flops_kind = bench._program_flops(
-        update, params, opt_state, tokens, targets, rng, n_params, B * T
+    flops_args = (
+        (params, opt_state, shadow_tree, tokens, targets, rng)
+        if shadow_tree is not None
+        else (params, opt_state, tokens, targets, rng)
     )
+    flops, flops_kind = bench._program_flops(update, flops_args, n_params, B * T)
     peak, peak_kind = bench._peak_flops_per_chip("cpu")
 
+    state = {"params": params, "opt": opt_state, "shadow": shadow_tree}
+    step_fn = _make_stepper(update, state)
     t0 = time.perf_counter()
     rng, sub = jax.random.split(rng)
-    params, opt_state, loss, _ = update(params, opt_state, tokens, targets, sub)
+    loss = step_fn(tokens, targets, sub)
     jax.block_until_ready(loss)
     compile_seconds = time.perf_counter() - t0
 
@@ -107,9 +153,7 @@ def measure(spec_name: str, B: int, T: int, steps: int, reps: int,
         t0 = time.perf_counter()
         for _ in range(steps):
             rng, sub = jax.random.split(rng)
-            params, opt_state, loss, _ = update(
-                params, opt_state, tokens, targets, sub
-            )
+            loss = step_fn(tokens, targets, sub)
         jax.block_until_ready(loss)
         rep_secs.append((time.perf_counter() - t0) / steps)
     step_seconds = float(np.median(rep_secs))
@@ -118,6 +162,8 @@ def measure(spec_name: str, B: int, T: int, steps: int, reps: int,
         "B": B,
         "T": T,
         "compute_dtype": compute_dtype,
+        "fused_update": bool(fused),
+        "param_shadow": bool(shadow),
         "tokens_per_step": B * T,
         "n_params": n_params,
         "words_per_step": n_words,
@@ -133,7 +179,7 @@ def measure(spec_name: str, B: int, T: int, steps: int, reps: int,
         "mfu": round(flops / step_seconds / peak, 5),
         "peak_tflops": round(peak / 1e12, 3),
         "peak_kind": peak_kind,
-        "state": (update, params, opt_state, tokens, targets),
+        "state": (update, state, tokens, targets),
     }
 
 
@@ -176,15 +222,14 @@ def trace_breakdown(meas: dict, steps: int) -> dict:
     by class. Returns {class: seconds} plus coverage stats."""
     import jax
 
-    update, params, opt_state, tokens, targets = meas["state"]
+    update, state, tokens, targets = meas["state"]
+    step_fn = _make_stepper(update, state)
     rng = jax.random.PRNGKey(1)
     trace_dir = tempfile.mkdtemp(prefix="trf_trace_")
     with jax.profiler.trace(trace_dir):
         for _ in range(steps):
             rng, sub = jax.random.split(rng)
-            params, opt_state, loss, _ = update(
-                params, opt_state, tokens, targets, sub
-            )
+            loss = step_fn(tokens, targets, sub)
         jax.block_until_ready(loss)
     files = glob.glob(trace_dir + "/**/*.trace.json.gz", recursive=True)
     if not files:
@@ -223,6 +268,56 @@ def trace_breakdown(meas: dict, steps: int) -> dict:
     }
 
 
+def load_records(path: Path) -> list:
+    """One JSON object per line (this tool's own output format)."""
+    return [
+        json.loads(line)
+        for line in Path(path).read_text(encoding="utf8").splitlines()
+        if line.strip()
+    ]
+
+
+def compare(before_path: Path, after_path: Path) -> None:
+    """``--compare before.json after.json``: per-op-class share/seconds
+    delta table between two --trace runs, matched by (config, B, T). The
+    PERF.md round-7 op-class evidence is this table, not hand math."""
+    before = {(r["name"], r["B"], r["T"]): r for r in load_records(before_path)}
+    after = {(r["name"], r["B"], r["T"]): r for r in load_records(after_path)}
+    for key in sorted(set(before) & set(after)):
+        b, a = before[key], after[key]
+        name, B, T = key
+        print(f"\n## {name} B={B} T={T}")
+        print(
+            f"step_seconds: {b['step_seconds']} -> {a['step_seconds']} "
+            f"({(a['step_seconds'] / b['step_seconds'] - 1) * 100:+.1f}%)  "
+            f"[before: fused={b.get('fused_update')} shadow={b.get('param_shadow')} "
+            f"dtype={b.get('compute_dtype')}; after: fused={a.get('fused_update')} "
+            f"shadow={a.get('param_shadow')} dtype={a.get('compute_dtype')}]"
+        )
+        bb = (b.get("breakdown") or {})
+        ab = (a.get("breakdown") or {})
+        if "class_share" not in bb or "class_share" not in ab:
+            print("(no --trace breakdown on one side; shares skipped)")
+            continue
+        classes = sorted(
+            set(bb["class_share"]) | set(ab["class_share"]),
+            key=lambda c: -(bb["class_share"].get(c, 0.0)),
+        )
+        print(f"{'class':<20}{'before':>10}{'after':>10}{'Δshare':>10}"
+              f"{'before s':>10}{'after s':>10}")
+        for c in classes:
+            bs = bb["class_share"].get(c, 0.0)
+            as_ = ab["class_share"].get(c, 0.0)
+            print(
+                f"{c:<20}{bs:>10.1%}{as_:>10.1%}{as_ - bs:>+10.1%}"
+                f"{bb['class_seconds'].get(c, 0.0):>10.3f}"
+                f"{ab['class_seconds'].get(c, 0.0):>10.3f}"
+            )
+    missing = set(before) ^ set(after)
+    if missing:
+        print(f"\n# unmatched (config, B, T) keys skipped: {sorted(missing)}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="trf",
@@ -240,7 +335,21 @@ def main():
                     choices=["auto", "bfloat16", "float32"],
                     help="pin the trunk matmul dtype (auto = platform "
                     "default: bf16 on accelerators, f32 on CPU)")
+    ap.add_argument("--fused", action="store_true",
+                    help="use the fused optimizer update "
+                    "(ops/fused_update.py) instead of the optax chain")
+    ap.add_argument("--shadow", action="store_true",
+                    help="enable the bf16 parameter shadow (pair with "
+                    "--compute-dtype bfloat16 on CPU)")
+    ap.add_argument("--compare", nargs=2, metavar=("BEFORE", "AFTER"),
+                    type=Path, default=None,
+                    help="two files of this tool's JSON lines: print the "
+                    "per-op-class share delta table (PERF.md evidence)")
     args = ap.parse_args()
+
+    if args.compare is not None:
+        compare(args.compare[0], args.compare[1])
+        return
 
     import jax
 
@@ -252,7 +361,8 @@ def main():
     )
     for B, T in shapes:
         meas = measure(args.config, B, T, args.steps, args.reps,
-                       args.compute_dtype)
+                       args.compute_dtype, fused=args.fused,
+                       shadow=args.shadow)
         out = {k: v for k, v in meas.items() if k != "state"}
         if args.trace:
             out["breakdown"] = trace_breakdown(meas, max(2, args.steps // 2))

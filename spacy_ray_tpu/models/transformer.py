@@ -23,7 +23,7 @@ worker.py:91/176-189). Differences, deliberate and TPU-native:
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -132,6 +132,62 @@ def _moe_ffn(p, h: jnp.ndarray, token_mask: jnp.ndarray, *,
     mean_prob = jnp.sum(probs * maskf[:, None], axis=0) / denom  # [E]
     aux = jnp.float32(E) * jnp.sum(frac * mean_prob)
     return y, aux
+
+
+# Leaves the bf16 parameter shadow covers: every weight/bias the layer
+# stack casts to the compute dtype each step (matmul operands + the biases
+# added to matmul outputs). LN params and the router stay f32 (they feed
+# fp32 ops), embeddings/positions are consumed in f32 by the embed path.
+SHADOW_LEAF_NAMES = frozenset({
+    "qkv_W", "qkv_b", "o_W", "o_b",
+    "ffn_W1", "ffn_b1", "ffn_W2", "ffn_b2",
+    "e_W1", "e_b1", "e_W2", "e_b2",
+})
+
+
+def build_param_shadow(params, dtype=jnp.bfloat16):
+    """Nested sub-tree of ``params`` holding ``dtype`` copies of every
+    transformer matmul weight (SHADOW_LEAF_NAMES under a ``layer_i`` dict).
+
+    The train step overlays this shadow onto the f32 master params for the
+    forward/backward pass: the layer stack's per-step (and, under remat,
+    per-backward) ``astype(compute_dtype)`` of the whole trunk becomes a
+    no-op, replaced by ONE incremental refresh of the shadow inside the
+    same jitted update (parallel/step.py). Returns None when nothing
+    qualifies (no transformer trunk in the tree)."""
+
+    def rec(node, in_layer):
+        out = {}
+        for k, v in node.items():
+            if isinstance(v, dict):
+                sub = rec(v, in_layer or str(k).startswith("layer_"))
+                if sub:
+                    out[k] = sub
+            elif (
+                in_layer
+                and k in SHADOW_LEAF_NAMES
+                and jnp.asarray(v).dtype == jnp.float32
+            ):
+                out[k] = v.astype(dtype)
+        return out
+
+    return rec(params, False) or None
+
+
+def pipeline_shadow_dtype(nlp) -> Optional[Any]:
+    """bfloat16 when some transformer trunk in the pipeline resolves its
+    compute dtype to bf16 (the only case a bf16 shadow is numerics-
+    preserving), else None — the ``[training] bf16_shadow = "auto"``
+    decision point."""
+    for comp in nlp.components.values():
+        model = getattr(comp, "model", None)
+        if model is None:
+            continue
+        for m in model.walk():
+            name = m.meta.get("compute_dtype_name")
+            if name and _resolve_compute_dtype(name) == jnp.bfloat16:
+                return jnp.bfloat16
+    return None
 
 
 def _resolve_compute_dtype(name: str):
@@ -506,6 +562,9 @@ def TransformerEncoder(
         apply_fn,
         dims={"nO": width, "depth": depth, "n_heads": n_heads},
         layers=[embed],
+        # the bf16-shadow decision point (pipeline_shadow_dtype) resolves
+        # this at loop-setup time — "auto" depends on the backend
+        meta={"compute_dtype_name": compute_dtype},
     )
 
 

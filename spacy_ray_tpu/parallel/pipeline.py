@@ -82,18 +82,24 @@ def spmd_pipeline(
         # batch semantics, so data/model constraints inside stage_fn apply
         x_spec = P()
         mask_spec = P()
+        aux_spec = P()  # aux is global under automatic data semantics
         sm_kwargs: dict = {"axis_names": frozenset({AXIS})}
-    else:  # pragma: no cover - older jax: fully manual fallback
+    else:  # older jax: fully manual fallback
         data = "data" if "data" in mesh.shape and mesh.shape["data"] > 1 else None
         x_spec = P(None, data, None, None)  # [M, mb/data, T, D]
         mask_spec = P(None, data, None)
+        # the region returns aux as a [1] vector (a bare scalar cannot be
+        # concatenated across shards); each data shard contributes its own
+        # value, averaged outside — standard data-parallel aggregation of
+        # the (already approximate, see docstring) pipelined aux
+        aux_spec = P(data)
         sm_kwargs = {}
 
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(param_spec, x_spec, mask_spec, P()),
-        out_specs=x_spec,
+        out_specs=(x_spec, aux_spec),
         **{_CHECK_KW: False},
         **sm_kwargs,
     )
@@ -138,6 +144,9 @@ def spmd_pipeline(
         # mean over microbatches (the dense loop computes each layer's aux
         # once over the full batch)
         aux_total = jax.lax.psum(aux_acc, AXIS) / jnp.float32(M)
-        return outputs, aux_total
+        return outputs, aux_total.reshape(1)
 
-    return run(stacked_params, microbatches, masks, rng)
+    outputs, aux_vec = run(stacked_params, microbatches, masks, rng)
+    # [1] under partial-manual (global aux); [n_data] under the fully
+    # manual fallback (one value per data shard) — mean restores a scalar
+    return outputs, jnp.mean(aux_vec)

@@ -161,9 +161,14 @@ def ring_attention(
                 sm_mesh = am
         except Exception:  # pragma: no cover - API drift: concrete mesh
             pass
-    else:  # pragma: no cover - older jax: fully manual over the whole mesh
-        data = "data" if "data" in mesh.shape else None
-        model = "model" if "model" in mesh.shape and mesh.shape["model"] > 1 else None
+    else:  # older jax: fully manual over the whole mesh
+        # the manual region shards B over `data` (and H over `model`) only
+        # when the dims actually divide — an indivisible layout falls back
+        # to replicating that dim on every shard (each device computes the
+        # full extent; wasteful but exact), instead of tripping shard_map's
+        # divisibility check
+        data = "data" if n_data > 1 and B_g % n_data == 0 else None
+        model = "model" if n_model > 1 and H_g % n_model == 0 else None
         qkv_spec = P(data, AXIS, model, None)
         mask_spec = P(data, AXIS)
         sm_kwargs = {}

@@ -46,6 +46,41 @@ def opt_state_shardings(opt_state: Any, mesh: Mesh, zero1: bool) -> Any:
     return jax.tree_util.tree_map(lambda leaf: zero1_spec(leaf, mesh), opt_state)
 
 
+def overlay_shadow(params: Any, shadow: Any) -> Any:
+    """Overlay a (sub-structure) shadow tree onto params: positions present
+    in ``shadow`` are taken from it (bf16 copies of the trunk's matmul
+    weights — models/transformer.py build_param_shadow), the rest from
+    ``params``. The forward then consumes the shadow leaves directly, so
+    the layer stack's per-step ``astype(compute_dtype)`` is a no-op."""
+    if not isinstance(shadow, dict):
+        return shadow
+    out = dict(params)
+    for k, v in shadow.items():
+        out[k] = overlay_shadow(params[k], v)
+    return out
+
+
+def refresh_shadow(new_params: Any, shadow: Any) -> Any:
+    """Re-derive the shadow from freshly updated master params — ONE cast
+    per shadowed leaf, fused into the same jitted update (the donated old
+    shadow buffer is reused; no second host-visible traversal)."""
+    if not isinstance(shadow, dict):
+        return new_params.astype(shadow.dtype)
+    return {k: refresh_shadow(new_params[k], v) for k, v in shadow.items()}
+
+
+def _cast_like(tree: Any, like: Any) -> Any:
+    """Cast shadow-leaf cotangents (bf16) back to the master dtype. The
+    VALUES match the cast-per-step path up to one bf16 rounding: the
+    baseline program's backward may elide the f32->bf16->f32 double
+    rounding inside the weight-grad matmul, so the two trajectories agree
+    to ~1e-8/step rather than bitwise (forward IS bit-exact — asserted by
+    tests/test_fused_update.py)."""
+    return jax.tree_util.tree_map(
+        lambda x, ref: x.astype(ref.dtype), tree, like
+    )
+
+
 def make_train_step(
     loss_fn: Callable,
     tx: optax.GradientTransformation,
@@ -55,6 +90,8 @@ def make_train_step(
     zero1: bool = False,
     opt_state_template: Any = None,
     donate: bool = True,
+    shadow: bool = False,
+    multi_dispatch: bool = False,
 ) -> Callable:
     """Build the jitted sharded update.
 
@@ -64,6 +101,22 @@ def make_train_step(
     (params, opt_state, loss, metrics). When accumulate_gradient > 1,
     tokens/targets leaves carry a leading [A] microbatch dim and the batch
     dim is sharded at position 1; otherwise position 0.
+
+    ``shadow=True``: the update takes (params, opt_state, shadow, tokens,
+    targets, rng) and returns (params, opt_state, shadow, loss, metrics).
+    The forward runs on ``overlay_shadow(params, shadow)`` (bf16 trunk
+    weights read directly — no per-step cast), gradients are cast back to
+    the master dtype before accumulation/optimizer, and the shadow is
+    refreshed from the new params inside the same program (all three
+    state arguments donated).
+
+    ``multi_dispatch=True``: tokens/targets leaves carry a leading [K]
+    per-dispatch dim; the update runs K full train steps as one
+    ``lax.scan`` (ONE host round-trip) and returns (params, opt_state,
+    [shadow,] rng, losses[K], metrics[K]) — ``rng`` is carried through
+    the scan with the same ``jax.random.split`` chain the host performs
+    at K=1, so K steps are bit-identical to K single dispatches. K is
+    read from the input shape: each distinct K compiles once.
     """
     accum = max(int(accumulate_gradient), 1)
 
@@ -73,15 +126,27 @@ def make_train_step(
         )
         return loss, metrics, grads
 
-    def update(params, opt_state, tokens, targets, rng):
+    applies_updates = bool(getattr(tx, "applies_updates", False))
+
+    def step_once(params, opt_state, shadow_t, tokens, targets, rng):
+        fwd_params = (
+            overlay_shadow(params, shadow_t) if shadow_t is not None else params
+        )
         if accum == 1:
-            loss, metrics, grads = grads_of(params, tokens, targets, rng)
+            loss, metrics, grads = grads_of(fwd_params, tokens, targets, rng)
+            if shadow_t is not None:
+                # bf16 cotangents at shadow leaves -> f32 master grads (the
+                # same values the cast-per-step path produces via the
+                # cast's transpose)
+                grads = _cast_like(grads, params)
         else:
             def body(carry, micro):
                 acc_grads, rng = carry
                 rng, sub = jax.random.split(rng)
                 m_tokens, m_targets = micro
-                loss, metrics, grads = grads_of(params, m_tokens, m_targets, sub)
+                loss, metrics, grads = grads_of(fwd_params, m_tokens, m_targets, sub)
+                if shadow_t is not None:
+                    grads = _cast_like(grads, acc_grads)
                 acc_grads = jax.tree_util.tree_map(jnp.add, acc_grads, grads)
                 return (acc_grads, rng), (loss, metrics)
 
@@ -92,12 +157,61 @@ def make_train_step(
             grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
             loss = jnp.mean(losses)
             metrics = jax.tree_util.tree_map(jnp.mean, metricses)
-        updates, new_opt_state = tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
+        if applies_updates:
+            # fused path (ops/fused_update.py): the whole optimizer chain
+            # plus apply_updates in one traversal
+            new_params, new_opt_state = tx.update(grads, opt_state, params)
+        else:
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+        new_shadow = (
+            refresh_shadow(new_params, shadow_t)
+            if shadow_t is not None
+            else None
+        )
         grad_norm = optax.global_norm(grads)
         metrics = dict(metrics)
         metrics["grad_norm"] = grad_norm
-        return new_params, new_opt_state, loss, metrics
+        return new_params, new_opt_state, new_shadow, loss, metrics
+
+    if multi_dispatch:
+        def multi_core(params, opt_state, shadow_t, tokens, targets, rng):
+            def body(carry, batch):
+                params, opt_state, shadow_t, rng = carry
+                rng, sub = jax.random.split(rng)
+                b_tokens, b_targets = batch
+                params, opt_state, shadow_t, loss, metrics = step_once(
+                    params, opt_state, shadow_t, b_tokens, b_targets, sub
+                )
+                return (params, opt_state, shadow_t, rng), (loss, metrics)
+
+            (params, opt_state, shadow_t, rng), (losses, metricses) = (
+                jax.lax.scan(
+                    body, (params, opt_state, shadow_t, rng), (tokens, targets)
+                )
+            )
+            return params, opt_state, shadow_t, rng, losses, metricses
+
+        if shadow:
+            update = multi_core
+        else:
+            def update(params, opt_state, tokens, targets, rng):
+                p, o, _, rng, losses, metricses = multi_core(
+                    params, opt_state, None, tokens, targets, rng
+                )
+                return p, o, rng, losses, metricses
+    elif shadow:
+        def update(params, opt_state, shadow_t, tokens, targets, rng):
+            p, o, s, loss, metrics = step_once(
+                params, opt_state, shadow_t, tokens, targets, rng
+            )
+            return p, o, s, loss, metrics
+    else:
+        def update(params, opt_state, tokens, targets, rng):
+            p, o, _, loss, metrics = step_once(
+                params, opt_state, None, tokens, targets, rng
+            )
+            return p, o, loss, metrics
 
     # Sharding layout, DECLARED to jit (not left to placement inference):
     # params replicated; batch sharded over `data`; opt state replicated or
@@ -105,39 +219,55 @@ def make_train_step(
     # layout so a ZeRO-1 state stays sharded across steps instead of being
     # replicated back by GSPMD.
     repl = replicated(mesh)
-    batch_shard = NamedSharding(mesh, P(None, "data") if accum > 1 else P("data"))
+    batch_dims = (1 if multi_dispatch else 0) + (1 if accum > 1 else 0)
+    batch_shard = NamedSharding(mesh, P(*([None] * batch_dims), "data"))
     if opt_state_template is not None:
         opt_sh: Any = opt_state_shardings(opt_state_template, mesh, zero1)
     else:
         opt_sh = repl  # prefix: whole subtree replicated
 
+    in_sh: Tuple[Any, ...] = (repl, opt_sh)
+    out_sh: Tuple[Any, ...] = (repl, opt_sh)
+    donate_argnums: Tuple[int, ...] = (0, 1)
+    if shadow:
+        in_sh += (repl,)
+        out_sh += (repl,)
+        donate_argnums += (2,)  # the old shadow buffer backs the refresh
+    in_sh += (batch_shard, batch_shard, repl)
+    if multi_dispatch:
+        out_sh += (repl, repl, repl)  # rng, losses [K], metrics [K]
+    else:
+        out_sh += (repl, repl)  # loss, metrics
+
     jit_kwargs: Dict[str, Any] = {
-        "in_shardings": (repl, opt_sh, batch_shard, batch_shard, repl),
-        "out_shardings": (repl, opt_sh, repl, repl),
+        "in_shardings": in_sh,
+        "out_shardings": out_sh,
     }
     if donate:
-        jit_kwargs["donate_argnums"] = (0, 1)
+        jit_kwargs["donate_argnums"] = donate_argnums
 
     jitted = jax.jit(update, **jit_kwargs)
 
-    def run(params, opt_state, tokens, targets, rng):
+    def run(*args):
         # install the mesh so model code (transformer TP/CP constraints,
         # ring attention) can consult it at trace time
         with pctx.use_mesh(mesh):
-            return jitted(params, opt_state, tokens, targets, rng)
+            return jitted(*args)
 
-    def lower(params, opt_state, tokens, targets, rng):
+    def lower(*args):
         # same mesh install as ``run``: model code consults the mesh at
         # trace time, and lowering traces without executing (used by
         # bench.py for XLA cost analysis — FLOPs/step for MFU accounting)
         with pctx.use_mesh(mesh):
-            return jitted.lower(params, opt_state, tokens, targets, rng)
+            return jitted.lower(*args)
 
     run.mesh = mesh
     run.batch_shard = batch_shard
     run.replicated = repl
     run.opt_shardings = opt_sh
     run.lower = lower
+    run.takes_shadow = shadow
+    run.multi_dispatch = multi_dispatch
     return run
 
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 import math
 import random
 import time
+from functools import partial
 from pathlib import Path
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -103,6 +104,29 @@ DEFAULT_TRAINING = {
     # detectors (only active when telemetry is on); they emit through
     # log_event so anomalies land in jsonl logger rows too
     "anomaly_detection": True,
+    # fused optimizer update (ops/fused_update.py): the whole Adam/RAdam
+    # chain + apply_updates as ONE traversal (pallas kernel on TPU when
+    # the startup probe passes). "auto" = fuse on accelerators when the
+    # optimizer is fusable (Adam.v1/RAdam.v1, no frozen components) and
+    # keep the reference chain on CPU (measured parity there — PERF.md
+    # round 7); "on" = require it anywhere, "off" = never. State
+    # structure is identical either way — checkpoints survive knob flips.
+    "fused_update": "auto",
+    # bf16 parameter shadow: keep a persistently maintained bfloat16 copy
+    # of the transformer trunk's matmul weights next to the f32 masters,
+    # refreshed inside the jitted update — the per-step (and per-remat-
+    # backward) 124M-weight cast disappears. "auto" = on when the trunk's
+    # compute dtype resolves to bfloat16 (accelerators; compute_dtype
+    # semantics unchanged), "on" = require that, "off" = never.
+    "bf16_shadow": "auto",
+    # run K train steps per host round-trip (lax.scan over K pre-staged
+    # device batches). Default 1 = exactly the old behavior; raised, the
+    # dispatch is capped so eval/max_steps boundaries still land exactly,
+    # and results are bit-identical to K=1 (tested). Auto-bypassed (K=1)
+    # for annotating runs, before_update callbacks, and use_averages —
+    # each needs the host between consecutive steps. See TUNING.md §11
+    # for when NOT to raise it (watchdog granularity, preemption latency).
+    "steps_per_dispatch": 1,
 }
 
 # Sub-blocks resolved through the registry rather than read as plain values.
@@ -200,6 +224,18 @@ _TRAINING_TYPES: Dict[str, Tuple[Callable[[Any], bool], str]] = {
         "a [start, stop] pair of ints with 0 <= start <= stop",
     ),
     "anomaly_detection": (lambda v: isinstance(v, bool), "a bool"),
+    "fused_update": (
+        lambda v: v in ("auto", "on", "off"),
+        'one of "auto", "on", "off"',
+    ),
+    "bf16_shadow": (
+        lambda v: v in ("auto", "on", "off"),
+        'one of "auto", "on", "off"',
+    ),
+    "steps_per_dispatch": (
+        lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 1,
+        "an int >= 1",
+    ),
 }
 
 
@@ -215,6 +251,27 @@ def _is_step_window(v: Any) -> bool:
 def _ms(seconds: Optional[float]) -> Optional[float]:
     """Seconds -> rounded milliseconds (None passes through)."""
     return round(seconds * 1000.0, 3) if seconds is not None else None
+
+
+def _group_shape_sig(group: Dict[str, Any]) -> Tuple:
+    """Shape/dtype signature of one staged batch group — steps_per_dispatch
+    stacks only groups in the SAME padding bucket (a lax.scan needs
+    homogeneous xs); a bucket change flushes the run and the odd group
+    leads the next dispatch."""
+    return tuple(
+        (x.shape, str(x.dtype))
+        for x in jax.tree_util.tree_leaves((group["tokens"], group["targets"]))
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _avg_step(avg, params, t):
+    """One running-mean step for use_averages. The ``avg`` accumulator is
+    DONATED: before this fix every eval-window step allocated a fresh
+    full-size param tree here — a second silent O(n_params) traversal's
+    worth of memory churn per step (donation-audit test pins this)."""
+    t = jnp.float32(t)
+    return jax.tree_util.tree_map(lambda a, p: a + (p - a) / t, avg, params)
 
 
 def _unknown_name_error(what: str, name: str, allowed) -> ValueError:
@@ -452,6 +509,26 @@ def train(
     n_data = mesh.shape["data"]
     tx = registry.resolve(T.get("optimizer") or {"@optimizers": "Adam.v1"})
     tx = _optimizers.mask_frozen(tx, nlp.params)  # skip frozen_ leaves entirely
+    # [training] fused_update: rebuild a fusable chain as one traversal
+    # (ops/fused_update.py). State structure is identical, so resume works
+    # across knob flips; "auto" silently keeps the reference chain for
+    # unfusable optimizers (masked/frozen, custom registrations) AND on
+    # CPU, where the round-7 A/B measured the mega-fusion at parity-to-
+    # slightly-slower vs XLA's own chain fusion (PERF.md "Fixed-cost
+    # floor"; the same platform-gating precedent as compute_dtype="auto").
+    fused_mode = str(T.get("fused_update", "auto"))
+    if fused_mode == "on" or (
+        fused_mode == "auto" and jax.default_backend() != "cpu"
+    ):
+        fused_tx = _optimizers.fuse_optimizer(tx)
+        if fused_tx is not None:
+            tx = fused_tx
+        elif fused_mode == "on":
+            raise ValueError(
+                '[training] fused_update = "on" needs a fusable optimizer '
+                "(Adam.v1 / RAdam.v1 with no frozen_ param leaves); use "
+                '"auto" to fall back to the reference chain silently'
+            )
     batcher = registry.resolve(
         T.get("batcher")
         or {"@batchers": "spacy.batch_by_words.v1", "size": 1000, "tolerance": 0.2}
@@ -610,12 +687,6 @@ def train(
                 f"{type(before_update).__name__})"
             )
 
-    loss_fn = nlp.make_loss_fn(dropout=float(T["dropout"]))
-    update = make_train_step(
-        loss_fn, tx, mesh, accumulate_gradient=accum, zero1=zero1,
-        opt_state_template=opt_state,
-    )
-
     # Parameter averaging (thinc Adam use_averages semantics): running mean
     # of params, used for eval + best-model checkpoints.
     use_averages = bool(getattr(tx, "use_averages", False))
@@ -626,10 +697,54 @@ def train(
     )
     avg_count = 0
 
-    @jax.jit
-    def _avg_step(avg, params, t):
-        t = jnp.float32(t)
-        return jax.tree_util.tree_map(lambda a, p: a + (p - a) / t, avg, params)
+    # [training] bf16_shadow: persistent bf16 copies of the trunk's matmul
+    # weights, built AFTER resume (from the final params) and maintained
+    # incrementally inside the jitted update. "auto" resolves through the
+    # trunk's compute dtype so CPU runs (f32 compute) change nothing.
+    shadow_mode = str(T.get("bf16_shadow", "auto"))
+    shadow = None
+    if shadow_mode in ("auto", "on"):
+        from ..models.transformer import build_param_shadow, pipeline_shadow_dtype
+
+        shadow_dtype = pipeline_shadow_dtype(nlp)
+        if shadow_dtype is not None:
+            shadow = build_param_shadow(params, shadow_dtype)
+        if shadow is None and shadow_mode == "on":
+            raise ValueError(
+                '[training] bf16_shadow = "on" needs a transformer trunk '
+                "whose compute dtype resolves to bfloat16 (compute_dtype = "
+                '"bfloat16", or "auto" on an accelerator); use "auto" to '
+                "disable the shadow silently where it cannot help"
+            )
+
+    # [training] steps_per_dispatch: K compiled steps per host round-trip.
+    # Modes that need the host between consecutive steps bypass to 1.
+    steps_per_dispatch = max(int(T.get("steps_per_dispatch", 1) or 1), 1)
+    if steps_per_dispatch > 1 and (
+        annotating or before_update is not None or use_averages
+    ):
+        log_event(
+            "steps-per-dispatch-bypass",
+            "steps_per_dispatch > 1 needs the host between steps for "
+            "annotating_components / before_update / use_averages; "
+            "running with K=1",
+        )
+        steps_per_dispatch = 1
+
+    loss_fn = nlp.make_loss_fn(dropout=float(T["dropout"]))
+    update = make_train_step(
+        loss_fn, tx, mesh, accumulate_gradient=accum, zero1=zero1,
+        opt_state_template=opt_state, shadow=shadow is not None,
+    )
+    update_multi = (
+        make_train_step(
+            loss_fn, tx, mesh, accumulate_gradient=accum, zero1=zero1,
+            opt_state_template=opt_state, shadow=shadow is not None,
+            multi_dispatch=True,
+        )
+        if steps_per_dispatch > 1
+        else None
+    )
 
     # ---- logger ----
     logger_cfg = T.get("logger") or {"@loggers": "spacy_ray_tpu.ConsoleLogger.v1"}
@@ -1038,6 +1153,7 @@ def train(
         last_saved_step = step  # on every rank: the skip must stay aligned
 
     last_consumed_epoch = epoch
+    dispatch_pushback: Optional[Dict[str, Any]] = None  # bucket-change carry
     params_cell = {"params": params}  # read by the annotation pass
     groups: Iterator[Dict[str, Any]] = device_groups()
     prefetch_n = int(T.get("prefetch_batches", 2) or 0)
@@ -1066,23 +1182,80 @@ def train(
             # With prefetch/pool active this is the residual the input
             # pipeline failed to hide; inline it equals the whole host-side
             # pipeline time (read+collate+transfer happen in this call).
-            t_wait = time.perf_counter()
-            try:
-                group = next(groups)
-            except StopIteration:
-                break
-            finally:
-                pipe_stats.add(
-                    "queue_wait", time.perf_counter() - t_wait, t0=t_wait
+            if dispatch_pushback is not None:
+                # bucket-change leftover from the previous gather leads
+                # this dispatch (no queue wait — it is already staged)
+                group = dispatch_pushback
+                dispatch_pushback = None
+            else:
+                t_wait = time.perf_counter()
+                try:
+                    group = next(groups)
+                except StopIteration:
+                    break
+                finally:
+                    pipe_stats.add(
+                        "queue_wait", time.perf_counter() - t_wait, t0=t_wait
+                    )
+            # multi-step dispatch: pull up to K groups, CAPPED so the
+            # dispatch lands exactly on the next eval/max_steps/patience
+            # boundary — those paths then run identically to K=1 (the
+            # "force K=1 at the boundary step" contract)
+            k_this = 1
+            if update_multi is not None:
+                k_this = min(
+                    steps_per_dispatch,
+                    eval_frequency - (step % eval_frequency),
                 )
+                if max_steps:
+                    k_this = min(k_this, max_steps - step)
+                if patience and best_step >= 0:
+                    k_this = min(k_this, max(patience - (step - best_step), 1))
+                if profile_dir is not None and profile_start < profile_stop:
+                    # land a dispatch exactly on each window edge, else a
+                    # window strictly inside one K-stride is never seen
+                    # (start is only checked at dispatch boundaries) and an
+                    # active trace would overshoot the stop by up to K-1
+                    if steps_run < profile_start:
+                        k_this = min(k_this, profile_start - steps_run)
+                    elif steps_run < profile_stop:
+                        k_this = min(k_this, profile_stop - steps_run)
+                k_this = max(k_this, 1)
+            dispatch_groups = [group]
+            if k_this > 1:
+                # stack only groups in the SAME padding bucket (the scan
+                # needs homogeneous shapes): a bucket change flushes this
+                # dispatch and the odd group leads the next one
+                sig0 = _group_shape_sig(group)
+                while len(dispatch_groups) < k_this:
+                    t_wait = time.perf_counter()
+                    try:
+                        g = next(groups)
+                    except StopIteration:
+                        # stream ran dry mid-gather: dispatch what we have
+                        break
+                    finally:
+                        pipe_stats.add(
+                            "queue_wait",
+                            time.perf_counter() - t_wait,
+                            t0=t_wait,
+                        )
+                    if _group_shape_sig(g) != sig0:
+                        dispatch_pushback = g
+                        break
+                    dispatch_groups.append(g)
+            k_this = len(dispatch_groups)
+            # the LAST group's data-position tags are the consumed position
+            # (save_last checkpoints the boundary after all k inner steps)
+            group = dispatch_groups[-1]
             tokens, targets = group["tokens"], group["targets"]
-            n_words = group["n_words"]
+            n_words = sum(g["n_words"] for g in dispatch_groups)
             cur_epoch = last_consumed_epoch = group["cur_epoch"]
             if (
                 profile_dir is not None
                 and not profile_active
                 and profile_start < profile_stop  # [start, stop): empty = off
-                and steps_run == profile_start
+                and profile_start <= steps_run < profile_stop
             ):
                 jax.profiler.start_trace(str(profile_dir))
                 profile_active = True
@@ -1091,19 +1264,64 @@ def train(
             # fault-injection site "step": a `sigterm` rule here exercises
             # the preemption path at an exact step; an error rule, the
             # supervisor's crash/restart path; a `nan` rule poisons this
-            # step's reported loss (telemetry NaN-detector drill)
-            maybe_fail("step")
-            poisoned = resilience.consume_poison("step")
-            rng, sub = jax.random.split(rng)
-            params, opt_state, loss, metrics = update(params, opt_state, tokens, targets, sub)
+            # step's reported loss (telemetry NaN-detector drill). One
+            # probe per INNER step so rule call-counts stay step-aligned
+            # when steps_per_dispatch > 1.
+            poisons = []
+            for _ in range(k_this):
+                maybe_fail("step")
+                poisons.append(resilience.consume_poison("step"))
+            if k_this == 1:
+                rng, sub = jax.random.split(rng)
+                if shadow is not None:
+                    params, opt_state, shadow, loss, metrics = update(
+                        params, opt_state, shadow, tokens, targets, sub
+                    )
+                else:
+                    params, opt_state, loss, metrics = update(
+                        params, opt_state, tokens, targets, sub
+                    )
+                step_metrics = [(metrics, poisons[0])]
+            else:
+                # ONE host round-trip for k_this steps: stack the staged
+                # device batches with a leading [k] dim and scan the
+                # update over them (bit-identical to k singles — the rng
+                # split chain continues inside the program)
+                def _stack(groups_, key):
+                    return jax.tree_util.tree_map(
+                        lambda *xs: jnp.stack(xs), *[g[key] for g in groups_]
+                    )
+
+                s_tokens = _stack(dispatch_groups, "tokens")
+                s_targets = _stack(dispatch_groups, "targets")
+                if shadow is not None:
+                    params, opt_state, shadow, rng, losses, metricses = (
+                        update_multi(
+                            params, opt_state, shadow, s_tokens, s_targets, rng
+                        )
+                    )
+                else:
+                    params, opt_state, rng, losses, metricses = update_multi(
+                        params, opt_state, s_tokens, s_targets, rng
+                    )
+                loss = losses[-1]
+
+                def _inner(tree, i):
+                    return jax.tree_util.tree_map(lambda x: x[i], tree)
+
+                step_metrics = [
+                    (_inner(metricses, i), poisons[i]) for i in range(k_this)
+                ]
             params_cell["params"] = params
-            step += 1
-            steps_run += 1
+            step += k_this
+            steps_run += k_this
             if profile_active and steps_run >= profile_stop:
                 jax.block_until_ready(loss)
                 jax.profiler.stop_trace()
                 profile_active = False
             if use_averages:
+                # steps_per_dispatch is bypassed to 1 under use_averages,
+                # so the running mean still sees every step's params
                 avg_count += 1
                 avg_params = _avg_step(avg_params, params, avg_count)
             result.words_seen += n_words
@@ -1112,14 +1330,21 @@ def train(
             # keep metrics as device arrays — float() here would synchronize the
             # host with the device EVERY step and kill host/device overlap; the
             # accumulated scalars are only materialized at eval/log time
-            # (tagged with this step's nan-poison flag for drain_metrics)
-            pending_metrics.append((metrics, poisoned))
+            # (tagged with each step's nan-poison flag for drain_metrics)
+            pending_metrics.extend(step_metrics)
             if tel is not None:
-                # ONE clock stamp per step: step-time histogram + step span
-                # + buffered metrics row + step-time regression check
+                # ONE clock stamp per dispatch: the boundary fans out into
+                # k_this per-inner-step histogram observations / rows /
+                # spans (elapsed/k each), so detectors and percentiles
+                # still see every step
                 tel.step_boundary(
                     step=step, epoch=cur_epoch, n_words=n_words,
-                    steps_run=steps_run,
+                    steps_run=steps_run, inner_steps=k_this,
+                    words_each=(
+                        [g["n_words"] for g in dispatch_groups]
+                        if k_this > 1
+                        else None
+                    ),
                 )
 
             info: Optional[Dict[str, Any]] = None
@@ -1167,9 +1392,19 @@ def train(
                         eval_seconds=eval_seconds,
                         input_pipeline=info["input_pipeline"],
                         # one-shot XLA cost analysis (a trace, not a
-                        # compile) — bench.py's MFU numerator path
+                        # compile) — bench.py's MFU numerator path; always
+                        # the SINGLE-step program (per-step flops), with
+                        # the shadow argument when the update takes one
                         flops_fn=lambda: program_flops(
-                            update, params, opt_state, tokens, targets, sub
+                            update,
+                            *(
+                                (params, opt_state, shadow)
+                                if shadow is not None
+                                else (params, opt_state)
+                            ),
+                            tokens,
+                            targets,
+                            rng,
                         ),
                         wps=wps,
                     )

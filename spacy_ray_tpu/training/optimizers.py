@@ -131,17 +131,46 @@ class OptimizerWrapper:
     evaluate/checkpoint with it (thinc Adam's averages semantics — the
     reference's optimizer is constructed from config with use_averages and
     spacy evaluates under ``use_params(optimizer.averages)``).
+
+    ``fusable`` (set by the Adam.v1 / RAdam.v1 factories) records the
+    chain's hyperparameters so :func:`fuse_optimizer` can rebuild it as a
+    single fused traversal (ops/fused_update.py — the ``[training]
+    fused_update`` knob). ``applies_updates`` marks a wrapper whose
+    ``update`` returns NEW PARAMS directly (apply folded in); the train
+    step checks it before running its own ``optax.apply_updates``.
     """
 
     def __init__(self, tx: optax.GradientTransformation, use_averages: bool = False):
         self.tx = tx
         self.use_averages = use_averages
+        self.fusable: Optional[dict] = None
+        self.applies_updates = False
 
     def init(self, params):
         return self.tx.init(params)
 
     def update(self, grads, state, params=None):
         return self.tx.update(grads, state, params)
+
+
+def fuse_optimizer(tx) -> Optional["OptimizerWrapper"]:
+    """Rebuild a fusable optimizer as a single-traversal fused update.
+
+    Returns None when ``tx`` is not fusable — an optimizer other than
+    Adam.v1/RAdam.v1, or one wrapped by ``optax.masked`` for frozen
+    components (``mask_frozen`` drops the metadata, so frozen runs keep
+    the reference chain). The fused state structure is identical to the
+    chain's (init delegates), so checkpoints survive knob flips.
+    """
+    meta = getattr(tx, "fusable", None)
+    if not meta:
+        return None
+    from ..ops import fused_update as _fu
+
+    fused = _fu.make_fused_transformation(reference_tx=tx.tx, **meta)
+    out = OptimizerWrapper(fused, use_averages=tx.use_averages)
+    out.applies_updates = True
+    return out
 
 
 def mask_frozen(tx, params):
@@ -187,11 +216,20 @@ def Adam(
         chain.append(optax.clip_by_global_norm(grad_clip))
     if L2 and not L2_is_weight_decay:
         chain.append(optax.add_decayed_weights(L2))  # classic L2 into grads
+    adam_idx = len(chain)
     chain.append(optax.scale_by_adam(b1=beta1, b2=beta2, eps=eps))
     if L2 and L2_is_weight_decay:
         chain.append(optax.add_decayed_weights(L2))
     chain.append(optax.scale_by_learning_rate(lr_fn))
-    return OptimizerWrapper(optax.chain(*chain), use_averages=use_averages)
+    out = OptimizerWrapper(optax.chain(*chain), use_averages=use_averages)
+    out.fusable = dict(
+        kind="adam", lr_fn=lr_fn, b1=beta1, b2=beta2, eps=eps,
+        grad_clip=grad_clip if grad_clip and grad_clip > 0 else 0.0,
+        l2_grad=L2 if (L2 and not L2_is_weight_decay) else 0.0,
+        l2_decay=L2 if (L2 and L2_is_weight_decay) else 0.0,
+        adam_idx=adam_idx, sched_idx=len(chain) - 1,
+    )
+    return out
 
 
 @registry.optimizers("SGD.v1")
@@ -216,13 +254,21 @@ def RAdam(
     eps: float = 1e-8,
     weight_decay: float = 0.0,
     grad_clip: float = 1.0,
-) -> optax.GradientTransformation:
+) -> OptimizerWrapper:
     lr_fn = as_schedule_fn(learn_rate)
     chain = []
     if grad_clip and grad_clip > 0:
         chain.append(optax.clip_by_global_norm(grad_clip))
+    adam_idx = len(chain)
     chain.append(optax.scale_by_radam(b1=beta1, b2=beta2, eps=eps))
     if weight_decay:
         chain.append(optax.add_decayed_weights(weight_decay))
     chain.append(optax.scale_by_learning_rate(lr_fn))
-    return optax.chain(*chain)
+    out = OptimizerWrapper(optax.chain(*chain))
+    out.fusable = dict(
+        kind="radam", lr_fn=lr_fn, b1=beta1, b2=beta2, eps=eps,
+        grad_clip=grad_clip if grad_clip and grad_clip > 0 else 0.0,
+        l2_grad=0.0, l2_decay=weight_decay or 0.0,
+        adam_idx=adam_idx, sched_idx=len(chain) - 1,
+    )
+    return out

@@ -754,37 +754,59 @@ class Telemetry:
         self.trace.set_recording(self.trace_steps[0] <= 0 < self.trace_steps[1])
 
     def step_boundary(
-        self, *, step: int, epoch: int, n_words: int, steps_run: int
+        self,
+        *,
+        step: int,
+        epoch: int,
+        n_words: int,
+        steps_run: int,
+        inner_steps: int = 1,
+        words_each: Optional[List[int]] = None,
     ) -> None:
         """THE one hot-path hook: a single clock stamp, one histogram
-        observation, one buffered row, and the trace-window gate."""
+        observation, one buffered row, and the trace-window gate.
+
+        ``inner_steps > 1`` (a ``steps_per_dispatch`` dispatch): the one
+        wall-clock window fans out into per-inner-step observations of
+        ``elapsed / k`` each — histograms, rows, spans, and the step-time
+        regression detector still see EVERY step (the device executed k
+        steps; only the host-side boundary is coarser). ``step`` is the
+        LAST inner step's index; ``words_each`` carries per-step word
+        counts (falls back to an even split)."""
         now = self.clock()
         prev = self._last_boundary
         self._last_boundary = now
-        self._steps.inc()
+        k = max(int(inner_steps), 1)
+        self._steps.inc(k)
         self._words.inc(n_words)
         if prev is not None:
-            dur = now - prev
-            self._step_hist.observe(dur)
-            self.trace.add_span(
-                "step",
-                prev,
-                dur,
-                cat="step",
-                args={"step": step, "words": n_words},
-            )
-            self._append_row(
-                {
+            total = now - prev
+            dur = total / k
+            for i in range(k):
+                step_i = step - k + 1 + i
+                words_i = (
+                    int(words_each[i]) if words_each is not None
+                    else n_words // k
+                )
+                self._step_hist.observe(dur)
+                args: Dict[str, Any] = {"step": step_i, "words": words_i}
+                row: Dict[str, Any] = {
                     "kind": "step",
-                    "step": step,
+                    "step": step_i,
                     "epoch": epoch,
-                    "t": round(now - self._t0, 6),
+                    "t": round(prev + (i + 1) * dur - self._t0, 6),
                     "step_seconds": round(dur, 6),
-                    "words": n_words,
+                    "words": words_i,
                 }
-            )
-            if self.detectors is not None:
-                self.detectors.check_step_time(step, dur)
+                if k > 1:
+                    args["dispatch_k"] = k
+                    row["dispatch_k"] = k
+                self.trace.add_span(
+                    "step", prev + i * dur, dur, cat="step", args=args
+                )
+                self._append_row(row)
+                if self.detectors is not None:
+                    self.detectors.check_step_time(step_i, dur)
         # gate the span firehose to the configured step window (rare
         # events — eval/checkpoint/anomaly — bypass with force=True).
         # Ordering matters: the step span ABOVE was gated by the flag set

@@ -46,7 +46,7 @@ def test_program_flops_analytical_fallback():
             raise RuntimeError("no cost analysis on this backend")
 
     flops, kind = bench._program_flops(
-        BrokenUpdate(), None, None, None, None, None,
+        BrokenUpdate(), (None, None, None, None, None),
         n_params=1000, n_tokens=50,
     )
     assert kind == "analytical_6ND"

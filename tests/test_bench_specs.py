@@ -41,6 +41,26 @@ def test_all_specs_have_rep_defaults():
     assert bench.N_REPS >= 3
 
 
+def test_round7_fixed_floor_ab_arms():
+    """The round-7 A/B arms exist with honest knob combinations: the
+    fused arms ride every suite; the bf16 pairs (the dtype regime where
+    the shadow acts) are manual_only evidence arms, shadow implies a
+    pinned bf16 trunk, and each A/B pair shares its baseline's shape."""
+    cpu = _by_name("cpu")
+    assert cpu["trf_fused"]["fused"] and "trf_fused" in _by_name("tpu")
+    assert cpu["trf_realistic_cpu_fused"]["fused"]
+    assert (cpu["trf_realistic_cpu_fused"]["B"], cpu["trf_realistic_cpu_fused"]["T"]) == (
+        cpu["trf_realistic_cpu"]["B"], cpu["trf_realistic_cpu"]["T"]
+    )
+    for base, arm in (("trf_bf16", "trf_bf16_shadow"),
+                      ("trf_bf16_realistic", "trf_bf16_realistic_shadow")):
+        b, a = cpu[base], cpu[arm]
+        assert b["manual_only"] and a["manual_only"]
+        assert b["compute_dtype"] == a["compute_dtype"] == "bfloat16"
+        assert not b.get("shadow") and a["shadow"] and a["fused"]
+        assert (b["B"], b["T"]) == (a["B"], a["T"])
+
+
 def test_headline_summary_prefers_flagship(tmp_path, monkeypatch, capsys):
     session = tmp_path / "session.jsonl"
     monkeypatch.setattr(bench, "SESSION_FILE", session)

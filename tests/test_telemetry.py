@@ -486,6 +486,28 @@ def test_profile_window_knob(tagger_config_text, data_dir, tmp_path):
     assert produced, "profile_window [0, 2] produced no profiler artifacts"
 
 
+def test_profile_window_inside_k_dispatch_stride(
+    tagger_config_text, data_dir, tmp_path
+):
+    """A profile_window strictly inside one steps_per_dispatch stride must
+    still fire: the loop caps k_this so a dispatch lands exactly on the
+    window edges (start is only checked at dispatch boundaries)."""
+    cfg = _config(
+        tagger_config_text,
+        data_dir,
+        **{
+            "training.max_steps": 8,
+            "training.steps_per_dispatch": 4,
+            "training.profile_window": [5, 7],
+        },
+    )
+    train(cfg, n_workers=1, stdout_log=False, profile_dir=tmp_path / "prof")
+    produced = [p for p in (tmp_path / "prof").rglob("*") if p.is_file()]
+    assert produced, (
+        "profile_window [5, 7] inside a K=4 stride produced no artifacts"
+    )
+
+
 def test_nan_fault_kind_rejected_at_unwired_sites():
     """Only the step site polls consume_poison — a nan rule anywhere else
     would be a silent no-op drill, so the plan rejects it loudly."""
