@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry serving bench baseline profile step-perf dryrun
+.PHONY: test test-fast test-slow resilience telemetry serving fleet bench baseline profile step-perf dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -25,6 +25,13 @@ telemetry:
 # the heavy open-loop load variant is slow-marked and excluded here
 serving:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q -m "not slow"
+
+# multi-replica fleet suite: router balancing/health/retry, response
+# cache, metrics aggregation, supervisor restarts, autoscaler hysteresis,
+# whole-fleet SIGTERM drain (docs/SERVING.md "Fleet"); the real-load
+# crash-recovery and bench-record variants are slow-marked and excluded
+fleet:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -m "not slow"
 
 bench:
 	python bench.py

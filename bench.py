@@ -39,7 +39,7 @@ import json
 import os
 import time
 from pathlib import Path
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -1365,22 +1365,65 @@ def _serving_texts(n: int, seed: int = 0) -> List[str]:
     ]
 
 
-def _post_parse(host: str, port: int, texts: List[str],
-                timeout_s: float = 30.0):
-    """One POST /v1/parse; returns (status, latency_seconds)."""
-    import http.client
+class _ParseSession:
+    """Thread-safe pool of keep-alive connections for the load drivers.
 
-    body = json.dumps({"texts": texts}).encode("utf8")
-    t0 = time.perf_counter()
-    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
-    try:
-        conn.request("POST", "/v1/parse", body,
-                     {"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        resp.read()
-        return resp.status, time.perf_counter() - t0
-    finally:
-        conn.close()
+    A fresh TCP dial + server-side handler-thread spawn per request costs
+    several ms of pure Python on this container — at serving rates that
+    overhead IS the measurement unless connections persist (the servers
+    speak HTTP/1.1 keep-alive; real clients reuse connections too). A
+    request that fails on a reused connection (server closed it while
+    idle) is retried once on a fresh dial before counting as a failure —
+    ``/v1/parse`` is pure, so the resend is safe."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+        import threading
+
+        self.host, self.port, self.timeout_s = host, port, timeout_s
+        self._lock = threading.Lock()
+        self._idle: List[Any] = []
+
+    def post(self, texts: List[str]) -> Tuple[int, float]:
+        import http.client
+
+        body = json.dumps({"texts": texts}).encode("utf8")
+        headers = {"Content-Type": "application/json"}
+        t0 = time.perf_counter()
+        with self._lock:
+            conn = self._idle.pop() if self._idle else None
+        while True:
+            fresh = conn is None
+            if fresh:
+                conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout_s
+                )
+            try:
+                conn.request("POST", "/v1/parse", body, headers)
+                resp = conn.getresponse()
+                resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                if not fresh:
+                    conn = None
+                    continue
+                if isinstance(e, OSError):
+                    raise
+                raise OSError(f"HTTP protocol error: {e!r}")
+            if resp.will_close:
+                conn.close()
+            else:
+                with self._lock:
+                    self._idle.append(conn)
+            return resp.status, time.perf_counter() - t0
+
+    def close(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 def _latency_stats(lat: List[float]) -> Dict[str, Any]:
@@ -1414,8 +1457,6 @@ def run_serving(
     growth by slowing its own clients down). Warmup uses the engine's
     own (B, T) bucket sweep, so the load can only hit warmed shapes.
     Records land in BENCH_SESSION.jsonl like every other spec."""
-    import threading
-
     from spacy_ray_tpu.serving.engine import InferenceEngine, ServingTelemetry
     from spacy_ray_tpu.serving.server import Server
 
@@ -1450,41 +1491,13 @@ def run_serving(
 
     try:
         # -- closed loop: each client fires its next request the moment
-        # the previous returns; measures saturation throughput
-        stop_at = time.perf_counter() + duration_s
-        lat_lock = threading.Lock()
-        latencies: List[float] = []
-        counts = {"ok": 0, "rejected": 0, "failed": 0, "docs": 0}
-
-        def client(idx: int) -> None:
-            i = 0
-            while time.perf_counter() < stop_at:
-                texts = texts_pool[(idx * 31 + i) % len(texts_pool)]
-                try:
-                    status, dt = _post_parse(host, port, texts)
-                except OSError:
-                    with lat_lock:
-                        counts["failed"] += 1
-                    continue
-                with lat_lock:
-                    if status == 200:
-                        counts["ok"] += 1
-                        counts["docs"] += len(texts)
-                        latencies.append(dt)
-                    elif status in (429, 503, 504):
-                        counts["rejected"] += 1
-                    else:
-                        counts["failed"] += 1
-                i += 1
-
-        t0 = time.perf_counter()
-        threads = [threading.Thread(target=client, args=(i,), daemon=True)
-                   for i in range(clients)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
+        # the previous returns; measures saturation throughput. Same
+        # _drive_closed/_drive_open harness as the fleet specs (pooled
+        # keep-alive clients), so single-engine vs fleet comparisons
+        # measure the topology, not the client's connection handling.
+        wall, counts, latencies = _drive_closed(
+            host, port, duration_s, clients, texts_pool
+        )
         occ = occupancy_snapshot(tel)
         closed_rps = counts["ok"] / wall
         rec = {
@@ -1523,44 +1536,9 @@ def run_serving(
         tel_open = ServingTelemetry()
         engine.tel = tel_open
         rate = open_rate or max(closed_rps * 0.6, 1.0)
-        interval = 1.0 / rate
-        latencies2: List[float] = []
-        counts2 = {"ok": 0, "rejected": 0, "failed": 0, "docs": 0}
-        n_requests = max(int(duration_s * rate), 1)
-        workers: List[threading.Thread] = []
-
-        def one_shot(i: int) -> None:
-            texts = texts_pool[i % len(texts_pool)]
-            try:
-                status, dt = _post_parse(host, port, texts)
-            except OSError:
-                with lat_lock:
-                    counts2["failed"] += 1
-                return
-            with lat_lock:
-                if status == 200:
-                    counts2["ok"] += 1
-                    counts2["docs"] += len(texts)
-                    latencies2.append(dt)
-                elif status in (429, 503, 504):
-                    counts2["rejected"] += 1
-                else:
-                    counts2["failed"] += 1
-
-        t0 = time.perf_counter()
-        for i in range(n_requests):
-            # fire at the scheduled instant regardless of in-flight
-            # completions — the defining property of open-loop load
-            target = t0 + i * interval
-            delay = target - time.perf_counter()
-            if delay > 0:
-                time.sleep(delay)
-            th = threading.Thread(target=one_shot, args=(i,), daemon=True)
-            th.start()
-            workers.append(th)
-        for th in workers:
-            th.join(timeout=35.0)
-        wall2 = time.perf_counter() - t0
+        wall2, counts2, latencies2 = _drive_open(
+            host, port, duration_s, rate, texts_pool
+        )
         rec2 = {
             "name": "serving_open",
             "metric": (
@@ -1589,6 +1567,303 @@ def run_serving(
     finally:
         server.request_shutdown()
         server.wait()
+    return records
+
+
+def _get_json(host: str, port: int, path: str, timeout_s: float = 30.0):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _drive_closed(
+    host: str, port: int, duration_s: float, clients: int,
+    texts_pool: List[List[str]],
+) -> Tuple[float, Dict[str, int], List[float]]:
+    """Closed-loop load: each of ``clients`` threads fires its next
+    request the moment the previous returns. Returns (wall, counts,
+    latencies). Shared by the single-engine and fleet serving specs."""
+    import threading
+
+    stop_at = time.perf_counter() + duration_s
+    lock = threading.Lock()
+    latencies: List[float] = []
+    counts = {"ok": 0, "rejected": 0, "failed": 0, "docs": 0}
+    session = _ParseSession(host, port)
+
+    def client(idx: int) -> None:
+        i = 0
+        while time.perf_counter() < stop_at:
+            texts = texts_pool[(idx * 31 + i) % len(texts_pool)]
+            try:
+                status, dt = session.post(texts)
+            except OSError:
+                with lock:
+                    counts["failed"] += 1
+                continue
+            with lock:
+                if status == 200:
+                    counts["ok"] += 1
+                    counts["docs"] += len(texts)
+                    latencies.append(dt)
+                elif status in (429, 503, 504):
+                    counts["rejected"] += 1
+                else:
+                    counts["failed"] += 1
+            i += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    session.close()
+    return time.perf_counter() - t0, counts, latencies
+
+
+def _drive_open(
+    host: str, port: int, duration_s: float, rate: float,
+    texts_pool: List[List[str]],
+) -> Tuple[float, Dict[str, int], List[float]]:
+    """Open-loop load: requests fired at the scheduled instants
+    regardless of in-flight completions (the defining property)."""
+    import threading
+
+    interval = 1.0 / rate
+    lock = threading.Lock()
+    latencies: List[float] = []
+    counts = {"ok": 0, "rejected": 0, "failed": 0, "docs": 0}
+    n_requests = max(int(duration_s * rate), 1)
+    # shots still get a thread each (open loop: fire at the scheduled
+    # instant no matter what's in flight) but share pooled connections —
+    # at the steady state the pool holds ~concurrency connections
+    session = _ParseSession(host, port)
+
+    def one_shot(i: int) -> None:
+        texts = texts_pool[i % len(texts_pool)]
+        try:
+            status, dt = session.post(texts)
+        except OSError:
+            with lock:
+                counts["failed"] += 1
+            return
+        with lock:
+            if status == 200:
+                counts["ok"] += 1
+                counts["docs"] += len(texts)
+                latencies.append(dt)
+            elif status in (429, 503, 504):
+                counts["rejected"] += 1
+            else:
+                counts["failed"] += 1
+
+    t0 = time.perf_counter()
+    workers: List[threading.Thread] = []
+    for i in range(n_requests):
+        target = t0 + i * interval
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        th = threading.Thread(target=one_shot, args=(i,), daemon=True)
+        th.start()
+        workers.append(th)
+    for th in workers:
+        th.join(timeout=35.0)
+    session.close()
+    return time.perf_counter() - t0, counts, latencies
+
+
+def _fleet_occupancy(host: str, port: int) -> Tuple[float, float]:
+    """(count, sum) of the fleet-merged batch_occupancy histogram via
+    the router's aggregated /metrics — exact across replicas, so a
+    before/after delta isolates one load phase."""
+    try:
+        status, payload = _get_json(host, port, "/metrics")
+    except OSError:
+        return 0.0, 0.0
+    if status != 200:
+        return 0.0, 0.0
+    hist = (((payload.get("fleet") or {}).get("histograms") or {})
+            .get("batch_occupancy") or {})
+    count = hist.get("count") or 0
+    total = hist.get("sum") or 0.0
+    return float(count), float(total)
+
+
+def run_serving_fleet(
+    platform: str,
+    *,
+    replica_counts: List[int],
+    duration_s: float = 3.0,
+    clients: int = 8,
+    open_rate: Optional[float] = None,
+    max_batch: int = 16,
+    max_wait_ms: float = 2.0,
+    texts_per_request: int = 2,
+) -> List[Dict[str, Any]]:
+    """``--serving --replicas N[,M,...]``: drive the REAL fleet — router
+    process + N ``serve`` replica subprocesses — over HTTP, one closed-
+    and one open-loop spec per replica count. This is the horizontal-
+    scaling proof: same model, same load harness, replicas as the only
+    variable; records carry ``replicas`` so the scaling curve is
+    reconstructable from BENCH_SESSION.jsonl alone."""
+    import tempfile
+
+    from spacy_ray_tpu.serving.fleet import Fleet, FleetConfig
+
+    nlp = _serving_nlp()
+    tmpdir = tempfile.mkdtemp(prefix="srt_fleet_bench_")
+    model_dir = Path(tmpdir) / "model"
+    nlp.to_disk(model_dir)
+    del nlp  # the bench process only drives load; replicas own the model
+
+    texts_pool = [_serving_texts(texts_per_request, seed=i)
+                  for i in range(64)]
+    records: List[Dict[str, Any]] = []
+    device = "cpu" if platform == "cpu" else platform
+
+    # On CPU every replica gets ONE core (round-robin over this process's
+    # affinity set) — the CPU value of --visible-devices, which on TPU
+    # masks each replica to one chip. This is the fleet's real topology
+    # semantics, n=1 included: an unmasked single replica sprawls an
+    # XLA pool over every core, and co-scheduled unmasked replicas
+    # thrash each other into NEGATIVE scaling (measured; PERF.md
+    # "Fleet horizontal scaling").
+    cpu_cores: Optional[List[str]] = None
+    if device == "cpu":
+        cpu_cores = [str(c) for c in sorted(os.sched_getaffinity(0))]
+
+    for n in replica_counts:
+        config = FleetConfig(
+            model_path=str(model_dir),
+            host="127.0.0.1",
+            port=0,
+            device=device,
+            replicas=n,
+            min_replicas=n,
+            max_replicas=n,
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            queue_size=max(8 * max_batch, 128),
+            timeout_ms=30_000.0,
+            max_doc_len=64,
+            cpu_cores=cpu_cores,
+            autoscale=False,  # fixed n: the spec measures topology, not policy
+            telemetry=True,
+        )
+        fleet = Fleet(config)
+        t0 = time.perf_counter()
+        host, port = fleet.start()
+        if not fleet.wait_ready(n, timeout_s=600.0):
+            ready = len(fleet.router.ready_handles())
+            print(f"# fleet bench: only {ready}/{n} replicas ready — "
+                  "recording a skip", flush=True)
+            _append_session(
+                {"name": f"serving_fleet_closed_r{n}", "skipped": True,
+                 "reason": f"{ready}/{n} replicas ready within 600s"},
+                platform,
+            )
+            fleet.request_shutdown()
+            fleet.wait()
+            continue
+        ready_seconds = time.perf_counter() - t0
+        print(f"# fleet bench: {n} replica(s) ready in {ready_seconds:.1f}s "
+              f"at {host}:{port}", flush=True)
+
+        occ0 = _fleet_occupancy(host, port)
+        wall, counts, latencies = _drive_closed(
+            host, port, duration_s, clients, texts_pool
+        )
+        occ1 = _fleet_occupancy(host, port)
+        d_count, d_sum = occ1[0] - occ0[0], occ1[1] - occ0[1]
+        closed_rps = counts["ok"] / wall
+        rec = {
+            "name": "serving_fleet_closed",
+            "metric": (
+                f"fleet_requests_per_sec (closed loop, {clients} clients, "
+                f"{n} replicas behind the router"
+                + (", 1 core/replica" if cpu_cores else "")
+                + ", cnn tagger, HTTP)"
+            ),
+            "value": round(closed_rps, 1),
+            "unit": "req/s",
+            "platform": platform,
+            "mode": "closed",
+            "replicas": n,
+            "clients": clients,
+            "duration_s": round(wall, 2),
+            "requests_ok": counts["ok"],
+            "rejected": counts["rejected"],
+            "failed": counts["failed"],
+            "docs_per_sec": round(counts["docs"] / wall, 1),
+            "texts_per_request": texts_per_request,
+            "max_batch_docs": max_batch,
+            "max_wait_ms": max_wait_ms,
+            "ready_seconds": round(ready_seconds, 1),
+            "cpu_cores": cpu_cores,
+            "occupancy_mean": (
+                round(d_sum / d_count, 2) if d_count else None
+            ),
+            "batches": int(d_count),
+            **_latency_stats(latencies),
+        }
+        print(json.dumps(rec), flush=True)
+        _append_session(rec, platform)
+        records.append(rec)
+
+        rate = open_rate or max(closed_rps * 0.6, 1.0)
+        occ0 = _fleet_occupancy(host, port)
+        wall2, counts2, latencies2 = _drive_open(
+            host, port, duration_s, rate, texts_pool
+        )
+        occ1 = _fleet_occupancy(host, port)
+        d_count, d_sum = occ1[0] - occ0[0], occ1[1] - occ0[1]
+        rec2 = {
+            "name": "serving_fleet_open",
+            "metric": (
+                f"fleet_latency_under_open_loop (fixed {rate:.0f} req/s "
+                f"offered, {n} replicas behind the router"
+                + (", 1 core/replica" if cpu_cores else "")
+                + ", cnn tagger, HTTP)"
+            ),
+            "value": round(counts2["ok"] / wall2, 1),
+            "unit": "req/s",
+            "platform": platform,
+            "mode": "open",
+            "replicas": n,
+            "offered_rps": round(rate, 1),
+            "duration_s": round(wall2, 2),
+            "requests_ok": counts2["ok"],
+            "rejected": counts2["rejected"],
+            "failed": counts2["failed"],
+            "docs_per_sec": round(counts2["docs"] / wall2, 1),
+            "texts_per_request": texts_per_request,
+            "max_batch_docs": max_batch,
+            "max_wait_ms": max_wait_ms,
+            "cpu_cores": cpu_cores,
+            "occupancy_mean": (
+                round(d_sum / d_count, 2) if d_count else None
+            ),
+            "batches": int(d_count),
+            **_latency_stats(latencies2),
+        }
+        print(json.dumps(rec2), flush=True)
+        _append_session(rec2, platform)
+        records.append(rec2)
+
+        fleet.request_shutdown()
+        fleet_rc = fleet.wait()
+        if fleet_rc != 0:
+            print(f"# fleet bench: WARNING drain rc={fleet_rc} at n={n}",
+                  flush=True)
     return records
 
 
@@ -1868,6 +2143,13 @@ def main() -> None:
         "measured closed-loop rate)",
     )
     parser.add_argument(
+        "--replicas", type=str, default="",
+        help="--serving: run the FLEET specs instead — comma-separated "
+        "replica counts (e.g. 1,2,4), each driven through the real "
+        "router + serve-subprocess topology; records carry 'replicas' "
+        "so the scaling curve lives in BENCH_SESSION.jsonl",
+    )
+    parser.add_argument(
         "--tpu-only", action="store_true",
         help="parent mode: if the accelerator never serves, exit WITHOUT "
         "the CPU fallback — for a background campaign that must not "
@@ -1891,12 +2173,24 @@ def main() -> None:
             print(f"# backend init failed ({e}); falling back to CPU",
                   flush=True)
             jax.config.update("jax_platforms", "cpu")
-        run_serving(
-            jax.default_backend(),
-            duration_s=float(args.serving_duration),
-            clients=int(args.serving_clients),
-            open_rate=float(args.serving_rate) or None,
-        )
+        if args.replicas.strip():
+            counts = [
+                int(c) for c in args.replicas.split(",") if c.strip()
+            ]
+            run_serving_fleet(
+                jax.default_backend(),
+                replica_counts=counts,
+                duration_s=float(args.serving_duration),
+                clients=int(args.serving_clients),
+                open_rate=float(args.serving_rate) or None,
+            )
+        else:
+            run_serving(
+                jax.default_backend(),
+                duration_s=float(args.serving_duration),
+                clients=int(args.serving_clients),
+                open_rate=float(args.serving_rate) or None,
+            )
         return
 
     if args.update_only:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 from pathlib import Path
 from typing import Any, Dict, List, Optional
@@ -1524,15 +1525,14 @@ def serve_command(argv: List[str]) -> int:
         max_doc_len=args.max_doc_len,
         telemetry=tel,
     )
-    engine.start(warmup=not args.no_warmup)
-    if engine.warmed:
-        print(f"warmed {len(engine.warmed)} (B, T) bucket programs "
-              f"(up to B={args.max_batch}, T≈{args.max_doc_len})", flush=True)
     server = Server(
         engine, args.host, args.port,
         telemetry=tel, drain_timeout_s=args.drain_timeout_s,
     )
-    rc = server.run()
+    # listener-first: the banner (and thus the bound port) appears before
+    # the warmup sweep, so a fleet supervisor can probe /healthz — which
+    # reports 503 "warming" until every bucket program is compiled
+    rc = server.run(warmup_engine=not args.no_warmup)
     if tel is not None and args.metrics_dir is not None:
         import json
 
@@ -1555,6 +1555,159 @@ def serve_command(argv: List[str]) -> int:
     return rc
 
 
+def serve_fleet_command(argv: List[str]) -> int:
+    """``serve-fleet`` — horizontally-scaled serving (docs/SERVING.md
+    "Fleet"): a router process load-balancing ``/v1/parse`` over N
+    ``serve`` replica subprocesses with health-probed rotation, crash
+    restarts with backoff, optional SLO-driven autoscaling, and a
+    fleet-wide SIGTERM drain (router stops admitting, replicas finish
+    in-flight work, exit 0).
+
+    This process never imports jax — it only spawns, probes, and proxies;
+    every device interaction lives in the replicas."""
+    parser = argparse.ArgumentParser(
+        prog="spacy_ray_tpu serve-fleet",
+        description="Serve a saved pipeline from N engine replicas behind "
+        "one load-balancing router (/v1/parse, /healthz, /metrics).",
+    )
+    parser.add_argument("model_path", type=Path)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8090,
+                        help="router port (0 = ephemeral; printed in the "
+                        "'fleet serving on http://...' banner)")
+    parser.add_argument("--device", type=str, default="tpu",
+                        choices=["tpu", "cpu", "gpu"],
+                        help="device each replica pins (replicas are "
+                        "separate processes; see --visible-devices)")
+    parser.add_argument("--replicas", type=int, default=2,
+                        help="initial replica count")
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument("--max-replicas", type=int, default=4)
+    parser.add_argument("--base-port", type=int, default=0,
+                        help="replica ports: 0 = ephemeral (parsed from "
+                        "each replica's banner), N = N + replica_id")
+    parser.add_argument("--visible-devices", type=str, default=None,
+                        help="comma-separated visible-device masks cycled "
+                        "per replica (sets CUDA_VISIBLE_DEVICES or "
+                        "--visible-devices-env in each replica's env)")
+    parser.add_argument("--visible-devices-env", type=str,
+                        default="CUDA_VISIBLE_DEVICES")
+    parser.add_argument("--cpu-cores", type=str, default=None,
+                        help="--device cpu only: 'auto' or comma-separated "
+                        "taskset -c core masks cycled per replica (e.g. "
+                        "'0-3,4-7' gives replica 0 cores 0-3). The CPU "
+                        "value of --visible-devices: without masks, "
+                        "co-scheduled replicas each spawn an nproc-wide "
+                        "XLA pool and thrash (measured NEGATIVE scaling); "
+                        "'auto' resolves to one core per replica, "
+                        "round-robin over this process's affinity set")
+    # per-replica serving knobs, passed through to each `serve` child
+    parser.add_argument("--max-batch", type=int, default=None)
+    parser.add_argument("--max-wait-ms", type=float, default=None)
+    parser.add_argument("--queue-size", type=int, default=None)
+    parser.add_argument("--timeout-ms", type=float, default=None)
+    parser.add_argument("--max-doc-len", type=int, default=None)
+    # router knobs
+    parser.add_argument("--cache-mb", type=float, default=0.0,
+                        help="router response cache budget in MB, keyed by "
+                        "input-text hash (0 = off); hit/miss counters in "
+                        "/metrics")
+    parser.add_argument("--probe-interval-s", type=float, default=0.5,
+                        help="how often the router re-probes each "
+                        "replica's /healthz")
+    # autoscaler knobs (TUNING.md §12)
+    parser.add_argument("--autoscale", action="store_true",
+                        help="enable the SLO-driven autoscaler (scale "
+                        "between --min/--max-replicas on p99 vs "
+                        "--p99-target-ms and queue pressure)")
+    parser.add_argument("--p99-target-ms", type=float, default=500.0)
+    parser.add_argument("--autoscale-interval-s", type=float, default=2.0)
+    parser.add_argument("--up-consecutive", type=int, default=3,
+                        help="breaching observations required to scale up")
+    parser.add_argument("--down-consecutive", type=int, default=10,
+                        help="idle observations required to scale down")
+    parser.add_argument("--cooldown-s", type=float, default=30.0,
+                        help="minimum seconds between scaling decisions")
+    parser.add_argument("--drain-timeout-s", type=float, default=60.0,
+                        help="fleet drain budget: router in-flight wait + "
+                        "per-replica graceful stop")
+    parser.add_argument("--ready-timeout-s", type=float, default=300.0)
+    parser.add_argument("--no-telemetry", action="store_true",
+                        help="disable router + replica telemetry (zero "
+                        "telemetry calls fleet-wide)")
+    parser.add_argument("--verbose", "-V", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.ERROR)
+    for name in ("spacy_ray_tpu.training", "spacy_ray_tpu.serving"):
+        logging.getLogger(name).setLevel(
+            logging.INFO if args.verbose else logging.WARNING
+        )
+    if args.min_replicas < 1 or args.replicas < 1:
+        print("--replicas/--min-replicas must be >= 1", file=sys.stderr)
+        return 2
+    if not (args.min_replicas <= args.replicas <= args.max_replicas):
+        print(
+            f"--replicas {args.replicas} must lie within --min-replicas "
+            f"{args.min_replicas} .. --max-replicas {args.max_replicas}",
+            file=sys.stderr,
+        )
+        return 2
+
+    from .serving.fleet import Fleet, FleetConfig
+
+    cpu_cores: Optional[List[str]] = None
+    if args.cpu_cores:
+        if args.device != "cpu":
+            print("--cpu-cores only applies to --device cpu; ignoring",
+                  file=sys.stderr)
+        elif args.cpu_cores.strip().lower() == "auto":
+            cpu_cores = [str(c) for c in sorted(os.sched_getaffinity(0))]
+        else:
+            cpu_cores = [m.strip() for m in args.cpu_cores.split(",")
+                         if m.strip()]
+
+    config = FleetConfig(
+        model_path=str(args.model_path),
+        host=args.host,
+        port=args.port,
+        device=args.device,
+        replicas=args.replicas,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_size=args.queue_size,
+        timeout_ms=args.timeout_ms,
+        max_doc_len=args.max_doc_len,
+        base_port=args.base_port,
+        visible_devices=(
+            [m.strip() for m in args.visible_devices.split(",") if m.strip()]
+            if args.visible_devices else None
+        ),
+        visible_devices_env=args.visible_devices_env,
+        cpu_cores=cpu_cores,
+        cache_mb=args.cache_mb,
+        probe_interval_s=args.probe_interval_s,
+        autoscale=args.autoscale,
+        p99_target_ms=args.p99_target_ms,
+        autoscale_interval_s=args.autoscale_interval_s,
+        up_consecutive=args.up_consecutive,
+        down_consecutive=args.down_consecutive,
+        cooldown_s=args.cooldown_s,
+        drain_timeout_s=args.drain_timeout_s,
+        ready_timeout_s=args.ready_timeout_s,
+        telemetry=not args.no_telemetry,
+    )
+    rc = Fleet(config).run()
+    if rc == 0:
+        print("fleet drained; exiting 0", flush=True)
+    else:
+        print("fleet drain incomplete (router timeout or nonzero replica "
+              f"exit) — exiting {rc}", flush=True)
+    return rc
+
+
 def _project_command(argv: List[str]) -> int:
     """spaCy-projects-style workflow runner (`project run` / `project
     document`); implementation in project.py."""
@@ -1571,6 +1724,7 @@ COMMANDS = {
     "apply": lambda argv: parse_command(argv, prog="apply"),
     "debug-profile": debug_profile_command,
     "serve": serve_command,
+    "serve-fleet": serve_fleet_command,
     "telemetry": telemetry_command,
     "find-threshold": find_threshold_command,
     "info": info_command,

@@ -16,6 +16,7 @@ from .batcher import (
     DeadlineExceeded,
     Draining,
     DynamicBatcher,
+    NotReady,
     QueueFull,
     RequestTooLarge,
     ServeRequest,
@@ -33,6 +34,7 @@ __all__ = [
     "ServingError",
     "QueueFull",
     "Draining",
+    "NotReady",
     "DeadlineExceeded",
     "RequestTooLarge",
     "ServeRequest",

@@ -37,6 +37,7 @@ __all__ = [
     "ServingError",
     "QueueFull",
     "Draining",
+    "NotReady",
     "DeadlineExceeded",
     "RequestTooLarge",
     "ServeRequest",
@@ -66,6 +67,16 @@ class Draining(ServingError):
 
     http_status = 503
     code = "draining"
+
+
+class NotReady(ServingError):
+    """The replica's bucket warmup sweep has not completed: admitting a
+    request now would run it into a live XLA compile (seconds of added
+    latency) — the exact surprise warmup exists to prevent. A router
+    treats this 503 as "do not send traffic yet", same as draining."""
+
+    http_status = 503
+    code = "warming"
 
 
 class DeadlineExceeded(ServingError):

@@ -256,6 +256,11 @@ class InferenceEngine:
         self._idle = threading.Condition(self._state_lock)
         self._active_batches = 0
         self._started = False
+        # readiness is distinct from started-ness: the HTTP listener may
+        # be up (so a router can probe /healthz and learn the port) while
+        # the warmup sweep is still compiling. Until ready, traffic gets
+        # a typed 503 NotReady — never a live mid-warmup compile.
+        self.ready = False
         self.warmed: List[Tuple[int, int]] = []
 
     # -- lifecycle ------------------------------------------------------
@@ -287,6 +292,7 @@ class InferenceEngine:
             target=self._dispatch_loop, name="serve-dispatch", daemon=True
         )
         self._thread.start()
+        self.ready = True  # last: readiness implies warmed AND dispatching
         return self
 
     # -- submission (handler threads) -----------------------------------
@@ -422,6 +428,7 @@ class InferenceEngine:
     def stop(self) -> None:
         """Hard stop: close the batcher (failing anything still queued)
         and join the dispatch thread."""
+        self.ready = False
         self.batcher.close()
         self.batcher.fail_all_queued(Draining("server shut down"))
         if self._thread is not None:

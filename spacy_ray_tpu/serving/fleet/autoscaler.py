@@ -1,0 +1,213 @@
+"""SLO-driven autoscaling: grow/shrink the replica count from the
+telemetry the engines already emit.
+
+The policy consumes one :class:`FleetObservation` per tick — worst
+replica p99 over its rolling latency window, total queued docs, mean
+batch occupancy, ready count — and answers "what replica count do we
+want?". Two design rules keep it boring (boring is what you want in a
+control loop):
+
+* **Hysteresis, not thresholds.** A decision needs ``up_consecutive``
+  (resp. ``down_consecutive``) CONSECUTIVE breaching observations; a
+  single recovered tick resets the streak. An oscillating metric that
+  crosses the threshold every other tick therefore never scales — the
+  classic flapping failure of naive threshold scaling.
+* **Cooldown after every action.** Scaling takes effect slowly (a new
+  replica must boot + warm before it absorbs load; a drained one hands
+  its load back); deciding again before the last decision has landed
+  would double-count the same pressure. ``cooldown_s`` on an injected
+  clock gates re-decisions; tests drive it deterministically.
+
+Scale-up triggers on SLO pressure (p99 above target) OR queue pressure
+(queued docs per ready replica above ``queue_high``); scale-down needs
+BOTH a comfortable p99 (under ``down_frac`` × target) AND an idle-ish
+fleet (occupancy under ``occupancy_low`` and near-empty queues) — the
+asymmetry is deliberate: adding capacity cheaply fixes a wrong guess up,
+while removing it wrongly burns the SLO.
+
+Every decision is a structured ``log_event`` row (machine-readable — the
+jsonl logger drains it) and, when fleet telemetry is attached, a trace
+instant + counter; the disabled-telemetry path makes zero telemetry
+calls, the repo-wide contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from ...training.resilience import log_event
+
+__all__ = ["FleetObservation", "AutoscalerPolicy", "observation_from_snapshots"]
+
+
+@dataclass
+class FleetObservation:
+    """One tick's worth of fleet SLO signal (already aggregated)."""
+
+    ready: int                       # replicas currently taking traffic
+    p99_s: Optional[float] = None    # worst replica request-latency p99
+    queue_depth: float = 0.0         # total queued docs across replicas
+    occupancy: Optional[float] = None  # mean batch occupancy
+
+
+def observation_from_snapshots(
+    snaps: List[Dict[str, Any]], ready: int
+) -> FleetObservation:
+    """Build an observation from scraped per-replica /metrics payloads
+    (the ServingTelemetry.snapshot() schema). Missing pieces stay None —
+    a replica with no traffic yet has no p99, and the policy treats
+    no-signal as no-pressure."""
+    p99s = []
+    queue = 0.0
+    occ_sum = occ_n = 0.0
+    for snap in snaps:
+        slo = snap.get("slo") or {}
+        p99 = slo.get("request_latency_p99")
+        if isinstance(p99, (int, float)):
+            p99s.append(float(p99))
+        gauges = snap.get("gauges") or {}
+        qd = gauges.get("queue_depth")
+        if isinstance(qd, (int, float)):
+            queue += float(qd)
+        occ = slo.get("batch_occupancy_p50")
+        if isinstance(occ, (int, float)):
+            occ_sum += float(occ)
+            occ_n += 1
+    return FleetObservation(
+        ready=int(ready),
+        p99_s=max(p99s) if p99s else None,
+        queue_depth=queue,
+        occupancy=(occ_sum / occ_n) if occ_n else None,
+    )
+
+
+class AutoscalerPolicy:
+    """Deterministic hysteresis policy: feed :meth:`observe` once per
+    tick; it returns the desired replica count, or None for "hold".
+
+    All timing runs on the injected ``clock`` — tests advance a fake
+    clock and the policy's behaviour is exactly reproducible.
+    """
+
+    def __init__(
+        self,
+        *,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        p99_target_s: float = 0.5,
+        queue_high: float = 32.0,
+        down_frac: float = 0.5,
+        occupancy_low: float = 2.0,
+        up_consecutive: int = 3,
+        down_consecutive: int = 10,
+        cooldown_s: float = 30.0,
+        step: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas ({max_replicas}) must be >= min_replicas "
+                f"({min_replicas})"
+            )
+        if up_consecutive < 1 or down_consecutive < 1:
+            raise ValueError("hysteresis windows must be >= 1 observation")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.p99_target_s = float(p99_target_s)
+        self.queue_high = float(queue_high)
+        self.down_frac = float(down_frac)
+        self.occupancy_low = float(occupancy_low)
+        self.up_consecutive = int(up_consecutive)
+        self.down_consecutive = int(down_consecutive)
+        self.cooldown_s = float(cooldown_s)
+        self.step = max(int(step), 1)
+        self.clock = clock
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_action_at: Optional[float] = None
+        self.decisions: List[Dict[str, Any]] = []  # bounded by caller usage
+
+    # -- signal classification ------------------------------------------
+    def _overloaded(self, obs: FleetObservation) -> bool:
+        if obs.p99_s is not None and obs.p99_s > self.p99_target_s:
+            return True
+        per_replica_queue = obs.queue_depth / max(obs.ready, 1)
+        return per_replica_queue > self.queue_high
+
+    def _idle(self, obs: FleetObservation) -> bool:
+        if obs.queue_depth > 0:
+            return False
+        if obs.p99_s is not None and obs.p99_s > self.down_frac * self.p99_target_s:
+            return False
+        if obs.occupancy is not None and obs.occupancy > self.occupancy_low:
+            return False
+        return True
+
+    # -- the tick --------------------------------------------------------
+    def observe(self, obs: FleetObservation) -> Optional[int]:
+        """Classify the tick, advance the streaks, return the desired
+        replica count when a streak completes outside the cooldown."""
+        over = self._overloaded(obs)
+        idle = self._idle(obs)
+        now = self.clock()
+        if (
+            self._last_action_at is not None
+            and now - self._last_action_at < self.cooldown_s
+        ):
+            # evidence observed during the cooldown is DISCARDED, not
+            # banked: the last action has not finished landing (replica
+            # still booting/draining), so these ticks measure a fleet in
+            # transition — a post-cooldown decision must rebuild its
+            # streak from fresh observations
+            self._up_streak = self._down_streak = 0
+            return None
+        # streaks reset on ANY non-confirming tick — that is the whole
+        # anti-flapping property
+        self._up_streak = self._up_streak + 1 if over else 0
+        self._down_streak = self._down_streak + 1 if idle else 0
+        if over and self._up_streak >= self.up_consecutive:
+            desired = min(obs.ready + self.step, self.max_replicas)
+            if desired > obs.ready:
+                self._record("up", obs, desired, now)
+                return desired
+            self._up_streak = 0  # pinned at max: don't re-fire every tick
+            return None
+        if idle and self._down_streak >= self.down_consecutive:
+            desired = max(obs.ready - self.step, self.min_replicas)
+            if desired < obs.ready:
+                self._record("down", obs, desired, now)
+                return desired
+            self._down_streak = 0
+            return None
+        return None
+
+    def _record(
+        self, direction: str, obs: FleetObservation, desired: int, now: float
+    ) -> None:
+        self._last_action_at = now
+        self._up_streak = 0
+        self._down_streak = 0
+        decision = {
+            "direction": direction,
+            "from": obs.ready,
+            "to": desired,
+            "p99_s": obs.p99_s,
+            "p99_target_s": self.p99_target_s,
+            "queue_depth": obs.queue_depth,
+            "occupancy": obs.occupancy,
+        }
+        self.decisions.append(decision)
+        log_event(
+            f"autoscale-{direction}",
+            f"scaling {obs.ready} -> {desired} replicas "
+            f"(p99 {obs.p99_s if obs.p99_s is not None else 'n/a'} vs "
+            f"target {self.p99_target_s}s, queue {obs.queue_depth:.0f}, "
+            f"occupancy {obs.occupancy if obs.occupancy is not None else 'n/a'})",
+            level=logging.INFO,
+            **decision,
+        )

@@ -1,0 +1,314 @@
+"""Fleet orchestration: wire supervisor + router + autoscaler into one
+process with one lifecycle.
+
+Topology (one fleet process, N replica processes)::
+
+            clients
+               |
+        RouterHTTPServer (:port)         <- this process
+         /v1/parse  /healthz  /metrics
+               |
+        Router (least-outstanding, health-probed, retry-on-crash)
+          |         |          |
+       serve #0  serve #1 ... serve #N-1  <- subprocesses (one engine each)
+          ^---- ReplicaSupervisor (spawn / backoff-restart / scale)
+                      ^---- AutoscalerPolicy (SLO telemetry -> scale_to)
+
+Shutdown is the trainer's drain discipline applied at fleet scope, via
+the same ``ShutdownCoordinator.add_callback`` hook the single-replica
+server uses: SIGTERM →
+
+1. the router stops admitting (``/v1/parse`` and ``/healthz`` go 503);
+2. in-flight forwarded requests complete (router-side wait);
+3. every replica gets SIGTERM and runs its OWN graceful drain
+   (finish queued + in-flight batches, exit 0) — in parallel, so the
+   fleet drains in max(replica drain), not sum;
+4. the fleet exits 0 iff the router went quiet AND every replica
+   exited 0 — the honest-failure contract everywhere else in the repo.
+"""
+
+from __future__ import annotations
+
+import logging
+import shutil
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ...training.resilience import ShutdownCoordinator, log_event
+from .autoscaler import AutoscalerPolicy, observation_from_snapshots
+from .replica import ReplicaSupervisor, build_serve_cmd
+from .router import Router, RouterHTTPServer, RouterTelemetry
+
+__all__ = ["FleetConfig", "Fleet"]
+
+logger = logging.getLogger("spacy_ray_tpu.serving")
+
+
+@dataclass
+class FleetConfig:
+    """Everything a fleet needs; CLI flags and bench specs both build
+    one of these (one knob surface, no drift)."""
+
+    model_path: str
+    host: str = "127.0.0.1"
+    port: int = 8090
+    device: str = "cpu"
+    replicas: int = 2                 # initial size
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # per-replica serving knobs (None = the serve command's defaults)
+    max_batch: Optional[int] = None
+    max_wait_ms: Optional[float] = None
+    queue_size: Optional[int] = None
+    timeout_ms: Optional[float] = None
+    max_doc_len: Optional[int] = None
+    replica_drain_timeout_s: float = 30.0
+    # replica port assignment: 0 = ephemeral (parsed from each banner);
+    # nonzero = base_port + slot (fixed layouts for firewalls — slots
+    # are recycled across scale cycles, so ports never drift)
+    base_port: int = 0
+    # per-replica device pinning: visible-device masks cycled by the
+    # replica's SLOT, e.g. ["0", "1"] -> slot 0 sees device 0, slot 1
+    # device 1 (slots recycle, so a scale cycle can't double-book one)
+    visible_devices: Optional[List[str]] = None
+    visible_devices_env: str = "CUDA_VISIBLE_DEVICES"
+    # the CPU value of the same idea: ``taskset -c`` core masks cycled by
+    # slot, e.g. ["0", "1"] -> slot 0 owns core 0. On CPU the
+    # "device" a replica must not share IS its core set — co-scheduled
+    # unmasked replicas each spawn an nproc-wide XLA pool and thrash
+    # (measured NEGATIVE scaling on this container without masks).
+    # "auto" in the CLI resolves to one core per replica round-robin.
+    cpu_cores: Optional[List[str]] = None
+    # router
+    cache_mb: float = 0.0             # 0 = response cache off
+    probe_interval_s: float = 0.5
+    # autoscaler (disabled unless autoscale=True)
+    autoscale: bool = False
+    p99_target_ms: float = 500.0
+    autoscale_interval_s: float = 2.0
+    up_consecutive: int = 3
+    down_consecutive: int = 10
+    cooldown_s: float = 30.0
+    # lifecycle
+    drain_timeout_s: float = 60.0
+    ready_timeout_s: float = 300.0
+    telemetry: bool = True
+    extra_replica_args: List[str] = field(default_factory=list)
+
+    def build_cmd(self, slot: int) -> List[str]:
+        # keyed on the replica's recycled resource SLOT, not its
+        # monotonically-growing id: after scale-down/scale-up cycles the
+        # mask and port layout stay within the configured set instead of
+        # drifting (two live replicas sharing one core while another
+        # sits idle is exactly the co-scheduling collapse masking exists
+        # to prevent)
+        port = 0 if self.base_port == 0 else self.base_port + slot
+        prefix: List[str] = []
+        if self.cpu_cores and self.device == "cpu":
+            taskset = shutil.which("taskset")
+            if taskset is None:
+                logger.warning(
+                    "cpu_cores set but taskset is unavailable; replica "
+                    "slot %d spawns unpinned", slot,
+                )
+            else:
+                mask = self.cpu_cores[slot % len(self.cpu_cores)]
+                prefix = [taskset, "-c", mask]
+        return prefix + build_serve_cmd(
+            self.model_path,
+            device=self.device,
+            port=port,
+            host="127.0.0.1",
+            max_batch=self.max_batch,
+            max_wait_ms=self.max_wait_ms,
+            queue_size=self.queue_size,
+            timeout_ms=self.timeout_ms,
+            max_doc_len=self.max_doc_len,
+            drain_timeout_s=self.replica_drain_timeout_s,
+            no_telemetry=not self.telemetry,
+            extra_args=self.extra_replica_args,
+        )
+
+    def build_env(self, slot: int) -> Dict[str, str]:
+        env: Dict[str, str] = {}
+        if self.device == "cpu":
+            # pin the platform in the child's env too: images whose
+            # sitecustomize imports jax at boot lock the platform before
+            # the child's _setup_device runs
+            env["JAX_PLATFORMS"] = "cpu"
+        if self.visible_devices:
+            mask = self.visible_devices[slot % len(self.visible_devices)]
+            env[self.visible_devices_env] = mask
+        return env
+
+
+class Fleet:
+    """One fleet lifecycle: ``run()`` for the CLI (signal handlers +
+    banner), ``start()``/``request_shutdown()``/``wait()`` for tests and
+    the bench — the same drain code either way, mirroring ``Server``."""
+
+    def __init__(self, config: FleetConfig) -> None:
+        self.config = config
+        self.tel = RouterTelemetry() if config.telemetry else None
+        self.supervisor = ReplicaSupervisor(
+            config.build_cmd,
+            build_env=config.build_env,
+            grace_s=config.replica_drain_timeout_s + 15.0,
+        )
+        self.router = Router(
+            self.supervisor.handles,
+            telemetry=self.tel,
+            cache_bytes=int(config.cache_mb * 1024 * 1024),
+            probe_interval_s=config.probe_interval_s,
+        )
+        self.policy: Optional[AutoscalerPolicy] = None
+        if config.autoscale:
+            self.policy = AutoscalerPolicy(
+                min_replicas=config.min_replicas,
+                max_replicas=config.max_replicas,
+                p99_target_s=config.p99_target_ms / 1e3,
+                up_consecutive=config.up_consecutive,
+                down_consecutive=config.down_consecutive,
+                cooldown_s=config.cooldown_s,
+            )
+        self.httpd = RouterHTTPServer((config.host, config.port), self.router)
+        self._stop = threading.Event()
+        self._serve_thread: Optional[threading.Thread] = None
+        self._autoscale_thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        host, port = self.httpd.server_address[:2]
+        return str(host), int(port)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> Tuple[str, int]:
+        self.supervisor.start(self.config.replicas)
+        self.router.start()
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="fleet-http",
+            daemon=True,
+        )
+        self._serve_thread.start()
+        if self.policy is not None:
+            self._autoscale_thread = threading.Thread(
+                target=self._autoscale_loop,
+                name="fleet-autoscaler",
+                daemon=True,
+            )
+            self._autoscale_thread.start()
+        return self.address
+
+    def wait_ready(
+        self, n: Optional[int] = None, timeout_s: Optional[float] = None
+    ) -> bool:
+        """Block until ``n`` replicas (default: all initial) are ready —
+        warmup done, /healthz 200. The prober runs on its own cadence;
+        this just polls its verdict."""
+        want = self.config.replicas if n is None else int(n)
+        deadline = time.monotonic() + (
+            self.config.ready_timeout_s if timeout_s is None else timeout_s
+        )
+        while time.monotonic() < deadline:
+            if len(self.router.ready_handles()) >= want:
+                return True
+            if self._stop.is_set():
+                return False
+            time.sleep(0.1)
+        return False
+
+    # -- autoscaling ----------------------------------------------------
+    def _autoscale_loop(self) -> None:
+        interval = self.config.autoscale_interval_s
+        while not self._stop.wait(interval):
+            if self.router.draining:
+                return
+            try:
+                self.autoscale_tick()
+            except Exception:  # the control loop must survive anything
+                logger.exception("autoscaler tick failed")
+
+    def autoscale_tick(self) -> Optional[int]:
+        """One observe-decide-act cycle (callable directly by tests)."""
+        assert self.policy is not None
+        snaps = self.router.scrape_replica_metrics()
+        obs = observation_from_snapshots(
+            snaps, ready=len(self.router.ready_handles())
+        )
+        desired = self.policy.observe(obs)
+        if desired is not None:
+            if self.tel is not None:
+                self.tel.trace.add_instant(
+                    "autoscale", cat="fleet",
+                    args={"from": obs.ready, "to": desired},
+                )
+                self.tel.registry.counter("autoscale_decisions").inc()
+            self.supervisor.scale_to(desired)
+        return desired
+
+    # -- shutdown -------------------------------------------------------
+    def request_shutdown(self, signum: Optional[int] = None) -> None:
+        """Signal-handler-safe (flag writes + Event set only, like
+        Server.request_shutdown): the admission gate flips instantly;
+        the waiting thread performs the actual drain."""
+        self.router.draining = True
+        self._stop.set()
+
+    def wait(self) -> int:
+        self._stop.wait()
+        self.router.begin_drain()
+        self.supervisor.begin_drain()  # a crash during drain stays down
+        log_event(
+            "fleet-drain",
+            "shutdown requested — draining router, then "
+            f"{self.supervisor.replica_count} replica(s)",
+            level=logging.INFO,
+        )
+        router_quiet = self.router.wait_inflight(self.config.drain_timeout_s)
+        self.router.stop()
+        replicas_clean = self.supervisor.stop_all()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        clean = router_quiet and replicas_clean
+        if not clean:
+            log_event(
+                "fleet-drain-failed",
+                f"router_quiet={router_quiet} replicas_clean={replicas_clean}",
+            )
+        return 0 if clean else 1
+
+    def run(self, *, banner: bool = True) -> int:
+        coordinator = ShutdownCoordinator()
+        coordinator.add_callback(self.request_shutdown)
+        coordinator.install()
+        try:
+            host, port = self.start()
+            if banner:
+                # parseable, like the single-replica banner: tests and
+                # operator scripts read the router address from it
+                print(
+                    f"fleet serving on http://{host}:{port} "
+                    f"({self.config.replicas} replica(s), device "
+                    f"{self.config.device})",
+                    flush=True,
+                )
+            if self.wait_ready():
+                if banner:
+                    print(
+                        f"fleet ready: {len(self.router.ready_handles())} "
+                        "replica(s) warmed", flush=True,
+                    )
+            elif not self._stop.is_set():
+                print(
+                    "fleet NOT ready within "
+                    f"{self.config.ready_timeout_s:.0f}s — serving with "
+                    f"{len(self.router.ready_handles())} ready replica(s)",
+                    flush=True,
+                )
+            return self.wait()
+        finally:
+            coordinator.restore()

@@ -1,0 +1,692 @@
+"""Fleet router: one HTTP front-end load-balancing ``/v1/parse`` over N
+engine replicas.
+
+Balancing policy is least-outstanding-requests: among READY replicas,
+pick the one with the fewest requests currently forwarded to it. With
+homogeneous replicas this is the classic supermarket rule — it tracks
+the real signal (how busy a replica is NOW, including slow batches)
+rather than round-robin's assumption that every request costs the same.
+
+Readiness is probed, never assumed: a background prober GETs each
+replica's ``/healthz`` — 200 marks it ready, 503 (``warming`` during
+the bucket compile sweep, ``draining`` during shutdown) or a connection
+error marks it out. A forward that fails at the socket level marks the
+replica unready IMMEDIATELY (no waiting for the next probe) and retries
+the request on another replica — a replica crash under load costs the
+in-flight retry, never a client-visible 5xx. When no replica is ready,
+admission fails with a typed 503 ``no_replica`` instantly (shed, don't
+queue blind).
+
+The router deliberately does NOT parse request/response JSON on the hot
+path — it forwards bytes. The single exception is the optional response
+cache (``cache_bytes > 0``): a byte-capped LRU keyed by the hash of the
+request's input texts (the ``CollateCache`` identity-key pattern from
+the input pipeline, applied at the serving edge — heavy real traffic is
+Zipfian), serving repeat bodies without touching a replica.
+
+``/metrics`` on the router is the FLEET view: each ready replica's SLO
+snapshot is scraped and merged (``training/telemetry.py:
+merge_serving_snapshots``) with the router's own counters — one scrape
+for the whole fleet instead of N.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import logging
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ...training.resilience import log_event
+from ..batcher import Draining, ServingError
+from .replica import ReplicaHandle
+
+__all__ = [
+    "NoReplicaAvailable",
+    "ResponseCache",
+    "RouterTelemetry",
+    "Router",
+    "RouterHTTPServer",
+]
+
+logger = logging.getLogger("spacy_ray_tpu.serving")
+
+MAX_BODY_BYTES = 8 << 20  # same abuse cap as the single-replica server
+
+
+class NoReplicaAvailable(ServingError):
+    """Zero ready replicas (all warming, crashed, or draining): a typed
+    503 the instant it is known — queueing the request blind would just
+    convert an outage into a timeout storm."""
+
+    http_status = 503
+    code = "no_replica"
+
+
+class ResponseCache:
+    """Byte-capped LRU of successful ``/v1/parse`` response bodies,
+    keyed by a digest of the request's input texts.
+
+    Unlike the input pipeline's ``CollateCache`` (which keys on object
+    identity because the corpus re-yields the same Examples), the edge
+    sees texts by VALUE over the wire — so the key is a content hash.
+    Responses are deterministic given the loaded params (same model →
+    same annotations), so a hit is exact, with one honest caveat: the
+    cached ``batch`` shape info reflects the batch the ORIGINAL request
+    ran in. Entries are only stored for status-200 bodies.
+
+    Thread-safe; hit/miss/eviction counters feed ``/metrics``.
+    """
+
+    def __init__(self, max_bytes: int) -> None:
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self._nbytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @staticmethod
+    def key_for(texts: List[str]) -> bytes:
+        h = hashlib.sha256()
+        for t in texts:
+            h.update(t.encode("utf8", "surrogatepass"))
+            h.update(b"\x00")  # unambiguous: ["ab"] != ["a","b"]
+        return h.digest()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            body = self._entries.get(key)
+            if body is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return body
+
+    def put(self, key: bytes, body: bytes) -> None:
+        if len(body) > self.max_bytes:
+            return  # one oversized response must not flush the cache
+        with self._lock:
+            if key in self._entries:
+                return
+            self._entries[key] = body
+            self._nbytes += len(body)
+            while self._nbytes > self.max_bytes and len(self._entries) > 1:
+                _, evicted = self._entries.popitem(last=False)
+                self._nbytes -= len(evicted)
+                self.evictions += 1
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "cache_hits": self.hits,
+                "cache_misses": self.misses,
+                "cache_evictions": self.evictions,
+                "cache_entries": len(self._entries),
+                "cache_bytes": self._nbytes,
+            }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class RouterTelemetry:
+    """Router-side SLO surface over the shared telemetry primitives:
+    fleet latency histogram (admission at the router to response),
+    routed/retried/rejected counters, ready-replica gauge, and a trace
+    instant per routing anomaly. Nullable like every telemetry facade in
+    this repo — when absent, the router makes ZERO telemetry calls."""
+
+    def __init__(
+        self,
+        *,
+        clock: Callable[[], float] = time.perf_counter,
+        trace_max_events: int = 100_000,
+    ) -> None:
+        from ...training.telemetry import MetricsRegistry, TraceBuffer
+
+        self.registry = MetricsRegistry(clock=clock)
+        self.trace = TraceBuffer(clock=clock, max_events=trace_max_events)
+        self._latency = self.registry.histogram(
+            "router_latency_seconds", 2048
+        )
+        self._requests = self.registry.counter("requests")
+        self._routed = self.registry.counter("routed")
+        self._retries = self.registry.counter("retries")
+        self._rej_no_replica = self.registry.counter("rejected_no_replica")
+        self._rej_draining = self.registry.counter("rejected_draining")
+        self._cache_hits = self.registry.counter("cache_hits")
+        self._ready = self.registry.gauge("ready_replicas")
+        self._replicas = self.registry.gauge("replicas")
+
+    def request(self) -> None:
+        self._requests.inc()
+
+    def routed(self, latency_s: float) -> None:
+        self._routed.inc()
+        self._latency.observe(latency_s)
+
+    def retry(self, replica_id: int, error: str) -> None:
+        self._retries.inc()
+        self.trace.add_instant(
+            "reroute", cat="fleet",
+            args={"replica": replica_id, "error": error},
+        )
+
+    def rejected(self, error: ServingError) -> None:
+        if isinstance(error, Draining):
+            self._rej_draining.inc()
+        else:
+            self._rej_no_replica.inc()
+        self.trace.add_instant(
+            f"reject:{error.code}", cat="fleet", args={"error": str(error)}
+        )
+
+    def cache_hit(self) -> None:
+        self._cache_hits.inc()
+
+    def replica_counts(self, ready: int, total: int) -> None:
+        self._ready.set(ready)
+        self._replicas.set(total)
+
+    def snapshot(self) -> Dict[str, Any]:
+        snap = self.registry.snapshot()
+        snap["slo"] = {
+            "router_latency_p50": self._latency.percentile(0.50),
+            "router_latency_p95": self._latency.percentile(0.95),
+            "router_latency_p99": self._latency.percentile(0.99),
+        }
+        return snap
+
+
+class Router:
+    """Balancing + health state over a set of :class:`ReplicaHandle`.
+
+    ``replicas`` is a zero-arg callable returning the current handles —
+    the supervisor's live view, so scale-up/down is visible to the
+    router without any registration protocol. Tests pass a lambda over
+    a static list pointed at stub servers.
+    """
+
+    def __init__(
+        self,
+        replicas: Callable[[], List[ReplicaHandle]],
+        *,
+        telemetry: Optional[RouterTelemetry] = None,
+        cache_bytes: int = 0,
+        probe_interval_s: float = 0.5,
+        probe_timeout_s: float = 5.0,
+        forward_timeout_s: float = 60.0,
+    ) -> None:
+        self.replicas = replicas
+        self.tel = telemetry
+        self.cache = ResponseCache(cache_bytes) if cache_bytes > 0 else None
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self._stop = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+        # drain gate + in-flight accounting for the fleet's own drain
+        self.draining = False
+        self._inflight_lock = threading.Lock()
+        self._inflight = 0
+        self._idle = threading.Condition(self._inflight_lock)
+
+    # -- health probing --------------------------------------------------
+    def probe_once(self) -> int:
+        """Probe every addressed replica's /healthz; update ready flags.
+        Returns the number of ready replicas. Called by the prober loop
+        and directly by tests (deterministic, no thread needed)."""
+        handles = self.replicas()
+        n_ready = 0
+        for h in handles:
+            addr = h.address
+            if addr is None or h.stopping or not h.alive:
+                self._mark_unready(h, "no address" if addr is None else "down")
+                continue
+            try:
+                conn = http.client.HTTPConnection(
+                    addr[0], addr[1], timeout=self.probe_timeout_s
+                )
+                try:
+                    conn.request("GET", "/healthz")
+                    resp = conn.getresponse()
+                    resp.read()
+                    ok = resp.status == 200
+                finally:
+                    conn.close()
+            except OSError:
+                ok = False
+            if ok:
+                self._mark_ready(h)
+                n_ready += 1
+            else:
+                self._mark_unready(h, "healthz != 200")
+        if self.tel is not None:
+            self.tel.replica_counts(n_ready, len(handles))
+        return n_ready
+
+    def _mark_ready(self, h: ReplicaHandle) -> None:
+        with h.lock:
+            was = h.ready
+            h.ready = True
+        if not was:
+            log_event(
+                "replica-ready",
+                f"replica {h.replica_id} ready at "
+                f"{h.host}:{h.port}",
+                level=logging.INFO,
+                replica=h.replica_id,
+            )
+
+    def _mark_unready(self, h: ReplicaHandle, reason: str) -> None:
+        with h.lock:
+            was = h.ready
+            h.ready = False
+        h.close_conns()  # pooled conns to a gone replica are all stale
+        if was:
+            log_event(
+                "replica-unready",
+                f"replica {h.replica_id} removed from rotation ({reason})",
+                replica=h.replica_id,
+                reason=reason,
+            )
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # the prober must survive anything
+                logger.exception("health probe pass failed")
+            self._stop.wait(self.probe_interval_s)
+
+    def start(self) -> "Router":
+        self._prober = threading.Thread(
+            target=self._probe_loop, daemon=True, name="fleet-prober"
+        )
+        self._prober.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=5.0)
+            self._prober = None
+        for h in self.replicas():
+            h.close_conns()
+
+    # -- balancing -------------------------------------------------------
+    def ready_handles(self) -> List[ReplicaHandle]:
+        return [
+            h for h in self.replicas()
+            if h.ready and not h.stopping and h.address is not None
+        ]
+
+    def pick(self) -> ReplicaHandle:
+        """Least-outstanding-requests over the ready set; ties broken by
+        lowest id (deterministic, and it keeps warm caches warm)."""
+        ready = self.ready_handles()
+        if not ready:
+            raise NoReplicaAvailable(
+                "no replica is ready (all warming, draining, or down)"
+            )
+        return min(
+            ready, key=lambda h: (h.outstanding, h.replica_id)
+        )
+
+    # -- forwarding --------------------------------------------------------
+    def forward_parse(
+        self, body: bytes, timeout_s: Optional[float] = None
+    ) -> Tuple[int, bytes]:
+        """Route one ``/v1/parse`` body: pick → forward → on socket
+        failure mark the replica unready and retry on another. The retry
+        budget is one attempt per distinct ready replica (+1): a body
+        that fails everywhere means the fleet is down, not the request.
+
+        Replica-level HTTP errors (429/504/...) are passed through
+        verbatim — they are per-replica admission decisions the client
+        must see, not routing failures. The exception is a replica's own
+        503 ``draining``/``warming``: that replica is leaving (or has not
+        yet joined) rotation — e.g. a scale-down SIGTERM landed between
+        ``pick()`` and the forward — so the request retries on another
+        replica (safe: ``/v1/parse`` is pure) instead of leaking a 5xx
+        to a client other replicas could have served.
+        """
+        if self.draining:
+            raise Draining("fleet is draining; not admitting requests")
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            attempts = 0
+            max_attempts = max(len(self.ready_handles()), 1) + 1
+            last_err: Optional[Exception] = None
+            while attempts < max_attempts:
+                attempts += 1
+                h = self.pick()  # raises NoReplicaAvailable when empty
+                addr = h.address
+                if addr is None:
+                    continue
+                with h.lock:
+                    h.outstanding += 1
+                try:
+                    status, payload = self._post(
+                        h, addr, "/v1/parse", body,
+                        timeout_s or self.forward_timeout_s,
+                    )
+                    if status == 503 and self._replica_unavailable(payload):
+                        # the replica itself says it can't take traffic
+                        # (draining out of a scale-down, or still
+                        # warming): out of rotation, retry elsewhere
+                        last_err = OSError(
+                            f"replica {h.replica_id} answered 503 "
+                            "(draining/warming)"
+                        )
+                        self._mark_unready(h, "replica 503 draining/warming")
+                        if self.tel is not None:
+                            self.tel.retry(h.replica_id, "Replica503")
+                        continue
+                    return status, payload
+                except OSError as e:
+                    # crashed or restarting mid-request: out of rotation
+                    # NOW; the prober re-adds it when /healthz recovers
+                    last_err = e
+                    self._mark_unready(h, f"forward failed: {e!r}")
+                    if self.tel is not None:
+                        self.tel.retry(h.replica_id, type(e).__name__)
+                finally:
+                    with h.lock:
+                        h.outstanding -= 1
+            raise NoReplicaAvailable(
+                f"request failed on {attempts} replica attempt(s); "
+                f"last error: {last_err!r}"
+            )
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+                self._idle.notify_all()
+
+    @staticmethod
+    def _replica_unavailable(payload: bytes) -> bool:
+        """True when a 503 body is the replica's own not-in-rotation
+        signal (typed ``draining``/``warming`` from server.py) — the only
+        replica statuses the router retries rather than passes through.
+        Off the hot path: only 503 bodies are ever parsed."""
+        try:
+            err = json.loads(payload)
+        except ValueError:
+            return False
+        return (
+            isinstance(err, dict)
+            and err.get("error") in ("draining", "warming")
+        )
+
+    @staticmethod
+    def _post(
+        h: ReplicaHandle, addr: Tuple[str, int], path: str, body: bytes,
+        timeout_s: float,
+    ) -> Tuple[int, bytes]:
+        """POST over a pooled keep-alive connection to the replica.
+
+        A fresh TCP dial + replica-side handler-thread spawn per forward
+        costs more than a small parse itself, so idle connections are
+        pooled per handle. A pooled connection can have gone stale (the
+        replica restarted, or closed it while idle): that failure gets
+        ONE retry on a freshly dialed connection before the error
+        propagates — safe to resend because ``/v1/parse`` is pure.
+        Failures on a fresh dial surface as OSError (the contract
+        ``forward_parse``'s replica-level retry loop keys on).
+        """
+        headers = {"Content-Type": "application/json"}
+        conn = h.checkout_conn()
+        while True:
+            fresh = conn is None
+            if fresh:
+                conn = http.client.HTTPConnection(
+                    addr[0], addr[1], timeout=timeout_s
+                )
+            try:
+                conn.request("POST", path, body, headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                if not fresh:
+                    conn = None
+                    continue
+                if not isinstance(e, OSError):
+                    raise OSError(f"replica HTTP protocol error: {e!r}")
+                raise
+            if resp.will_close:
+                conn.close()
+            else:
+                h.checkin_conn(conn)
+            return resp.status, payload
+
+    # -- fleet metrics ----------------------------------------------------
+    def scrape_replica_metrics(self) -> List[Dict[str, Any]]:
+        """GET /metrics from every ready replica (best-effort: a replica
+        that fails the scrape is skipped, not fatal).
+
+        Scrapes run CONCURRENTLY, one thread per replica: a single hung
+        replica bounds the whole pass at max(timeout), not sum — this is
+        on the caller's thread for both client ``/metrics`` requests and
+        the autoscaler tick, which must keep its cadence exactly when
+        replicas are unhealthy and scaling decisions matter most."""
+        handles = [h for h in self.ready_handles() if h.address is not None]
+        results: List[Optional[Dict[str, Any]]] = [None] * len(handles)
+
+        def scrape(i: int, h: ReplicaHandle) -> None:
+            addr = h.address
+            if addr is None:
+                return
+            try:
+                conn = http.client.HTTPConnection(
+                    addr[0], addr[1], timeout=self.probe_timeout_s
+                )
+                try:
+                    conn.request("GET", "/metrics")
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                finally:
+                    conn.close()
+                if resp.status == 200:
+                    snap = json.loads(raw)
+                    if isinstance(snap, dict):
+                        snap["replica_id"] = h.replica_id
+                        results[i] = snap
+            except (OSError, ValueError):
+                pass
+
+        if len(handles) == 1:  # no thread churn for the common small case
+            scrape(0, handles[0])
+        elif handles:
+            threads = [
+                threading.Thread(
+                    target=scrape, args=(i, h), daemon=True,
+                    name=f"scrape-replica-{h.replica_id}",
+                )
+                for i, h in enumerate(handles)
+            ]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + self.probe_timeout_s + 1.0
+            for t in threads:
+                t.join(timeout=max(deadline - time.monotonic(), 0.0))
+        return [snap for snap in results if snap is not None]
+
+    def fleet_metrics(self) -> Dict[str, Any]:
+        """The aggregated /metrics payload: per-replica snapshots merged
+        into one fleet view + the router's own counters + cache stats."""
+        from ...training.telemetry import merge_serving_snapshots
+
+        merged = merge_serving_snapshots(self.scrape_replica_metrics())
+        out: Dict[str, Any] = {"fleet": merged}
+        out["replicas"] = [h.describe() for h in self.replicas()]
+        if self.tel is not None:
+            out["router"] = self.tel.snapshot()
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+        return out
+
+    # -- drain -------------------------------------------------------------
+    def begin_drain(self) -> None:
+        self.draining = True
+
+    def wait_inflight(self, timeout_s: float) -> bool:
+        """Block until every in-flight forwarded request completed (the
+        replicas behind them are still up — the fleet drain stops THEM
+        only after the router is quiet). False on timeout."""
+        deadline = time.monotonic() + float(timeout_s)
+        with self._idle:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(timeout=min(remaining, 0.1))
+        return True
+
+
+class RouterHTTPServer(ThreadingHTTPServer):
+    """Handler threads do byte-level proxying only; all JSON work stays
+    on the replicas (the router must not become the GIL bottleneck the
+    fleet exists to remove)."""
+
+    daemon_threads = True
+
+    def __init__(self, addr: Tuple[str, int], router: Router) -> None:
+        super().__init__(addr, _RouterHandler)
+        self.router = router
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    # loopback is immune, but over a real link Nagle + delayed ACK can
+    # add ~40ms between the header write and the body write
+    disable_nagle_algorithm = True
+    server: RouterHTTPServer
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        logger.debug("%s " + fmt, self.address_string(), *args)
+
+    def _reply_bytes(self, status: int, body: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply(self, status: int, payload: Dict[str, Any]) -> None:
+        self._reply_bytes(status, json.dumps(payload).encode("utf8"))
+
+    def _reply_error(self, err: ServingError) -> None:
+        self._reply(
+            err.http_status, {"error": err.code, "message": str(err)}
+        )
+
+    # -- GET ------------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802
+        router = self.server.router
+        if self.path == "/healthz":
+            replicas = [h.describe() for h in router.replicas()]
+            n_ready = sum(1 for r in replicas if r["ready"])
+            if router.draining:
+                self._reply(
+                    503, {"status": "draining", "replicas": replicas}
+                )
+            elif n_ready == 0:
+                self._reply(
+                    503,
+                    {
+                        "status": "unavailable",
+                        "ready": 0,
+                        "replicas": replicas,
+                    },
+                )
+            else:
+                self._reply(
+                    200,
+                    {"status": "ok", "ready": n_ready, "replicas": replicas},
+                )
+        elif self.path == "/metrics":
+            from ...training.telemetry import sanitize_json
+
+            self._reply(200, sanitize_json(router.fleet_metrics()))
+        else:
+            self._reply(404, {"error": "not_found", "message": self.path})
+
+    # -- POST -----------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802
+        router = self.server.router
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = -1
+        if length < 0 or length > MAX_BODY_BYTES:
+            self.close_connection = True
+            self._reply(
+                400,
+                {
+                    "error": "bad_request",
+                    "message": f"Content-Length must be 0..{MAX_BODY_BYTES}",
+                },
+            )
+            return
+        body = self.rfile.read(length)  # consume BEFORE any early reply
+        if self.path != "/v1/parse":
+            self._reply(404, {"error": "not_found", "message": self.path})
+            return
+        if router.tel is not None:
+            router.tel.request()
+        if router.draining:
+            err = Draining("fleet is draining; not admitting requests")
+            if router.tel is not None:
+                router.tel.rejected(err)
+            self._reply_error(err)
+            return
+        # response cache: only when enabled does the router parse JSON —
+        # the disabled path stays a pure byte proxy
+        cache_key: Optional[bytes] = None
+        if router.cache is not None:
+            texts = self._texts_from(body)
+            if texts is not None:
+                cache_key = ResponseCache.key_for(texts)
+                hit = router.cache.get(cache_key)
+                if hit is not None:
+                    if router.tel is not None:
+                        router.tel.cache_hit()
+                    self._reply_bytes(200, hit)
+                    return
+        t0 = time.perf_counter()
+        try:
+            status, payload = router.forward_parse(body)
+        except ServingError as e:
+            if router.tel is not None:
+                router.tel.rejected(e)
+            self._reply_error(e)
+            return
+        if router.tel is not None:
+            router.tel.routed(time.perf_counter() - t0)
+        if status == 200 and cache_key is not None:
+            router.cache.put(cache_key, payload)
+        self._reply_bytes(status, payload)
+
+    @staticmethod
+    def _texts_from(body: bytes) -> Optional[List[str]]:
+        try:
+            payload = json.loads(body or b"{}")
+        except ValueError:
+            return None
+        texts = payload.get("texts") if isinstance(payload, dict) else None
+        if isinstance(texts, list) and texts and all(
+            isinstance(t, str) for t in texts
+        ):
+            return texts
+        return None

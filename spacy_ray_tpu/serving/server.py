@@ -35,7 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 from ..training.resilience import ShutdownCoordinator, log_event
-from .batcher import Draining, ServingError
+from .batcher import Draining, NotReady, ServingError
 from .engine import InferenceEngine, ServingTelemetry
 
 __all__ = ["ServingHTTPServer", "Server"]
@@ -66,6 +66,9 @@ class ServingHTTPServer(ThreadingHTTPServer):
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # loopback is immune, but over a real link Nagle + delayed ACK can
+    # add ~40ms between the header write and the body write
+    disable_nagle_algorithm = True
     server: ServingHTTPServer
 
     # stdlib default logs every request to stderr; route to the logger so
@@ -91,6 +94,18 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             if self.server.draining:
                 self._reply(503, {"status": "draining"})
+            elif not self.server.engine.ready:
+                # readiness gate: the listener comes up BEFORE the bucket
+                # warmup sweep (so a router can probe), but traffic routed
+                # here now would hit a live mid-warmup compile — 503 until
+                # the sweep completes and the dispatch thread is running
+                self._reply(
+                    503,
+                    {
+                        "status": "warming",
+                        "warmed_buckets": len(self.server.engine.warmed),
+                    },
+                )
             else:
                 self._reply(
                     200,
@@ -138,6 +153,11 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.server.draining:
             self._reply_error(Draining("server is draining"))
+            return
+        if not self.server.engine.ready:
+            self._reply_error(
+                NotReady("bucket warmup in progress; not admitting yet")
+            )
             return
         try:
             payload = json.loads(body or b"{}")
@@ -252,16 +272,30 @@ class Server:
         self.httpd.server_close()
         return 0 if clean else 1
 
-    def run(self, *, banner: bool = True) -> int:
+    def run(
+        self, *, banner: bool = True, warmup_engine: Optional[bool] = None
+    ) -> int:
         coordinator = ShutdownCoordinator()
         coordinator.add_callback(self.request_shutdown)
         coordinator.install()
         try:
             host, port = self.start()
             if banner:
-                # exact, parseable line: the drain subprocess test (and
-                # any operator script) reads the bound port from it
+                # exact, parseable line: the drain subprocess test, the
+                # fleet replica supervisor (and any operator script) read
+                # the bound port from it
                 print(f"serving on http://{host}:{port}", flush=True)
+            if warmup_engine is not None:
+                # listener-first startup: the port is announced and
+                # /healthz answers "warming" (503) while the bucket sweep
+                # compiles; a SIGTERM landing mid-warmup is honored right
+                # after (wait() returns immediately on the set flag)
+                self.engine.start(warmup=warmup_engine)
+                if banner and self.engine.warmed:
+                    print(
+                        f"warmed {len(self.engine.warmed)} (B, T) bucket "
+                        "programs; ready", flush=True,
+                    )
             return self.wait()
         finally:
             coordinator.restore()

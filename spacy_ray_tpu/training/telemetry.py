@@ -66,6 +66,7 @@ __all__ = [
     "device_peak_flops",
     "sanitize_json",
     "summarize_metrics",
+    "merge_serving_snapshots",
 ]
 
 
@@ -97,6 +98,133 @@ def sanitize_json(obj: Any) -> Any:
     if isinstance(obj, (list, tuple)):
         return [sanitize_json(v) for v in obj]
     return obj
+
+
+def merge_serving_snapshots(
+    snaps: List[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Merge per-replica ``ServingTelemetry.snapshot()`` payloads into
+    one fleet view (the router's ``/metrics``) — one scrape instead of N.
+
+    Merge rules, stated honestly:
+
+    * **counters** — summed: counts of events are exactly additive.
+    * **gauges** — reported as ``{sum, max, mean}`` per key: which
+      aggregate is meaningful depends on the gauge (total queue depth is
+      the ``sum``; a worst-replica occupancy is the ``max``) — the fleet
+      view carries all three rather than guessing.
+    * **histograms** — ``count``/``sum``/``min``/``max`` merge exactly.
+      Percentiles do NOT: a fleet p99 cannot be derived from per-replica
+      p99s (the underlying samples are gone). The merged view reports
+      the count-weighted mean (``p50``/``p95``/``p99`` — a reasonable
+      center) and the worst replica (``p99_worst`` etc.) — the honest
+      bound an SLO check should use.
+    * the ``slo`` block follows the histogram rule (weighted by the
+      replica's latency sample count, worst alongside).
+    """
+    merged: Dict[str, Any] = {
+        "replicas": len(snaps),
+        "counters": {},
+        "gauges": {},
+        "histograms": {},
+        "slo": {},
+    }
+    if not snaps:
+        return merged
+    counters: Dict[str, float] = {}
+    for snap in snaps:
+        for k, v in (snap.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                counters[k] = counters.get(k, 0) + v
+    merged["counters"] = counters
+    gauges: Dict[str, List[float]] = {}
+    for snap in snaps:
+        for k, v in (snap.get("gauges") or {}).items():
+            if isinstance(v, (int, float)):
+                gauges.setdefault(k, []).append(float(v))
+    merged["gauges"] = {
+        k: {
+            "sum": sum(vs),
+            "max": max(vs),
+            "mean": sum(vs) / len(vs),
+        }
+        for k, vs in gauges.items()
+    }
+
+    def _weight(snap: Dict[str, Any], hist_key: str) -> float:
+        h = (snap.get("histograms") or {}).get(hist_key) or {}
+        c = h.get("count")
+        return float(c) if isinstance(c, (int, float)) and c > 0 else 0.0
+
+    hist_keys = {
+        k for snap in snaps for k in (snap.get("histograms") or {})
+    }
+    for key in sorted(hist_keys):
+        entries = [
+            (snap.get("histograms") or {}).get(key) or {} for snap in snaps
+        ]
+        counts = [
+            e.get("count") for e in entries
+            if isinstance(e.get("count"), (int, float))
+        ]
+        sums = [
+            e.get("sum") for e in entries
+            if isinstance(e.get("sum"), (int, float))
+        ]
+        mins = [
+            e.get("min") for e in entries
+            if isinstance(e.get("min"), (int, float))
+        ]
+        maxs = [
+            e.get("max") for e in entries
+            if isinstance(e.get("max"), (int, float))
+        ]
+        out: Dict[str, Any] = {
+            "count": sum(counts) if counts else 0,
+            "sum": sum(sums) if sums else 0.0,
+            "min": min(mins) if mins else None,
+            "max": max(maxs) if maxs else None,
+        }
+        for q in ("p50", "p95", "p99"):
+            pairs = [
+                (float(e[q]), e.get("count") or 0)
+                for e in entries
+                if isinstance(e.get(q), (int, float))
+            ]
+            if pairs:
+                total_w = sum(w for _, w in pairs)
+                out[q] = (
+                    sum(v * w for v, w in pairs) / total_w
+                    if total_w > 0
+                    else sum(v for v, _ in pairs) / len(pairs)
+                )
+                out[f"{q}_worst"] = max(v for v, _ in pairs)
+            else:
+                out[q] = out[f"{q}_worst"] = None
+        merged["histograms"][key] = out
+
+    slo_keys = {k for snap in snaps for k in (snap.get("slo") or {})}
+    for key in sorted(slo_keys):
+        hist_key = (
+            "batch_occupancy" if "occupancy" in key
+            else "request_latency_seconds"
+        )
+        pairs = [
+            (float((snap.get("slo") or {})[key]), _weight(snap, hist_key))
+            for snap in snaps
+            if isinstance((snap.get("slo") or {}).get(key), (int, float))
+        ]
+        if not pairs:
+            merged["slo"][key] = merged["slo"][f"{key}_worst"] = None
+            continue
+        total_w = sum(w for _, w in pairs)
+        merged["slo"][key] = (
+            sum(v * w for v, w in pairs) / total_w
+            if total_w > 0
+            else sum(v for v, _ in pairs) / len(pairs)
+        )
+        merged["slo"][f"{key}_worst"] = max(v for v, _ in pairs)
+    return merged
 
 
 class _Counter:

@@ -1,0 +1,996 @@
+"""Multi-replica serving fleet (spacy_ray_tpu/serving/fleet/): router
+balancing/health/retry semantics against stub replicas (fast, no jax on
+the hot path), response-cache behaviour, fleet /metrics aggregation,
+supervisor crash-restart/scale with stub scripts, autoscaler hysteresis
+under a fake clock, the disabled-telemetry zero-calls contract, and the
+whole-fleet SIGTERM drain through the real ``serve-fleet`` CLI in a
+subprocess (heavy crash-under-load and bench variants are slow-marked).
+"""
+
+import json
+import http.client
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))  # for `import bench`
+
+from spacy_ray_tpu.serving.fleet import (
+    AutoscalerPolicy,
+    FleetObservation,
+    NoReplicaAvailable,
+    ReplicaHandle,
+    ReplicaSupervisor,
+    ResponseCache,
+    Router,
+    RouterHTTPServer,
+    RouterTelemetry,
+    observation_from_snapshots,
+)
+from spacy_ray_tpu.training.resilience import RetryPolicy, drain_events
+from spacy_ray_tpu.training.telemetry import merge_serving_snapshots
+
+
+# ----------------------------------------------------------------------
+# Stub replicas: the `serve` HTTP surface without an engine (or jax)
+# ----------------------------------------------------------------------
+
+
+class _StubServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _reply(self, status, payload):
+        body = json.dumps(payload).encode("utf8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        stub = self.server.stub
+        if self.path == "/healthz":
+            if stub.warming:
+                self._reply(503, {"status": "warming"})
+            else:
+                self._reply(200, {"status": "ok"})
+        elif self.path == "/metrics":
+            self._reply(200, stub.snapshot)
+        else:
+            self._reply(404, {"error": "not_found"})
+
+    def do_POST(self):  # noqa: N802
+        stub = self.server.stub
+        length = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(length)
+        with stub.lock:
+            stub.parse_calls += 1
+        if stub.draining:
+            # what server.py answers mid-scale-down: a typed 503 the
+            # router must retry elsewhere, not pass to the client
+            self._reply(503, {"error": "draining",
+                              "message": "draining; not admitting"})
+            return
+        if stub.latency_s:
+            time.sleep(stub.latency_s)
+        self._reply(
+            200, {"docs": [{"stub": stub.tag}], "batch": {"occupancy": 1}}
+        )
+
+
+class StubReplica:
+    """One fake replica endpoint; behaviour is mutable mid-test
+    (``warming`` flips readiness, ``close()`` simulates a crash)."""
+
+    def __init__(self, *, warming=False, latency_s=0.0, snapshot=None,
+                 tag="stub"):
+        self.warming = warming
+        self.draining = False
+        self.latency_s = latency_s
+        self.snapshot = snapshot or {"counters": {}, "gauges": {},
+                                     "histograms": {}, "slo": {}}
+        self.tag = tag
+        self.parse_calls = 0
+        self.lock = threading.Lock()
+        self.httpd = _StubServer(("127.0.0.1", 0), _StubHandler)
+        self.httpd.stub = self
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        self._thread.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def make_handle(replica_id, stub, *, ready=True):
+    h = ReplicaHandle(replica_id)
+    h.set_address("127.0.0.1", stub.port)
+    h.ready = ready
+    return h
+
+
+def _post(host, port, payload, timeout=30.0, path="/v1/parse"):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        body = json.dumps(payload).encode("utf8")
+        conn.request("POST", path, body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _get(host, port, path, timeout=10.0):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def serve_router(router):
+    """RouterHTTPServer on an ephemeral port; returns (httpd, host, port)."""
+    httpd = RouterHTTPServer(("127.0.0.1", 0), router)
+    threading.Thread(
+        target=httpd.serve_forever, kwargs={"poll_interval": 0.05},
+        daemon=True,
+    ).start()
+    host, port = httpd.server_address[:2]
+    return httpd, str(host), int(port)
+
+
+# ----------------------------------------------------------------------
+# Router: balancing, health, retry, typed 503
+# ----------------------------------------------------------------------
+
+
+def test_pick_least_outstanding():
+    stubs = [StubReplica(tag=f"s{i}") for i in range(3)]
+    try:
+        handles = [make_handle(i, s) for i, s in enumerate(stubs)]
+        handles[0].outstanding = 2
+        handles[1].outstanding = 0
+        handles[2].outstanding = 1
+        router = Router(lambda: handles)
+        assert router.pick() is handles[1]
+        handles[1].ready = False  # not ready -> out of rotation
+        assert router.pick() is handles[2]
+    finally:
+        for s in stubs:
+            s.close()
+
+
+def test_no_replica_ready_is_typed_503():
+    stub = StubReplica(warming=True)
+    try:
+        handle = make_handle(0, stub, ready=False)
+        router = Router(lambda: [handle])
+        with pytest.raises(NoReplicaAvailable):
+            router.pick()
+        httpd, host, port = serve_router(router)
+        try:
+            status, payload = _post(host, port, {"texts": ["x"]})
+            assert status == 503 and payload["error"] == "no_replica"
+            status, health = _get(host, port, "/healthz")
+            assert status == 503 and health["status"] == "unavailable"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+    finally:
+        stub.close()
+
+
+def test_probe_marks_warming_replica_unready_then_readds_it():
+    """Automatic removal and re-add: a replica is out of rotation while
+    its /healthz says warming (or it is unreachable) and returns the
+    moment the probe sees 200 again."""
+    stub = StubReplica(warming=True)
+    try:
+        handle = make_handle(0, stub, ready=False)
+        router = Router(lambda: [handle])
+        assert router.probe_once() == 0
+        assert not handle.ready
+        stub.warming = False  # warmup finished
+        assert router.probe_once() == 1
+        assert handle.ready
+        stub.warming = True  # draining/unhealthy again
+        assert router.probe_once() == 0
+        assert not handle.ready
+    finally:
+        stub.close()
+
+
+def test_replica_crash_midload_rerouted_zero_5xx():
+    """Acceptance: a replica dying under load costs the in-flight retry,
+    never a client-visible 5xx — the router marks it unready on the
+    socket error and re-forwards to a surviving replica."""
+    dead = StubReplica(tag="dead")
+    alive = StubReplica(tag="alive")
+    handles = [make_handle(0, dead), make_handle(1, alive)]
+    tel = RouterTelemetry()
+    router = Router(lambda: handles, telemetry=tel)
+    dead.close()  # crash BEFORE the load: every pick of it fails at the socket
+    httpd, host, port = serve_router(router)
+    try:
+        statuses = []
+        for _ in range(5):
+            status, payload = _post(host, port, {"texts": ["x"]})
+            statuses.append(status)
+            assert payload["docs"][0]["stub"] == "alive"
+        assert statuses == [200] * 5, statuses
+        assert not handles[0].ready  # removed from rotation on first failure
+        snap = tel.snapshot()
+        assert snap["counters"]["retries"] >= 1
+        assert snap["counters"]["routed"] == 5
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        alive.close()
+
+
+def test_scale_down_503_draining_retried_not_passed_through():
+    """A replica SIGTERM'd by a scale-down between pick() and the
+    forward answers its own 503 draining — the router must retry on a
+    remaining ready replica (the resend is safe, /v1/parse is pure),
+    never leak that 5xx to a client other replicas could serve."""
+    leaving = StubReplica(tag="leaving")
+    leaving.draining = True  # drain flag flips before the router notices
+    staying = StubReplica(tag="staying")
+    handles = [make_handle(0, leaving), make_handle(1, staying)]
+    tel = RouterTelemetry()
+    router = Router(lambda: handles, telemetry=tel)
+    httpd, host, port = serve_router(router)
+    try:
+        for _ in range(4):
+            status, payload = _post(host, port, {"texts": ["x"]})
+            assert status == 200
+            assert payload["docs"][0]["stub"] == "staying"
+        assert not handles[0].ready  # out of rotation after its first 503
+        assert tel.snapshot()["counters"]["retries"] >= 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        leaving.close()
+        staying.close()
+
+
+def test_forward_when_all_replicas_dead_is_typed_not_5xx():
+    stub = StubReplica()
+    handle = make_handle(0, stub)
+    router = Router(lambda: [handle])
+    stub.close()
+    with pytest.raises(NoReplicaAvailable):
+        router.forward_parse(b'{"texts": ["x"]}')
+
+
+# ----------------------------------------------------------------------
+# Response cache at the router edge
+# ----------------------------------------------------------------------
+
+
+def test_response_cache_byte_cap_lru():
+    cache = ResponseCache(100)
+    k = ResponseCache.key_for
+    cache.put(k(["a"]), b"x" * 40)
+    cache.put(k(["b"]), b"y" * 40)
+    assert cache.get(k(["a"])) == b"x" * 40  # refresh 'a' in LRU order
+    cache.put(k(["c"]), b"z" * 40)  # cap 100: evicts LRU ('b')
+    assert cache.get(k(["b"])) is None
+    assert cache.get(k(["a"])) is not None
+    assert cache.get(k(["c"])) is not None
+    assert cache.evictions == 1
+    # oversized bodies are refused, not cache-flushing
+    cache.put(k(["big"]), b"w" * 1000)
+    assert cache.get(k(["big"])) is None
+    # the key is the text CONTENT, unambiguous across boundaries
+    assert k(["ab"]) != k(["a", "b"])
+
+
+def test_router_cache_serves_repeats_without_touching_replicas():
+    stub = StubReplica(tag="origin")
+    handle = make_handle(0, stub)
+    tel = RouterTelemetry()
+    router = Router(lambda: [handle], telemetry=tel,
+                    cache_bytes=1 << 20)
+    httpd, host, port = serve_router(router)
+    try:
+        body = {"texts": ["the cat runs", "a dog sleeps"]}
+        status1, payload1 = _post(host, port, body)
+        status2, payload2 = _post(host, port, body)
+        assert (status1, status2) == (200, 200)
+        assert payload1 == payload2
+        assert stub.parse_calls == 1  # second answer came from the cache
+        assert router.cache.stats()["cache_hits"] == 1
+        assert tel.snapshot()["counters"]["cache_hits"] == 1
+        # different texts -> miss -> forwarded
+        status3, _ = _post(host, port, {"texts": ["different text"]})
+        assert status3 == 200 and stub.parse_calls == 2
+        # hit/miss counters are surfaced on the aggregated /metrics
+        status, metrics = _get(host, port, "/metrics")
+        assert status == 200
+        assert metrics["cache"]["cache_hits"] == 1
+        assert metrics["cache"]["cache_misses"] == 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        stub.close()
+
+
+def test_router_cache_off_by_default():
+    stub = StubReplica()
+    handle = make_handle(0, stub)
+    router = Router(lambda: [handle])
+    assert router.cache is None
+    httpd, host, port = serve_router(router)
+    try:
+        body = {"texts": ["same text"]}
+        _post(host, port, body)
+        _post(host, port, body)
+        assert stub.parse_calls == 2  # every request forwarded
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        stub.close()
+
+
+# ----------------------------------------------------------------------
+# Fleet /metrics aggregation
+# ----------------------------------------------------------------------
+
+
+def _snap(n_requests, p99, queue_depth):
+    return {
+        "counters": {"requests": n_requests, "docs": 2 * n_requests},
+        "gauges": {"queue_depth": queue_depth, "last_batch_occupancy": 4},
+        "histograms": {
+            "request_latency_seconds": {
+                "count": n_requests, "sum": 0.1 * n_requests,
+                "min": 0.01, "max": p99, "p50": p99 / 3, "p95": p99 / 2,
+                "p99": p99,
+            },
+            "batch_occupancy": {
+                "count": n_requests // 2, "sum": 2.0 * n_requests,
+                "min": 1, "max": 8, "p50": 4, "p95": 6, "p99": 8,
+            },
+        },
+        "slo": {"request_latency_p50": p99 / 3, "request_latency_p95": p99 / 2,
+                "request_latency_p99": p99, "batch_occupancy_p50": 4},
+    }
+
+
+def test_merge_serving_snapshots_sums_counts_and_weights_percentiles():
+    merged = merge_serving_snapshots([_snap(10, 0.3, 4), _snap(30, 0.1, 2)])
+    assert merged["replicas"] == 2
+    assert merged["counters"]["requests"] == 40
+    assert merged["counters"]["docs"] == 80
+    # gauges carry sum/max/mean — total queue depth is the sum
+    assert merged["gauges"]["queue_depth"]["sum"] == 6
+    assert merged["gauges"]["queue_depth"]["max"] == 4
+    lat = merged["histograms"]["request_latency_seconds"]
+    assert lat["count"] == 40
+    assert lat["sum"] == pytest.approx(4.0)
+    assert lat["min"] == 0.01 and lat["max"] == 0.3
+    # p99: count-weighted mean plus the honest worst-replica bound
+    assert lat["p99"] == pytest.approx((0.3 * 10 + 0.1 * 30) / 40)
+    assert lat["p99_worst"] == 0.3
+    assert merged["slo"]["request_latency_p99"] == pytest.approx(0.15)
+    assert merged["slo"]["request_latency_p99_worst"] == 0.3
+    # empty input stays well-formed
+    empty = merge_serving_snapshots([])
+    assert empty["replicas"] == 0 and empty["counters"] == {}
+
+
+def test_router_metrics_endpoint_aggregates_replicas():
+    """One scrape of the router returns the merged fleet view instead of
+    requiring N per-replica scrapes."""
+    stubs = [
+        StubReplica(tag="a", snapshot=_snap(10, 0.3, 4)),
+        StubReplica(tag="b", snapshot=_snap(30, 0.1, 2)),
+    ]
+    handles = [make_handle(i, s) for i, s in enumerate(stubs)]
+    tel = RouterTelemetry()
+    router = Router(lambda: handles, telemetry=tel)
+    httpd, host, port = serve_router(router)
+    try:
+        status, metrics = _get(host, port, "/metrics")
+        assert status == 200
+        fleet = metrics["fleet"]
+        assert fleet["replicas"] == 2
+        assert fleet["counters"]["requests"] == 40
+        assert fleet["slo"]["request_latency_p99_worst"] == 0.3
+        assert {r["id"] for r in metrics["replicas"]} == {0, 1}
+        assert "router" in metrics  # the router's own counters ride along
+        # an unreachable replica is skipped, not fatal
+        stubs[0].close()
+        handles[0].ready = True  # stale — scrape must tolerate it
+        status, metrics = _get(host, port, "/metrics")
+        assert status == 200 and metrics["fleet"]["replicas"] == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        stubs[1].close()
+
+
+# ----------------------------------------------------------------------
+# Autoscaler: deterministic hysteresis under a fake clock
+# ----------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _policy(clock, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("p99_target_s", 0.2)
+    kw.setdefault("up_consecutive", 3)
+    kw.setdefault("down_consecutive", 5)
+    kw.setdefault("cooldown_s", 30.0)
+    return AutoscalerPolicy(clock=clock, **kw)
+
+
+def hot(ready):  # p99 breach
+    return FleetObservation(ready=ready, p99_s=0.5, queue_depth=0.0,
+                            occupancy=8.0)
+
+
+def cold(ready):  # comfortably idle
+    return FleetObservation(ready=ready, p99_s=0.01, queue_depth=0.0,
+                            occupancy=1.0)
+
+
+def test_autoscaler_scales_up_after_consecutive_breaches_only():
+    clock = FakeClock()
+    pol = _policy(clock)
+    assert pol.observe(hot(1)) is None
+    clock.advance(2)
+    assert pol.observe(hot(1)) is None
+    clock.advance(2)
+    assert pol.observe(hot(1)) == 2  # third consecutive breach fires
+    assert pol.decisions[-1]["direction"] == "up"
+
+
+def test_autoscaler_oscillating_metric_never_flaps():
+    clock = FakeClock()
+    pol = _policy(clock)
+    for _ in range(20):  # breach, recover, breach, recover ...
+        assert pol.observe(hot(1)) is None
+        clock.advance(2)
+        assert pol.observe(cold(1)) is None
+        clock.advance(2)
+    assert pol.decisions == []
+
+
+def test_autoscaler_cooldown_blocks_back_to_back_decisions():
+    clock = FakeClock()
+    pol = _policy(clock)
+    for _ in range(3):
+        decision = pol.observe(hot(1))
+        clock.advance(1)
+    assert decision == 2
+    # still breaching, but inside the cooldown: hold
+    for _ in range(10):
+        assert pol.observe(hot(2)) is None
+        clock.advance(1)
+    clock.advance(30)  # cooldown expires; streak must rebuild from zero
+    assert pol.observe(hot(2)) is None
+    clock.advance(1)
+    assert pol.observe(hot(2)) is None
+    clock.advance(1)
+    assert pol.observe(hot(2)) == 3
+
+
+def test_autoscaler_scale_down_and_bounds():
+    clock = FakeClock()
+    pol = _policy(clock)
+    # idle fleet of 3: down after 5 consecutive idle ticks
+    for i in range(4):
+        assert pol.observe(cold(3)) is None
+        clock.advance(2)
+    assert pol.observe(cold(3)) == 2
+    assert pol.decisions[-1]["direction"] == "down"
+    # at min_replicas: never below
+    clock.advance(60)
+    for _ in range(20):
+        assert pol.observe(cold(1)) is None
+        clock.advance(2)
+    # at max_replicas: never above
+    clock.advance(60)
+    for _ in range(20):
+        assert pol.observe(hot(4)) is None
+        clock.advance(2)
+
+
+def test_autoscaler_queue_pressure_triggers_without_p99():
+    clock = FakeClock()
+    pol = _policy(clock, queue_high=16.0)
+    obs = FleetObservation(ready=2, p99_s=None, queue_depth=80.0)
+    assert pol.observe(obs) is None
+    clock.advance(2)
+    assert pol.observe(obs) is None
+    clock.advance(2)
+    assert pol.observe(obs) == 3  # 40 queued docs/replica > 16
+
+
+def test_autoscaler_decisions_emit_structured_events():
+    drain_events()  # clear whatever other tests queued
+    clock = FakeClock()
+    pol = _policy(clock)
+    for _ in range(3):
+        pol.observe(hot(1))
+        clock.advance(1)
+    events = [e for e in drain_events() if e["event"] == "autoscale-up"]
+    assert len(events) == 1
+    assert events[0]["from"] == 1 and events[0]["to"] == 2
+    assert events[0]["p99_s"] == 0.5
+
+
+def test_observation_from_snapshots_worst_p99_total_queue():
+    obs = observation_from_snapshots(
+        [_snap(10, 0.3, 4), _snap(30, 0.1, 2)], ready=2
+    )
+    assert obs.ready == 2
+    assert obs.p99_s == 0.3  # worst replica, not the mean
+    assert obs.queue_depth == 6.0
+    assert obs.occupancy == 4.0
+    # no traffic yet -> no signal -> treated as no pressure
+    empty = observation_from_snapshots([], ready=1)
+    assert empty.p99_s is None and empty.queue_depth == 0.0
+
+
+def test_autoscaler_rejects_bad_bounds():
+    with pytest.raises(ValueError):
+        AutoscalerPolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscalerPolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        AutoscalerPolicy(up_consecutive=0)
+
+
+# ----------------------------------------------------------------------
+# Disabled-telemetry contract: zero telemetry calls fleet-wide
+# ----------------------------------------------------------------------
+
+
+def test_fleet_disabled_telemetry_makes_zero_calls(monkeypatch):
+    """The PR 3/4 contract at fleet scope: with telemetry off, neither
+    the router path, the metrics merge, nor the autoscaler policy
+    constructs ANYTHING from telemetry.py."""
+    from spacy_ray_tpu.training import telemetry as telemetry_mod
+
+    def _boom(*a, **k):
+        raise AssertionError("telemetry constructed on the disabled path")
+
+    monkeypatch.setattr(telemetry_mod.MetricsRegistry, "__init__", _boom)
+    monkeypatch.setattr(telemetry_mod.TraceBuffer, "__init__", _boom)
+    stub = StubReplica(snapshot=_snap(10, 0.3, 4))
+    handle = make_handle(0, stub)
+    router = Router(lambda: [handle], telemetry=None)
+    httpd, host, port = serve_router(router)
+    try:
+        router.probe_once()
+        status, _ = _post(host, port, {"texts": ["x"]})
+        assert status == 200
+        status, metrics = _get(host, port, "/metrics")
+        assert status == 200
+        assert "router" not in metrics  # no router-telemetry block
+        assert metrics["fleet"]["counters"]["requests"] == 10
+        clock = FakeClock()
+        pol = _policy(clock)
+        for _ in range(3):
+            pol.observe(hot(1))
+            clock.advance(1)
+        assert pol.decisions  # decisions still logged, zero telemetry
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        stub.close()
+
+
+# ----------------------------------------------------------------------
+# Replica supervisor: banner parsing, crash restart w/ backoff, scaling
+# ----------------------------------------------------------------------
+
+# stub replica processes: a banner, then the chosen behaviour — no jax,
+# so supervisor semantics are tested in milliseconds
+SLEEP_SCRIPT = (
+    "import signal, sys, time\n"
+    "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+    "print('serving on http://127.0.0.1:59000', flush=True)\n"
+    "while True:\n"
+    "    time.sleep(0.05)\n"
+)
+CRASH_SCRIPT = (
+    "print('serving on http://127.0.0.1:59001', flush=True)\n"
+    "raise SystemExit(1)\n"
+)
+
+
+def _wait_until(cond, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _script_cmd(script):
+    return lambda replica_id: [sys.executable, "-c", script]
+
+
+def _fast_supervisor(script, **kw):
+    kw.setdefault("restart_policy",
+                  RetryPolicy(max_retries=10, base_delay=0.0, jitter=0.0))
+    kw.setdefault("monitor_poll_s", 0.02)
+    kw.setdefault("grace_s", 10.0)
+    return ReplicaSupervisor(_script_cmd(script), **kw)
+
+
+def test_supervisor_parses_banner_and_stops_clean():
+    sup = _fast_supervisor(SLEEP_SCRIPT)
+    [handle] = sup.start(1)
+    try:
+        assert _wait_until(lambda: handle.address is not None)
+        assert handle.address == ("127.0.0.1", 59000)
+        assert handle.alive
+    finally:
+        assert sup.stop_all() is True  # SIGTERM -> the script exits 0
+
+
+def test_supervisor_restarts_crashes_then_gives_up():
+    sup = _fast_supervisor(CRASH_SCRIPT, max_restarts_per_replica=2)
+    [handle] = sup.start(1)
+    try:
+        # 1 initial run + 2 restarts, then the cap: restarts counts crashes
+        assert _wait_until(lambda: handle.restarts >= 3)
+        time.sleep(0.3)  # give a buggy supervisor time to over-restart
+        assert handle.restarts == 3  # capped: left down, not crash-looping
+        assert not handle.alive
+        # terminal: the gave-up handle leaves the ACTIVE set, so the
+        # autoscaler's scale_to sees the honest count and can spawn a
+        # replacement instead of silently no-op'ing against a zombie
+        assert _wait_until(lambda: sup.replica_count == 0)
+        sup.scale_to(1)
+        assert sup.replica_count == 1
+        [fresh] = sup.handles()
+        assert fresh.replica_id != handle.replica_id  # own restart budget
+        assert fresh.slot == handle.slot  # ...but the freed slot recycles
+    finally:
+        sup.stop_all()
+
+
+def test_supervisor_scale_up_and_down():
+    sup = _fast_supervisor(SLEEP_SCRIPT)
+    sup.start(1)
+    try:
+        assert sup.replica_count == 1
+        sup.scale_to(3)
+        assert sup.replica_count == 3
+        assert _wait_until(
+            lambda: all(h.address for h in sup.handles())
+        )
+        sup.scale_to(1)
+        # the shrink SIGTERMs the two youngest; handles leave the set as
+        # each process exits
+        assert _wait_until(lambda: sup.replica_count == 1)
+        [survivor] = sup.handles()
+        assert survivor.replica_id == 0  # oldest survives
+    finally:
+        sup.stop_all()
+
+
+def test_scale_cycle_reuses_freed_slot():
+    """Device/core masks and base-port offsets key on the replica's
+    SLOT, which recycles: after scale-down/scale-up cycles two live
+    replicas must never share a mask while another sits idle (the
+    co-scheduling collapse the pinning exists to prevent)."""
+    seen = []
+
+    def build(slot):
+        seen.append(slot)
+        return [sys.executable, "-c", SLEEP_SCRIPT]
+
+    sup = ReplicaSupervisor(build, monitor_poll_s=0.02, grace_s=10.0)
+    sup.start(2)  # replicas 0,1 -> slots 0,1
+    try:
+        assert _wait_until(lambda: all(h.address for h in sup.handles()))
+        sup.scale_to(1)  # stops the youngest (id 1, slot 1)
+        assert _wait_until(lambda: sup.replica_count == 1)
+        sup.scale_to(2)  # new replica id 2 must REUSE freed slot 1
+        assert _wait_until(lambda: sup.replica_count == 2)
+        assert seen == [0, 1, 1]
+        assert sorted(h.slot for h in sup.handles()) == [0, 1]
+        assert sorted(h.replica_id for h in sup.handles()) == [0, 2]
+    finally:
+        sup.stop_all()
+
+
+def test_supervisor_no_restart_while_draining():
+    sup = _fast_supervisor(SLEEP_SCRIPT)
+    [handle] = sup.start(1)
+    try:
+        assert _wait_until(lambda: handle.address is not None)
+        sup.begin_drain()
+        handle.proc.kill()  # crash during drain
+        handle.proc.wait(timeout=10)
+        time.sleep(0.3)
+        assert handle.restarts == 0  # not restarted: the fleet is exiting
+    finally:
+        sup.stop_all()
+
+
+# ----------------------------------------------------------------------
+# Whole-fleet SIGTERM drain: the real serve-fleet CLI in a subprocess
+# ----------------------------------------------------------------------
+
+SERVE_CFG = """
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger"]
+
+[components]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 64
+depth = 2
+embed_size = 512
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = 64
+"""
+
+FLEET_BANNER_RE = re.compile(r"fleet serving on http://([^:\s]+):(\d+)")
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.pipeline.language import Pipeline
+    from spacy_ray_tpu.util import synth_corpus
+
+    nlp = Pipeline.from_config(Config.from_str(SERVE_CFG))
+    egs = synth_corpus(64, "tagger", seed=0)
+    nlp.initialize(lambda: iter(egs), seed=0)
+    out = tmp_path_factory.mktemp("fleet_model") / "model"
+    nlp.to_disk(out)
+    return out
+
+
+def _spawn_fleet(model_dir, *extra):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "spacy_ray_tpu", "serve-fleet",
+            str(model_dir),
+            "--device", "cpu", "--port", "0", "--replicas", "2",
+            "--max-replicas", "2", "--max-batch", "4",
+            "--max-doc-len", "16", "--probe-interval-s", "0.2",
+            *extra,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
+
+
+def _read_fleet_banner(proc, lines, timeout=60.0):
+    addr = [None]
+
+    def reader():
+        for line in proc.stdout:
+            lines.append(line)
+            m = FLEET_BANNER_RE.search(line)
+            if m and addr[0] is None:
+                addr[0] = (m.group(1), int(m.group(2)))
+
+    threading.Thread(target=reader, daemon=True).start()
+    deadline = time.monotonic() + timeout
+    while addr[0] is None and time.monotonic() < deadline:
+        if proc.poll() is not None:
+            pytest.fail(f"serve-fleet exited early:\n{''.join(lines)}")
+        time.sleep(0.1)
+    assert addr[0] is not None, f"no fleet banner:\n{''.join(lines)}"
+    return addr[0]
+
+
+def _wait_fleet_ready(host, port, lines, want_ready=2, timeout=240.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            status, health = _get(host, port, "/healthz", timeout=10.0)
+        except OSError:
+            time.sleep(0.2)
+            continue
+        if status == 200 and health["ready"] >= want_ready:
+            return health
+        if status != 200:
+            assert health["status"] in ("unavailable", "warming"), health
+        time.sleep(0.3)
+    pytest.fail(f"fleet never became ready:\n{''.join(lines)}")
+
+
+def test_fleet_sigterm_drains_all_replicas_and_exits_zero(model_dir):
+    """Acceptance: SIGTERM against the fleet — router stops admitting,
+    the in-flight request (held in a replica's 600ms coalescing window)
+    completes with 200, every replica drains and exits 0, the fleet
+    exits 0."""
+    proc = _spawn_fleet(model_dir, "--max-wait-ms", "600")
+    lines = []
+    try:
+        host, port = _read_fleet_banner(proc, lines)
+        health = _wait_fleet_ready(host, port, lines)
+        assert health["ready"] == 2, health
+        assert all(r["pid"] for r in health["replicas"])
+
+        # the aggregated metrics endpoint answers through the real stack
+        status, metrics = _get(host, port, "/metrics", timeout=30.0)
+        assert status == 200 and metrics["fleet"]["replicas"] == 2
+
+        # a request served end-to-end through router -> replica
+        status, payload = _post(host, port, {"texts": ["the cat runs"]},
+                                timeout=60.0)
+        assert status == 200 and payload["docs"][0]["tags"]
+
+        # in-flight request: sits in a replica's 600ms coalescing window
+        inflight = {}
+
+        def one_request():
+            try:
+                inflight["result"] = _post(
+                    host, port, {"texts": ["a dog sleeps"]}, timeout=90.0
+                )
+            except Exception as e:  # noqa: BLE001 — recorded for the assert
+                inflight["result"] = e
+
+        t = threading.Thread(target=one_request)
+        t.start()
+        time.sleep(0.25)  # admitted by a replica, not yet dispatched
+        proc.send_signal(signal.SIGTERM)
+
+        t.join(timeout=90.0)
+        result = inflight.get("result")
+        assert isinstance(result, tuple) and result[0] == 200, (
+            f"in-flight request not completed through the fleet drain: "
+            f"{result!r}\n{''.join(lines)}"
+        )
+
+        # new admissions after SIGTERM: typed 503 or (post-exit) refused
+        try:
+            status, payload = _post(host, port, {"texts": ["another"]},
+                                    timeout=10.0)
+            assert status == 503, (status, payload)
+        except OSError:
+            pass  # listener already closed — also a rejection
+
+        rc = proc.wait(timeout=120.0)
+        assert rc == 0, f"fleet drain exit {rc}:\n{''.join(lines)}"
+        assert any("fleet drained; exiting 0" in l for l in lines), lines
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+
+@pytest.mark.slow
+def test_fleet_replica_crash_under_real_load_recovers(model_dir):
+    """Heavy variant: SIGKILL one real replica while clients hammer the
+    router — every client request must come back 200 (the router retry
+    absorbs the crash) and the supervisor must restart the replica back
+    to ready."""
+    proc = _spawn_fleet(model_dir, "--max-wait-ms", "2")
+    lines = []
+    try:
+        host, port = _read_fleet_banner(proc, lines)
+        health = _wait_fleet_ready(host, port, lines)
+        victim_pid = health["replicas"][0]["pid"]
+
+        stop_at = time.monotonic() + 8.0
+        failures = []
+        ok = [0]
+
+        def client():
+            while time.monotonic() < stop_at:
+                try:
+                    status, _ = _post(host, port, {"texts": ["the cat"]},
+                                      timeout=60.0)
+                except OSError as e:
+                    failures.append(repr(e))
+                    continue
+                if status == 200:
+                    ok[0] += 1
+                elif status >= 500 and status != 503:
+                    failures.append(status)
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        os.kill(victim_pid, signal.SIGKILL)  # replica crash under load
+        for t in threads:
+            t.join(timeout=120.0)
+        assert not failures, f"client-visible failures: {failures[:10]}"
+        assert ok[0] > 0
+        # the supervisor restarts the victim back to ready
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            status, health = _get(host, port, "/healthz", timeout=10.0)
+            if status == 200 and health["ready"] == 2:
+                break
+            time.sleep(0.5)
+        assert health["ready"] == 2, health
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+
+@pytest.mark.slow
+def test_bench_fleet_appends_session_records(tmp_path, monkeypatch):
+    """bench.py --serving --replicas drives the real fleet topology and
+    appends closed/open records tagged with the replica count."""
+    import bench
+
+    session = tmp_path / "session.jsonl"
+    monkeypatch.setattr(bench, "SESSION_FILE", session)
+    records = bench.run_serving_fleet(
+        "cpu", replica_counts=[1], duration_s=0.6, clients=4,
+        max_batch=4, max_wait_ms=3.0,
+    )
+    assert [r["name"] for r in records] == [
+        "serving_fleet_closed", "serving_fleet_open"
+    ]
+    for rec in records:
+        assert rec["replicas"] == 1
+        assert rec["value"] > 0 and rec["unit"] == "req/s"
+        assert rec["failed"] == 0
+        assert rec["latency_ms_p50"] is not None
+    on_disk = [json.loads(l) for l in session.read_text().splitlines()]
+    assert [r["name"] for r in on_disk] == [
+        "serving_fleet_closed", "serving_fleet_open"
+    ]

@@ -363,6 +363,34 @@ def test_draining_server_rejects_with_503(serve_nlp):
     assert server.wait() == 0
 
 
+def test_healthz_warming_until_warmup_completes(serve_nlp):
+    """Readiness gating regression: a replica whose bucket warmup sweep
+    has not completed must answer 503 (not 200) on /healthz — and 503
+    "warming" on /v1/parse — so a router never sends traffic into a
+    mid-warmup compile. Only after the sweep does it report 200 ok."""
+    engine = InferenceEngine(
+        serve_nlp, max_batch_docs=4, max_wait_s=0.0, max_doc_len=32
+    )
+    server = Server(engine, "127.0.0.1", 0)
+    host, port = server.start()
+    try:
+        # listener up, engine NOT started: the pre-ready window
+        status, health = _get(host, port, "/healthz")
+        assert status == 503 and health["status"] == "warming", health
+        status, payload = _post(host, port, {"texts": ["the cat runs"]})
+        assert status == 503 and payload["error"] == "warming", payload
+        # warmup completes (shapes already compiled by the module's other
+        # tests, so warmup=False stands in for the finished sweep)
+        engine.start(warmup=False)
+        status, health = _get(host, port, "/healthz")
+        assert status == 200 and health["status"] == "ok"
+        status, payload = _post(host, port, {"texts": ["the cat runs"]})
+        assert status == 200 and payload["docs"][0]["tags"]
+    finally:
+        server.request_shutdown()
+        assert server.wait() == 0
+
+
 def test_disabled_telemetry_makes_zero_calls(serve_nlp, monkeypatch):
     """The training loop's contract, enforced for serving too: with no
     ServingTelemetry, the engine/server construct NOTHING from
@@ -443,8 +471,21 @@ def test_sigterm_graceful_drain_subprocess(model_dir):
         assert addr[0] is not None, f"no banner:\n{''.join(lines)}"
         host, port = addr[0]
 
-        status, health = _get(host, port, "/healthz", timeout=30.0)
-        assert status == 200 and health["status"] == "ok"
+        # listener-first startup: the banner (and the port) appear BEFORE
+        # the bucket warmup sweep; /healthz answers 503 "warming" until
+        # the sweep completes — poll for readiness exactly like a fleet
+        # router would
+        ready_deadline = time.monotonic() + 150.0
+        while True:
+            status, health = _get(host, port, "/healthz", timeout=30.0)
+            if status == 200:
+                assert health["status"] == "ok"
+                break
+            assert status == 503 and health["status"] == "warming", health
+            assert time.monotonic() < ready_deadline, (
+                f"never became ready:\n{''.join(lines)}"
+            )
+            time.sleep(0.2)
 
         # in-flight request: sits in the 600ms coalescing window
         inflight = {}
