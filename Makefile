@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry serving fleet bench baseline profile step-perf dryrun
+.PHONY: test test-fast test-slow resilience telemetry serving fleet bench baseline profile step-perf serve-perf dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -48,6 +48,16 @@ profile:
 step-perf:
 	JAX_PLATFORMS=cpu python bench.py --update-only
 	JAX_PLATFORMS=cpu python bin/profile_trf.py --sweep
+
+# per-replica serving speed A/Bs (PERF.md round 9): window vs continuous
+# admission and f32 vs bf16 precision overlay, each open-loop at FIXED
+# offered rates (committed baseline + saturation points); records append
+# to BENCH_SESSION.jsonl with honest batching/precision labels. The
+# tier-1 smoke of the same harness lives in tests/test_serving.py; the
+# sustained variants are slow-marked.
+serve-perf:
+	JAX_PLATFORMS=cpu python bench.py --serving-ab
+	JAX_PLATFORMS=cpu python bench.py --serving
 
 dryrun:
 	python __graft_entry__.py

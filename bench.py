@@ -1439,6 +1439,55 @@ def _latency_stats(lat: List[float]) -> Dict[str, Any]:
     }
 
 
+def _committed_session_value(
+    name: str, field: str = "offered_rps", **match: Any
+) -> Optional[Tuple[float, str]]:
+    """Latest committed value of ``field`` from the BENCH_SESSION.jsonl
+    record named ``name`` whose fields equal ``match`` — the matching-
+    METHODOLOGY record for the spec being run (e.g. the fleet open-loop
+    rate for n replicas comes from the last pinned fleet record at that
+    n, never from the round-6 unpinned single-engine record; PERF.md's
+    cross-round caveat, closed in code). Returns ``(value, source)`` or
+    None when no matching record exists.
+
+    This is what makes "fixed offered rate" actually FIXED across rounds
+    and across A/B arms: deriving each run's open-loop rate from its own
+    (noisy, ±30% on this container) closed-loop measurement would quote
+    every round's percentiles at a different operating point."""
+    try:
+        lines = SESSION_FILE.read_text(encoding="utf8").splitlines()
+    except OSError:
+        return None
+    best: Optional[float] = None
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("name") != name or rec.get("skipped"):
+            continue
+        value = rec.get(field)
+        if not isinstance(value, (int, float)) or value <= 0:
+            continue
+        if any(rec.get(k) != v for k, v in match.items()):
+            continue
+        best = float(value)  # last matching line wins: newest committed
+    if best is None:
+        return None
+    return best, f"committed:{name}.{field}"
+
+
+def _engine_labels(engine) -> Dict[str, Any]:
+    """The honest-labeling block every serving record carries: the
+    admission discipline and the precision the device actually runs
+    (never the requested knob)."""
+    return {
+        "batching": engine.batching,
+        "precision": engine.overlay.resolved,
+        "precision_label": engine.overlay.label,
+    }
+
+
 def run_serving(
     platform: str,
     *,
@@ -1521,6 +1570,7 @@ def run_serving(
             "max_wait_ms": max_wait_ms,
             "warmed_buckets": len(engine.warmed),
             "warmup_seconds": round(warmup_seconds, 2),
+            **_engine_labels(engine),
             **occ,
             **_latency_stats(latencies),
         }
@@ -1528,14 +1578,27 @@ def run_serving(
         _append_session(rec, platform)
         records.append(rec)
 
-        # -- open loop: fixed arrival rate (default 60% of the measured
-        # closed-loop saturation — the regime an SLO is quoted for).
+        # -- open loop: fixed arrival rate — the regime an SLO is quoted
+        # for. The rate comes from the matching committed record (same
+        # spec, same shape), so every round measures at the SAME point;
+        # only with no committed history does it fall back to 60% of the
+        # just-measured closed-loop rate (which swings ±30% run-to-run
+        # on this container — PERF.md dispersion notes).
         # Fresh telemetry for the phase: the registry's count/sum are
         # cumulative, so reusing the closed-loop instance would blend
         # that phase's occupancy into this record.
         tel_open = ServingTelemetry()
         engine.tel = tel_open
-        rate = open_rate or max(closed_rps * 0.6, 1.0)
+        if open_rate:
+            rate, rate_source = float(open_rate), "cli"
+        else:
+            committed = _committed_session_value(
+                "serving_open", platform=platform, max_batch_docs=max_batch,
+                texts_per_request=texts_per_request,
+            )
+            rate, rate_source = committed or (
+                max(closed_rps * 0.6, 1.0), "measured_closed_x0.6"
+            )
         wall2, counts2, latencies2 = _drive_open(
             host, port, duration_s, rate, texts_pool
         )
@@ -1550,6 +1613,7 @@ def run_serving(
             "platform": platform,
             "mode": "open",
             "offered_rps": round(rate, 1),
+            "offered_rate_source": rate_source,
             "duration_s": round(wall2, 2),
             "requests_ok": counts2["ok"],
             "rejected": counts2["rejected"],
@@ -1558,6 +1622,7 @@ def run_serving(
             "texts_per_request": texts_per_request,
             "max_batch_docs": max_batch,
             "max_wait_ms": max_wait_ms,
+            **_engine_labels(engine),
             **occupancy_snapshot(tel_open),
             **_latency_stats(latencies2),
         }
@@ -1567,6 +1632,232 @@ def run_serving(
     finally:
         server.request_shutdown()
         server.wait()
+    return records
+
+
+def _serving_trf_nlp():
+    """Tiny transformer tagger for the precision-overlay A/B: the CNN
+    serving model has no trunk (the overlay honestly refuses it), so the
+    precision arms need a pipeline with shadow-eligible leaves — the
+    smallest one the presets ship, initialized in-process."""
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.pipeline.language import Pipeline
+    from spacy_ray_tpu.presets import TINY_TRF_TAGGER_CFG
+
+    nlp = Pipeline.from_config(Config.from_str(TINY_TRF_TAGGER_CFG))
+    examples = _corpus(["tagger"], 128)
+    nlp.initialize(lambda: iter(examples), seed=0)
+    return nlp
+
+
+def _run_one_open_arm(
+    nlp, *, engine_kwargs: Dict[str, Any], rate: float, duration_s: float,
+    texts_pool: List[List[str]],
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """One A/B arm: fresh engine + server + telemetry, one open-loop
+    phase at ``rate``, clean shutdown. Returns (counts-and-latency
+    fields, engine labels) ready to merge into a record. Arms NEVER
+    share an engine: the knob under test is an engine constructor
+    argument, and a shared jit cache across arms is fine (the programs
+    are dtype/shape-keyed) while shared telemetry would blend phases."""
+    from spacy_ray_tpu.serving.engine import InferenceEngine, ServingTelemetry
+    from spacy_ray_tpu.serving.server import Server
+
+    tel = ServingTelemetry()
+    engine = InferenceEngine(nlp, telemetry=tel, **engine_kwargs)
+    engine.start(warmup=True)
+    server = Server(engine, "127.0.0.1", 0, telemetry=tel)
+    host, port = server.start()
+    try:
+        wall, counts, latencies = _drive_open(
+            host, port, duration_s, rate, texts_pool
+        )
+        snap = tel.snapshot()
+        slo = snap.get("slo") or {}
+        h = snap["histograms"].get("batch_occupancy") or {}
+        ms = lambda v: round(v * 1e3, 2) if isinstance(v, (int, float)) else None  # noqa: E731
+        fields = {
+            "value": round(counts["ok"] / wall, 1),
+            "unit": "req/s",
+            "mode": "open",
+            "offered_rps": round(rate, 1),
+            "duration_s": round(wall, 2),
+            "requests_ok": counts["ok"],
+            "rejected": counts["rejected"],
+            "failed": counts["failed"],
+            "occupancy_mean": (
+                round(h["sum"] / h["count"], 2) if h.get("count") else None
+            ),
+            # the per-request proof of the continuous-batching mechanism:
+            # admission -> device-dispatch wait, straight from telemetry
+            "dispatch_wait_ms_p50": ms(slo.get("dispatch_wait_p50")),
+            "dispatch_wait_ms_p99": ms(slo.get("dispatch_wait_p99")),
+            **_latency_stats(latencies),
+        }
+        return fields, _engine_labels(engine)
+    finally:
+        server.request_shutdown()
+        server.wait()
+
+
+def run_serving_ab(
+    platform: str,
+    *,
+    duration_s: float = 3.0,
+    texts_per_request: int = 2,
+    max_batch: int = 16,
+    max_doc_len: int = 64,
+    skip_precision: bool = False,
+) -> List[Dict[str, Any]]:
+    """``--serving-ab``: the two per-replica speed A/Bs (ROADMAP item 2),
+    each OPEN-LOOP AT A FIXED OFFERED RATE so both arms see identical
+    arrivals and the latency percentiles are directly comparable.
+
+    Pair 1 — window vs continuous admission (cnn tagger, the serving
+    flagship): both arms at the committed round-6 operating point
+    (47 req/s) and at a higher point pinned to the committed closed-loop
+    saturation rate, where the window discipline's coalescing tax
+    compounds into queue growth. ``window`` runs the serve default
+    window (SERVING_DEFAULTS max_wait_s), not the bench's 2 ms, because
+    the A/B claim is about the shipped configuration.
+
+    Pair 2 — f32 vs bf16 precision overlay (tiny trf: the cnn model has
+    no trunk and the overlay honestly refuses it). Same fixed rate both
+    arms. On CPU the bf16 arm must be FORCED (auto resolves f32 — the
+    PR 5 policy) and its record label says so; the honest-labeling
+    contract is the point of the record, not a CPU speedup (XLA CPU
+    emulates bf16 — PERF.md)."""
+    from spacy_ray_tpu.serving.engine import SERVING_DEFAULTS
+
+    records: List[Dict[str, Any]] = []
+    texts_pool = [_serving_texts(texts_per_request, seed=i)
+                  for i in range(64)]
+
+    # ---- pair 1: admission discipline --------------------------------
+    nlp = _serving_nlp()
+    base = _committed_session_value(
+        "serving_open", platform=platform, max_batch_docs=max_batch,
+        texts_per_request=texts_per_request,
+    )
+    baseline_rate, baseline_src = base or (47.0, "fallback:round6_point")
+    # the saturation point pins to the A/B's OWN committed record first:
+    # seeding it from the latest serving_closed would let a closed-loop
+    # record measured under a DIFFERENT admission discipline (continuous
+    # saturates >2x higher than window on this container) silently move
+    # the operating point between rounds — the drift this function
+    # exists to prevent. serving_closed only seeds the very first round.
+    sat = _committed_session_value(
+        "serving_ab_open", rate_point="saturation", platform=platform,
+        max_batch_docs=max_batch, texts_per_request=texts_per_request,
+    ) or _committed_session_value(
+        "serving_closed", field="value", platform=platform,
+        max_batch_docs=max_batch, texts_per_request=texts_per_request,
+    )
+    sat_rate, sat_src = sat or (baseline_rate * 1.7, "fallback:baseline_x1.7")
+    print(f"# serving A/B: baseline {baseline_rate:.1f} req/s "
+          f"({baseline_src}), saturation point {sat_rate:.1f} req/s "
+          f"({sat_src})", flush=True)
+    for batching in ("window", "continuous"):
+        for point, rate, src in (
+            ("baseline", baseline_rate, baseline_src),
+            ("saturation", sat_rate, sat_src),
+        ):
+            fields, labels = _run_one_open_arm(
+                nlp,
+                engine_kwargs={
+                    "max_batch_docs": max_batch,
+                    "max_wait_s": SERVING_DEFAULTS["max_wait_s"],
+                    "max_queue_docs": max(8 * max_batch, 128),
+                    "timeout_s": 30.0,
+                    "max_doc_len": max_doc_len,
+                    "batching": batching,
+                },
+                rate=rate, duration_s=duration_s, texts_pool=texts_pool,
+            )
+            rec = {
+                "name": "serving_ab_open",
+                "metric": (
+                    f"open_loop_latency ({batching} admission, fixed "
+                    f"{rate:.0f} req/s offered [{point}], cnn tagger, "
+                    "HTTP end-to-end)"
+                ),
+                "platform": platform,
+                "rate_point": point,
+                "offered_rate_source": src,
+                "texts_per_request": texts_per_request,
+                "max_batch_docs": max_batch,
+                "max_wait_ms": SERVING_DEFAULTS["max_wait_s"] * 1e3,
+                **labels,
+                **fields,
+            }
+            print(json.dumps(rec), flush=True)
+            _append_session(rec, platform)
+            records.append(rec)
+
+    # ---- pair 2: precision overlay -----------------------------------
+    if skip_precision:
+        return records
+    trf_nlp = _serving_trf_nlp()
+    committed = _committed_session_value(
+        "serving_precision_open", platform=platform,
+        texts_per_request=texts_per_request,
+    )
+    if committed:
+        prate, prate_src = committed
+    else:
+        # no history yet: probe the f32 arm closed-loop once and fix 60%
+        # of it for BOTH arms (the fixed point matters more than its
+        # absolute value; it becomes the committed point for later rounds)
+        from spacy_ray_tpu.serving.engine import InferenceEngine
+        from spacy_ray_tpu.serving.server import Server
+
+        probe_engine = InferenceEngine(
+            trf_nlp, max_batch_docs=8, max_doc_len=32, timeout_s=30.0,
+            precision="f32",
+        )
+        probe_engine.start(warmup=True)
+        probe_server = Server(probe_engine, "127.0.0.1", 0)
+        phost, pport = probe_server.start()
+        try:
+            wall, counts, _ = _drive_closed(
+                phost, pport, min(duration_s, 2.0), 4, texts_pool
+            )
+        finally:
+            probe_server.request_shutdown()
+            probe_server.wait()
+        prate = max(counts["ok"] / wall * 0.6, 1.0)
+        prate_src = "measured_f32_closed_x0.6"
+    print(f"# precision A/B: fixed {prate:.1f} req/s ({prate_src})",
+          flush=True)
+    for precision in ("f32", "bf16"):
+        fields, labels = _run_one_open_arm(
+            trf_nlp,
+            engine_kwargs={
+                "max_batch_docs": 8,
+                "max_doc_len": 32,
+                "timeout_s": 30.0,
+                "precision": precision,
+            },
+            rate=prate, duration_s=duration_s, texts_pool=texts_pool,
+        )
+        rec = {
+            "name": "serving_precision_open",
+            "metric": (
+                f"open_loop_latency (precision {labels['precision']}, "
+                f"fixed {prate:.0f} req/s offered, tiny trf tagger, "
+                "HTTP end-to-end)"
+            ),
+            "platform": platform,
+            "offered_rate_source": prate_src,
+            "texts_per_request": texts_per_request,
+            "max_batch_docs": 8,
+            "requested_precision": precision,
+            **labels,
+            **fields,
+        }
+        print(json.dumps(rec), flush=True)
+        _append_session(rec, platform)
+        records.append(rec)
     return records
 
 
@@ -1819,7 +2110,21 @@ def run_serving_fleet(
         _append_session(rec, platform)
         records.append(rec)
 
-        rate = open_rate or max(closed_rps * 0.6, 1.0)
+        # fixed offered rate from the matching PINNED fleet record at
+        # this replica count (never the round-6 unpinned single-engine
+        # record, never this run's noisy closed loop unless there is no
+        # history) — the cross-round caveat PERF.md flags, closed here
+        if open_rate:
+            rate, rate_source = float(open_rate), "cli"
+        else:
+            committed = _committed_session_value(
+                "serving_fleet_open", platform=platform, replicas=n,
+                max_batch_docs=max_batch,
+                texts_per_request=texts_per_request,
+            )
+            rate, rate_source = committed or (
+                max(closed_rps * 0.6, 1.0), "measured_closed_x0.6"
+            )
         occ0 = _fleet_occupancy(host, port)
         wall2, counts2, latencies2 = _drive_open(
             host, port, duration_s, rate, texts_pool
@@ -1840,6 +2145,7 @@ def run_serving_fleet(
             "mode": "open",
             "replicas": n,
             "offered_rps": round(rate, 1),
+            "offered_rate_source": rate_source,
             "duration_s": round(wall2, 2),
             "requests_ok": counts2["ok"],
             "rejected": counts2["rejected"],
@@ -2150,6 +2456,19 @@ def main() -> None:
         "so the scaling curve lives in BENCH_SESSION.jsonl",
     )
     parser.add_argument(
+        "--serving-ab", action="store_true",
+        help="run the per-replica speed A/B pairs open-loop at fixed "
+        "offered rates (window vs continuous admission at the committed "
+        "baseline + saturation points; f32 vs bf16 precision overlay on "
+        "the tiny trf) — `make serve-perf`; records land in "
+        "BENCH_SESSION.jsonl with honest batching/precision labels",
+    )
+    parser.add_argument(
+        "--skip-precision", action="store_true",
+        help="--serving-ab: only the batching pair (skips the trf "
+        "precision arms and their warmup compiles)",
+    )
+    parser.add_argument(
         "--tpu-only", action="store_true",
         help="parent mode: if the accelerator never serves, exit WITHOUT "
         "the CPU fallback — for a background campaign that must not "
@@ -2157,7 +2476,7 @@ def main() -> None:
     )
     args = parser.parse_args()
 
-    if args.serving:
+    if args.serving or args.serving_ab:
         # host+device online path; resolve the backend like --input-pipeline
         import jax
 
@@ -2173,7 +2492,13 @@ def main() -> None:
             print(f"# backend init failed ({e}); falling back to CPU",
                   flush=True)
             jax.config.update("jax_platforms", "cpu")
-        if args.replicas.strip():
+        if args.serving_ab:
+            run_serving_ab(
+                jax.default_backend(),
+                duration_s=float(args.serving_duration),
+                skip_precision=bool(args.skip_precision),
+            )
+        elif args.replicas.strip():
             counts = [
                 int(c) for c in args.replicas.split(",") if c.strip()
             ]

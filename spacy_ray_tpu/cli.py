@@ -1474,10 +1474,33 @@ def serve_command(argv: List[str]) -> int:
     parser.add_argument("--max-batch", type=int,
                         default=SERVING_DEFAULTS["max_batch_docs"],
                         help="max docs coalesced into one device batch")
-    parser.add_argument("--max-wait-ms", type=float,
+    parser.add_argument("--batching",
+                        choices=["continuous", "window"],
+                        default=SERVING_DEFAULTS["batching"],
+                        help="admission discipline: 'continuous' (default) "
+                        "admits queued requests into the next dispatch's "
+                        "free slots immediately — the in-flight batch is "
+                        "the coalescing window; 'window' is the classic "
+                        "size-or-deadline rule bounded by --window-ms")
+    parser.add_argument("--continuous", action="store_const",
+                        const="continuous", dest="batching",
+                        help="alias for --batching continuous")
+    parser.add_argument("--max-wait-ms", "--window-ms", type=float,
+                        dest="max_wait_ms",
                         default=SERVING_DEFAULTS["max_wait_s"] * 1e3,
-                        help="coalescing window from the first queued "
-                        "request (added latency bound)")
+                        help="window mode only: coalescing window from the "
+                        "first queued request (added latency bound); "
+                        "ignored under continuous admission")
+    parser.add_argument("--precision",
+                        choices=["auto", "f32", "bf16", "int8"],
+                        default=SERVING_DEFAULTS["precision"],
+                        help="serving precision overlay (docs/SERVING.md): "
+                        "'auto' arms a bf16 trunk overlay on accelerators "
+                        "and resolves f32 on CPU (emulated bf16 is a "
+                        "measured pessimization there); 'bf16' forces the "
+                        "overlay; 'int8' is probe-gated (refuses — and "
+                        "serves f32 with an honest label — until an int8 "
+                        "serving kernel exists)")
     parser.add_argument("--queue-size", type=int,
                         default=SERVING_DEFAULTS["max_queue_docs"],
                         help="bounded admission queue (docs); beyond it "
@@ -1523,8 +1546,12 @@ def serve_command(argv: List[str]) -> int:
         max_queue_docs=args.queue_size,
         timeout_s=max(args.timeout_ms, 1.0) / 1e3,
         max_doc_len=args.max_doc_len,
+        batching=args.batching,
+        precision=args.precision,
         telemetry=tel,
     )
+    print(f"serving batching={engine.batching} "
+          f"precision={engine.overlay.label}", flush=True)
     server = Server(
         engine, args.host, args.port,
         telemetry=tel, drain_timeout_s=args.drain_timeout_s,
@@ -1603,10 +1630,23 @@ def serve_fleet_command(argv: List[str]) -> int:
                         "round-robin over this process's affinity set")
     # per-replica serving knobs, passed through to each `serve` child
     parser.add_argument("--max-batch", type=int, default=None)
-    parser.add_argument("--max-wait-ms", type=float, default=None)
+    parser.add_argument("--max-wait-ms", "--window-ms", type=float,
+                        dest="max_wait_ms", default=None)
     parser.add_argument("--queue-size", type=int, default=None)
     parser.add_argument("--timeout-ms", type=float, default=None)
     parser.add_argument("--max-doc-len", type=int, default=None)
+    parser.add_argument("--batching",
+                        choices=["continuous", "window"], default=None,
+                        help="replica admission discipline (None = the "
+                        "serve default, continuous)")
+    parser.add_argument("--continuous", action="store_const",
+                        const="continuous", dest="batching",
+                        help="alias for --batching continuous")
+    parser.add_argument("--precision",
+                        choices=["auto", "f32", "bf16", "int8"], default=None,
+                        help="replica serving precision overlay (None = "
+                        "the serve default, auto — bf16 on accelerators, "
+                        "f32 on CPU)")
     # router knobs
     parser.add_argument("--cache-mb", type=float, default=0.0,
                         help="router response cache budget in MB, keyed by "
@@ -1680,6 +1720,8 @@ def serve_fleet_command(argv: List[str]) -> int:
         queue_size=args.queue_size,
         timeout_ms=args.timeout_ms,
         max_doc_len=args.max_doc_len,
+        batching=args.batching,
+        precision=args.precision,
         base_port=args.base_port,
         visible_devices=(
             [m.strip() for m in args.visible_devices.split(",") if m.strip()]

@@ -23,7 +23,7 @@ worker.py:91/176-189). Differences, deliberate and TPU-native:
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -143,6 +143,41 @@ SHADOW_LEAF_NAMES = frozenset({
     "ffn_W1", "ffn_b1", "ffn_W2", "ffn_b2",
     "e_W1", "e_b1", "e_W2", "e_b2",
 })
+
+# Trunk leaves that stay f32 BY DESIGN (they feed fp32 ops): layer norms
+# and the MoE router. A layer leaf in neither set is UNKNOWN to the
+# shadow scheme — a serving precision overlay must refuse rather than
+# ship a tree it only half understands (serving/overlay.py).
+TRUNK_F32_LEAF_NAMES = frozenset({
+    "ln1_g", "ln1_b", "ln2_g", "ln2_b", "router_W",
+})
+
+
+def shadow_coverage(params) -> "Tuple[int, List[str]]":
+    """Audit a param tree against the shadow scheme: returns
+    ``(n_eligible, unknown)`` where ``n_eligible`` counts f32 trunk
+    leaves :func:`build_param_shadow` would overlay and ``unknown``
+    lists the paths of ``layer_i`` leaves in neither SHADOW_LEAF_NAMES
+    nor TRUNK_F32_LEAF_NAMES. Non-empty ``unknown`` means the overlay's
+    coverage claim would be false for this model — callers fall back to
+    f32 with an honest label instead of serving a partial overlay."""
+    eligible = 0
+    unknown: List[str] = []
+
+    def rec(node, in_layer, path):
+        nonlocal eligible
+        for k, v in node.items():
+            if isinstance(v, dict):
+                rec(v, in_layer or str(k).startswith("layer_"), path + (str(k),))
+            elif in_layer:
+                if k in SHADOW_LEAF_NAMES:
+                    if jnp.asarray(v).dtype == jnp.float32:
+                        eligible += 1
+                elif k not in TRUNK_F32_LEAF_NAMES:
+                    unknown.append("/".join(path + (str(k),)))
+
+    rec(params, False, ())
+    return eligible, unknown
 
 
 def build_param_shadow(params, dtype=jnp.bfloat16):

@@ -2,10 +2,12 @@
 
 Three layers (see each module's docstring for the contracts):
 
-* :mod:`.batcher` — bounded admission queue, size-or-deadline
-  micro-batch coalescing, typed rejects;
+* :mod:`.batcher` — bounded admission queue, continuous slot-based or
+  size-or-deadline window batch assembly, typed rejects;
 * :mod:`.engine` — device-resident params, (B, T) bucket warmup sweep,
   the single dispatch thread, SLO telemetry facade;
+* :mod:`.overlay` — serving precision policy: bf16 trunk overlays of
+  the f32 param tree, probe-gated/auto-armed with honest labels;
 * :mod:`.server` — stdlib HTTP JSON API (``/v1/parse``, ``/healthz``,
   ``/metrics``) and SIGTERM graceful drain.
 
@@ -28,6 +30,12 @@ from .engine import (
     ServingTelemetry,
     warmup_buckets,
 )
+from .overlay import (
+    OverlayResult,
+    PRECISION_CHOICES,
+    build_serving_overlay,
+    resolve_precision,
+)
 from .server import Server, ServingHTTPServer
 
 __all__ = [
@@ -43,6 +51,10 @@ __all__ = [
     "ServingTelemetry",
     "SERVING_DEFAULTS",
     "warmup_buckets",
+    "OverlayResult",
+    "PRECISION_CHOICES",
+    "build_serving_overlay",
+    "resolve_precision",
     "Server",
     "ServingHTTPServer",
 ]

@@ -16,12 +16,27 @@ Contract:
   raises a TYPED error the server maps to an HTTP status. A full queue
   or a draining server rejects instantly; nobody's latency degrades
   because someone else's request sat behind an unserviceable backlog.
-* ``next_batch`` is called by the single dispatch thread: it blocks for
-  the first request, then coalesces follow-ups until ``max_batch_docs``
-  are in hand or ``max_wait_s`` has elapsed since the first arrival —
-  the classic size-or-deadline micro-batching rule. Requests whose
-  deadline already passed are completed with ``DeadlineExceeded``
-  *here*, before they waste a device dispatch.
+* ``next_batch`` is called by the single dispatch thread. Two admission
+  disciplines, selected by ``mode``:
+
+  - ``"window"`` — the classic size-or-deadline rule: block for the
+    first request, then coalesce follow-ups until ``max_batch_docs``
+    are in hand or ``max_wait_s`` has elapsed since the first arrival.
+    Every partial batch pays the window timer as added latency, even
+    when the device sits idle.
+  - ``"continuous"`` — slot-based continuous admission: whatever is
+    queued the instant the dispatch thread is free fills the batch's
+    slots (up to ``max_batch_docs``) and dispatches IMMEDIATELY. There
+    is no window timer; the in-flight device batch is the coalescing
+    window — requests arriving while the device runs accumulate in the
+    queue and are admitted into the next dispatch's free slots the
+    moment the previous one is handed to the device. No queued request
+    ever waits for a timer or for an in-flight batch to drain when a
+    slot is free (property-tested).
+
+  Requests whose deadline already passed are completed with
+  ``DeadlineExceeded`` *here*, before they waste a device dispatch —
+  both modes.
 * Per-request deadlines are absolute clock() stamps. The clock is
   injectable; tests drive every timing path with a fake clock.
 """
@@ -102,7 +117,7 @@ class ServeRequest:
     event."""
 
     __slots__ = (
-        "docs", "deadline", "enqueued_at", "started_at",
+        "docs", "deadline", "enqueued_at", "started_at", "dispatched_at",
         "_done", "error", "batch_info",
     )
 
@@ -110,7 +125,14 @@ class ServeRequest:
         self.docs = docs
         self.deadline = float(deadline)
         self.enqueued_at = float(enqueued_at)
+        # started_at: picked out of the queue into a batch (time-in-queue
+        # ends); dispatched_at: the assembled batch is handed to the
+        # device (time-to-first-dispatch ends). In window mode the gap
+        # between them is the remaining coalescing window; continuous
+        # admission collapses it to ~0 — the telemetry pair that makes
+        # the continuous-batching win visible per request.
         self.started_at: Optional[float] = None
+        self.dispatched_at: Optional[float] = None
         self._done = threading.Event()
         self.error: Optional[ServingError] = None
         self.batch_info: Dict[str, Any] = {}
@@ -128,9 +150,13 @@ class ServeRequest:
 
 
 class DynamicBatcher:
-    """Bounded queue + size-or-deadline coalescing (docs are the unit:
-    one request may carry several docs, and occupancy accounting is in
-    docs because that is what fills a padded device batch)."""
+    """Bounded queue + batch assembly (docs are the unit: one request may
+    carry several docs, and occupancy accounting is in docs because that
+    is what fills a padded device batch). ``mode`` picks the admission
+    discipline — ``"window"`` size-or-deadline coalescing or
+    ``"continuous"`` slot-based immediate admission (module docstring)."""
+
+    MODES = ("window", "continuous")
 
     def __init__(
         self,
@@ -138,6 +164,7 @@ class DynamicBatcher:
         max_queue_docs: int = 128,
         max_batch_docs: int = 16,
         max_wait_s: float = 0.005,
+        mode: str = "window",
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if max_batch_docs < 1:
@@ -147,9 +174,14 @@ class DynamicBatcher:
                 f"max_queue_docs ({max_queue_docs}) must be >= max_batch_docs "
                 f"({max_batch_docs}) or a full batch could never be admitted"
             )
+        if mode not in self.MODES:
+            raise ValueError(
+                f"mode must be one of {list(self.MODES)}, got {mode!r}"
+            )
         self.max_queue_docs = int(max_queue_docs)
         self.max_batch_docs = int(max_batch_docs)
         self.max_wait_s = float(max_wait_s)
+        self.mode = mode
         self.clock = clock
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
@@ -218,8 +250,10 @@ class DynamicBatcher:
             have += len(head.docs)
 
     def next_batch(self, poll_s: float = 0.05) -> Optional[List[ServeRequest]]:
-        """Block for the next coalesced batch. Returns None when the
-        batcher is closed AND empty (the dispatch thread's exit signal).
+        """Block for the next assembled batch. Returns None when the
+        batcher is closed AND empty (the dispatch thread's exit signal);
+        may return an empty list when every popped request had already
+        expired (the caller loops around).
 
         ``poll_s`` bounds each condvar wait so a fake-clock test (or a
         drain) is never stuck inside a long real-time wait.
@@ -232,6 +266,12 @@ class DynamicBatcher:
             batch: List[ServeRequest] = []
             first_at = self.clock()
             self._pop_ready(batch, first_at)
+            if self.mode == "continuous":
+                # slot-based continuous admission: dispatch NOW with
+                # whatever filled the slots — zero added wait. Follow-ups
+                # landing while this batch runs on the device are popped
+                # the moment the dispatch thread returns here.
+                return batch
             # coalescing window: more requests may land while we wait —
             # the entire point of dynamic batching. The window is capped
             # by max_wait_s from the FIRST request (bounded added

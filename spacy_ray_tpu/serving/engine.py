@@ -56,13 +56,22 @@ __all__ = [
 
 # One place for the serving knob defaults: the CLI, the bench load specs,
 # and the tests read these — a bench that "agrees with serve" must not
-# restate numbers that can drift.
+# restate numbers that can drift. ``batching`` defaults to continuous
+# admission (the window discipline survives behind the knob for A/Bs and
+# for operators who want to trade latency for bigger batches);
+# ``max_wait_s`` only applies in window mode. ``precision`` is the
+# serving overlay policy (serving/overlay.py — "auto" arms bf16 on
+# accelerators only). ``slo_window_s`` is the sliding window the SLO
+# percentiles are additionally reported over (recent load, not lifetime).
 SERVING_DEFAULTS: Dict[str, Any] = {
     "max_batch_docs": 16,
     "max_wait_s": 0.005,
     "max_queue_docs": 128,
     "timeout_s": 10.0,
     "max_doc_len": 64,
+    "batching": "continuous",
+    "precision": "auto",
+    "slo_window_s": 30.0,
 }
 
 
@@ -104,8 +113,19 @@ class ServingTelemetry:
 
     * ``request_latency_seconds`` histogram — admission to completion,
       the SLO number; p50/p95/p99 come from the shared nearest-rank
-      percentile convention (one implementation, telemetry.py).
-    * ``queue_wait_seconds`` histogram — admission to dispatch pickup.
+      percentile convention (one implementation, telemetry.py). The
+      latency histogram also keeps a ``slo_window_s`` sliding TIME
+      window: the ``slo_window`` snapshot block reports p50/p95/p99
+      over the last N seconds only, so a control loop (the fleet
+      autoscaler) sees a fresh load spike instead of the spike diluted
+      across the whole run's samples.
+    * ``queue_wait_seconds`` histogram — admission to batch-assembly
+      pickup (time-in-queue).
+    * ``dispatch_wait_seconds`` histogram — admission to the batch being
+      handed to the device (time-to-first-dispatch). The gap between
+      this and queue_wait is the coalescing-window tax; continuous
+      batching exists to erase it, and this pair is the per-request
+      proof.
     * ``batch_occupancy`` histogram + ``last_batch_occupancy`` gauge —
       docs per dispatched device batch; occupancy ≈ 1 under load means
       coalescing is broken (N serial batches of 1).
@@ -123,6 +143,7 @@ class ServingTelemetry:
         clock: Callable[[], float] = time.perf_counter,
         process_index: int = 0,
         trace_max_events: int = 100_000,
+        slo_window_s: float = SERVING_DEFAULTS["slo_window_s"],
     ) -> None:
         from ..training.telemetry import MetricsRegistry, TraceBuffer
 
@@ -130,8 +151,13 @@ class ServingTelemetry:
         self.trace = TraceBuffer(
             clock=clock, pid=int(process_index), max_events=trace_max_events
         )
-        self._latency = self.registry.histogram("request_latency_seconds", 2048)
+        self._latency = self.registry.histogram(
+            "request_latency_seconds", 2048, window_s=slo_window_s or None
+        )
         self._queue_wait = self.registry.histogram("queue_wait_seconds", 2048)
+        self._dispatch_wait = self.registry.histogram(
+            "dispatch_wait_seconds", 2048
+        )
         self._occupancy = self.registry.histogram("batch_occupancy", 1024)
         self._queue_depth = self.registry.gauge("queue_depth")
         self._last_occ = self.registry.gauge("last_batch_occupancy")
@@ -171,6 +197,7 @@ class ServingTelemetry:
         queue_wait_s: Optional[float],
         t0: Optional[float],
         error: Optional[ServingError],
+        dispatch_wait_s: Optional[float] = None,
     ) -> None:
         if error is not None:
             self.request_rejected(error)
@@ -178,6 +205,8 @@ class ServingTelemetry:
             self._latency.observe(latency_s)
             if queue_wait_s is not None:
                 self._queue_wait.observe(queue_wait_s)
+            if dispatch_wait_s is not None:
+                self._dispatch_wait.observe(dispatch_wait_s)
         if t0 is not None:
             self.trace.add_span(
                 "request",
@@ -199,15 +228,31 @@ class ServingTelemetry:
         self._queue_depth.set(depth)
 
     def snapshot(self) -> Dict[str, Any]:
-        """The /metrics payload: registry snapshot + the SLO percentiles
-        (p50/p95/p99 over the rolling latency window, seconds)."""
+        """The /metrics payload: registry snapshot + the SLO percentiles.
+        ``slo`` keeps the sample-ring convention (last 2048 requests);
+        ``slo_window`` re-states the latency percentiles over the last
+        ``slo_window_s`` SECONDS only — the block the autoscaler reads,
+        because a run-lifetime-ish ring dilutes a fresh spike exactly
+        when the control loop needs to react to it (fake-clock
+        regression-tested in test_telemetry.py)."""
         snap = self.registry.snapshot()
         snap["slo"] = {
             "request_latency_p50": self._latency.percentile(0.50),
             "request_latency_p95": self._latency.percentile(0.95),
             "request_latency_p99": self._latency.percentile(0.99),
             "batch_occupancy_p50": self._occupancy.percentile(0.50),
+            "dispatch_wait_p50": self._dispatch_wait.percentile(0.50),
+            "dispatch_wait_p99": self._dispatch_wait.percentile(0.99),
         }
+        win = self._latency.window_snapshot()
+        if win is not None:
+            snap["slo_window"] = {
+                "window_s": win["window_s"],
+                "samples": win["samples"],
+                "request_latency_p50": win["p50"],
+                "request_latency_p95": win["p95"],
+                "request_latency_p99": win["p99"],
+            }
         return snap
 
 
@@ -217,9 +262,15 @@ class InferenceEngine:
     ``submit_texts``/``submit_docs`` run on caller (HTTP handler)
     threads: tokenize, admission-check, enqueue, block until the
     dispatch thread completes the request (or a typed error says why
-    not). The dispatch thread coalesces via :class:`DynamicBatcher` and
-    executes ONE ``predict_docs`` call per coalesced batch with the
-    padded bucket pinned explicitly — exactly a warmed shape.
+    not). The dispatch thread assembles batches via
+    :class:`DynamicBatcher` (continuous slot-based admission by default;
+    the window discipline behind ``batching="window"``) and executes ONE
+    ``predict_docs`` call per batch with the padded bucket pinned
+    explicitly — exactly a warmed shape. The params it dispatches are
+    ``serve_params`` — the precision overlay's output (f32 untouched, or
+    a bf16 trunk overlay on accelerators; serving/overlay.py) — and
+    ``overlay.label`` is the honest precision story every surface
+    reports.
     """
 
     def __init__(
@@ -231,6 +282,8 @@ class InferenceEngine:
         max_queue_docs: int = SERVING_DEFAULTS["max_queue_docs"],
         timeout_s: float = SERVING_DEFAULTS["timeout_s"],
         max_doc_len: int = SERVING_DEFAULTS["max_doc_len"],
+        batching: str = SERVING_DEFAULTS["batching"],
+        precision: str = SERVING_DEFAULTS["precision"],
         telemetry: Optional[ServingTelemetry] = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
@@ -245,12 +298,23 @@ class InferenceEngine:
         self.timeout_s = float(timeout_s)
         self.tel = telemetry
         self.clock = clock
+        self.batching = batching
         self.batcher = DynamicBatcher(
             max_queue_docs=max_queue_docs,
             max_batch_docs=max_batch_docs,
             max_wait_s=max_wait_s,
+            mode=batching,
             clock=clock,
         )
+        # precision overlay, applied ONCE at construction: every dispatch
+        # (warmup sweep included, so warmed programs match live traffic's
+        # param dtypes) consumes self.serve_params, never nlp.params
+        # directly. overlay.resolved/label are the honest story /healthz
+        # and the bench records carry.
+        from .overlay import build_serving_overlay
+
+        self.overlay = build_serving_overlay(nlp, precision)
+        self.serve_params = self.overlay.params
         self._thread: Optional[threading.Thread] = None
         self._state_lock = threading.Lock()
         self._idle = threading.Condition(self._state_lock)
@@ -277,7 +341,8 @@ class InferenceEngine:
         for B, T in grid:
             docs = [Doc(words=["the"] * T) for _ in range(B)]
             self.nlp.predict_docs(
-                docs, batch_size=B, pad_batch_to=B, pad_len_to=T
+                docs, params=self.serve_params,
+                batch_size=B, pad_batch_to=B, pad_len_to=T,
             )
         self.warmed = grid
         return grid
@@ -335,6 +400,11 @@ class InferenceEngine:
             if req.started_at is not None
             else None
         )
+        dispatch_wait = (
+            req.dispatched_at - req.enqueued_at
+            if req.dispatched_at is not None
+            else None
+        )
         if not req.done:
             err = DeadlineExceeded(
                 f"request not completed within {timeout:.3f}s"
@@ -350,6 +420,7 @@ class InferenceEngine:
                 queue_wait_s=queue_wait,
                 t0=t0,
                 error=req.error,
+                dispatch_wait_s=dispatch_wait,
             )
         if req.error is not None:
             raise req.error
@@ -379,16 +450,21 @@ class InferenceEngine:
         T = bucket_length(
             max((len(d) for d in docs), default=1), self.nlp.length_buckets
         )
+        dispatched_at = self.clock()  # assembly over, handed to the device
+        for r in requests:
+            r.dispatched_at = dispatched_at
         try:
             if self.tel is not None:
                 with self.tel.batch_span(n, B, T):
                     self.nlp.predict_docs(
-                        docs, batch_size=n, pad_batch_to=B, pad_len_to=T
+                        docs, params=self.serve_params,
+                        batch_size=n, pad_batch_to=B, pad_len_to=T,
                     )
                 self.tel.set_queue_depth(self.batcher.queue_depth())
             else:
                 self.nlp.predict_docs(
-                    docs, batch_size=n, pad_batch_to=B, pad_len_to=T
+                    docs, params=self.serve_params,
+                    batch_size=n, pad_batch_to=B, pad_len_to=T,
                 )
         except Exception as e:  # a poisoned batch must not kill the server
             log_event(

@@ -59,13 +59,29 @@ def observation_from_snapshots(
     """Build an observation from scraped per-replica /metrics payloads
     (the ServingTelemetry.snapshot() schema). Missing pieces stay None —
     a replica with no traffic yet has no p99, and the policy treats
-    no-signal as no-pressure."""
+    no-signal as no-pressure.
+
+    The p99 signal PREFERS the replica's ``slo_window`` block (latency
+    percentiles over the last T seconds) over the run-lifetime-ish
+    sample ring in ``slo``: a control loop must react to the load of
+    the last half-minute, not a spike diluted across thousands of
+    older samples (regression-tested with a fake clock in
+    test_fleet.py). A window that is present but EMPTY (no requests in
+    the last T seconds) is also no-signal — falling back to the stale
+    ring there would re-report a long-gone spike forever."""
     p99s = []
     queue = 0.0
     occ_sum = occ_n = 0.0
     for snap in snaps:
         slo = snap.get("slo") or {}
-        p99 = slo.get("request_latency_p99")
+        win = snap.get("slo_window")
+        if isinstance(win, dict):
+            p99 = (
+                win.get("request_latency_p99")
+                if int(win.get("samples") or 0) > 0 else None
+            )
+        else:
+            p99 = slo.get("request_latency_p99")
         if isinstance(p99, (int, float)):
             p99s.append(float(p99))
         gauges = snap.get("gauges") or {}

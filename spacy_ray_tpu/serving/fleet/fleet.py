@@ -64,6 +64,11 @@ class FleetConfig:
     queue_size: Optional[int] = None
     timeout_ms: Optional[float] = None
     max_doc_len: Optional[int] = None
+    # admission discipline + precision overlay policy, passed through to
+    # every replica (None = the serve command's defaults: continuous
+    # admission, precision "auto" — bf16 overlay on accelerators only)
+    batching: Optional[str] = None
+    precision: Optional[str] = None
     replica_drain_timeout_s: float = 30.0
     # replica port assignment: 0 = ephemeral (parsed from each banner);
     # nonzero = base_port + slot (fixed layouts for firewalls — slots
@@ -127,6 +132,8 @@ class FleetConfig:
             timeout_ms=self.timeout_ms,
             max_doc_len=self.max_doc_len,
             drain_timeout_s=self.replica_drain_timeout_s,
+            batching=self.batching,
+            precision=self.precision,
             no_telemetry=not self.telemetry,
             extra_args=self.extra_replica_args,
         )

@@ -467,6 +467,8 @@ def build_serve_cmd(
     timeout_ms: Optional[float] = None,
     max_doc_len: Optional[int] = None,
     drain_timeout_s: Optional[float] = None,
+    batching: Optional[str] = None,
+    precision: Optional[str] = None,
     no_telemetry: bool = False,
     extra_args: Sequence[str] = (),
 ) -> List[str]:
@@ -488,6 +490,10 @@ def build_serve_cmd(
         cmd += ["--max-doc-len", str(int(max_doc_len))]
     if drain_timeout_s is not None:
         cmd += ["--drain-timeout-s", str(float(drain_timeout_s))]
+    if batching is not None:
+        cmd += ["--batching", str(batching)]
+    if precision is not None:
+        cmd += ["--precision", str(precision)]
     if no_telemetry:
         cmd.append("--no-telemetry")
     cmd += list(extra_args)

@@ -115,6 +115,12 @@ class _Handler(BaseHTTPRequestHandler):
                         "warmed_buckets": len(self.server.engine.warmed),
                         "max_batch_docs": self.server.engine.max_batch_docs,
                         "max_doc_len": self.server.engine.max_doc_len,
+                        # the engine's honest labels: admission discipline
+                        # and the precision the device actually runs —
+                        # operators and bench records read them here
+                        "batching": self.server.engine.batching,
+                        "precision": self.server.engine.overlay.resolved,
+                        "precision_label": self.server.engine.overlay.label,
                     },
                 )
         elif self.path == "/metrics":

@@ -100,6 +100,28 @@ def sanitize_json(obj: Any) -> Any:
     return obj
 
 
+def _merge_weighted(
+    out: Dict[str, Any], key: str, pairs: List[Tuple[float, float]]
+) -> None:
+    """The one fleet-percentile merge rule (``merge_serving_snapshots``
+    uses it for histogram percentiles, the ``slo`` block, and the
+    ``slo_window`` block): a fleet p99 is not derivable from per-replica
+    p99s, so report the weight-weighted mean under ``key`` AND the worst
+    replica under ``key_worst`` — the honest bound an SLO check should
+    use. Zero total weight (all-idle replicas) falls back to the
+    unweighted mean; no values at all writes None for both."""
+    if not pairs:
+        out[key] = out[f"{key}_worst"] = None
+        return
+    total_w = sum(w for _, w in pairs)
+    out[key] = (
+        sum(v * w for v, w in pairs) / total_w
+        if total_w > 0
+        else sum(v for v, _ in pairs) / len(pairs)
+    )
+    out[f"{key}_worst"] = max(v for v, _ in pairs)
+
+
 def merge_serving_snapshots(
     snaps: List[Dict[str, Any]]
 ) -> Dict[str, Any]:
@@ -121,6 +143,10 @@ def merge_serving_snapshots(
       bound an SLO check should use.
     * the ``slo`` block follows the histogram rule (weighted by the
       replica's latency sample count, worst alongside).
+    * the ``slo_window`` block (sliding-window percentiles — recent
+      load, not run lifetime) merges the same way, weighted by each
+      replica's IN-WINDOW sample count, so the fleet view reacts to a
+      spike as fast as the freshest replica does.
     """
     merged: Dict[str, Any] = {
         "replicas": len(snaps),
@@ -186,21 +212,11 @@ def merge_serving_snapshots(
             "max": max(maxs) if maxs else None,
         }
         for q in ("p50", "p95", "p99"):
-            pairs = [
-                (float(e[q]), e.get("count") or 0)
+            _merge_weighted(out, q, [
+                (float(e[q]), float(e.get("count") or 0))
                 for e in entries
                 if isinstance(e.get(q), (int, float))
-            ]
-            if pairs:
-                total_w = sum(w for _, w in pairs)
-                out[q] = (
-                    sum(v * w for v, w in pairs) / total_w
-                    if total_w > 0
-                    else sum(v for v, _ in pairs) / len(pairs)
-                )
-                out[f"{q}_worst"] = max(v for v, _ in pairs)
-            else:
-                out[q] = out[f"{q}_worst"] = None
+            ])
         merged["histograms"][key] = out
 
     slo_keys = {k for snap in snaps for k in (snap.get("slo") or {})}
@@ -209,21 +225,34 @@ def merge_serving_snapshots(
             "batch_occupancy" if "occupancy" in key
             else "request_latency_seconds"
         )
-        pairs = [
+        _merge_weighted(merged["slo"], key, [
             (float((snap.get("slo") or {})[key]), _weight(snap, hist_key))
             for snap in snaps
             if isinstance((snap.get("slo") or {}).get(key), (int, float))
-        ]
-        if not pairs:
-            merged["slo"][key] = merged["slo"][f"{key}_worst"] = None
-            continue
-        total_w = sum(w for _, w in pairs)
-        merged["slo"][key] = (
-            sum(v * w for v, w in pairs) / total_w
-            if total_w > 0
-            else sum(v for v, _ in pairs) / len(pairs)
-        )
-        merged["slo"][f"{key}_worst"] = max(v for v, _ in pairs)
+        ])
+
+    window_snaps = [
+        snap.get("slo_window") for snap in snaps
+        if isinstance(snap.get("slo_window"), dict)
+    ]
+    if window_snaps:
+        win: Dict[str, Any] = {
+            "window_s": max(
+                float(w.get("window_s") or 0.0) for w in window_snaps
+            ),
+            "samples": sum(int(w.get("samples") or 0) for w in window_snaps),
+        }
+        win_keys = {
+            k for w in window_snaps for k in w
+            if k not in ("window_s", "samples")
+        }
+        for key in sorted(win_keys):
+            _merge_weighted(win, key, [
+                (float(w[key]), float(w.get("samples") or 0))
+                for w in window_snaps
+                if isinstance(w.get(key), (int, float))
+            ])
+        merged["slo_window"] = win
     return merged
 
 
@@ -257,17 +286,40 @@ class _Histogram:
     The ring doubles as the ROLLING window (rolling p50 for the
     step-time regression detector): percentiles describe the last
     ``max_samples`` observations, count/sum describe the whole run.
+
+    ``window_s`` additionally keeps TIME-stamped samples so
+    :meth:`window_snapshot` can answer "what do the last T seconds look
+    like" — the count-based ring dilutes a fresh load spike among
+    thousands of older samples exactly when a control loop (the fleet
+    autoscaler) needs to see it. The timed buffer is hard-capped at
+    8 × ``max_samples`` entries as a memory bound; at rates that
+    overflow the cap within the window, the window percentiles describe
+    the most recent cap-sized slice (still the freshest data).
     """
 
-    __slots__ = ("_lock", "_samples", "count", "sum", "max", "min")
+    __slots__ = (
+        "_lock", "_samples", "count", "sum", "max", "min",
+        "window_s", "_clock", "_timed",
+    )
 
-    def __init__(self, lock: threading.Lock, max_samples: int = 512):
+    def __init__(
+        self,
+        lock: threading.Lock,
+        max_samples: int = 512,
+        window_s: Optional[float] = None,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
         self._lock = lock
         self._samples: "deque[float]" = deque(maxlen=max_samples)
         self.count = 0
         self.sum = 0.0
         self.max: Optional[float] = None
         self.min: Optional[float] = None
+        self.window_s = float(window_s) if window_s else None
+        self._clock = clock
+        self._timed: "deque[Tuple[float, float]]" = deque(
+            maxlen=8 * max_samples
+        )
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -277,6 +329,34 @@ class _Histogram:
             self.sum += v
             self.max = v if self.max is None else max(self.max, v)
             self.min = v if self.min is None else min(self.min, v)
+            if self.window_s is not None:
+                now = self._clock()
+                self._timed.append((now, v))
+                self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        """Drop timed samples older than the window (caller holds lock)."""
+        cutoff = now - (self.window_s or 0.0)
+        while self._timed and self._timed[0][0] < cutoff:
+            self._timed.popleft()
+
+    def window_snapshot(self) -> Optional[Dict[str, Any]]:
+        """p50/p95/p99 over the last ``window_s`` seconds only (None when
+        the histogram has no time window configured). Pruning happens at
+        read time too, so a quiet period empties the window instead of
+        freezing its last busy picture."""
+        if self.window_s is None:
+            return None
+        with self._lock:
+            self._prune(self._clock())
+            samples = sorted(v for _, v in self._timed)
+        return {
+            "window_s": self.window_s,
+            "samples": len(samples),
+            "p50": _nearest_rank(samples, 0.5),
+            "p95": _nearest_rank(samples, 0.95),
+            "p99": _nearest_rank(samples, 0.99),
+        }
 
     def percentile(self, q: float) -> Optional[float]:
         """q in [0, 1] over the rolling sample window (nearest-rank)."""
@@ -330,10 +410,18 @@ class MetricsRegistry:
                 self._gauges[name] = _Gauge(self._lock)
             return self._gauges[name]
 
-    def histogram(self, name: str, max_samples: int = 512) -> _Histogram:
+    def histogram(
+        self,
+        name: str,
+        max_samples: int = 512,
+        window_s: Optional[float] = None,
+    ) -> _Histogram:
         with self._lock:
             if name not in self._histograms:
-                self._histograms[name] = _Histogram(self._lock, max_samples)
+                self._histograms[name] = _Histogram(
+                    self._lock, max_samples, window_s=window_s,
+                    clock=self._clock,
+                )
             return self._histograms[name]
 
     def snapshot(self) -> Dict[str, Any]:

@@ -181,6 +181,142 @@ def test_batcher_close_unblocks_dispatcher():
     assert got == [None]
 
 
+# ----------------------------------------------------------------------
+# Continuous admission: slot-based assembly, no window timer
+# ----------------------------------------------------------------------
+
+
+class _FakeClock:
+    """Deterministic clock; also counts reads so a test can assert a
+    code path never even consulted time."""
+
+    def __init__(self, t=100.0):
+        self.t = t
+        self.reads = 0
+
+    def __call__(self):
+        self.reads += 1
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_continuous_dispatches_partial_batch_immediately():
+    """The defining property: a partial batch dispatches the instant the
+    dispatch thread asks, never sitting out the window timer (here an
+    absurd 30 s — a timer-waiting implementation would hang)."""
+    b = DynamicBatcher(
+        max_queue_docs=32, max_batch_docs=8, max_wait_s=30.0,
+        mode="continuous",
+    )
+    b.submit(_req(2))
+    t0 = time.monotonic()
+    batch = b.next_batch()
+    assert time.monotonic() - t0 < 5.0
+    assert sum(len(r.docs) for r in batch) == 2  # partial, not full
+
+
+def test_continuous_no_queued_request_waits_for_inflight_drain():
+    """Property (the tentpole's contract): while a batch is IN FLIGHT
+    (popped, not completed), newly queued requests are admitted into the
+    next dispatch's free slots the moment the dispatch thread returns —
+    with a fake clock, zero simulated time passes between the in-flight
+    handoff and the follow-up's admission into a batch."""
+    clock = _FakeClock()
+    b = DynamicBatcher(
+        max_queue_docs=32, max_batch_docs=4, max_wait_s=30.0,
+        mode="continuous", clock=clock,
+    )
+    b.submit(_req(4, clock=clock))
+    inflight = b.next_batch()  # handed to the "device", never completed
+    assert sum(len(r.docs) for r in inflight) == 4
+    # requests landing while the device runs
+    late = [_req(1, clock=clock), _req(2, clock=clock)]
+    for r in late:
+        b.submit(r)
+    batch = b.next_batch()  # dispatch thread frees up
+    assert batch == late  # all queued slots filled at once
+    assert all(r.started_at == clock.t for r in late)
+    # the in-flight batch was NEVER completed — its drain was not a
+    # precondition for admitting the follow-ups
+    assert not any(r.done for r in inflight)
+
+
+def test_continuous_typed_rejects_still_fire():
+    b = DynamicBatcher(
+        max_queue_docs=4, max_batch_docs=4, max_wait_s=0.0,
+        mode="continuous",
+    )
+    with pytest.raises(RequestTooLarge):
+        b.submit(_req(5))
+    b.submit(_req(3))
+    with pytest.raises(QueueFull):
+        b.submit(_req(2))
+    assert b.rejected_full == 1
+    b.begin_drain()
+    with pytest.raises(Draining):
+        b.submit(_req(1))
+    assert b.rejected_draining == 1
+
+
+def test_continuous_deadlines_honored_before_and_after_admission():
+    """An already-expired request never reaches a batch (pre-admission
+    check), and a request whose deadline passes while it sits queued
+    behind an in-flight batch gets its typed DeadlineExceeded at the
+    next slot-fill, not a response nobody reads."""
+    clock = _FakeClock()
+    b = DynamicBatcher(
+        max_queue_docs=32, max_batch_docs=4, max_wait_s=0.0,
+        mode="continuous", clock=clock,
+    )
+    dead = _req(1, deadline_in=-0.5, clock=clock)
+    live = _req(1, deadline_in=10.0, clock=clock)
+    b.submit(dead)
+    b.submit(live)
+    assert b.next_batch() == [live]
+    assert dead.done and isinstance(dead.error, DeadlineExceeded)
+    # queued during an in-flight batch, expires before the slots free up
+    expiring = _req(1, deadline_in=1.0, clock=clock)
+    survivor = _req(1, deadline_in=60.0, clock=clock)
+    b.submit(expiring)
+    b.submit(survivor)
+    clock.advance(5.0)  # the in-flight batch ran long
+    assert b.next_batch() == [survivor]
+    assert expiring.done and isinstance(expiring.error, DeadlineExceeded)
+    assert b.expired == 2
+
+
+def test_continuous_drain_completes_requests_mid_assembly():
+    """begin_drain with requests queued (mid-assembly for the next
+    dispatch): admission closes, but every queued request still
+    dispatches — the graceful-drain contract is mode-independent."""
+    b = DynamicBatcher(
+        max_queue_docs=32, max_batch_docs=2, max_wait_s=0.0,
+        mode="continuous",
+    )
+    queued = [_req(2), _req(2), _req(1)]
+    for r in queued:
+        b.submit(r)
+    b.begin_drain()
+    with pytest.raises(Draining):
+        b.submit(_req(1))
+    served = []
+    while True:
+        batch = b.next_batch(poll_s=0.01)
+        served.extend(batch)
+        if len(served) == len(queued):
+            break
+    assert served == queued  # FIFO, whole requests, none dropped
+    b.close()
+    assert b.next_batch() is None
+
+
+def test_batcher_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        DynamicBatcher(mode="adaptive")
+
+
 def test_warmup_bucket_grid_uses_trainer_tables():
     grid = warmup_buckets(8, 32, (16, 32, 64))
     assert grid == [(1, 16), (1, 32), (2, 16), (2, 32), (4, 16), (4, 32),
@@ -289,6 +425,11 @@ def test_healthz_and_metrics_endpoints(served):
     assert health["status"] == "ok"
     assert health["pipeline"] == ["tok2vec", "tagger"]
     assert health["warmed_buckets"] == 8  # (1|2|4|8) x (16|32)
+    # honest labels: the default admission discipline and the precision
+    # the device actually runs (CPU auto resolves the overlay OFF)
+    assert health["batching"] == "continuous"
+    assert health["precision"] == "f32"
+    assert "precision_label" in health
     status, metrics = _get(host, port, "/metrics")
     assert status == 200
     assert {"counters", "gauges", "histograms", "slo"} <= set(metrics)
@@ -324,11 +465,14 @@ def test_too_long_doc_rejected_413(served):
 def test_request_deadline_maps_to_504(serve_nlp):
     """A deadline shorter than the coalescing window must come back as a
     typed 504, not hang: the dispatcher completes expired requests
-    before spending device time."""
+    before spending device time. Window mode pinned explicitly — it is
+    the window that guarantees the deadline passes pre-dispatch
+    (continuous admission would race the 1 ms deadline)."""
     engine = InferenceEngine(
         serve_nlp,
         max_batch_docs=4,
         max_wait_s=0.3,
+        batching="window",
         timeout_s=30.0,
         max_doc_len=32,
     )
@@ -434,14 +578,17 @@ def model_dir(serve_nlp, tmp_path_factory):
 def test_sigterm_graceful_drain_subprocess(model_dir):
     """Acceptance: SIGTERM mid-load completes the in-flight request,
     rejects new admissions, and the process exits 0. The in-flight
-    request is HELD in the coalescing window (max_wait 600ms) when the
-    signal lands, so the drain provably finishes admitted work."""
+    request is HELD in the coalescing window (max_wait 600ms — window
+    mode pinned: continuous admission would dispatch it before the
+    signal) when the signal lands, so the drain provably finishes
+    admitted-but-not-dispatched work."""
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     proc = subprocess.Popen(
         [
             sys.executable, "-m", "spacy_ray_tpu", "serve", str(model_dir),
             "--device", "cpu", "--port", "0",
-            "--max-batch", "4", "--max-wait-ms", "600",
+            "--max-batch", "4", "--batching", "window",
+            "--max-wait-ms", "600",
             "--max-doc-len", "16", "--drain-timeout-s", "30",
         ],
         stdout=subprocess.PIPE,
@@ -556,6 +703,123 @@ def test_bench_serving_appends_session_records(tmp_path, monkeypatch):
     closed, open_ = on_disk
     assert closed["clients"] == 4
     assert open_["offered_rps"] > 0
+
+
+def test_committed_session_value_selects_matching_record(tmp_path, monkeypatch):
+    """The open-loop offered rate derives from the matching committed
+    record for the spec being run (latest wins, skips and mismatched
+    shapes filtered) — never from a cross-methodology record. This is
+    the PERF.md cross-round caveat closed in code."""
+    import bench
+
+    session = tmp_path / "session.jsonl"
+    rows = [
+        {"name": "serving_open", "offered_rps": 40.0,
+         "max_batch_docs": 16, "texts_per_request": 2},
+        {"name": "serving_open", "offered_rps": 99.0,
+         "max_batch_docs": 8, "texts_per_request": 2},   # wrong shape
+        {"name": "serving_open", "skipped": True, "offered_rps": 77.0,
+         "max_batch_docs": 16, "texts_per_request": 2},  # skip record
+        {"name": "serving_open", "offered_rps": 47.3,
+         "max_batch_docs": 16, "texts_per_request": 2},  # newest match
+        {"name": "serving_fleet_open", "offered_rps": 18.1, "replicas": 1,
+         "max_batch_docs": 16, "texts_per_request": 2},
+    ]
+    session.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    monkeypatch.setattr(bench, "SESSION_FILE", session)
+    assert bench._committed_session_value(
+        "serving_open", max_batch_docs=16, texts_per_request=2
+    ) == (47.3, "committed:serving_open.offered_rps")
+    # the fleet spec at n=1 matches ITS pinned record, not the
+    # single-engine one
+    assert bench._committed_session_value(
+        "serving_fleet_open", replicas=1, max_batch_docs=16,
+        texts_per_request=2,
+    ) == (18.1, "committed:serving_fleet_open.offered_rps")
+    assert bench._committed_session_value(
+        "serving_fleet_open", replicas=4, max_batch_docs=16,
+        texts_per_request=2,
+    ) is None
+    monkeypatch.setattr(bench, "SESSION_FILE", tmp_path / "missing.jsonl")
+    assert bench._committed_session_value("serving_open") is None
+
+
+def test_bench_serving_ab_smoke(tmp_path, monkeypatch):
+    """--serving-ab smoke: both admission arms run open-loop AT THE SAME
+    committed offered rates (baseline + saturation), records carry the
+    honest batching/precision labels and the rate's provenance."""
+    import bench
+
+    session = tmp_path / "session.jsonl"
+    seed_rows = [
+        {"name": "serving_open", "platform": "cpu", "offered_rps": 10.0,
+         "max_batch_docs": 4, "texts_per_request": 2},
+        {"name": "serving_closed", "platform": "cpu", "value": 18.0,
+         "max_batch_docs": 4, "texts_per_request": 2},
+        # a closed-loop record from ANOTHER backend must never set this
+        # platform's operating point
+        {"name": "serving_closed", "platform": "tpu", "value": 500.0,
+         "max_batch_docs": 4, "texts_per_request": 2},
+    ]
+    session.write_text("\n".join(json.dumps(r) for r in seed_rows) + "\n")
+    monkeypatch.setattr(bench, "SESSION_FILE", session)
+    records = bench.run_serving_ab(
+        "cpu", duration_s=0.5, max_batch=4, max_doc_len=32,
+        skip_precision=True,
+    )
+    assert [(r["batching"], r["rate_point"]) for r in records] == [
+        ("window", "baseline"), ("window", "saturation"),
+        ("continuous", "baseline"), ("continuous", "saturation"),
+    ]
+    for rec in records:
+        assert rec["name"] == "serving_ab_open"
+        assert rec["precision"] == "f32"  # CPU: auto resolves OFF
+        assert rec["requests_ok"] > 0
+        assert rec["latency_ms_p99"] is not None
+        assert rec["dispatch_wait_ms_p99"] is not None
+    # both arms measured at the SAME fixed points, from committed records
+    baselines = [r for r in records if r["rate_point"] == "baseline"]
+    assert {r["offered_rps"] for r in baselines} == {10.0}
+    assert {r["offered_rate_source"] for r in baselines} == {
+        "committed:serving_open.offered_rps"
+    }
+    sats = [r for r in records if r["rate_point"] == "saturation"]
+    assert {r["offered_rps"] for r in sats} == {18.0}
+    # saturation pinning: once the A/B's own saturation record exists, a
+    # newer closed-loop record (e.g. measured under continuous admission,
+    # which saturates far higher) can no longer move the operating point
+    with open(session, "a") as f:
+        f.write(json.dumps({
+            "name": "serving_closed", "platform": "cpu", "value": 99.0,
+            "max_batch_docs": 4, "texts_per_request": 2,
+        }) + "\n")
+    assert bench._committed_session_value(
+        "serving_ab_open", rate_point="saturation", platform="cpu",
+        max_batch_docs=4, texts_per_request=2,
+    ) == (18.0, "committed:serving_ab_open.offered_rps")
+
+
+@pytest.mark.slow
+def test_bench_serving_ab_with_precision_arms(tmp_path, monkeypatch):
+    """Heavy variant: the full A/B including the trf precision arms —
+    on CPU the f32 arm is auto-resolved and the bf16 arm carries the
+    forced-overlay label (the honest-labeling acceptance)."""
+    import bench
+
+    session = tmp_path / "session.jsonl"
+    monkeypatch.setattr(bench, "SESSION_FILE", session)
+    records = bench.run_serving_ab(
+        "cpu", duration_s=1.0, max_batch=4, max_doc_len=32,
+    )
+    precision = [r for r in records if r["name"] == "serving_precision_open"]
+    assert [r["requested_precision"] for r in precision] == ["f32", "bf16"]
+    f32_rec, bf16_rec = precision
+    assert f32_rec["precision"] == "f32"
+    assert bf16_rec["precision"] == "bf16"
+    assert "forced" in bf16_rec["precision_label"]
+    assert f32_rec["offered_rps"] == bf16_rec["offered_rps"]  # fixed rate
+    for rec in precision:
+        assert rec["requests_ok"] > 0
 
 
 @pytest.mark.slow

@@ -144,6 +144,99 @@ def test_histogram_percentiles():
     assert snap["count"] == 100 and snap["min"] == 1.0 and snap["max"] == 100.0
 
 
+def test_windowed_histogram_sees_spike_lifetime_ring_dilutes_it():
+    """The autoscaler regression (fake clock): a load spike in the last
+    few seconds must be VISIBLE in the sliding time window while the
+    big sample ring still dilutes it below 1% — reacting to the ring
+    means reacting to the lifetime average, i.e. never in time."""
+    clk = FakeClock()
+    reg = MetricsRegistry(clock=clk.now)
+    hist = reg.histogram("lat", 4096, window_s=10.0)
+    # 200 s of healthy 5 ms traffic (2000 samples)
+    for _ in range(2000):
+        hist.observe(0.005)
+        clk.advance(0.1)
+    # a spike: 15 requests at 2 s latency inside the last 5 seconds
+    for _ in range(15):
+        hist.observe(2.0)
+        clk.advance(0.3)
+    # ring (4096 cap holds all 2015): 15/2015 < 1% -> p99 stays healthy
+    assert hist.percentile(0.99) == 0.005
+    win = hist.window_snapshot()
+    assert win["window_s"] == 10.0
+    # the 10 s window holds the 4.5 s spike plus ~5.5 s of 5 ms
+    # stragglers (≤56): ~70 samples where the spike is >20%, vs <1%
+    # of the 2015-sample ring
+    assert win["samples"] <= 75
+    assert win["p99"] == 2.0, "spike invisible in the sliding window"
+    # quiet period: the window EMPTIES instead of freezing the spike
+    clk.advance(30.0)
+    assert hist.window_snapshot()["samples"] == 0
+    assert hist.window_snapshot()["p99"] is None
+
+
+def test_windowless_histogram_has_no_window_snapshot():
+    reg = MetricsRegistry()
+    hist = reg.histogram("h2")
+    hist.observe(1.0)
+    assert hist.window_snapshot() is None
+
+
+def test_serving_telemetry_snapshot_carries_slo_window():
+    """ServingTelemetry surfaces both blocks: `slo` (sample ring) and
+    `slo_window` (last-T-seconds) — and a spike shows up in the window
+    block while the ring percentile lags."""
+    from spacy_ray_tpu.serving.engine import ServingTelemetry
+
+    clk = FakeClock()
+    tel = ServingTelemetry(clock=clk.now, slo_window_s=10.0)
+    for _ in range(1500):
+        tel.request_completed(
+            latency_s=0.004, queue_wait_s=0.001, t0=None, error=None
+        )
+        clk.advance(0.1)
+    for _ in range(12):
+        tel.request_completed(
+            latency_s=1.5, queue_wait_s=1.0, t0=None, error=None,
+            dispatch_wait_s=1.2,
+        )
+        clk.advance(0.2)
+    snap = tel.snapshot()
+    assert snap["slo"]["request_latency_p99"] == 0.004  # diluted
+    win = snap["slo_window"]
+    assert win["window_s"] == 10.0
+    assert win["request_latency_p99"] == 1.5  # visible
+    assert snap["slo"]["dispatch_wait_p99"] == 1.2
+
+
+def test_merge_serving_snapshots_merges_slo_window():
+    from spacy_ray_tpu.training.telemetry import merge_serving_snapshots
+
+    a = {
+        "counters": {}, "gauges": {}, "histograms": {},
+        "slo": {"request_latency_p99": 0.01},
+        "slo_window": {"window_s": 30.0, "samples": 90,
+                       "request_latency_p99": 0.01},
+    }
+    b = {
+        "counters": {}, "gauges": {}, "histograms": {},
+        "slo": {"request_latency_p99": 0.5},
+        "slo_window": {"window_s": 30.0, "samples": 10,
+                       "request_latency_p99": 0.5},
+    }
+    merged = merge_serving_snapshots([a, b])
+    win = merged["slo_window"]
+    assert win["samples"] == 100
+    # count-weighted mean + honest worst-replica bound
+    assert abs(win["request_latency_p99"] - 0.059) < 1e-9
+    assert win["request_latency_p99_worst"] == 0.5
+    # replicas without a window block don't break the merge
+    merged2 = merge_serving_snapshots(
+        [a, {"counters": {}, "gauges": {}, "histograms": {}, "slo": {}}]
+    )
+    assert merged2["slo_window"]["samples"] == 90
+
+
 def test_gauge_and_counter():
     reg = MetricsRegistry()
     reg.gauge("hbm").set(123.0)
