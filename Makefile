@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry serving fleet bench baseline profile step-perf serve-perf dryrun
+.PHONY: test test-fast test-slow resilience telemetry serving fleet live bench baseline profile step-perf serve-perf dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -32,6 +32,16 @@ serving:
 # crash-recovery and bench-record variants are slow-marked and excluded
 fleet:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -m "not slow"
+
+# live continuous-learning suite (docs/SERVING.md "Continuous learning"):
+# Checkpoints reader API + writer-protocol contract, watcher torn-skip,
+# swap-at-dispatch-boundary bit-exactness, rollback, canary guard +
+# fleet rollout controller (incl. forced-regression auto-rollback), the
+# train+fleet integration and train-and-serve SIGTERM drain — then the
+# hot-swap tail-latency bench at the committed offered rate
+live:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_live.py -q -m "not slow"
+	JAX_PLATFORMS=cpu python bench.py --serving --swap
 
 bench:
 	python bench.py

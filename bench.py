@@ -1479,12 +1479,16 @@ def _committed_session_value(
 
 def _engine_labels(engine) -> Dict[str, Any]:
     """The honest-labeling block every serving record carries: the
-    admission discipline and the precision the device actually runs
-    (never the requested knob)."""
+    admission discipline, the precision the device actually runs (never
+    the requested knob), and the live-serving identity — which
+    checkpoint generation answered (None = the model as loaded) after
+    how many hot-swap flips."""
     return {
         "batching": engine.batching,
         "precision": engine.overlay.resolved,
         "precision_label": engine.overlay.label,
+        "generation": engine.serving_generation,
+        "swap_count": engine.swap_count,
     }
 
 
@@ -1859,6 +1863,202 @@ def run_serving_ab(
         _append_session(rec, platform)
         records.append(rec)
     return records
+
+
+def _drive_open_timed(
+    host: str, port: int, duration_s: float, rate: float,
+    texts_pool: List[List[str]],
+) -> Tuple[float, List[Tuple[float, float, int]]]:
+    """Open-loop load that keeps per-request provenance: returns (wall,
+    [(issue_offset_s, latency_s, http_status), ...]). The swap spec
+    needs to classify each request by whether its LIFETIME overlapped a
+    swap window — aggregate counters can't answer that."""
+    import threading
+
+    interval = 1.0 / rate
+    lock = threading.Lock()
+    shots: List[Tuple[float, float, int]] = []
+    n_requests = max(int(duration_s * rate), 1)
+    session = _ParseSession(host, port)
+
+    def one_shot(i: int, issued: float) -> None:
+        texts = texts_pool[i % len(texts_pool)]
+        try:
+            status, dt = session.post(texts)
+        except OSError:
+            status, dt = -1, 0.0
+        with lock:
+            shots.append((issued, dt, status))
+
+    t0 = time.perf_counter()
+    workers: List[threading.Thread] = []
+    for i in range(n_requests):
+        target = t0 + i * interval
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        th = threading.Thread(
+            target=one_shot, args=(i, time.perf_counter() - t0), daemon=True
+        )
+        th.start()
+        workers.append(th)
+    for th in workers:
+        th.join(timeout=35.0)
+    session.close()
+    return time.perf_counter() - t0, shots
+
+
+def run_serving_swap(
+    platform: str,
+    *,
+    duration_s: float = 6.0,
+    swaps: int = 3,
+    max_batch: int = 16,
+    texts_per_request: int = 2,
+    open_rate: Optional[float] = None,
+) -> Dict[str, Any]:
+    """``--serving --swap``: open-loop load at the committed offered
+    rate while forcing N live hot-swaps mid-run — the honest headline is
+    what a swap costs AT THE TAIL (p99 of requests whose lifetime
+    overlapped a swap), not the mean.
+
+    The checkpoint directory is real (TrainCheckpoint generations,
+    digests and all), so each forced swap pays the full production path:
+    generation load + digest verify + overlay staging + dispatch-boundary
+    flip. Both generations hold the SAME weights — the spec measures the
+    mechanism's cost, and identical outputs keep every response
+    byte-comparable. Zero 5xx across the run is part of the record."""
+    import tempfile
+
+    from spacy_ray_tpu.serving.engine import InferenceEngine, ServingTelemetry
+    from spacy_ray_tpu.serving.server import Server
+    from spacy_ray_tpu.training.checkpoint import Checkpoints, TrainCheckpoint
+
+    nlp = _serving_nlp()
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_swap_ckpt_")
+    opt_stub = {"note": np.zeros(1, np.float32)}
+    for stamp in (1, 2):
+        TrainCheckpoint.save(
+            ckpt_dir, params=nlp.params, opt_state=opt_stub, step=stamp,
+            epoch=0, rng=np.zeros(2, np.uint32), best_score=0.0,
+            best_step=0, keep=4,
+        )
+    ckpts = Checkpoints(ckpt_dir)
+
+    tel = ServingTelemetry()
+    engine = InferenceEngine(
+        nlp,
+        max_batch_docs=max_batch,
+        max_queue_docs=max(8 * max_batch, 128),
+        timeout_s=30.0,
+        max_doc_len=64,
+        telemetry=tel,
+    )
+    engine.start(warmup=True)
+    server = Server(engine, "127.0.0.1", 0, telemetry=tel)
+    host, port = server.start()
+
+    if open_rate:
+        rate, rate_source = float(open_rate), "cli"
+    else:
+        committed = _committed_session_value(
+            "serving_open", platform=platform, max_batch_docs=max_batch,
+            texts_per_request=texts_per_request,
+        )
+        rate, rate_source = committed or (30.0, "fallback:30rps")
+    texts_pool = [_serving_texts(texts_per_request, seed=i)
+                  for i in range(64)]
+    print(f"# swap bench: {rate:.1f} req/s offered ({rate_source}), "
+          f"{swaps} forced swap(s) over {duration_s:.1f}s", flush=True)
+
+    swap_windows: List[Tuple[float, float]] = []
+    driver_out: Dict[str, Any] = {}
+
+    def drive() -> None:
+        wall, shots = _drive_open_timed(
+            host, port, duration_s, rate, texts_pool
+        )
+        driver_out["wall"], driver_out["shots"] = wall, shots
+
+    try:
+        t_base = time.perf_counter()
+        driver = __import__("threading").Thread(target=drive, daemon=True)
+        driver.start()
+        # evenly spaced swaps, the first after the load has warmed up —
+        # alternating between the two resident generations so every swap
+        # is a real flip (and odd swaps exercise re-staging, not rollback)
+        gen_cycle = [2, 1]
+        for i in range(int(swaps)):
+            at = duration_s * (i + 1) / (swaps + 1)
+            delay = (t_base + at) - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            stamp = gen_cycle[i % 2]
+            w0 = time.perf_counter() - t_base
+            state = ckpts.load_generation_params(stamp)
+            engine.swap_params(state["params"], stamp, source="bench")
+            swap_windows.append((w0, time.perf_counter() - t_base))
+        driver.join(timeout=duration_s + 40.0)
+    finally:
+        server.request_shutdown()
+        server.wait()
+
+    shots = driver_out.get("shots") or []
+    wall = driver_out.get("wall") or duration_s
+    ok = [(t, dt) for t, dt, s in shots if s == 200]
+    rejected = sum(1 for _, _, s in shots if s == 429)
+    http_5xx = sum(1 for _, _, s in shots if s >= 500)
+    failed = sum(1 for _, _, s in shots if s < 0)
+
+    def overlaps(t: float, dt: float) -> bool:
+        return any(t <= w1 and t + dt >= w0 for w0, w1 in swap_windows)
+
+    during = [dt for t, dt in ok if overlaps(t, dt)]
+    steady = [dt for t, dt in ok if not overlaps(t, dt)]
+    snap = tel.snapshot()
+    hists = snap.get("histograms") or {}
+    stage_h = hists.get("swap_stage_seconds") or {}
+    flip_h = hists.get("swap_flip_seconds") or {}
+    ms = lambda v: round(v * 1e3, 3) if isinstance(v, (int, float)) else None  # noqa: E731
+    during_stats = _latency_stats(during)
+    rec = {
+        "name": "serving_swap_open",
+        "metric": (
+            f"hot_swap_tail_latency (fixed {rate:.0f} req/s offered, "
+            f"{swaps} live swaps mid-run, cnn tagger, HTTP end-to-end)"
+        ),
+        "value": during_stats["latency_ms_p99"],
+        "unit": "ms p99 during-swap",
+        "platform": platform,
+        "mode": "open",
+        "offered_rps": round(rate, 1),
+        "offered_rate_source": rate_source,
+        "duration_s": round(wall, 2),
+        "requests_ok": len(ok),
+        "rejected": rejected,
+        "failed": failed,
+        "http_5xx": http_5xx,
+        "texts_per_request": texts_per_request,
+        "max_batch_docs": max_batch,
+        "swaps_forced": int(swaps),
+        "swap_windows_s": [
+            [round(a, 3), round(b, 3)] for a, b in swap_windows
+        ],
+        "requests_during_swap": len(during),
+        "requests_steady": len(steady),
+        "during_swap_ms_p50": during_stats["latency_ms_p50"],
+        "during_swap_ms_p99": during_stats["latency_ms_p99"],
+        "during_swap_ms_max": during_stats["latency_ms_max"],
+        "steady_ms_p50": _latency_stats(steady)["latency_ms_p50"],
+        "steady_ms_p99": _latency_stats(steady)["latency_ms_p99"],
+        "swap_stage_ms_max": ms(stage_h.get("max")),
+        "swap_flip_ms_max": ms(flip_h.get("max")),
+        **_engine_labels(engine),
+        **_latency_stats([dt for _, dt in ok]),
+    }
+    print(json.dumps(rec), flush=True)
+    _append_session(rec, platform)
+    return rec
 
 
 def _get_json(host: str, port: int, path: str, timeout_s: float = 30.0):
@@ -2456,6 +2656,18 @@ def main() -> None:
         "so the scaling curve lives in BENCH_SESSION.jsonl",
     )
     parser.add_argument(
+        "--swap", action="store_true",
+        help="--serving: run the live hot-swap spec instead — open-loop "
+        "load at the committed offered rate while forcing --swap-count "
+        "checkpoint-generation hot-swaps mid-run; the record splits p99 "
+        "into during-swap vs steady-state (the honest headline is the "
+        "tail) and requires zero 5xx; lands in BENCH_SESSION.jsonl",
+    )
+    parser.add_argument(
+        "--swap-count", type=int, default=3,
+        help="--serving --swap: how many hot-swaps to force mid-run",
+    )
+    parser.add_argument(
         "--serving-ab", action="store_true",
         help="run the per-replica speed A/B pairs open-loop at fixed "
         "offered rates (window vs continuous admission at the committed "
@@ -2497,6 +2709,13 @@ def main() -> None:
                 jax.default_backend(),
                 duration_s=float(args.serving_duration),
                 skip_precision=bool(args.skip_precision),
+            )
+        elif args.swap:
+            run_serving_swap(
+                jax.default_backend(),
+                duration_s=max(float(args.serving_duration), 4.0),
+                swaps=int(args.swap_count),
+                open_rate=float(args.serving_rate) or None,
             )
         elif args.replicas.strip():
             counts = [

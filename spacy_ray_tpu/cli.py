@@ -1514,6 +1514,22 @@ def serve_command(argv: List[str]) -> int:
                         help="longest admissible doc in tokens (the warmed "
                         "shape cap; longer docs are rejected 413)")
     parser.add_argument("--drain-timeout-s", type=float, default=30.0)
+    parser.add_argument("--watch", type=Path, default=None, metavar="CKPT_DIR",
+                        help="live continuous learning (docs/SERVING.md): "
+                        "poll this TrainCheckpoint directory (a training "
+                        "run's <output>/last-model) and hot-swap each new "
+                        "digest-verified generation at a dispatch boundary "
+                        "— zero dropped requests, torn generations "
+                        "skipped, instant rollback via POST /admin/rollback")
+    parser.add_argument("--watch-interval-s", type=float, default=2.0,
+                        help="checkpoint-directory poll interval")
+    parser.add_argument("--swap-dir", type=Path, action="append",
+                        default=[], dest="swap_dirs", metavar="CKPT_DIR",
+                        help="checkpoint directory POST /admin/swap may "
+                        "load generations from (repeatable; --watch is "
+                        "allowed implicitly). With neither, admin swaps "
+                        "are refused 403 — an open port must not accept "
+                        "arbitrary client-supplied weight paths")
     parser.add_argument("--no-warmup", action="store_true",
                         help="skip the bucket compile sweep (first requests "
                         "then pay compiles — testing only)")
@@ -1552,9 +1568,20 @@ def serve_command(argv: List[str]) -> int:
     )
     print(f"serving batching={engine.batching} "
           f"precision={engine.overlay.label}", flush=True)
+    watcher = None
+    if args.watch is not None:
+        from .serving.live import CheckpointWatcher
+
+        def _swap(stamp: int, state: dict, _engine=engine) -> None:
+            _engine.swap_params(state["params"], stamp, source="watch")
+
+        watcher = CheckpointWatcher(
+            args.watch, _swap, interval_s=args.watch_interval_s
+        )
     server = Server(
         engine, args.host, args.port,
         telemetry=tel, drain_timeout_s=args.drain_timeout_s,
+        watcher=watcher, swap_dirs=[str(d) for d in args.swap_dirs],
     )
     # listener-first: the banner (and thus the bound port) appears before
     # the warmup sweep, so a fleet supervisor can probe /healthz — which
@@ -1655,6 +1682,35 @@ def serve_fleet_command(argv: List[str]) -> int:
     parser.add_argument("--probe-interval-s", type=float, default=0.5,
                         help="how often the router re-probes each "
                         "replica's /healthz")
+    # live continuous learning (docs/SERVING.md "Continuous learning",
+    # TUNING.md §14)
+    parser.add_argument("--watch", type=Path, default=None,
+                        metavar="CKPT_DIR",
+                        help="poll this TrainCheckpoint directory (a "
+                        "training run's <output>/last-model); each new "
+                        "digest-verified generation is canaried onto "
+                        "--canary-fraction of the replicas (router splits "
+                        "traffic by generation), then promoted fleet-wide "
+                        "or auto-rolled-back by the guard")
+    parser.add_argument("--watch-interval-s", type=float, default=2.0)
+    parser.add_argument("--canary-fraction", type=float, default=0.25,
+                        help="fraction of replicas (and of traffic) a new "
+                        "generation canaries on before promote/rollback; "
+                        "<=0 or >=1 disables the canary phase (direct "
+                        "rollout to every replica)")
+    parser.add_argument("--guard-p99-frac", type=float, default=1.5,
+                        help="rollback when canary window p99 exceeds this "
+                        "multiple of the baseline's")
+    parser.add_argument("--guard-error-rate", type=float, default=0.02,
+                        help="rollback when the canary's error rate "
+                        "exceeds this (and the baseline's)")
+    parser.add_argument("--guard-min-samples", type=int, default=20,
+                        help="minimum canary requests / window samples "
+                        "before any verdict")
+    parser.add_argument("--guard-verdict-timeout-s", type=float,
+                        default=120.0,
+                        help="a canary with no verdict after this long is "
+                        "rolled back (ship on evidence, not silence)")
     # autoscaler knobs (TUNING.md §12)
     parser.add_argument("--autoscale", action="store_true",
                         help="enable the SLO-driven autoscaler (scale "
@@ -1731,6 +1787,13 @@ def serve_fleet_command(argv: List[str]) -> int:
         cpu_cores=cpu_cores,
         cache_mb=args.cache_mb,
         probe_interval_s=args.probe_interval_s,
+        watch_dir=str(args.watch) if args.watch is not None else None,
+        watch_interval_s=args.watch_interval_s,
+        canary_fraction=args.canary_fraction,
+        guard_p99_frac=args.guard_p99_frac,
+        guard_error_rate=args.guard_error_rate,
+        guard_min_samples=args.guard_min_samples,
+        guard_verdict_timeout_s=args.guard_verdict_timeout_s,
         autoscale=args.autoscale,
         p99_target_ms=args.p99_target_ms,
         autoscale_interval_s=args.autoscale_interval_s,
@@ -1747,6 +1810,137 @@ def serve_fleet_command(argv: List[str]) -> int:
     else:
         print("fleet drain incomplete (router timeout or nonzero replica "
               f"exit) — exiting {rc}", flush=True)
+    return rc
+
+
+def train_and_serve_command(argv: List[str]) -> int:
+    """``train-and-serve`` — the continuous-learning loop as one command
+    (docs/SERVING.md "Continuous learning"): spawn a ``train`` subprocess
+    writing checkpoint generations into ``<output>/last-model``, and a
+    serving fleet that watches that directory and hot-swaps each new
+    digest-verified generation (canary + guard when replicas > 1)
+    without dropping a request. SIGTERM drains BOTH: the trainer
+    checkpoints out (exit 75 = preempted-clean), the fleet finishes
+    in-flight work — exit 0 iff both were clean."""
+    parser = argparse.ArgumentParser(
+        prog="spacy_ray_tpu train-and-serve",
+        description="Run training and a hot-swapping serving fleet "
+        "against one checkpoint directory, under one lifecycle.",
+    )
+    parser.add_argument("config_path", type=Path)
+    parser.add_argument("--output", "-o", type=Path, required=True,
+                        help="training output dir; the fleet watches "
+                        "<output>/last-model for generations")
+    parser.add_argument("--model", type=Path, default=None,
+                        help="serve this model dir from t=0 (e.g. the "
+                        "previous run's best-model). Default: wait for "
+                        "this run's first best-model save and bootstrap "
+                        "from a snapshot of it")
+    parser.add_argument("--bootstrap-timeout-s", type=float, default=600.0,
+                        help="--model unset: how long to wait for the "
+                        "first best-model save before giving up")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8090)
+    parser.add_argument("--device", type=str, default="tpu",
+                        choices=["tpu", "cpu", "gpu"],
+                        help="device for the trainer AND each serving "
+                        "replica (separate processes; on one-device "
+                        "hosts run --device cpu serving next to an "
+                        "accelerator trainer via --serve-device)")
+    parser.add_argument("--serve-device", type=str, default=None,
+                        choices=["tpu", "cpu", "gpu"],
+                        help="override the replicas' device (default: "
+                        "--device)")
+    parser.add_argument("--replicas", type=int, default=1)
+    parser.add_argument("--base-port", type=int, default=0)
+    parser.add_argument("--cpu-cores", type=str, default=None,
+                        help="serve-fleet's --cpu-cores, applied to the "
+                        "replicas ('auto' = one core per replica)")
+    parser.add_argument("--max-batch", type=int, default=None)
+    parser.add_argument("--max-doc-len", type=int, default=None)
+    parser.add_argument("--batching",
+                        choices=["continuous", "window"], default=None)
+    parser.add_argument("--precision",
+                        choices=["auto", "f32", "bf16", "int8"], default=None)
+    parser.add_argument("--watch-interval-s", type=float, default=2.0)
+    parser.add_argument("--canary-fraction", type=float, default=0.25)
+    parser.add_argument("--guard-p99-frac", type=float, default=1.5)
+    parser.add_argument("--guard-error-rate", type=float, default=0.02)
+    parser.add_argument("--guard-min-samples", type=int, default=20)
+    parser.add_argument("--guard-verdict-timeout-s", type=float,
+                        default=120.0)
+    parser.add_argument("--drain-timeout-s", type=float, default=60.0)
+    parser.add_argument("--no-telemetry", action="store_true")
+    parser.add_argument("--train-arg", action="append", default=[],
+                        dest="train_args", metavar="ARG",
+                        help="extra argument appended to the train "
+                        "subprocess command (repeatable), e.g. "
+                        "--train-arg=--max-restarts --train-arg=2")
+    parser.add_argument("--verbose", "-V", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.ERROR)
+    for name in ("spacy_ray_tpu.training", "spacy_ray_tpu.serving"):
+        logging.getLogger(name).setLevel(
+            logging.INFO if args.verbose else logging.WARNING
+        )
+    serve_device = args.serve_device or args.device
+
+    from .serving.fleet import FleetConfig
+    from .serving.live import TrainAndServe
+
+    cpu_cores: Optional[List[str]] = None
+    if args.cpu_cores and serve_device == "cpu":
+        if args.cpu_cores.strip().lower() == "auto":
+            cpu_cores = [str(c) for c in sorted(os.sched_getaffinity(0))]
+        else:
+            cpu_cores = [m.strip() for m in args.cpu_cores.split(",")
+                         if m.strip()]
+
+    output = args.output
+    train_cmd = [
+        sys.executable, "-m", "spacy_ray_tpu", "train",
+        str(args.config_path), "--output", str(output),
+        "--device", args.device,
+    ] + list(args.train_args)
+    train_env = {"JAX_PLATFORMS": "cpu"} if args.device == "cpu" else None
+
+    config = FleetConfig(
+        model_path=str(args.model) if args.model is not None else "",
+        host=args.host,
+        port=args.port,
+        device=serve_device,
+        replicas=args.replicas,
+        min_replicas=1,
+        max_replicas=max(args.replicas, 1),
+        max_batch=args.max_batch,
+        max_doc_len=args.max_doc_len,
+        batching=args.batching,
+        precision=args.precision,
+        base_port=args.base_port,
+        cpu_cores=cpu_cores,
+        watch_dir=str(output / "last-model"),
+        watch_interval_s=args.watch_interval_s,
+        canary_fraction=args.canary_fraction,
+        guard_p99_frac=args.guard_p99_frac,
+        guard_error_rate=args.guard_error_rate,
+        guard_min_samples=args.guard_min_samples,
+        guard_verdict_timeout_s=args.guard_verdict_timeout_s,
+        drain_timeout_s=args.drain_timeout_s,
+        telemetry=not args.no_telemetry,
+    )
+    rc = TrainAndServe(
+        train_cmd,
+        config,
+        output_dir=output,
+        train_env=train_env,
+        bootstrap_timeout_s=args.bootstrap_timeout_s,
+    ).run()
+    if rc == 0:
+        print("train-and-serve: exiting 0", flush=True)
+    else:
+        print(f"train-and-serve: incomplete drain or trainer failure — "
+              f"exiting {rc}", flush=True)
     return rc
 
 
@@ -1767,6 +1961,7 @@ COMMANDS = {
     "debug-profile": debug_profile_command,
     "serve": serve_command,
     "serve-fleet": serve_fleet_command,
+    "train-and-serve": train_and_serve_command,
     "telemetry": telemetry_command,
     "find-threshold": find_threshold_command,
     "info": info_command,

@@ -9,7 +9,10 @@ Three layers (see each module's docstring for the contracts):
 * :mod:`.overlay` — serving precision policy: bf16 trunk overlays of
   the f32 param tree, probe-gated/auto-armed with honest labels;
 * :mod:`.server` — stdlib HTTP JSON API (``/v1/parse``, ``/healthz``,
-  ``/metrics``) and SIGTERM graceful drain.
+  ``/metrics``, ``/admin/swap``, ``/admin/rollback``) and SIGTERM
+  graceful drain;
+* :mod:`.live` — continuous learning: checkpoint watcher, hot-swap
+  orchestration, canary guard (docs/SERVING.md "Continuous learning").
 
 Entry point: ``spacy-ray-tpu serve <model_dir>`` (cli.py).
 """
@@ -23,6 +26,7 @@ from .batcher import (
     RequestTooLarge,
     ServeRequest,
     ServingError,
+    SwapFailed,
 )
 from .engine import (
     InferenceEngine,
@@ -33,6 +37,7 @@ from .engine import (
 from .overlay import (
     OverlayResult,
     PRECISION_CHOICES,
+    build_params_overlay,
     build_serving_overlay,
     resolve_precision,
 )
@@ -45,6 +50,7 @@ __all__ = [
     "NotReady",
     "DeadlineExceeded",
     "RequestTooLarge",
+    "SwapFailed",
     "ServeRequest",
     "DynamicBatcher",
     "InferenceEngine",
@@ -53,6 +59,7 @@ __all__ = [
     "warmup_buckets",
     "OverlayResult",
     "PRECISION_CHOICES",
+    "build_params_overlay",
     "build_serving_overlay",
     "resolve_precision",
     "Server",

@@ -55,6 +55,7 @@ __all__ = [
     "NotReady",
     "DeadlineExceeded",
     "RequestTooLarge",
+    "SwapFailed",
     "ServeRequest",
     "DynamicBatcher",
 ]
@@ -108,6 +109,19 @@ class RequestTooLarge(ServingError):
 
     http_status = 413
     code = "request_too_large"
+
+
+class SwapFailed(ServingError):
+    """A hot-swap/rollback request could not be honored: the candidate
+    generation is torn (CheckpointCorrupt), its tree does not match the
+    resident one (different shapes/dtypes would void the warmed-program
+    contract), or there is no previous resident to roll back to. The
+    engine keeps serving the CURRENT generation — a failed swap is a
+    refused swap, never a degraded server — and the admin caller gets a
+    typed 409 saying why."""
+
+    http_status = 409
+    code = "swap_failed"
 
 
 class ServeRequest:

@@ -28,6 +28,7 @@ contract the training loop enforces, test-enforced here too.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -45,6 +46,7 @@ from .batcher import (
     RequestTooLarge,
     ServeRequest,
     ServingError,
+    SwapFailed,
 )
 
 __all__ = [
@@ -168,6 +170,19 @@ class ServingTelemetry:
         self._rej_drain = self.registry.counter("rejected_draining")
         self._deadline = self.registry.counter("deadline_exceeded")
         self._errors = self.registry.counter("errors")
+        # hot-swap instruments (serving/live): how often the resident
+        # generation flipped, and what each swap cost — staging (load +
+        # overlay + device put, off the dispatch path) and the flip
+        # itself (the only part a dispatch boundary can observe) are
+        # timed SEPARATELY, because "swaps are cheap" is only honest if
+        # the flip — the part that could stall traffic — is the cheap
+        # part
+        self._swaps = self.registry.counter("swaps")
+        self._rollbacks = self.registry.counter("rollbacks")
+        self._swap_total = self.registry.histogram("swap_seconds", 256)
+        self._swap_stage = self.registry.histogram("swap_stage_seconds", 256)
+        self._swap_flip = self.registry.histogram("swap_flip_seconds", 256)
+        self._generation = self.registry.gauge("serving_generation")
 
     def now(self) -> float:
         return self.trace.now()
@@ -226,6 +241,36 @@ class ServingTelemetry:
 
     def set_queue_depth(self, depth: int) -> None:
         self._queue_depth.set(depth)
+
+    def swap_completed(
+        self,
+        *,
+        stage_s: float,
+        flip_s: float,
+        t0: Optional[float],
+        generation: Optional[int],
+        rollback: bool = False,
+    ) -> None:
+        """One resident-generation flip: counters, the stage/flip/total
+        histograms, the generation gauge, and two trace spans (staging
+        then flip, back to back on the swapping thread's track)."""
+        self._swaps.inc()
+        if rollback:
+            self._rollbacks.inc()
+        self._swap_stage.observe(stage_s)
+        self._swap_flip.observe(flip_s)
+        self._swap_total.observe(stage_s + flip_s)
+        if generation is not None:
+            self._generation.set(float(generation))
+        if t0 is not None:
+            args = {"generation": generation, "rollback": rollback}
+            self.trace.add_span(
+                "swap_stage", t0, max(stage_s, 0.0), cat="serve", args=args
+            )
+            self.trace.add_span(
+                "swap_flip", t0 + stage_s, max(flip_s, 0.0), cat="serve",
+                args=args,
+            )
 
     def snapshot(self) -> Dict[str, Any]:
         """The /metrics payload: registry snapshot + the SLO percentiles.
@@ -313,8 +358,24 @@ class InferenceEngine:
         # and the bench records carry.
         from .overlay import build_serving_overlay
 
+        self.precision = precision
         self.overlay = build_serving_overlay(nlp, precision)
         self.serve_params = self.overlay.params
+        # live hot-swap state (serving/live, docs/SERVING.md "Continuous
+        # learning"): the f32 master tree the overlay was built from,
+        # the generation stamp it came from (None = the model as loaded
+        # from disk), and ONE previous resident kept for instant
+        # rollback. _flip_lock makes (serve_params, overlay, generation)
+        # one atomic unit: the dispatch thread snapshots all three at a
+        # batch boundary, so no batch ever runs mixed weights or carries
+        # another generation's stamp.
+        self._master_params = nlp.params
+        self.serving_generation: Optional[int] = None
+        self.swap_count = 0
+        self.rollback_count = 0
+        self._previous: Optional[Tuple[Optional[int], Any, Any]] = None
+        self._swap_lock = threading.Lock()   # serializes swap/rollback
+        self._flip_lock = threading.Lock()   # guards the resident unit
         self._thread: Optional[threading.Thread] = None
         self._state_lock = threading.Lock()
         self._idle = threading.Condition(self._state_lock)
@@ -450,20 +511,29 @@ class InferenceEngine:
         T = bucket_length(
             max((len(d) for d in docs), default=1), self.nlp.length_buckets
         )
+        # the dispatch boundary: snapshot the resident (params,
+        # generation) unit ONCE, under the flip lock. A swap that lands
+        # after this point is observed by the NEXT batch; this batch
+        # runs entirely on one tree and is stamped with that tree's
+        # generation — the no-mixed-weights contract (test-enforced).
+        with self._flip_lock:
+            serve_params = self.serve_params
+            generation = self.serving_generation
         dispatched_at = self.clock()  # assembly over, handed to the device
         for r in requests:
             r.dispatched_at = dispatched_at
+        info = {"occupancy": n, "B": B, "T": T, "generation": generation}
         try:
             if self.tel is not None:
                 with self.tel.batch_span(n, B, T):
                     self.nlp.predict_docs(
-                        docs, params=self.serve_params,
+                        docs, params=serve_params,
                         batch_size=n, pad_batch_to=B, pad_len_to=T,
                     )
                 self.tel.set_queue_depth(self.batcher.queue_depth())
             else:
                 self.nlp.predict_docs(
-                    docs, params=self.serve_params,
+                    docs, params=serve_params,
                     batch_size=n, pad_batch_to=B, pad_len_to=T,
                 )
         except Exception as e:  # a poisoned batch must not kill the server
@@ -475,12 +545,174 @@ class InferenceEngine:
             )
             err = ServingError(f"inference failed: {type(e).__name__}: {e}")
             for r in requests:
-                r.batch_info = {"occupancy": n, "B": B, "T": T}
+                r.batch_info = dict(info)
                 r.complete(err)
             return
         for r in requests:
-            r.batch_info = {"occupancy": n, "B": B, "T": T}
+            r.batch_info = dict(info)
             r.complete()
+
+    # -- live hot-swap (serving/live; docs/SERVING.md) -------------------
+    @staticmethod
+    def _tree_spec(tree: Any, prefix: str = "") -> Dict[str, Tuple]:
+        """(path -> (shape, dtype)) without materializing anything — the
+        compatibility fingerprint a candidate tree must match for the
+        warmed (B, T) programs (shape- AND dtype-keyed in the jit cache)
+        to keep applying after a flip."""
+        out: Dict[str, Tuple] = {}
+        if isinstance(tree, dict):
+            for k in sorted(tree):
+                out.update(
+                    InferenceEngine._tree_spec(tree[k], f"{prefix}/{k}")
+                )
+        else:
+            out[prefix] = (
+                tuple(getattr(tree, "shape", ())),
+                str(getattr(tree, "dtype", type(tree).__name__)),
+            )
+        return out
+
+    def _stage(self, params: Any):
+        """Build the candidate's precision overlay (same requested knob,
+        fresh resolution — honest label preserved) and force it onto the
+        device NOW, so the flip itself transfers nothing. Runs on the
+        swapping thread; the dispatch thread keeps serving the current
+        resident throughout. Raises :class:`SwapFailed` on any tree
+        mismatch — a candidate that would void the warmed-program
+        contract (or silently re-shape the model) is refused, and the
+        engine keeps serving what it was serving."""
+        import jax
+
+        from .overlay import build_params_overlay
+
+        want = self._tree_spec(self._master_params)
+        got = self._tree_spec(params)
+        if want != got:
+            missing = sorted(set(want) - set(got))[:4]
+            extra = sorted(set(got) - set(want))[:4]
+            changed = sorted(
+                k for k in set(want) & set(got) if want[k] != got[k]
+            )[:4]
+            raise SwapFailed(
+                "candidate param tree does not match the resident one "
+                f"(missing: {missing}, unexpected: {extra}, reshaped/"
+                f"retyped: {changed}) — swap refused, still serving "
+                f"generation {self.serving_generation}"
+            )
+        overlay = build_params_overlay(params, self.precision)
+        try:
+            jax.block_until_ready(jax.device_put(overlay.params))
+        except Exception:  # older jax without pytree support here:
+            # arrays will transfer lazily on the first post-flip
+            # dispatch instead — correct, just less instant
+            pass
+        return overlay
+
+    def swap_params(
+        self, params: Any, generation: int, *, source: str = "api"
+    ) -> Dict[str, Any]:
+        """Hot-swap the resident param tree to ``params`` (a verified
+        checkpoint generation's f32 masters). Staging — overlay build +
+        device put — happens off the dispatch path; the flip is an
+        O(pointers) exchange at a dispatch boundary (the single dispatch
+        thread snapshots the resident unit once per batch, so no
+        in-flight batch ever sees mixed weights). The displaced resident
+        stays staged for instant :meth:`rollback`. Returns a summary
+        dict; raises :class:`SwapFailed` on an incompatible tree."""
+        t_wall = self.clock()
+        t0 = self.tel.now() if self.tel is not None else None
+        with self._swap_lock:
+            overlay = self._stage(params)
+            stage_s = self.clock() - t_wall
+            t_flip = self.clock()
+            with self._flip_lock:
+                prev = (
+                    self.serving_generation, self.overlay,
+                    self._master_params,
+                )
+                self.overlay = overlay
+                self.serve_params = overlay.params
+                self._master_params = params
+                self.serving_generation = int(generation)
+                self.swap_count += 1
+                self._previous = prev
+            flip_s = self.clock() - t_flip
+        if self.tel is not None:
+            self.tel.swap_completed(
+                stage_s=stage_s, flip_s=flip_s, t0=t0,
+                generation=int(generation),
+            )
+        log_event(
+            "serve-swap",
+            f"hot-swapped serving params to generation {generation} "
+            f"(from {prev[0]}; staged {stage_s * 1e3:.1f} ms, flip "
+            f"{flip_s * 1e3:.3f} ms, precision {overlay.label}; "
+            f"source {source})",
+            level=logging.INFO,
+            generation=int(generation),
+            previous=prev[0],
+            stage_s=round(stage_s, 6),
+            flip_s=round(flip_s, 6),
+            source=source,
+        )
+        return {
+            "generation": int(generation),
+            "previous_generation": prev[0],
+            "swap_count": self.swap_count,
+            "stage_s": stage_s,
+            "flip_s": flip_s,
+            "precision_label": overlay.label,
+        }
+
+    def rollback(self) -> Dict[str, Any]:
+        """Instant rollback to the previous RESIDENT generation: its
+        overlay never left staging, so this is a pure flip (no load, no
+        digest work, no device transfer). The displaced generation
+        becomes the new previous — rollback is its own inverse. Raises
+        :class:`SwapFailed` when no previous resident exists."""
+        t0 = self.tel.now() if self.tel is not None else None
+        with self._swap_lock:
+            if self._previous is None:
+                raise SwapFailed(
+                    "no previous resident generation to roll back to "
+                    f"(serving generation {self.serving_generation}, "
+                    f"{self.swap_count} swap(s) so far)"
+                )
+            t_flip = self.clock()
+            with self._flip_lock:
+                displaced = (
+                    self.serving_generation, self.overlay,
+                    self._master_params,
+                )
+                gen, overlay, master = self._previous
+                self.overlay = overlay
+                self.serve_params = overlay.params
+                self._master_params = master
+                self.serving_generation = gen
+                self.swap_count += 1
+                self.rollback_count += 1
+                self._previous = displaced
+            flip_s = self.clock() - t_flip
+        if self.tel is not None:
+            self.tel.swap_completed(
+                stage_s=0.0, flip_s=flip_s, t0=t0, generation=gen,
+                rollback=True,
+            )
+        log_event(
+            "serve-rollback",
+            f"rolled serving params back to generation {gen} (from "
+            f"{displaced[0]}; flip {flip_s * 1e3:.3f} ms)",
+            generation=gen,
+            displaced=displaced[0],
+            flip_s=round(flip_s, 6),
+        )
+        return {
+            "generation": gen,
+            "displaced_generation": displaced[0],
+            "swap_count": self.swap_count,
+            "flip_s": flip_s,
+            "precision_label": self.overlay.label,
+        }
 
     # -- drain / stop ----------------------------------------------------
     def drain(self, timeout_s: float = 30.0) -> bool:

@@ -89,6 +89,20 @@ class FleetConfig:
     # router
     cache_mb: float = 0.0             # 0 = response cache off
     probe_interval_s: float = 0.5
+    # live continuous learning (docs/SERVING.md "Continuous learning"):
+    # watch_dir = a TrainCheckpoint directory a training run writes into;
+    # new intact generations are canaried onto canary_fraction of the
+    # replicas (traffic split by generation), then promoted fleet-wide or
+    # auto-rolled-back by the guard (error rate / window-p99 regression)
+    watch_dir: Optional[str] = None
+    watch_interval_s: float = 2.0
+    canary_fraction: float = 0.25
+    guard_p99_frac: float = 1.5
+    guard_error_rate: float = 0.02
+    guard_min_samples: int = 20
+    guard_bad_consecutive: int = 2
+    guard_good_consecutive: int = 3
+    guard_verdict_timeout_s: float = 120.0
     # autoscaler (disabled unless autoscale=True)
     autoscale: bool = False
     p99_target_ms: float = 500.0
@@ -134,6 +148,7 @@ class FleetConfig:
             drain_timeout_s=self.replica_drain_timeout_s,
             batching=self.batching,
             precision=self.precision,
+            swap_dir=self.watch_dir,
             no_telemetry=not self.telemetry,
             extra_args=self.extra_replica_args,
         )
@@ -169,7 +184,31 @@ class Fleet:
             telemetry=self.tel,
             cache_bytes=int(config.cache_mb * 1024 * 1024),
             probe_interval_s=config.probe_interval_s,
+            # the split only activates while ready replicas actually
+            # straddle two generations, i.e. during a controller rollout
+            canary_fraction=(
+                config.canary_fraction if config.watch_dir else 0.0
+            ),
         )
+        self.controller = None
+        if config.watch_dir:
+            from ..live import CanaryGuard, LiveFleetController
+
+            self.controller = LiveFleetController(
+                config.watch_dir,
+                self.router,
+                canary_fraction=config.canary_fraction,
+                interval_s=config.watch_interval_s,
+                guard=CanaryGuard(
+                    p99_frac=config.guard_p99_frac,
+                    error_rate_high=config.guard_error_rate,
+                    min_window_samples=config.guard_min_samples,
+                    min_canary_requests=config.guard_min_samples,
+                    bad_consecutive=config.guard_bad_consecutive,
+                    good_consecutive=config.guard_good_consecutive,
+                ),
+                verdict_timeout_s=config.guard_verdict_timeout_s,
+            )
         self.policy: Optional[AutoscalerPolicy] = None
         if config.autoscale:
             self.policy = AutoscalerPolicy(
@@ -208,6 +247,8 @@ class Fleet:
                 daemon=True,
             )
             self._autoscale_thread.start()
+        if self.controller is not None:
+            self.controller.start()
         return self.address
 
     def wait_ready(
@@ -269,6 +310,8 @@ class Fleet:
         self._stop.wait()
         self.router.begin_drain()
         self.supervisor.begin_drain()  # a crash during drain stays down
+        if self.controller is not None:
+            self.controller.stop()  # no swaps into a draining fleet
         log_event(
             "fleet-drain",
             "shutdown requested — draining router, then "

@@ -76,6 +76,12 @@ class ReplicaHandle:
         # router-maintained: a replica is ready only after ITS /healthz
         # answered 200 (warmup complete, not draining)
         self.ready = False
+        # router-maintained from the /healthz body: which checkpoint
+        # generation this replica's engine is serving (None = the model
+        # as loaded from disk) and its flip count — the canary split and
+        # the live fleet controller key on these
+        self.generation: Optional[int] = None
+        self.swap_count = 0
         # router-maintained: requests currently forwarded to this replica
         self.outstanding = 0
         self.restarts = 0
@@ -133,6 +139,10 @@ class ReplicaHandle:
         with self.lock:
             self.host = self.port = None
             self.ready = False
+            # a restarted replica boots from the on-disk model again —
+            # its generation identity is re-learned from /healthz
+            self.generation = None
+            self.swap_count = 0
         self.close_conns()
 
     @property
@@ -157,6 +167,8 @@ class ReplicaHandle:
                 "pid": proc.pid if proc is not None else None,
                 "outstanding": self.outstanding,
                 "restarts": self.restarts,
+                "generation": self.generation,
+                "swap_count": self.swap_count,
             }
 
 
@@ -469,6 +481,7 @@ def build_serve_cmd(
     drain_timeout_s: Optional[float] = None,
     batching: Optional[str] = None,
     precision: Optional[str] = None,
+    swap_dir: Optional[str] = None,
     no_telemetry: bool = False,
     extra_args: Sequence[str] = (),
 ) -> List[str]:
@@ -494,6 +507,10 @@ def build_serve_cmd(
         cmd += ["--batching", str(batching)]
     if precision is not None:
         cmd += ["--precision", str(precision)]
+    if swap_dir is not None:
+        # the ONE directory this replica's /admin/swap may load from —
+        # the fleet controller's rollouts; anything else is 403
+        cmd += ["--swap-dir", str(swap_dir)]
     if no_telemetry:
         cmd.append("--no-telemetry")
     cmd += list(extra_args)

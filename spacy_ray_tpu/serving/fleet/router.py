@@ -166,6 +166,11 @@ class RouterTelemetry:
         self._cache_hits = self.registry.counter("cache_hits")
         self._ready = self.registry.gauge("ready_replicas")
         self._replicas = self.registry.gauge("replicas")
+        # generation-split accounting: how many picks went to the canary
+        # vs baseline side while a rollout was in flight — the exact
+        # ratio the deterministic accumulator promises is auditable here
+        self._canary_picks = self.registry.counter("routed_canary")
+        self._baseline_picks = self.registry.counter("routed_baseline")
 
     def request(self) -> None:
         self._requests.inc()
@@ -192,6 +197,9 @@ class RouterTelemetry:
 
     def cache_hit(self) -> None:
         self._cache_hits.inc()
+
+    def split_pick(self, canary: bool) -> None:
+        (self._canary_picks if canary else self._baseline_picks).inc()
 
     def replica_counts(self, ready: int, total: int) -> None:
         self._ready.set(ready)
@@ -225,6 +233,7 @@ class Router:
         probe_interval_s: float = 0.5,
         probe_timeout_s: float = 5.0,
         forward_timeout_s: float = 60.0,
+        canary_fraction: float = 0.0,
     ) -> None:
         self.replicas = replicas
         self.tel = telemetry
@@ -232,6 +241,23 @@ class Router:
         self.probe_interval_s = float(probe_interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
         self.forward_timeout_s = float(forward_timeout_s)
+        # generation traffic splitting (docs/SERVING.md "Continuous
+        # learning"): active ONLY while a rollout controller has
+        # declared a canary generation (``canary_generation`` set by
+        # LiveFleetController at canary start, cleared at
+        # promote/rollback/abort) — mere generation heterogeneity is
+        # NOT a split trigger, because a crash-restarted replica serving
+        # the disk model would otherwise become a one-node "baseline"
+        # absorbing 1-fraction of all traffic. While active, this
+        # fraction of requests routes to the canary generation's
+        # replicas and the rest to everyone else. The split is a
+        # deterministic error-diffusion accumulator, not a coin flip —
+        # an exact long-run ratio the guard's sample-count math can
+        # rely on, and reproducible tests.
+        self.canary_fraction = float(canary_fraction)
+        self.canary_generation: Optional[int] = None
+        self._split_lock = threading.Lock()
+        self._split_acc = 0.0
         self._stop = threading.Event()
         self._prober: Optional[threading.Thread] = None
         # drain gate + in-flight accounting for the fleet's own drain
@@ -259,13 +285,29 @@ class Router:
                 try:
                     conn.request("GET", "/healthz")
                     resp = conn.getresponse()
-                    resp.read()
+                    raw = resp.read()
                     ok = resp.status == 200
                 finally:
                     conn.close()
             except OSError:
                 ok = False
+                raw = b""
             if ok:
+                # the healthz body carries the replica's live-serving
+                # identity (generation + swap_count) — the canary split
+                # and the fleet controller read it from the handle, so
+                # it must be as fresh as readiness itself
+                try:
+                    health = json.loads(raw)
+                except ValueError:
+                    health = {}
+                if isinstance(health, dict):
+                    gen = health.get("generation")
+                    swaps = health.get("swap_count")
+                    with h.lock:
+                        h.generation = gen if isinstance(gen, int) else None
+                        if isinstance(swaps, int):
+                            h.swap_count = swaps
                 self._mark_ready(h)
                 n_ready += 1
             else:
@@ -332,14 +374,37 @@ class Router:
 
     def pick(self) -> ReplicaHandle:
         """Least-outstanding-requests over the ready set; ties broken by
-        lowest id (deterministic, and it keeps warm caches warm)."""
+        lowest id (deterministic, and it keeps warm caches warm).
+
+        With ``canary_fraction > 0`` and an ACTIVE rollout
+        (``canary_generation`` set by the controller), the ready set
+        first splits into canary (replicas on that generation) vs
+        baseline (everyone else), the accumulator picks the side, and
+        least-outstanding runs WITHIN it — load stays balanced inside
+        each generation while the cross-generation ratio stays exact.
+        Outside a rollout there is never a split, no matter how
+        heterogeneous the observed generations are."""
         ready = self.ready_handles()
         if not ready:
             raise NoReplicaAvailable(
                 "no replica is ready (all warming, draining, or down)"
             )
+        pool = ready
+        target = self.canary_generation
+        if self.canary_fraction > 0.0 and target is not None:
+            canary = [h for h in ready if h.generation == target]
+            baseline = [h for h in ready if h.generation != target]
+            if canary and baseline:
+                with self._split_lock:
+                    self._split_acc += min(self.canary_fraction, 1.0)
+                    take_canary = self._split_acc >= 1.0 - 1e-9
+                    if take_canary:
+                        self._split_acc -= 1.0
+                pool = canary if take_canary else baseline
+                if self.tel is not None:
+                    self.tel.split_pick(take_canary)
         return min(
-            ready, key=lambda h: (h.outstanding, h.replica_id)
+            pool, key=lambda h: (h.outstanding, h.replica_id)
         )
 
     # -- forwarding --------------------------------------------------------

@@ -1,0 +1,44 @@
+"""Live continuous-learning subsystem: a serving fleet that tracks a
+running training job without dropping a request (docs/SERVING.md
+"Continuous learning").
+
+Three cooperating pieces:
+
+* :mod:`watcher` — poll a :class:`~...training.checkpoint.TrainCheckpoint`
+  directory for new generations, digest-verify before touching them
+  (torn generations are skipped with a structured event, never loaded),
+  hand verified param trees to subscribers.
+* engine hot-swap — ``InferenceEngine.swap_params`` (serving/engine.py):
+  stage the new tree + precision overlay off the dispatch path, flip at
+  a dispatch boundary, one-call rollback.
+* :mod:`canary` + :mod:`controller` — fleet-side rollout: swap a canary
+  subset of replicas first, split traffic by generation (router
+  ``canary_fraction``), promote or auto-roll-back on the guard's
+  error-rate / p99 verdict over the sliding SLO window.
+
+:mod:`orchestrator` wires the whole loop as one process tree: a training
+subprocess and a serving fleet sharing the checkpoint directory under a
+single ShutdownCoordinator (the ``train-and-serve`` CLI).
+
+This package's modules import jax lazily (only on the param-loading
+paths), so the fleet/router process — which never touches a device —
+can drive rollouts without pulling a jax runtime into the proxy.
+"""
+
+from .canary import CanaryGuard, GenerationStats  # noqa: F401
+from .controller import LiveFleetController  # noqa: F401
+from .orchestrator import TrainAndServe, wait_for_best_model  # noqa: F401
+from .watcher import (  # noqa: F401
+    CheckpointWatcher,
+    scan_intact_generations,
+)
+
+__all__ = [
+    "CanaryGuard",
+    "GenerationStats",
+    "CheckpointWatcher",
+    "LiveFleetController",
+    "TrainAndServe",
+    "scan_intact_generations",
+    "wait_for_best_model",
+]

@@ -1,0 +1,255 @@
+"""Canary guard: the promote/rollback policy for generation rollouts.
+
+The guard consumes exactly the signal the serving stack already emits —
+per-generation error/request counters and the sliding-window latency
+percentiles (the PR 7 ``slo_window`` block, split by generation in
+``merge_serving_snapshots``) — and answers one question per tick: keep
+the canary, kill it, or keep watching.
+
+Design rules, borrowed from the autoscaler (the repo's other control
+loop, fleet/autoscaler.py), because boring is what you want when the
+action is "rewire production traffic":
+
+* **Counter deltas, not lifetimes.** Replica counters are process-
+  lifetime; a canary replica carries its pre-swap history into the new
+  generation's group. :meth:`begin` snapshots both sides' counters at
+  canary start, so error rates are measured over canary traffic only.
+* **Hysteresis both ways.** A rollback needs ``bad_consecutive``
+  CONSECUTIVE breaching ticks (one latency blip must not kill a good
+  generation); a promote needs ``good_consecutive`` clean ticks AND a
+  minimum canary sample count (a canary that served three requests has
+  proven nothing).
+* **No-signal is not good news.** Missing percentiles (idle window) or
+  too few samples HOLD the rollout; only evidence promotes. The
+  asymmetry vs the autoscaler (where no-signal means no-pressure) is
+  deliberate: scaling up on silence wastes a replica; promoting on
+  silence ships an unvalidated model.
+
+Every verdict is a structured ``log_event`` row; the controller turns
+it into admin swap/rollback calls.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ...training.resilience import log_event
+
+__all__ = ["GenerationStats", "CanaryGuard"]
+
+
+@dataclass
+class GenerationStats:
+    """One generation group's signal for one tick — distilled from a
+    ``by_generation`` entry of ``merge_serving_snapshots`` (or built
+    directly in tests)."""
+
+    generation: Optional[int] = None
+    requests: float = 0.0            # lifetime counter (delta'd by guard)
+    errors: float = 0.0              # lifetime counter (delta'd by guard)
+    window_samples: int = 0          # latency samples in the slo window
+    p99_s: Optional[float] = None    # sliding-window p99 (worst replica)
+
+    @classmethod
+    def from_merged(
+        cls, block: Optional[Dict[str, Any]], generation: Optional[int] = None
+    ) -> "GenerationStats":
+        """Distill a merged per-generation metrics block. Missing pieces
+        stay at no-signal defaults — the guard treats those as "hold",
+        never as evidence."""
+        if not isinstance(block, dict):
+            return cls(generation=generation)
+        counters = block.get("counters") or {}
+        win = block.get("slo_window") or {}
+        p99 = win.get("request_latency_p99_worst")
+        if not isinstance(p99, (int, float)):
+            p99 = win.get("request_latency_p99")
+        # "errors" for guard purposes = dispatch failures PLUS request
+        # timeouts: a generation that blows every deadline produces no
+        # 500s and no latency samples (timed-out requests never reach
+        # the latency histogram), so deadline_exceeded is the ONLY
+        # signal that distinguishes it from a healthy canary
+        errors = float(counters.get("errors") or 0.0) + float(
+            counters.get("deadline_exceeded") or 0.0
+        )
+        return cls(
+            generation=block.get("generation", generation),
+            requests=float(counters.get("requests") or 0.0),
+            errors=errors,
+            window_samples=int(win.get("samples") or 0),
+            p99_s=float(p99) if isinstance(p99, (int, float)) else None,
+        )
+
+
+class CanaryGuard:
+    """Feed :meth:`observe` once per tick during a rollout; it returns
+    ``"promote"``, ``"rollback"``, or None (keep watching).
+
+    Rollback triggers (either, for ``bad_consecutive`` ticks):
+
+    * canary error rate above ``error_rate_high`` AND above the
+      baseline's rate over the same interval (an absolute cap alone
+      would kill a canary for inheriting a fleet-wide problem);
+    * canary window p99 above ``p99_frac`` x baseline window p99, both
+      windows holding >= ``min_window_samples`` samples.
+
+    Promote requires ``good_consecutive`` consecutive clean ticks with
+    >= ``min_canary_requests`` canary requests observed since
+    :meth:`begin` — and "clean" includes a latency verdict: either both
+    windows have enough samples and the canary is within budget, or the
+    baseline has no latency signal to compare against (single-replica
+    fleets, idle baselines) and the error-rate evidence stands alone.
+    """
+
+    def __init__(
+        self,
+        *,
+        p99_frac: float = 1.5,
+        error_rate_high: float = 0.02,
+        min_window_samples: int = 20,
+        min_canary_requests: int = 20,
+        bad_consecutive: int = 2,
+        good_consecutive: int = 3,
+    ) -> None:
+        if p99_frac <= 0:
+            raise ValueError("p99_frac must be > 0")
+        if not (0.0 <= error_rate_high <= 1.0):
+            raise ValueError("error_rate_high must be within 0..1")
+        if bad_consecutive < 1 or good_consecutive < 1:
+            raise ValueError("hysteresis windows must be >= 1 tick")
+        self.p99_frac = float(p99_frac)
+        self.error_rate_high = float(error_rate_high)
+        self.min_window_samples = int(min_window_samples)
+        self.min_canary_requests = int(min_canary_requests)
+        self.bad_consecutive = int(bad_consecutive)
+        self.good_consecutive = int(good_consecutive)
+        self._bad_streak = 0
+        self._good_streak = 0
+        self._base0: Dict[str, float] = {}
+        self.decisions: List[Dict[str, Any]] = []
+
+    # -- rollout lifecycle ----------------------------------------------
+    def begin(
+        self, baseline: GenerationStats, canary: GenerationStats
+    ) -> None:
+        """Mark canary start: snapshot both sides' lifetime counters so
+        every later tick measures THIS rollout's traffic only."""
+        self._bad_streak = self._good_streak = 0
+        self._base0 = {
+            "canary_requests": canary.requests,
+            "canary_errors": canary.errors,
+            "baseline_requests": baseline.requests,
+            "baseline_errors": baseline.errors,
+        }
+
+    # -- the tick --------------------------------------------------------
+    def observe(
+        self, baseline: GenerationStats, canary: GenerationStats
+    ) -> Optional[str]:
+        c_req = max(canary.requests - self._base0.get("canary_requests", 0.0), 0.0)
+        c_err = max(canary.errors - self._base0.get("canary_errors", 0.0), 0.0)
+        b_req = max(
+            baseline.requests - self._base0.get("baseline_requests", 0.0), 0.0
+        )
+        b_err = max(
+            baseline.errors - self._base0.get("baseline_errors", 0.0), 0.0
+        )
+        c_rate = c_err / c_req if c_req > 0 else 0.0
+        b_rate = b_err / b_req if b_req > 0 else 0.0
+
+        reasons: List[str] = []
+        if (
+            c_req >= self.min_canary_requests
+            and c_rate > self.error_rate_high
+            and c_rate > b_rate
+        ):
+            reasons.append(
+                f"error rate {c_rate:.3f} > {self.error_rate_high:.3f} "
+                f"(baseline {b_rate:.3f})"
+            )
+        latency_comparable = (
+            canary.p99_s is not None
+            and baseline.p99_s is not None
+            and canary.window_samples >= self.min_window_samples
+            and baseline.window_samples >= self.min_window_samples
+        )
+        if (
+            latency_comparable
+            and canary.p99_s > self.p99_frac * baseline.p99_s  # type: ignore[operator]
+        ):
+            reasons.append(
+                f"window p99 {canary.p99_s:.4f}s > {self.p99_frac:.2f} x "
+                f"baseline {baseline.p99_s:.4f}s"
+            )
+
+        bad = bool(reasons)
+        self._bad_streak = self._bad_streak + 1 if bad else 0
+        if bad:
+            self._good_streak = 0
+        else:
+            # a clean tick only counts toward promote once the canary
+            # has seen real traffic AND carries a latency verdict: the
+            # canary within budget against a comparable baseline, or a
+            # baseline with no latency signal at all (then the
+            # error-rate evidence stands alone). A baseline WITH signal
+            # but a canary window too thin to compare is silence, and
+            # silence must not promote — it holds, and the verdict
+            # timeout eventually rolls it back.
+            baseline_has_signal = (
+                baseline.p99_s is not None
+                and baseline.window_samples >= self.min_window_samples
+            )
+            latency_ok = not baseline_has_signal or (
+                latency_comparable
+                and canary.p99_s <= self.p99_frac * baseline.p99_s  # type: ignore[operator]
+            )
+            if c_req >= self.min_canary_requests and latency_ok:
+                self._good_streak += 1
+        if self._bad_streak >= self.bad_consecutive:
+            return self._decide(
+                "rollback", baseline, canary, c_req, c_rate, b_rate,
+                "; ".join(reasons),
+            )
+        if self._good_streak >= self.good_consecutive:
+            # latency evidence when comparable; error-rate evidence alone
+            # when the baseline has nothing to compare against
+            return self._decide(
+                "promote", baseline, canary, c_req, c_rate, b_rate,
+                "canary healthy over "
+                f"{self._good_streak} consecutive tick(s)",
+            )
+        return None
+
+    def _decide(
+        self,
+        verdict: str,
+        baseline: GenerationStats,
+        canary: GenerationStats,
+        c_req: float,
+        c_rate: float,
+        b_rate: float,
+        why: str,
+    ) -> str:
+        decision = {
+            "verdict": verdict,
+            "canary_generation": canary.generation,
+            "baseline_generation": baseline.generation,
+            "canary_requests": c_req,
+            "canary_error_rate": round(c_rate, 4),
+            "baseline_error_rate": round(b_rate, 4),
+            "canary_p99_s": canary.p99_s,
+            "baseline_p99_s": baseline.p99_s,
+            "why": why,
+        }
+        self.decisions.append(decision)
+        self._bad_streak = self._good_streak = 0
+        log_event(
+            f"canary-{verdict}",
+            f"generation {canary.generation} vs {baseline.generation}: "
+            f"{verdict} ({why})",
+            level=logging.WARNING if verdict == "rollback" else logging.INFO,
+            **decision,
+        )
+        return verdict

@@ -1,0 +1,397 @@
+"""Live fleet controller: roll new checkpoint generations across a
+serving fleet — canary first, guard verdict, promote or roll back.
+
+Runs inside the fleet/router process (jax-free: generation detection is
+the stdlib digest scan from :mod:`watcher`; replicas do their own param
+loading behind their ``/admin/swap`` endpoint). One rollout at a time::
+
+    idle --(new intact generation)--> canary phase
+      canary subset swapped via POST /admin/swap
+      router splits traffic by generation (canary_fraction)
+      guard watches per-replica error rates + window p99
+    --promote--> swap the rest, generation becomes current --> idle
+    --rollback--> POST /admin/rollback to canaries, stamp rejected --> idle
+
+Grouping during a rollout is by REPLICA ID, not by the generation tag
+in the scraped metrics: the probe learns a replica's new generation with
+up to one probe-interval of lag, and counter baselines must be
+snapshotted at the instant of the swap — replica-id grouping makes both
+exact while ``by_generation`` in the router's ``/metrics`` stays the
+operator-facing view of the same split.
+
+Failure posture: a 409 from ``/admin/swap`` (torn generation on the
+replica's read, tree mismatch) permanently rejects the stamp; transient
+errors (replica mid-restart) abort the attempt and the next poll
+retries. A rollout that gets no guard verdict within
+``verdict_timeout_s`` rolls back — generations ship on evidence, never
+on silence. In idle phase the controller also HEALS stragglers: a
+replica that crashed and restarted from the disk model (generation
+None) is re-swapped to the fleet's current generation.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ...training.resilience import log_event
+from .canary import CanaryGuard, GenerationStats
+from .watcher import scan_intact_generations
+
+__all__ = ["LiveFleetController"]
+
+logger = logging.getLogger("spacy_ray_tpu.serving")
+
+
+def _admin_post(
+    addr: Tuple[str, int], path: str, payload: Dict[str, Any],
+    timeout_s: float,
+) -> Tuple[int, Dict[str, Any]]:
+    conn = http.client.HTTPConnection(addr[0], addr[1], timeout=timeout_s)
+    try:
+        body = json.dumps(payload).encode("utf8")
+        conn.request("POST", path, body, {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read()
+    finally:
+        conn.close()
+    try:
+        parsed = json.loads(raw)
+    except ValueError:
+        parsed = {}
+    return resp.status, parsed if isinstance(parsed, dict) else {}
+
+
+class LiveFleetController:
+    """Ticks via :meth:`poll_once` (deterministic for tests) or a
+    background thread (:meth:`start`); ``router`` supplies the live
+    replica view, traffic split, and metrics scrape."""
+
+    def __init__(
+        self,
+        ckpt_dir,
+        router,
+        *,
+        canary_fraction: float = 0.25,
+        interval_s: float = 2.0,
+        guard: Optional[CanaryGuard] = None,
+        admin_timeout_s: float = 120.0,
+        verdict_timeout_s: float = 120.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.ckpt_dir = Path(ckpt_dir)
+        self.router = router
+        self.canary_fraction = float(canary_fraction)
+        self.interval_s = float(interval_s)
+        self.guard = guard or CanaryGuard()
+        self.admin_timeout_s = float(admin_timeout_s)
+        self.verdict_timeout_s = float(verdict_timeout_s)
+        self.clock = clock
+        # rollout state
+        self.phase = "idle"                      # "idle" | "canary"
+        self.current: Optional[int] = None       # fleet-wide generation
+        self.target: Optional[int] = None        # generation under canary
+        self.canary_ids: List[int] = []
+        self.rejected: Set[int] = set()          # rolled-back stamps
+        self._verdict_deadline: Optional[float] = None
+        self.rollouts = 0
+        self.promotes = 0
+        self.rollbacks = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- metrics grouping ------------------------------------------------
+    def _side_stats(
+        self, snaps: List[Dict[str, Any]], canary: bool
+    ) -> GenerationStats:
+        from ...training.telemetry import merge_serving_snapshots
+
+        ids = set(self.canary_ids)
+        side = [
+            s for s in snaps
+            if (s.get("replica_id") in ids) == canary
+        ]
+        merged = merge_serving_snapshots(side, _tag_generations=False)
+        return GenerationStats.from_merged(
+            merged, generation=self.target if canary else self.current
+        )
+
+    # -- one tick --------------------------------------------------------
+    def poll_once(self) -> Optional[str]:
+        """One observe-decide-act cycle. Returns "canary", "promote",
+        "rollback", "heal", or None (nothing happened)."""
+        if self.phase == "canary":
+            return self._guard_tick()
+        # filtered scan: only stamps we might actually roll out are
+        # digest-verified (params only — the replica swap discards
+        # opt_state and re-verifies on its own read anyway), so an idle
+        # tick hashes NOTHING instead of re-hashing every retained
+        # generation's gigabytes each poll
+        candidates = scan_intact_generations(
+            self.ckpt_dir,
+            newer_than=self.current,
+            skip=self.rejected,
+            params_only=True,
+        )
+        if candidates:
+            return self._begin_rollout(max(candidates))
+        return self._heal_stragglers()
+
+    # -- rollout start ---------------------------------------------------
+    def _begin_rollout(self, stamp: int) -> Optional[str]:
+        ready = self.router.ready_handles()
+        if not ready:
+            return None  # nobody to roll to; retry next tick
+        n = len(ready)
+        if 0.0 < self.canary_fraction < 1.0:
+            k = max(1, int(round(self.canary_fraction * n)))
+        else:
+            k = n
+        if k >= n:
+            # no baseline to guard against: direct rollout (the
+            # single-replica / canary-disabled path — each replica still
+            # flips at a dispatch boundary, so zero requests drop)
+            ok = True
+            for h in ready:
+                if not self._swap_one(h, stamp):
+                    ok = False
+            if ok:
+                self.current = stamp
+                self.rollouts += 1
+                log_event(
+                    "live-rollout-direct",
+                    f"generation {stamp} rolled out to all {n} replica(s) "
+                    "(no canary split configured/possible)",
+                    level=logging.INFO,
+                    generation=stamp,
+                    replicas=n,
+                )
+                return "promote"
+            return None  # partial: retried next tick (swap is idempotent)
+        # canary subset: youngest replicas (same choice scale-down makes
+        # — the oldest replicas hold the longest-proven baseline)
+        canaries = sorted(ready, key=lambda h: -h.replica_id)[:k]
+        snaps = self.router.scrape_replica_metrics()
+        self.canary_ids = [h.replica_id for h in canaries]
+        self.target = stamp
+        baseline0 = self._side_stats(snaps, canary=False)
+        canary0 = self._side_stats(snaps, canary=True)
+        swapped: List[Any] = []
+        for h in canaries:
+            if self._swap_one(h, stamp):
+                swapped.append(h)
+                continue
+            # abort: restore any canary already flipped, keep idle state
+            for done in swapped:
+                self._rollback_one(done)
+            self.canary_ids = []
+            self.target = None
+            return None
+        self.guard.begin(baseline0, canary0)
+        self._verdict_deadline = self.clock() + self.verdict_timeout_s
+        self.phase = "canary"
+        # activate the router's traffic split for exactly this rollout:
+        # outside it, generation heterogeneity (e.g. a crash-restarted
+        # replica on the disk model) must NOT redirect traffic
+        self.router.canary_generation = stamp
+        self.rollouts += 1
+        log_event(
+            "live-canary-start",
+            f"generation {stamp} canarying on replica(s) "
+            f"{self.canary_ids} ({k}/{n}; fraction "
+            f"{self.canary_fraction:.2f} of traffic)",
+            level=logging.INFO,
+            generation=stamp,
+            canary_ids=list(self.canary_ids),
+            replicas=n,
+        )
+        return "canary"
+
+    # -- guard phase -----------------------------------------------------
+    def _guard_tick(self) -> Optional[str]:
+        assert self.target is not None
+        # canaries gone entirely (scale-down SIGTERM'd them, or they all
+        # crashed): there is no evidence to judge and never will be —
+        # abort WITHOUT rejecting the stamp (its quality was never the
+        # problem) so the next idle tick starts a fresh rollout
+        ids = set(self.canary_ids)
+        if not any(
+            h.replica_id in ids for h in self.router.ready_handles()
+        ):
+            stamp = self.target
+            self._finish_rollout()
+            log_event(
+                "live-canary-aborted",
+                f"every canary replica for generation {stamp} left the "
+                "fleet (scale-down or crash) — rollout aborted, stamp "
+                "stays eligible for a fresh canary",
+                generation=stamp,
+                canary_ids=sorted(ids),
+            )
+            return None
+        snaps = self.router.scrape_replica_metrics()
+        baseline = self._side_stats(snaps, canary=False)
+        canary = self._side_stats(snaps, canary=True)
+        verdict = self.guard.observe(baseline, canary)
+        if verdict is None and (
+            self._verdict_deadline is not None
+            and self.clock() >= self._verdict_deadline
+        ):
+            verdict = "rollback"
+            log_event(
+                "canary-verdict-timeout",
+                f"generation {self.target} produced no guard verdict "
+                f"within {self.verdict_timeout_s:.0f}s — rolling back "
+                "(generations ship on evidence, not silence)",
+                generation=self.target,
+            )
+        if verdict == "promote":
+            return self._promote()
+        if verdict == "rollback":
+            return self._rollback()
+        return None
+
+    def _promote(self) -> str:
+        assert self.target is not None
+        stamp = self.target
+        for h in self.router.ready_handles():
+            if h.generation != stamp:
+                self._swap_one(h, stamp)
+        self.current = stamp
+        self.promotes += 1
+        self._finish_rollout()
+        log_event(
+            "live-promote",
+            f"generation {stamp} promoted fleet-wide",
+            level=logging.INFO,
+            generation=stamp,
+        )
+        return "promote"
+
+    def _rollback(self) -> str:
+        assert self.target is not None
+        stamp = self.target
+        ids = set(self.canary_ids)
+        for h in self.router.ready_handles():
+            if h.replica_id in ids:
+                self._rollback_one(h)
+        self.rejected.add(stamp)
+        self.rollbacks += 1
+        self._finish_rollout()
+        log_event(
+            "live-rollback",
+            f"generation {stamp} rolled back off the canary set "
+            f"{sorted(ids)}; stamp rejected until a newer one appears",
+            generation=stamp,
+            canary_ids=sorted(ids),
+        )
+        return "rollback"
+
+    def _finish_rollout(self) -> None:
+        self.phase = "idle"
+        self.target = None
+        self.canary_ids = []
+        self._verdict_deadline = None
+        self.router.canary_generation = None  # split off outside rollouts
+
+    # -- idle-phase healing ---------------------------------------------
+    def _heal_stragglers(self) -> Optional[str]:
+        """A replica that crashed mid-life restarts from the disk model
+        (generation None) — bring it to the fleet's current generation
+        so the split stays two-sided only during actual rollouts."""
+        if self.current is None:
+            return None
+        healed = False
+        for h in self.router.ready_handles():
+            if h.generation != self.current:
+                healed = self._swap_one(h, self.current) or healed
+        return "heal" if healed else None
+
+    # -- replica admin ---------------------------------------------------
+    def _swap_one(self, handle, stamp: int) -> bool:
+        addr = handle.address
+        if addr is None:
+            return False
+        try:
+            status, payload = _admin_post(
+                addr, "/admin/swap",
+                {"dir": str(self.ckpt_dir), "generation": int(stamp)},
+                self.admin_timeout_s,
+            )
+        except OSError as e:
+            log_event(
+                "live-swap-error",
+                f"replica {handle.replica_id}: /admin/swap unreachable "
+                f"({e!r}) — will retry",
+                replica=handle.replica_id,
+                generation=int(stamp),
+            )
+            return False
+        if status == 200:
+            # don't wait a probe interval to see what we just did: the
+            # router's split and this controller's straggler check both
+            # read the handle
+            with handle.lock:
+                handle.generation = int(stamp)
+            return True
+        if status == 409:
+            # the replica verified and REFUSED (torn files on its read,
+            # tree mismatch): permanent for this stamp
+            self.rejected.add(int(stamp))
+        log_event(
+            "live-swap-refused",
+            f"replica {handle.replica_id} refused swap to generation "
+            f"{stamp}: HTTP {status} {payload.get('message', '')}"
+            + (" — stamp rejected" if status == 409 else ""),
+            replica=handle.replica_id,
+            generation=int(stamp),
+            status=status,
+        )
+        return False
+
+    def _rollback_one(self, handle) -> bool:
+        addr = handle.address
+        if addr is None:
+            return False
+        try:
+            status, payload = _admin_post(
+                addr, "/admin/rollback", {}, self.admin_timeout_s
+            )
+        except OSError:
+            return False  # replica died mid-rollout: its restart boots
+            # from the disk model anyway — already "rolled back"
+        if status == 200:
+            gen = payload.get("generation")
+            with handle.lock:
+                handle.generation = gen if isinstance(gen, int) else None
+            return True
+        return False
+
+    # -- thread lifecycle ------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # the rollout loop must survive anything
+                logger.exception("live fleet controller tick failed")
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "LiveFleetController":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="live-controller"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

@@ -1,0 +1,238 @@
+"""Checkpoint watcher: the bridge from a live training run's checkpoint
+directory to a serving engine's hot-swap.
+
+The watcher polls a :class:`~...training.checkpoint.TrainCheckpoint`
+directory on an interval, digest-verifies any generation newer than the
+one it last delivered, and hands the verified state to a subscriber
+callback. The integrity discipline is PR 2's, reused verbatim: a torn,
+truncated, or mid-retirement generation raises the one typed
+:class:`~...training.checkpoint.CheckpointCorrupt`, which the watcher
+turns into a structured ``log_event`` row (once per stamp, not a storm)
+and a fallback to the next-newest intact candidate — a bad generation
+is *skipped*, never loaded, never fatal. The crash-safe rename protocol
+the watcher relies on is documented on
+:class:`~...training.checkpoint.Checkpoints` (array files land before
+their meta; every rename atomic; retention deletes only committed-over
+generations).
+
+Two consumers with different weight classes:
+
+* :func:`scan_intact_generations` — stdlib-only (hashlib/json) digest
+  scan, importable WITHOUT jax. The fleet/router process uses it to
+  detect new generations it will roll out via replica admin endpoints;
+  it never deserializes arrays.
+* :class:`CheckpointWatcher` — runs inside a serving (replica) process;
+  its load path imports the checkpoint module (and thus jax) lazily to
+  hand full param trees to ``engine.swap_params``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from ...training.resilience import log_event
+
+__all__ = ["scan_intact_generations", "CheckpointWatcher"]
+
+logger = logging.getLogger("spacy_ray_tpu.serving")
+
+
+def _sha256(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def scan_intact_generations(
+    path,
+    *,
+    newer_than: Optional[int] = None,
+    skip: Any = (),
+    params_only: bool = False,
+) -> List[int]:
+    """Stamps of every generation in ``path`` whose files digest-verify,
+    ascending — the jax-free twin of
+    ``Checkpoints.latest_intact_generation`` (stdlib only, nothing
+    deserialized), for processes that must not import a device runtime.
+    A generation with unreadable meta, missing files, or a digest
+    mismatch is silently absent from the result (the caller's policy
+    decides whether that is worth an event; for a scan it is not —
+    mid-write races make transient misses normal).
+
+    ``newer_than``/``skip`` filter BEFORE any hashing — a control loop
+    polling every couple of seconds must not re-SHA-256 gigabytes of
+    already-adopted generations per tick; with both set, an idle tick
+    hashes nothing. ``params_only`` skips the opt_state digest (the
+    serving-swap scope: that file is discarded by a swap anyway)."""
+    path = Path(path)
+    intact: List[int] = []
+    for meta_path in path.glob("train_meta-*.json"):
+        name = meta_path.name
+        try:
+            stamp = int(name[len("train_meta-"):-len(".json")])
+        except ValueError:
+            continue
+        if newer_than is not None and stamp <= newer_than:
+            continue
+        if stamp in skip:
+            continue
+        try:
+            meta = json.loads(meta_path.read_text(encoding="utf8"))
+        except (OSError, ValueError):
+            continue
+        if not isinstance(meta, dict) or meta.get("stamp") != stamp:
+            continue
+        digests = meta.get("digests") or {}
+        fnames = [f"params-{stamp}.npz"]
+        if not params_only:
+            fnames.append(f"opt_state-{stamp}.pkl")
+        ok = True
+        for fname in fnames:
+            f = path / fname
+            try:
+                if not f.exists():
+                    ok = False
+                    break
+                expect = digests.get(fname)
+                if expect is not None and _sha256(f) != expect:
+                    ok = False
+                    break
+            except OSError:
+                ok = False
+                break
+        if ok:
+            intact.append(stamp)
+    return sorted(intact)
+
+
+class CheckpointWatcher:
+    """Poll a checkpoint directory; deliver each new verified generation
+    to ``on_generation(stamp, state)`` exactly once, newest-first.
+
+    ``state`` is the full ``Checkpoints.load_generation`` dict (params,
+    step, ...). Delivery happens on the watcher thread (or the caller's
+    thread via :meth:`poll_once` in tests) — subscribers that need a
+    dispatch-boundary flip do their own staging, which is exactly what
+    ``engine.swap_params`` provides.
+
+    Skip semantics: a candidate that fails verification is skipped with
+    ONE ``live-generation-skipped`` event per stamp (a torn generation
+    sitting in the directory must not emit a row per poll), but is
+    re-checked on later polls — a transient race with the writer (the
+    meta landing a beat before our digest read of a being-replaced
+    file) heals itself; a genuinely torn write stays skipped until
+    retention deletes it. The newest intact candidate wins even when an
+    older unseen one also exists (serving wants the freshest weights,
+    not a replay of history).
+    """
+
+    def __init__(
+        self,
+        ckpt_dir,
+        on_generation: Callable[[int, Dict[str, Any]], None],
+        *,
+        interval_s: float = 2.0,
+        start_from: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.ckpt_dir = Path(ckpt_dir)
+        self.on_generation = on_generation
+        self.interval_s = float(interval_s)
+        self.clock = clock
+        # the newest stamp already delivered; candidates must beat it
+        self.current: Optional[int] = start_from
+        self._warned: Set[int] = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.polls = 0
+        self.delivered = 0
+        self.skipped = 0
+
+    # -- one poll (deterministic; the thread loop calls this) -----------
+    def poll_once(self) -> Optional[int]:
+        """Scan once; deliver the newest intact generation newer than
+        ``current`` (skipping torn candidates toward older ones).
+        Returns the delivered stamp, or None when nothing new/intact."""
+        from ...training.checkpoint import CheckpointCorrupt, Checkpoints
+
+        self.polls += 1
+        ckpts = Checkpoints(self.ckpt_dir)
+        try:
+            stamps = ckpts.generations()
+        except OSError:
+            return None  # directory vanished mid-poll: nothing to do
+        floor = self.current if self.current is not None else -1
+        for stamp in sorted(stamps, reverse=True):
+            if stamp <= floor:
+                break  # everything below is older than what we serve
+            try:
+                # params-only load: a swap discards opt_state, so the
+                # watcher neither hashes nor unpickles it (for Adam
+                # that is ~2x the param bytes per generation)
+                state = ckpts.load_generation_params(stamp)
+            except CheckpointCorrupt as e:
+                self.skipped += 1
+                if stamp not in self._warned:
+                    self._warned.add(stamp)
+                    log_event(
+                        "live-generation-skipped",
+                        f"checkpoint generation {stamp} failed verification "
+                        f"({e}) — skipped, trying the previous candidate",
+                        stamp=int(stamp),
+                        path=str(self.ckpt_dir),
+                    )
+                continue
+            log_event(
+                "live-generation",
+                f"verified checkpoint generation {stamp} "
+                f"(step {state.get('step')}) — delivering to subscriber",
+                level=logging.INFO,
+                stamp=int(stamp),
+                path=str(self.ckpt_dir),
+            )
+            # deliver FIRST, advance after: a subscriber that fails
+            # transiently (device hiccup mid-stage) must get this
+            # generation retried on the next poll, not have it slide
+            # below the floor forever — a permanently-incompatible
+            # generation therefore retries loudly every poll, which is
+            # an operator signal, not a bug
+            self.on_generation(stamp, state)
+            self.current = stamp
+            self._warned.discard(stamp)
+            self.delivered += 1
+            return stamp
+        return None
+
+    # -- thread lifecycle ------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # the watcher must survive anything —
+                # a failed swap or a subscriber bug must not kill the
+                # polling loop (the NEXT generation may be fine)
+                logger.exception("checkpoint watcher poll failed")
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="ckpt-watcher"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

@@ -51,6 +51,7 @@ __all__ = [
     "PRECISION_CHOICES",
     "OverlayResult",
     "resolve_precision",
+    "build_params_overlay",
     "build_serving_overlay",
 ]
 
@@ -126,18 +127,28 @@ def build_serving_overlay(nlp, precision: str = "auto") -> OverlayResult:
     extraction (one mechanism, two phases) — or refuses with an honest
     f32 fallback when coverage would be partial."""
     assert nlp.params is not None, "serving overlay needs initialized params"
+    return build_params_overlay(nlp.params, precision)
+
+
+def build_params_overlay(params: Any, precision: str = "auto") -> OverlayResult:
+    """The param-tree core of :func:`build_serving_overlay`, callable on
+    a bare tree: the engine's hot-swap path re-runs the SAME overlay
+    resolution on every incoming checkpoint generation (same requested
+    knob, fresh coverage check, honest label preserved), so a swapped-in
+    tree can never silently ship at a different precision than the one
+    the engine advertised at startup."""
     resolved, reason = resolve_precision(precision)
     if resolved == "f32":
         return OverlayResult(
             requested=precision, resolved="f32",
             label=f"f32 ({reason})" if precision != "f32" else "f32",
-            reason=reason, params=nlp.params, n_overlaid=0,
+            reason=reason, params=params, n_overlaid=0,
         )
 
     from ..models.transformer import build_param_shadow, shadow_coverage
     from ..parallel.step import overlay_shadow
 
-    eligible, unknown = shadow_coverage(nlp.params)
+    eligible, unknown = shadow_coverage(params)
     if unknown:
         reason = (
             f"overlay refused: {len(unknown)} trunk leaf(s) unknown to the "
@@ -148,7 +159,7 @@ def build_serving_overlay(nlp, precision: str = "auto") -> OverlayResult:
                   unknown=unknown[:16])
         return OverlayResult(
             requested=precision, resolved="f32", label=f"f32 ({reason})",
-            reason=reason, params=nlp.params, n_overlaid=0,
+            reason=reason, params=params, n_overlaid=0,
         )
     if eligible == 0:
         reason = (
@@ -158,11 +169,11 @@ def build_serving_overlay(nlp, precision: str = "auto") -> OverlayResult:
         log_event("serving-overlay-refused", reason, level=logging.INFO)
         return OverlayResult(
             requested=precision, resolved="f32", label=f"f32 ({reason})",
-            reason=reason, params=nlp.params, n_overlaid=0,
+            reason=reason, params=params, n_overlaid=0,
         )
-    shadow = build_param_shadow(nlp.params)
+    shadow = build_param_shadow(params)
     assert shadow is not None  # eligible > 0 guarantees it
-    served = overlay_shadow(nlp.params, shadow)
+    served = overlay_shadow(params, shadow)
     label = f"bf16 (overlay: {eligible} trunk leaves; {reason})"
     log_event(
         "serving-overlay-armed",

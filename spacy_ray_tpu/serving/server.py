@@ -35,7 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 from ..training.resilience import ShutdownCoordinator, log_event
-from .batcher import Draining, NotReady, ServingError
+from .batcher import Draining, NotReady, ServingError, SwapFailed
 from .engine import InferenceEngine, ServingTelemetry
 
 __all__ = ["ServingHTTPServer", "Server"]
@@ -62,6 +62,12 @@ class ServingHTTPServer(ThreadingHTTPServer):
         self.engine = engine
         self.tel = telemetry
         self.draining = False
+        # checkpoint directories /admin/swap may load from. EMPTY means
+        # the admin swap surface is OFF (403): accepting an arbitrary
+        # client-supplied path would let anyone who can reach the port
+        # point the server at weights they control. Configured via
+        # serve --watch / --swap-dir (Server wires it through).
+        self.allowed_swap_dirs: list = []
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -121,16 +127,39 @@ class _Handler(BaseHTTPRequestHandler):
                         "batching": self.server.engine.batching,
                         "precision": self.server.engine.overlay.resolved,
                         "precision_label": self.server.engine.overlay.label,
+                        # live-serving identity: which checkpoint
+                        # generation the dispatch thread is serving (null
+                        # = the model as loaded from disk) and how many
+                        # flips got it there — the router's canary split
+                        # and the fleet's generation-tagged metrics key
+                        # on exactly this pair
+                        "generation": self.server.engine.serving_generation,
+                        "swap_count": self.server.engine.swap_count,
                     },
                 )
         elif self.path == "/metrics":
             tel = self.server.tel
+            engine = self.server.engine
             if tel is None:
-                self._reply(200, {"telemetry": "disabled"})
+                self._reply(
+                    200,
+                    {
+                        "telemetry": "disabled",
+                        "generation": engine.serving_generation,
+                        "swap_count": engine.swap_count,
+                    },
+                )
             else:
                 from ..training.telemetry import sanitize_json
 
-                self._reply(200, sanitize_json(tel.snapshot()))
+                snap = tel.snapshot()
+                # stamp the snapshot with the generation it describes:
+                # merge_serving_snapshots groups per-replica snapshots by
+                # this key, which is what makes fleet slo_window
+                # percentiles splittable by generation
+                snap["generation"] = engine.serving_generation
+                snap["swap_count"] = engine.swap_count
+                self._reply(200, sanitize_json(snap))
         else:
             self._reply(404, {"error": "not_found", "message": self.path})
 
@@ -154,6 +183,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         body = self.rfile.read(length)  # consume BEFORE any early reply:
         # an unread body desyncs every later request on this connection
+        if self.path in ("/admin/swap", "/admin/rollback"):
+            self._handle_admin(body)
+            return
         if self.path != "/v1/parse":
             self._reply(404, {"error": "not_found", "message": self.path})
             return
@@ -203,6 +235,122 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
 
+    # -- admin: live hot-swap control (docs/SERVING.md "Continuous
+    # learning"). These run on the LISTENER, not a side channel, so the
+    # fleet controller reaches replicas over the address it already
+    # knows; staging runs on this handler thread while the dispatch
+    # thread keeps serving, and the flip itself is an O(pointers)
+    # exchange at a dispatch boundary.
+    def _handle_admin(self, body: bytes) -> None:
+        engine = self.server.engine
+        if self.server.draining:
+            self._reply_error(Draining("server is draining; no swaps"))
+            return
+        if not self.server.allowed_swap_dirs:
+            # the WHOLE admin surface keys off the swap-dir config —
+            # rollback included: an ungated rollback on an open port
+            # would let any client revert a fleet to stale weights (and
+            # toggle generations at will, since rollback is its own
+            # inverse)
+            self._reply(
+                403,
+                {
+                    "error": "forbidden",
+                    "message": "admin swap/rollback is disabled: no swap "
+                    "directory configured (serve --watch/--swap-dir)",
+                },
+            )
+            return
+        if self.path == "/admin/rollback":
+            try:
+                result = engine.rollback()
+            except ServingError as e:
+                self._reply_error(e)
+                return
+            self._reply(200, {k: v for k, v in result.items()})
+            return
+        # /admin/swap {"dir": <ckpt dir>, "generation": optional stamp}
+        if not engine.ready:
+            self._reply_error(
+                NotReady("bucket warmup in progress; not swapping yet")
+            )
+            return
+        try:
+            payload = json.loads(body or b"{}")
+        except ValueError:
+            self._reply(
+                400, {"error": "bad_request", "message": "body is not JSON"}
+            )
+            return
+        ckpt_dir = payload.get("dir") if isinstance(payload, dict) else None
+        if not isinstance(ckpt_dir, str) or not ckpt_dir:
+            self._reply(
+                400,
+                {
+                    "error": "bad_request",
+                    "message": 'body must be {"dir": <checkpoint dir>, '
+                    '"generation": optional int}',
+                },
+            )
+            return
+        from pathlib import Path
+
+        allowed = self.server.allowed_swap_dirs
+        try:
+            requested = Path(ckpt_dir).resolve()
+        except OSError:
+            requested = None
+        if requested is None or not any(
+            requested == Path(d).resolve() for d in allowed
+        ):
+            # not an allowlisted checkpoint directory: loading weights
+            # from an arbitrary client-supplied path is how a reachable
+            # port becomes an arbitrary-model (or worse) endpoint
+            self._reply(
+                403,
+                {
+                    "error": "forbidden",
+                    "message": (
+                        "dir is not an allowed swap directory (configure "
+                        "via serve --watch/--swap-dir)"
+                        if allowed
+                        else "admin swap is disabled: no swap directory "
+                        "configured (serve --watch/--swap-dir)"
+                    ),
+                },
+            )
+            return
+        from ..training.checkpoint import CheckpointCorrupt, Checkpoints
+
+        try:
+            ckpts = Checkpoints(ckpt_dir)
+            generation = payload.get("generation")
+            if generation is None:
+                generation = ckpts.latest_intact_generation(
+                    params_only=True
+                )
+                if generation is None:
+                    raise SwapFailed(
+                        f"no intact checkpoint generation in {ckpt_dir}"
+                    )
+            # params-only: the swap discards opt_state, so the admin
+            # route neither hashes nor unpickles it (no pickle.load on
+            # a network-reachable path, and half the I/O per swap)
+            state = ckpts.load_generation_params(int(generation))
+            result = engine.swap_params(
+                state["params"], int(generation), source="admin"
+            )
+        except CheckpointCorrupt as e:
+            # a torn generation is a refused swap, not a crash — the
+            # caller (controller/operator) picks another generation
+            self._reply_error(SwapFailed(str(e)))
+            return
+        except ServingError as e:
+            self._reply_error(e)
+            return
+        self._reply(200, {k: v for k, v in result.items()})
+
+
 class Server:
     """Lifecycle orchestration: start the listener, wait for a shutdown
     request (signal or programmatic), drain gracefully, exit.
@@ -220,11 +368,25 @@ class Server:
         *,
         telemetry: Optional[ServingTelemetry] = None,
         drain_timeout_s: float = 30.0,
+        watcher: Optional[Any] = None,
+        swap_dirs: Optional[list] = None,
     ) -> None:
         self.engine = engine
         self.tel = telemetry
         self.drain_timeout_s = float(drain_timeout_s)
+        # optional live-serving CheckpointWatcher (serve --watch): started
+        # only after the engine is ready (swapping mid-warmup would race
+        # the sweep), stopped before the drain (a swap mid-drain serves
+        # nobody)
+        self.watcher = watcher
         self.httpd = ServingHTTPServer((host, port), engine, telemetry)
+        # /admin/swap allowlist: the watched dir plus any explicit
+        # --swap-dir entries; empty = admin swaps 403 (see
+        # ServingHTTPServer.allowed_swap_dirs)
+        dirs = [str(d) for d in (swap_dirs or [])]
+        if watcher is not None and str(watcher.ckpt_dir) not in dirs:
+            dirs.append(str(watcher.ckpt_dir))
+        self.httpd.allowed_swap_dirs = dirs
         self._stop = threading.Event()
         self._serve_thread: Optional[threading.Thread] = None
 
@@ -260,6 +422,8 @@ class Server:
         had to be abandoned at the timeout."""
         self._stop.wait()
         self.httpd.draining = True
+        if self.watcher is not None:
+            self.watcher.stop()
         self.engine.batcher.begin_drain()
         log_event(
             "serve-drain",
@@ -301,6 +465,15 @@ class Server:
                     print(
                         f"warmed {len(self.engine.warmed)} (B, T) bucket "
                         "programs; ready", flush=True,
+                    )
+            if self.watcher is not None and not self._stop.is_set():
+                self.watcher.start()
+                if banner:
+                    print(
+                        f"watching {self.watcher.ckpt_dir} for new "
+                        "checkpoint generations "
+                        f"(every {self.watcher.interval_s:.1f}s)",
+                        flush=True,
                     )
             return self.wait()
         finally:

@@ -331,6 +331,20 @@ class TrainCheckpoint:
             ) from e
 
     @staticmethod
+    def generation_stamps(path) -> List[int]:
+        """Stamps of every generation whose per-generation meta exists in
+        ``path``, ascending. Presence of the meta means the writer
+        COMMITTED the generation (array files + digests land before it —
+        see :meth:`save`); intactness is still verified at load time."""
+        return sorted(
+            s
+            for s in (
+                _gen_stamp(p) for p in Path(path).glob("train_meta-*.json")
+            )
+            if s is not None
+        )
+
+    @staticmethod
     def load(path) -> Optional[Dict[str, Any]]:
         """Load the newest INTACT generation.
 
@@ -395,3 +409,135 @@ class TrainCheckpoint:
             f"no intact checkpoint generation in {path} "
             f"(last error: {last_err})"
         )
+
+
+class Checkpoints:
+    """Read-only view of a :class:`TrainCheckpoint` directory for a
+    CONCURRENT reader (the live-serving checkpoint watcher) while a
+    training process keeps writing into it.
+
+    The reader-vs-writer contract it relies on — the writer side is
+    :meth:`TrainCheckpoint.save`, and every property below is load-
+    bearing for a reader that races it:
+
+    1. **Array files land before their meta.** ``params-{stamp}.npz``
+       and ``opt_state-{stamp}.pkl`` are fully written (tmp +
+       ``os.replace``) BEFORE ``train_meta-{stamp}.json`` appears, so a
+       per-generation meta's existence means its array files are
+       complete on disk (modulo torn writes, which digests catch).
+    2. **Every rename is atomic.** A reader never observes a
+       half-written file under a final name — only a missing file
+       (generation not committed yet / already retired) or a complete
+       one. Torn bytes can only come from the filesystem itself, and
+       the SHA-256 digests in the meta catch exactly that.
+    3. **Retention deletes oldest-first, after the new generation is
+       committed.** A reader holding a stamp may find its files deleted
+       on the NEXT access (the generation aged out) — that surfaces as
+       :class:`CheckpointCorrupt` ("file missing"), which callers treat
+       as "move on to a newer generation", never as data corruption.
+
+    Verification policy: :meth:`load_generation` re-hashes the exact
+    bytes it is about to deserialize, the same rule ``load()`` applies —
+    a torn or mid-retirement generation raises one typed
+    :class:`CheckpointCorrupt` and the caller falls back/retries; it is
+    never loaded and never a crash.
+    """
+
+    def __init__(self, path) -> None:
+        self.path = Path(path)
+
+    def generations(self) -> List[int]:
+        """Committed generation stamps, ascending (cheap: directory scan
+        only, no digest work)."""
+        return TrainCheckpoint.generation_stamps(self.path)
+
+    def _meta_for(self, stamp: int) -> Dict[str, Any]:
+        return TrainCheckpoint._read_meta(
+            self.path / f"train_meta-{int(stamp)}.json"
+        )
+
+    def verify_generation(self, stamp: int, *, params_only: bool = False) -> None:
+        """Digest-verify one generation's files without deserializing
+        them; raises :class:`CheckpointCorrupt` on any missing/torn
+        piece. Cheaper than a load (no unpickle, no jnp conversion) —
+        the watcher's "is it worth loading?" probe. ``params_only``
+        skips the opt_state file entirely (the serving-swap question:
+        for Adam that file is ~2x the param bytes of pure hash I/O a
+        swap would then discard)."""
+        meta = self._meta_for(stamp)
+        digests = meta.get("digests") or {}
+        files = [self.path / f"params-{int(stamp)}.npz"]
+        if not params_only:
+            files.append(self.path / f"opt_state-{int(stamp)}.pkl")
+        for f in files:
+            if not f.exists():
+                raise CheckpointCorrupt(f"checkpoint file missing: {f}")
+            expect = digests.get(f.name)
+            if expect is not None and _sha256_file(f) != expect:
+                raise CheckpointCorrupt(
+                    f"checkpoint digest mismatch: {f} (torn or tampered "
+                    "write)"
+                )
+
+    def latest_intact_generation(
+        self, *, params_only: bool = False
+    ) -> Optional[int]:
+        """Newest stamp whose files digest-verify, or None when the
+        directory holds no verifiable generation. A torn newest
+        generation falls back to the next, the same walk ``load()``
+        does — one fallback policy, two consumers. ``params_only``
+        applies the serving-swap verification scope (see
+        :meth:`verify_generation`)."""
+        for stamp in sorted(self.generations(), reverse=True):
+            try:
+                self.verify_generation(stamp, params_only=params_only)
+            except CheckpointCorrupt:
+                continue
+            return stamp
+        return None
+
+    def load_generation(self, stamp: int) -> Dict[str, Any]:
+        """Load one specific generation (params/opt_state/step/... — the
+        ``load()`` state dict), digest-verified. Raises
+        :class:`CheckpointCorrupt` when torn, missing, or retired."""
+        meta = self._meta_for(stamp)
+        if meta.get("stamp") != int(stamp):
+            raise CheckpointCorrupt(
+                f"generation meta train_meta-{stamp}.json carries stamp "
+                f"{meta.get('stamp')!r} (directory rewritten under us?)"
+            )
+        return TrainCheckpoint._load_generation(self.path, meta)
+
+    def load_generation_params(self, stamp: int) -> Dict[str, Any]:
+        """Load ONLY one generation's param tree, digest-verified —
+        the serving hot-swap path. Deliberately narrower than
+        :meth:`load_generation`: it never touches ``opt_state`` (which
+        a swap discards anyway — for Adam that is ~2x the param bytes
+        of load + hash + host->device churn per swap) and therefore
+        never runs ``pickle.load`` at all, which matters because the
+        ``/admin/swap`` route is network-reachable. Returns
+        ``{"params": tree, "step": stamp}``; raises
+        :class:`CheckpointCorrupt` on any torn/missing/retired piece."""
+        meta = self._meta_for(stamp)
+        if meta.get("stamp") != int(stamp):
+            raise CheckpointCorrupt(
+                f"generation meta train_meta-{stamp}.json carries stamp "
+                f"{meta.get('stamp')!r} (directory rewritten under us?)"
+            )
+        params_file = self.path / f"params-{int(stamp)}.npz"
+        if not params_file.exists():
+            raise CheckpointCorrupt(f"checkpoint file missing: {params_file}")
+        expect = (meta.get("digests") or {}).get(params_file.name)
+        if expect is not None and _sha256_file(params_file) != expect:
+            raise CheckpointCorrupt(
+                f"checkpoint digest mismatch: {params_file} (torn or "
+                "tampered write)"
+            )
+        try:
+            params = load_params(params_file)
+        except Exception as e:
+            raise CheckpointCorrupt(
+                f"corrupt checkpoint generation stamp {stamp} in "
+                f"{self.path}: {type(e).__name__}: {e}"
+            ) from e
+        return {"params": params, "step": int(meta.get("step", stamp))}

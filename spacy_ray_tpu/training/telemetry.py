@@ -123,7 +123,7 @@ def _merge_weighted(
 
 
 def merge_serving_snapshots(
-    snaps: List[Dict[str, Any]]
+    snaps: List[Dict[str, Any]], *, _tag_generations: bool = True
 ) -> Dict[str, Any]:
     """Merge per-replica ``ServingTelemetry.snapshot()`` payloads into
     one fleet view (the router's ``/metrics``) — one scrape instead of N.
@@ -147,6 +147,14 @@ def merge_serving_snapshots(
       load, not run lifetime) merges the same way, weighted by each
       replica's IN-WINDOW sample count, so the fleet view reacts to a
       spike as fast as the freshest replica does.
+    * **generations** — when any snapshot carries a ``generation`` stamp
+      (live serving: the checkpoint generation that replica's dispatch
+      thread is running), the merged view adds ``by_generation``: the
+      SAME merge re-run per generation group, so the slo_window
+      percentiles (and error/request counters) are splittable by
+      generation — the canary guard's entire signal. Replicas serving
+      the model as loaded from disk (generation null) group under
+      ``"none"``.
     """
     merged: Dict[str, Any] = {
         "replicas": len(snaps),
@@ -253,6 +261,19 @@ def merge_serving_snapshots(
                 if isinstance(w.get(key), (int, float))
             ])
         merged["slo_window"] = win
+
+    if _tag_generations:
+        gens = {snap.get("generation") for snap in snaps}
+        if any(g is not None for g in gens):
+            by_gen: Dict[str, Any] = {}
+            for g in sorted(gens, key=lambda x: (x is None, x)):
+                subset = [s for s in snaps if s.get("generation") == g]
+                sub = merge_serving_snapshots(
+                    subset, _tag_generations=False
+                )
+                sub["generation"] = g
+                by_gen["none" if g is None else str(g)] = sub
+            merged["by_generation"] = by_gen
     return merged
 
 

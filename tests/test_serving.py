@@ -557,7 +557,11 @@ def test_disabled_telemetry_makes_zero_calls(serve_nlp, monkeypatch):
         assert status == 200
         assert payload["docs"][0]["tags"]
         status, metrics = _get(host, port, "/metrics")
-        assert status == 200 and metrics == {"telemetry": "disabled"}
+        # generation/swap_count are engine state, not telemetry — they
+        # ride along even with the telemetry surface disabled
+        assert status == 200 and metrics == {
+            "telemetry": "disabled", "generation": None, "swap_count": 0,
+        }
     finally:
         server.request_shutdown()
         assert server.wait() == 0
