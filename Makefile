@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry serving fleet live bench baseline profile step-perf serve-perf dryrun
+.PHONY: test test-fast test-slow resilience telemetry serving fleet live bench baseline profile step-perf serve-perf update-shard dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -69,5 +69,14 @@ serve-perf:
 	JAX_PLATFORMS=cpu python bench.py --serving-ab
 	JAX_PLATFORMS=cpu python bench.py --serving
 
+# cross-replica update sharding (PERF.md "Update sharding (round 11)"):
+# the full==replicated equality suite + v2 owner-shard checkpoint format +
+# elastic (8->4->1) resume bit-exactness, then the sharded update-only A/B
+# (replicated vs zero1 vs full at 1/2/4/8 virtual devices, with the
+# grad-reduce/apply/allgather phase split on every record)
+update-shard:
+	python -m pytest tests/test_update_sharding.py -q
+	python bench.py --update-only --sharded
+
 dryrun:
-	python __graft_entry__.py
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" python __graft_entry__.py

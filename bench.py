@@ -1333,6 +1333,266 @@ def run_update_only(platform: str, configs=None) -> None:
 
 
 # ----------------------------------------------------------------------
+# Cross-replica update sharding A/B (--update-only --sharded)
+# ----------------------------------------------------------------------
+
+
+def _time_jitted(step_fn, args, *, donate_cycle=True) -> Dict[str, float]:
+    """compile + adaptive-rep timing loop shared by the sharded update
+    arms (same discipline as run_update_only: median of N_REPS reps, each
+    at least MIN_REP_SECONDS). ``args`` are recycled through the program
+    (outputs replace the donated inputs)."""
+    import jax
+
+    t0 = time.perf_counter()
+    out = step_fn(*args)
+    jax.block_until_ready(out)
+    compile_seconds = time.perf_counter() - t0
+    state = list(out) + list(args[len(out):]) if donate_cycle else list(args)
+    t0 = time.perf_counter()
+    out = step_fn(*state)
+    jax.block_until_ready(out)
+    probe_dt = time.perf_counter() - t0
+    state = list(out) + list(state[len(out):]) if donate_cycle else state
+    steps = max(
+        3, min(500, int(np.ceil(MIN_REP_SECONDS / max(probe_dt, 1e-6))))
+    )
+    rep_secs: List[float] = []
+    for _rep in range(N_REPS):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = step_fn(*state)
+            if donate_cycle:
+                state = list(out) + list(state[len(out):])
+        jax.block_until_ready(out)
+        rep_secs.append((time.perf_counter() - t0) / steps)
+    return {
+        "seconds": float(np.median(rep_secs)),
+        "seconds_min": float(min(rep_secs)),
+        "seconds_max": float(max(rep_secs)),
+        "compile_seconds": compile_seconds,
+        "steps_per_rep": steps,
+    }
+
+
+def run_update_sharded(platform: str, n_devices: int, configs=None) -> None:
+    """``--update-only --sharded`` child: the update-phase A/B at ONE
+    virtual-device count — replicated vs zero1 vs full update sharding on
+    the cnn_tagger tree (always) and the trf tree (n_devices 1 or 8; its
+    134M-param updates make every extra count minutes).
+
+    Three measurements per arm, each honestly scoped:
+
+    * ``update_seconds`` — the ONE-program update (the thing the train
+      loop dispatches), including full's params allgather.
+    * ``update_phases`` (telemetry.update_phase_block) — grad-reduce /
+      apply / allgather timed as SEPARATE jitted programs: an isolation
+      attribution, not a decomposition of the one-program time (XLA
+      overlaps phases there). The apply phase is where full's
+      1/n_data-work claim shows up; the allgather phase is its honest
+      cost.
+
+    All arms run the FUSED Adam transformation (the flagship update path;
+    its stable_global_norm is what makes full == replicated bit-exact),
+    labeled via fused_status + update_sharding_status on each record.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from spacy_ray_tpu.config import Config
+    from spacy_ray_tpu.ops.fused_update import fused_status
+    from spacy_ray_tpu.parallel.mesh import build_mesh, zero1_spec
+    from spacy_ray_tpu.parallel.step import (
+        make_update_only,
+        place_replicated,
+        shard_opt_state,
+        update_sharding_status,
+    )
+    from spacy_ray_tpu.pipeline.language import Pipeline
+    from spacy_ray_tpu.presets import CNN_TAGGER_CFG, INIT_PRESETS
+    from spacy_ray_tpu.registry import registry
+    from spacy_ray_tpu.training.optimizers import fuse_optimizer
+    from spacy_ray_tpu.training.telemetry import update_phase_block
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    peak, _peak_kind = _peak_flops_per_chip(platform)
+    mesh = build_mesh(n_data=n_devices)
+    if configs is None:
+        configs = [
+            ("cnn_tagger", CNN_TAGGER_CFG.format(width=96, depth=4,
+                                                 embed_size=2000), ["tagger"]),
+        ]
+        if n_devices in (1, 8):
+            configs.append(("trf", INIT_PRESETS["trf"], ["parser", "ner"]))
+    for cfg_name, cfg_text, kinds in configs:
+        nlp = Pipeline.from_config(Config.from_str(cfg_text))
+        examples = _corpus(kinds, 512)
+        nlp.initialize(lambda: iter(examples), seed=0)
+        host_params = jax.tree_util.tree_map(np.asarray, nlp.params)
+        n_params = int(sum(int(np.prod(p.shape))
+                           for p in jax.tree_util.tree_leaves(host_params)))
+        host_grads = jax.tree_util.tree_map(
+            lambda p: p * 1e-3 + 1e-4, host_params
+        )
+
+        # -- grad-reduce phase (mode-independent): sum the n_devices
+        # per-replica partial-grad stacks to the replicated layout — the
+        # data-parallel gradient reduction as GSPMD compiles it
+        reduce_s: Optional[float] = None
+        if n_devices > 1:
+            part_sh = NamedSharding(mesh, P("data"))
+            repl_sh = NamedSharding(mesh, P())
+
+            def reduce_fn(parts):
+                return jax.tree_util.tree_map(
+                    lambda x: jax.lax.with_sharding_constraint(
+                        jnp.sum(x, axis=0), repl_sh
+                    ),
+                    parts,
+                )
+
+            parts = jax.tree_util.tree_map(
+                lambda g: jax.device_put(
+                    np.broadcast_to(g, (n_devices,) + g.shape), part_sh
+                ),
+                host_grads,
+            )
+            jit_reduce = jax.jit(reduce_fn)
+            timing = _time_jitted(
+                jit_reduce, (parts,), donate_cycle=False
+            )
+            reduce_s = timing["seconds"]
+            del parts
+
+        for mode in ("replicated", "zero1", "full"):
+            tx = fuse_optimizer(
+                registry.get("optimizers", "Adam.v1")(learn_rate=0.001)
+            )
+            params = place_replicated(
+                jax.tree_util.tree_map(jnp.asarray, host_params), mesh
+            )
+            opt_state = shard_opt_state(tx.init(params), mesh, mode)
+            grads = place_replicated(
+                jax.tree_util.tree_map(jnp.asarray, host_grads), mesh
+            )
+            step = make_update_only(tx, mesh, mode, opt_state)
+            timing = _time_jitted(step, (params, opt_state, grads))
+            update_seconds = timing["seconds"]
+
+            # -- apply phase: the same program STOPPED before the params
+            # allgather (full only; elsewhere apply IS the whole program)
+            apply_s = update_seconds
+            allgather_s: Optional[float] = None
+            if mode == "full" and n_devices > 1:
+                params2 = place_replicated(
+                    jax.tree_util.tree_map(jnp.asarray, host_params), mesh
+                )
+                opt2 = shard_opt_state(tx.init(params2), mesh, mode)
+                # donation off: the apply program's sharded outputs could
+                # not be fed back as its replicated inputs — fixed inputs,
+                # discarded outputs (isolation measurement)
+                step_ng = make_update_only(
+                    tx, mesh, mode, opt2, gather=False, donate=False
+                )
+                apply_timing = _time_jitted(
+                    step_ng, (params2, opt2, grads), donate_cycle=False
+                )
+                apply_s = apply_timing["seconds"]
+                # -- allgather phase: owner shards -> replicated, alone
+                shard_params = jax.tree_util.tree_map(
+                    lambda p: jax.device_put(
+                        np.asarray(p), zero1_spec(p, mesh)
+                    ),
+                    host_params,
+                )
+                repl_sh = NamedSharding(mesh, P())
+                jit_gather = jax.jit(
+                    lambda t: jax.tree_util.tree_map(
+                        lambda x: jax.lax.with_sharding_constraint(
+                            x, repl_sh
+                        ),
+                        t,
+                    )
+                )
+                gather_timing = _time_jitted(
+                    jit_gather, (shard_params,), donate_cycle=False
+                )
+                allgather_s = gather_timing["seconds"]
+                del shard_params, params2, opt2
+
+            reprobe_ratio = None
+            if platform == "cpu":
+                reprobe = _measure_matmul_peak(platform)
+                if reprobe > peak:
+                    peak = reprobe
+                reprobe_ratio = reprobe / peak
+            rec = {
+                "name": f"update_sharded_{cfg_name}_n{n_devices}_{mode}",
+                "metric": (
+                    "optimizer_update_seconds (jitted fused Adam update "
+                    f"alone, update_sharding={mode}, {n_devices} virtual "
+                    "devices)"
+                ),
+                "value": round(update_seconds, 4),
+                "unit": "seconds/update",
+                "platform": platform,
+                "devices": n_devices,
+                "n_params": n_params,
+                "updates_per_sec": round(1.0 / update_seconds, 2),
+                "compile_seconds": round(timing["compile_seconds"], 2),
+                "n_reps": N_REPS,
+                "steps_per_rep": timing["steps_per_rep"],
+                "update_seconds_min": round(timing["seconds_min"], 4),
+                "update_seconds_max": round(timing["seconds_max"], 4),
+                "update_sharding": update_sharding_status(mode, mesh),
+                "fused_update": fused_status(tx, mesh),
+                "update_phases": update_phase_block(
+                    reduce_s, apply_s, allgather_s
+                ),
+                "peak_reprobe_ratio": (
+                    round(reprobe_ratio, 3) if reprobe_ratio is not None
+                    else None
+                ),
+                "contended": (
+                    reprobe_ratio is not None
+                    and reprobe_ratio < CONTENTION_RATIO
+                ),
+            }
+            print(json.dumps(rec), flush=True)
+            _append_session(rec, platform)
+
+
+def run_update_sharded_parent(device_counts: List[int]) -> None:
+    """``--update-only --sharded`` parent: one child process per virtual
+    device count (the device count is locked at backend init, so each
+    count needs a fresh interpreter — the same isolation discipline as
+    tests/test_dryrun_scale.py)."""
+    import subprocess
+    import sys as _sys
+
+    run_id = f"{os.getpid()}-{int(time.time())}"
+    for n in device_counts:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["SRT_BENCH_RUN_ID"] = run_id
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+        print(f"# --sharded child: {n} virtual device(s)", flush=True)
+        proc = subprocess.run(
+            [_sys.executable, __file__, "--update-only", "--sharded-child",
+             str(n)],
+            env=env,
+            cwd=str(Path(__file__).parent),
+            timeout=3600,
+        )
+        if proc.returncode != 0:
+            print(f"# --sharded child n={n} failed rc={proc.returncode}",
+                  flush=True)
+
+
+# ----------------------------------------------------------------------
 # Serving benchmark (--serving): online path under closed/open-loop load
 # ----------------------------------------------------------------------
 
@@ -2629,6 +2889,26 @@ def main() -> None:
         "fixed floor measured directly; records land in BENCH_SESSION.jsonl",
     )
     parser.add_argument(
+        "--sharded", action="store_true",
+        help="--update-only: run the cross-replica update-sharding A/B "
+        "instead (replicated vs zero1 vs full, per arXiv 2004.13336) — "
+        "spawns one child per --sharded-devices count with that many "
+        "virtual CPU devices (the dryrun_multichip harness idiom) and "
+        "records one-program update time plus the grad-reduce/apply/"
+        "allgather phase split on each record",
+    )
+    parser.add_argument(
+        "--sharded-devices", type=str, default="1,2,4,8",
+        help="--update-only --sharded: comma-separated virtual-device "
+        "counts to fan out over (the trf tree runs at 1 and 8 only)",
+    )
+    parser.add_argument(
+        "--sharded-child", type=str, default="",
+        help="internal: child mode of --update-only --sharded at ONE "
+        "device count (forces the CPU platform with that many virtual "
+        "devices; run directly on real hardware to skip the fan-out)",
+    )
+    parser.add_argument(
         "--serving", action="store_true",
         help="measure the online serving path (engine+batcher+HTTP): a "
         "closed-loop spec (sustained req/s at client saturation) and an "
@@ -2738,6 +3018,24 @@ def main() -> None:
         return
 
     if args.update_only:
+        if args.sharded_child.strip():
+            # sharded-A/B child: ONE virtual-device count, CPU forced
+            # BEFORE any backend touch (a wedged relay must not hang the
+            # A/B — the dryrun_multichip discipline)
+            n = int(args.sharded_child)
+            from spacy_ray_tpu.devices import force_cpu
+
+            force_cpu(max(n, 1))
+            import jax
+
+            run_update_sharded(jax.default_backend(), n)
+            return
+        if args.sharded:
+            counts = [
+                int(c) for c in args.sharded_devices.split(",") if c.strip()
+            ]
+            run_update_sharded_parent(counts)
+            return
         # device-update-only mode: no subprocess fan-out (tiny programs);
         # resolve the backend like --input-pipeline
         import jax

@@ -1020,16 +1020,29 @@ def info_command(argv: List[str]) -> int:
     if args.probe:
         import subprocess
 
+        # the probe child also resolves the [training] update_sharding
+        # "auto" gate for the probed topology — the same honest-label
+        # discipline as fused_update: what the knob would ACTUALLY do
+        # there, not what was requested
         p = subprocess.Popen(
             [sys.executable, "-c",
-             "import jax; d = jax.devices(); print(d[0].platform, len(d))"],
+             "import jax; d = jax.devices(); print(d[0].platform, len(d)); "
+             "from spacy_ray_tpu.parallel.step import "
+             "resolve_update_sharding as r, update_sharding_status as s; "
+             "from spacy_ray_tpu.parallel.mesh import build_mesh; "
+             "m = build_mesh(n_data=len(d)); "
+             "print(s(r('auto', n_data=len(d), "
+             "backend=d[0].platform), m))"],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         )
         try:
             out, _ = p.communicate(timeout=60)
             if p.returncode == 0 and out.strip():
-                platform_name, n = out.split()
+                lines = out.strip().splitlines()
+                platform_name, n = lines[0].split()
                 print(f"accelerator      reachable: {platform_name} x{n}")
+                if len(lines) > 1:
+                    print(f"update_sharding  auto -> {lines[1].strip()}")
             else:
                 print("accelerator      UNREACHABLE (backend init failed)")
         except subprocess.TimeoutExpired:

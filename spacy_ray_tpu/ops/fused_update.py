@@ -267,6 +267,45 @@ def fused_status(tx: Any, mesh: Any = None) -> str:
 # ------------------------------------------------- fused transformation
 
 
+def stable_global_norm(tree: Any) -> "jnp.ndarray":
+    """Global L2 norm with a partitioner-proof computation.
+
+    Under a multi-device mesh the SPMD partitioner is free to split a
+    full-tree norm reduction into per-shard partial sums + psum, and it
+    makes that choice per-program: the same norm compiles to different
+    accumulation orders in the replicated vs full-update-sharding
+    programs (and at different mesh shapes), drifting the grad-clip
+    scale by an ulp and with it every updated parameter. Here every
+    device instead computes the WHOLE reduction locally over its
+    replicated copy inside ``shard_map`` (manual mode — GSPMD cannot
+    re-partition the body), so the value is identical across
+    ``update_sharding`` modes and across mesh shapes. Off-mesh (or on a
+    single device) this is exactly ``optax.global_norm``, which keeps
+    the fused==optax single-device bitwise tests intact.
+
+    Callers must hand in grads that are logically replicated (the train
+    step pins them with a ``with_sharding_constraint`` + barrier before
+    the optimizer runs — parallel/step.py).
+    """
+    from ..parallel import context as pctx
+
+    mesh = pctx.current_mesh()
+    if mesh is None or int(mesh.size) == 1:
+        return optax.global_norm(tree)
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    fn = shard_map(
+        lambda *ls: optax.global_norm(ls),
+        mesh=mesh,
+        in_specs=tuple(P() for _ in leaves),
+        out_specs=P(),
+        check_rep=False,
+    )
+    return fn(*leaves)
+
+
 def _single_mesh() -> bool:
     """Kernel gate: a pallas_call has no GSPMD partitioning rule, so under
     a multi-device mesh (replicated params / ZeRO-1 sharded moments) the
@@ -319,8 +358,12 @@ class FusedTransformation:
         step_size = jnp.float32(-1.0) * self.lr_fn(sched_state.count)
         bc1 = 1 - hyper.b1**count_inc
         bc2 = 1 - hyper.b2**count_inc
+        # partitioner-proof norm: the clip scale must be the same VALUE in
+        # every update-sharding mode and at every mesh shape, or the fused
+        # update can never be bit-compared across them (see the function's
+        # docstring; single-device this IS optax.global_norm)
         gnorm = (
-            optax.global_norm(grads)
+            stable_global_norm(grads)
             if hyper.grad_clip > 0
             else jnp.float32(0.0)
         )

@@ -64,14 +64,22 @@ def batch_spec(accumulate: bool = False):
 
 
 def zero1_spec(leaf: "jax.Array", mesh: Mesh) -> NamedSharding:
-    """ZeRO-1 sharding for one optimizer-state leaf: shard the first axis
-    divisible by the data-axis size; replicate otherwise.
+    """Data-axis ownership sharding for one optimizer-state or parameter
+    leaf: shard the first axis divisible by the data-axis size; replicate
+    otherwise.
 
     The GSPMD version of the reference's parameter-ownership split
     (reference util.py:57-75 ``divide_params`` + owner-applied updates at
     proxies.py:111-133): ownership becomes a sharding annotation and the
     update math is compiled with its collectives (SURVEY.md §2.2 row
-    "Optimizer/param-state sharding").
+    "Optimizer/param-state sharding"). The SAME spec describes both ZeRO-1
+    state sharding and the ``update_sharding = "full"`` shard-local apply
+    (arXiv:2004.13336): a param leaf and its Adam moments share one spec,
+    so the owner of a state shard is the owner of the param shard it
+    updates.
+
+    Works on tracers too (only ``shape`` is consulted), so the train step
+    can apply it as a ``with_sharding_constraint`` inside jit.
     """
     n_data = mesh.shape["data"]
     shape = getattr(leaf, "shape", ())
@@ -81,3 +89,17 @@ def zero1_spec(leaf: "jax.Array", mesh: Mesh) -> NamedSharding:
             spec[axis] = "data"
             return NamedSharding(mesh, P(*spec))
     return NamedSharding(mesh, P())
+
+
+# alias with the ownership reading: "the shard of this leaf one data-rank
+# owns" — the update_sharding="full" vocabulary for the same layout
+owner_shard_spec = zero1_spec
+
+
+def owner_shard_specs(tree, mesh: Mesh):
+    """Per-leaf :func:`owner_shard_spec` over a whole pytree."""
+    import jax as _jax
+
+    return _jax.tree_util.tree_map(
+        lambda leaf: owner_shard_spec(leaf, mesh), tree
+    )

@@ -2,12 +2,35 @@
 
 This single function replaces the reference's entire L3/L4 communication
 machinery (SURVEY.md §1): forward, backward, gradient all-reduce over ICI,
-optimizer update, and (with ``zero1=True``) sharded optimizer state — where
-the reference does per-parameter RPC push/broadcast with version gates and
-quorums (reference proxies.py:54-133, worker.py:117-132), here GSPMD insert
-collectives from sharding annotations and the whole exchange compiles into
-the step (SURVEY.md §2.2: "synchronous allreduce is strictly better on TPU
-ICI").
+optimizer update, and a sharded update phase — where the reference does
+per-parameter RPC push/broadcast with version gates and quorums (reference
+proxies.py:54-133, worker.py:117-132), here GSPMD insert collectives from
+sharding annotations and the whole exchange compiles into the step
+(SURVEY.md §2.2: "synchronous allreduce is strictly better on TPU ICI").
+
+Update-phase sharding (``[training] update_sharding``, subsuming the old
+``zero1`` bool):
+
+* ``"replicated"`` — every replica holds the full optimizer state and
+  applies the full update (the original layout).
+* ``"zero1"`` — optimizer STATE is sharded over the data axis
+  (:func:`~..mesh.zero1_spec`); where the update math runs is left to
+  GSPMD's placement inference.
+* ``"full"`` — the update COMPUTATION itself is sharded (arXiv
+  2004.13336 "Automatic Cross-Replica Sharding of Weight Update in
+  Data-Parallel Training", the TPU-native completion of the reference's
+  owner-applies-the-update scheme): each replica applies the optimizer
+  chain only to its owned param shard and the updated params are
+  allgathered back to the replicated data-parallel layout. Bit-exactness
+  with ``"replicated"`` is engineered, not hoped for: the all-reduced
+  gradients are pinned replicated behind an ``optimization_barrier``
+  (XLA must not rewrite the all-reduce into a reduce-scatter, whose
+  different accumulation order changes last-ulp values) so any global
+  reduction inside the optimizer (grad-clip global norm) sees the same
+  full arrays in the same order, and everything downstream is elementwise
+  — identical per element whether computed on a shard or the whole leaf.
+  tests/test_update_sharding.py asserts full == replicated to EQUALITY,
+  the same discipline as the fused==optax tests.
 
 Gradient accumulation: the reference folds ``accumulate_gradient`` into its
 distributed quorum (reference worker.py:151-155,182 — with the dead-code bug
@@ -30,18 +53,110 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import context as pctx
 from .mesh import replicated, zero1_spec
 
+# the full [training] update_sharding knob surface; "auto" resolves via
+# resolve_update_sharding before any of the functions below see it
+UPDATE_SHARDING_MODES = ("auto", "replicated", "zero1", "full")
 
-def shard_opt_state(opt_state: Any, mesh: Mesh, zero1: bool) -> Any:
-    """Place optimizer state: ZeRO-1 sharded over data axis, or replicated."""
-    if not zero1:
+
+def resolve_update_sharding(
+    mode: str,
+    *,
+    zero1: bool = False,
+    n_data: int = 1,
+    backend: Optional[str] = None,
+) -> str:
+    """Resolve the ``[training] update_sharding`` knob to a concrete mode.
+
+    ``zero1`` is the legacy bool knob, kept as an accepted alias:
+    ``zero1 = true`` under ``update_sharding = "auto"`` resolves to
+    ``"zero1"`` (existing configs keep their exact behavior). An explicit
+    non-auto ``update_sharding`` wins over the alias. ``"auto"`` without
+    the alias arms ``"full"`` on accelerator backends with more than one
+    data rank — the same platform-gating discipline as ``fused_update`` /
+    ``bf16_shadow`` (PERF.md round 7: CPU measures the mega-rewrites at
+    parity-to-worse; accelerators are where the bandwidth/compute ratios
+    pay) — and stays ``"replicated"`` on CPU or single-replica meshes.
+    """
+    if mode not in UPDATE_SHARDING_MODES:
+        raise ValueError(
+            f"update_sharding must be one of {UPDATE_SHARDING_MODES}, "
+            f"got {mode!r}"
+        )
+    if mode != "auto":
+        return mode
+    if zero1:
+        return "zero1"
+    if backend is None:
+        backend = jax.default_backend()
+    if backend != "cpu" and n_data > 1:
+        return "full"
+    return "replicated"
+
+
+def update_sharding_status(mode: str, mesh: Optional[Mesh] = None) -> str:
+    """Honest-labeling string for bench records / ``info --probe``: what
+    the update phase ACTUALLY does, the same discipline as
+    ``fused_update``'s label — a single-replica mesh must not masquerade
+    as a sharded update."""
+    n_data = int(mesh.shape["data"]) if mesh is not None else 1
+    if mode == "replicated" or n_data <= 1:
+        degenerate = mode != "replicated" and n_data <= 1
+        return "replicated" + (
+            f" ({mode} degenerates: 1 data rank)" if degenerate else ""
+        )
+    if mode == "zero1":
+        return f"zero1 (state sharded {n_data}-way, apply placement free)"
+    return (
+        f"full (state + apply sharded {n_data}-way, params allgathered)"
+    )
+
+
+def _mode_of(zero1_or_mode: Any) -> str:
+    """Accept the legacy bool OR a resolved mode string."""
+    if isinstance(zero1_or_mode, str):
+        if zero1_or_mode == "auto":
+            raise ValueError(
+                "update_sharding 'auto' must be resolved before use "
+                "(resolve_update_sharding)"
+            )
+        if zero1_or_mode not in UPDATE_SHARDING_MODES:
+            raise ValueError(
+                f"unknown update_sharding mode {zero1_or_mode!r}"
+            )
+        return zero1_or_mode
+    return "zero1" if zero1_or_mode else "replicated"
+
+
+def _constrain_owner_shards(tree: Any, mesh: Mesh) -> Any:
+    """with_sharding_constraint every leaf to its owner-shard spec."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.with_sharding_constraint(x, zero1_spec(x, mesh)),
+        tree,
+    )
+
+
+def _constrain_replicated(tree: Any, mesh: Mesh) -> Any:
+    repl_sh = replicated(mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.with_sharding_constraint(x, repl_sh), tree
+    )
+
+
+def shard_opt_state(opt_state: Any, mesh: Mesh, zero1: Any) -> Any:
+    """Place optimizer state per mode (bool = legacy ZeRO-1 alias):
+    sharded over the data axis for ``"zero1"``/``"full"``, replicated
+    otherwise. Input leaves may be host arrays from ANY saved mesh shape
+    (the checkpoint's canonical unsharded layout) — placement here is
+    what re-shards a resumed state to the CURRENT mesh."""
+    if _mode_of(zero1) == "replicated":
         return jax.device_put(opt_state, replicated(mesh))
     return jax.tree_util.tree_map(
         lambda leaf: jax.device_put(leaf, zero1_spec(leaf, mesh)), opt_state
     )
 
 
-def opt_state_shardings(opt_state: Any, mesh: Mesh, zero1: bool) -> Any:
-    if not zero1:
+def opt_state_shardings(opt_state: Any, mesh: Mesh, zero1: Any) -> Any:
+    if _mode_of(zero1) == "replicated":
         return jax.tree_util.tree_map(lambda _: replicated(mesh), opt_state)
     return jax.tree_util.tree_map(lambda leaf: zero1_spec(leaf, mesh), opt_state)
 
@@ -87,7 +202,8 @@ def make_train_step(
     mesh: Mesh,
     *,
     accumulate_gradient: int = 1,
-    zero1: bool = False,
+    zero1: Any = False,
+    update_sharding: Optional[str] = None,
     opt_state_template: Any = None,
     donate: bool = True,
     shadow: bool = False,
@@ -117,8 +233,35 @@ def make_train_step(
     the scan with the same ``jax.random.split`` chain the host performs
     at K=1, so K steps are bit-identical to K single dispatches. K is
     read from the input shape: each distinct K compiles once.
+
+    ``update_sharding``: a RESOLVED mode ("replicated" | "zero1" |
+    "full"); when None the legacy ``zero1`` bool decides. "full" shards
+    the optimizer apply itself across the data axis and allgathers the
+    updated params (module docstring) — with ``shadow=True`` the bf16
+    shadow is refreshed SHARD-LOCAL from the still-sharded new params
+    before its own allgather, so the refresh cast costs 1/n_data of the
+    work and the gather moves bf16 bytes.
     """
     accum = max(int(accumulate_gradient), 1)
+    mode = _mode_of(update_sharding if update_sharding is not None else zero1)
+    # a 1-rank data axis makes every owner-shard spec replicated: skip the
+    # constraint/barrier scaffolding entirely (bit-identical either way)
+    multi_replica = int(mesh.shape["data"]) > 1
+    full_sharded = mode == "full" and multi_replica
+    # Gradients are pinned fully replicated behind an optimization_barrier
+    # in BOTH "replicated" and "full" modes: the two programs then share an
+    # identical region up to the barrier (same all-reduce, same
+    # accumulation order), which is what makes full == replicated hold to
+    # EQUALITY rather than tolerance. "zero1" deliberately keeps its
+    # pre-knob unpinned program byte-for-byte (GSPMD placement freedom —
+    # it was never bit-compared against replicated, only rtol-tested).
+    pin_grads = multi_replica and mode in ("replicated", "full")
+
+    def _to_owner_shards(tree):
+        return _constrain_owner_shards(tree, mesh)
+
+    def _to_replicated(tree):
+        return _constrain_replicated(tree, mesh)
 
     def grads_of(params, tokens, targets, rng):
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -157,19 +300,52 @@ def make_train_step(
             grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
             loss = jnp.mean(losses)
             metrics = jax.tree_util.tree_map(jnp.mean, metricses)
+        if pin_grads:
+            # pin the all-reduced grads REPLICATED and fence them: XLA must
+            # not rewrite the gradient all-reduce into a reduce-scatter
+            # (a different accumulation order drifts last-ulp values), and
+            # any global reduction inside the optimizer (grad-clip norm)
+            # then sees the identical full arrays — the two properties the
+            # full==replicated equality test stands on
+            grads = jax.lax.optimization_barrier(_to_replicated(grads))
+        upd_params = _to_owner_shards(params) if full_sharded else params
         if applies_updates:
             # fused path (ops/fused_update.py): the whole optimizer chain
             # plus apply_updates in one traversal
-            new_params, new_opt_state = tx.update(grads, opt_state, params)
+            new_params, new_opt_state = tx.update(grads, opt_state, upd_params)
         else:
-            updates, new_opt_state = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
-        new_shadow = (
-            refresh_shadow(new_params, shadow_t)
-            if shadow_t is not None
-            else None
-        )
-        grad_norm = optax.global_norm(grads)
+            updates, new_opt_state = tx.update(grads, opt_state, upd_params)
+            if full_sharded:
+                updates = _to_owner_shards(updates)
+            new_params = optax.apply_updates(upd_params, updates)
+        if full_sharded:
+            # shard-local results; the shadow refresh happens PRE-allgather
+            # (each rank casts only its owned shard, and the gather moves
+            # bf16 bytes); then the ONE allgather returns the updated
+            # params to the replicated data-parallel layout
+            new_params = _to_owner_shards(new_params)
+            new_shadow = None
+            if shadow_t is not None:
+                new_shadow = _to_replicated(
+                    _to_owner_shards(refresh_shadow(new_params, shadow_t))
+                )
+            new_params = _to_replicated(new_params)
+        else:
+            new_shadow = (
+                refresh_shadow(new_params, shadow_t)
+                if shadow_t is not None
+                else None
+            )
+        if pin_grads:
+            # same partitioner-proof reduction the fused clip uses, so the
+            # reported norm is identical across modes and mesh shapes (the
+            # free-floating optax.global_norm compiles to a different
+            # accumulation order per program — ops/fused_update.py)
+            from ..ops.fused_update import stable_global_norm
+
+            grad_norm = stable_global_norm(grads)
+        else:
+            grad_norm = optax.global_norm(grads)
         metrics = dict(metrics)
         metrics["grad_norm"] = grad_norm
         return new_params, new_opt_state, new_shadow, loss, metrics
@@ -222,7 +398,7 @@ def make_train_step(
     batch_dims = (1 if multi_dispatch else 0) + (1 if accum > 1 else 0)
     batch_shard = NamedSharding(mesh, P(*([None] * batch_dims), "data"))
     if opt_state_template is not None:
-        opt_sh: Any = opt_state_shardings(opt_state_template, mesh, zero1)
+        opt_sh: Any = opt_state_shardings(opt_state_template, mesh, mode)
     else:
         opt_sh = repl  # prefix: whole subtree replicated
 
@@ -268,6 +444,79 @@ def make_train_step(
     run.lower = lower
     run.takes_shadow = shadow
     run.multi_dispatch = multi_dispatch
+    run.update_sharding = mode
+    return run
+
+
+def make_update_only(
+    tx: Any,
+    mesh: Mesh,
+    update_sharding: Any,
+    opt_state_template: Any,
+    *,
+    donate: bool = True,
+    gather: bool = True,
+) -> Callable:
+    """Jitted optimizer-update-ONLY program (no forward/backward): takes
+    (params, opt_state, grads) and returns (params, opt_state).
+
+    This is the microbench path (``bench.py --update-only --sharded``)
+    and it shares the exact mode semantics of :func:`make_train_step`'s
+    update section — pin-the-grads barrier, owner-shard apply, final
+    allgather — so the A/B measures the program the training loop runs,
+    not a bench-only approximation. ``gather=False`` (only meaningful
+    under "full") stops BEFORE the params allgather and returns
+    owner-sharded params: the bench's isolated "apply" phase.
+    """
+    mode = _mode_of(update_sharding)
+    multi_replica = int(mesh.shape["data"]) > 1
+    full_sharded = mode == "full" and multi_replica
+    pin_grads = multi_replica and mode in ("replicated", "full")
+    applies_updates = bool(getattr(tx, "applies_updates", False))
+
+    def update(params, opt_state, grads):
+        if pin_grads:
+            grads = jax.lax.optimization_barrier(
+                _constrain_replicated(grads, mesh)
+            )
+        upd_params = (
+            _constrain_owner_shards(params, mesh) if full_sharded else params
+        )
+        if applies_updates:
+            new_params, new_opt_state = tx.update(grads, opt_state, upd_params)
+        else:
+            import optax as _optax
+
+            updates, new_opt_state = tx.update(grads, opt_state, upd_params)
+            if full_sharded:
+                updates = _constrain_owner_shards(updates, mesh)
+            new_params = _optax.apply_updates(upd_params, updates)
+        if full_sharded:
+            new_params = _constrain_owner_shards(new_params, mesh)
+            if gather:
+                new_params = _constrain_replicated(new_params, mesh)
+        return new_params, new_opt_state
+
+    repl = replicated(mesh)
+    opt_sh = opt_state_shardings(opt_state_template, mesh, mode)
+    jit_kwargs: Dict[str, Any] = {
+        "in_shardings": (repl, opt_sh, repl),
+    }
+    if gather or not full_sharded:
+        jit_kwargs["out_shardings"] = (repl, opt_sh)
+    # gather=False: no out_shardings — the in-program owner-shard
+    # constraints fully pin the (sharded) output placement
+    if donate:
+        jit_kwargs["donate_argnums"] = (0, 1)
+    jitted = jax.jit(update, **jit_kwargs)
+
+    def run(*args):
+        with pctx.use_mesh(mesh):
+            return jitted(*args)
+
+    run.mesh = mesh
+    run.update_sharding = mode
+    run.gather = gather
     return run
 
 

@@ -26,6 +26,7 @@ max_epochs = 0
 max_steps = 20000
 eval_frequency = 200
 zero1 = {zero1}
+update_sharding = "{update_sharding}"
 
 [training.optimizer]
 @optimizers = "Adam.v1"
@@ -46,10 +47,17 @@ tolerance = 0.2
 
 
 def _full(components: str, score_weights: str, accumulate_gradient: int = 1,
-          zero1: bool = False) -> str:
+          zero1: bool = False, update_sharding: str = "auto") -> str:
+    # update_sharding defaults to "auto" (arms "full" on accelerator
+    # meshes with >1 data rank, honors a zero1 alias, stays replicated on
+    # CPU); the trf preset pins "full" outright — it subsumes its old
+    # zero1=true (state sharded in both; full also shards the apply,
+    # bit-exactly vs replicated) at every mesh shape, degenerating
+    # harmlessly to replicated on one device
     return components + _TRAINING_TAIL.format(
         accumulate_gradient=accumulate_gradient,
         zero1="true" if zero1 else "false",
+        update_sharding=update_sharding,
         score_weights=score_weights,
     )
 
@@ -244,7 +252,7 @@ INIT_PRESETS = {
         _TRF_COMPONENTS,
         "tag_acc = 0.33\ndep_las = 0.33\nents_f = 0.34",
         accumulate_gradient=3,
-        zero1=True,
+        update_sharding="full",
     ),
     "spancat": _full(
         _SPANCAT_COMPONENTS,
@@ -423,10 +431,9 @@ def compose_pipeline_config(
     for comp in pipeline:
         tmpl, kwargs = COMPOSABLE[comp]
         parts.append(tmpl.format(name=comp, width=width, **kwargs))
-    zero1 = trunk == "trf"
     return _full(
         "".join(parts),
         "",  # empty: loop derives weights from component metadata
         accumulate_gradient=3 if trunk == "trf" else 1,
-        zero1=zero1,
+        update_sharding="full" if trunk == "trf" else "auto",
     )

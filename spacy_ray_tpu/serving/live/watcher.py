@@ -93,7 +93,18 @@ def scan_intact_generations(
         digests = meta.get("digests") or {}
         fnames = [f"params-{stamp}.npz"]
         if not params_only:
-            fnames.append(f"opt_state-{stamp}.pkl")
+            # format v2 (meta["format"] >= 2) shards the opt state into
+            # owner-shard part files; v1 is one pickle — keep this logic in
+            # lockstep with training/checkpoint.py:_opt_file_names (this
+            # twin stays stdlib-only, so it cannot import it)
+            if int(meta.get("format", 1) or 1) >= 2:
+                parts = int(meta.get("opt_shards", 1) or 1)
+                fnames.extend(
+                    f"opt_state-{stamp}.part{k}of{parts}.pkl"
+                    for k in range(parts)
+                )
+            else:
+                fnames.append(f"opt_state-{stamp}.pkl")
         ok = True
         for fname in fnames:
             f = path / fname

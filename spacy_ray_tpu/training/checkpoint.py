@@ -10,8 +10,22 @@ resume at all (SURVEY.md §5.4). Here both are first-class:
 * ``TrainCheckpoint``: full training state (params, optax opt_state, step,
   epoch, rng, best score/step, data position) for exact resume.
 
-Arrays are gathered to host before writing; restore re-shards by whatever
-shardings the caller puts them under.
+The on-disk layout is the CANONICAL UNSHARDED logical state: whatever mesh
+the run was sharded over, ``load()`` returns full host arrays, and resume
+re-shards them under the CURRENT mesh (``shard_opt_state`` /
+``place_replicated``) — which is what makes checkpoints mesh-shape
+portable (elastic resume: preempted at 8 devices, resume at 4 or 1).
+
+Format v2 (``meta["format"] == 2``): when the optimizer state is sharded
+on device (``update_sharding = "zero1" | "full"``), each owner shard is
+written as its own sequentially-pickled, hash-while-write part file
+(``opt_state-{stamp}.part{k}of{K}.pkl``) and the canonical layout is
+REASSEMBLED at load — the writer never materializes the full opt_state on
+one host (the old path allgathered every ZeRO-1 shard through every host
+before hashing; arXiv:2004.13336's sharded-state regime makes that the
+biggest single allocation of a save). Unsharded state (host trees, single
+device, replicated mode) keeps the v1 single-pickle layout byte-for-byte,
+and v1 generations stay loadable forever (regression-tested).
 
 Integrity + history (the resilience subsystem's torn-checkpoint story):
 every generation's files are SHA-256-stamped in its meta, the last
@@ -73,6 +87,119 @@ class _HashingWriter:
         return self._f.write(b)
 
 
+# checkpoint layout version written by TrainCheckpoint.save when the opt
+# state is device-sharded; absent/1 = the single-pickle legacy layout
+CHECKPOINT_FORMAT = 2
+
+
+def _opt_part_name(stamp: int, k: int, parts: int) -> str:
+    return f"opt_state-{int(stamp)}.part{k}of{parts}.pkl"
+
+
+def _opt_file_names(meta: Dict[str, Any], stamp: int) -> List[str]:
+    """The opt-state file names one generation's meta commits to: the v2
+    part files, or the single v1 pickle."""
+    if int(meta.get("format", 1) or 1) >= 2:
+        parts = int(meta.get("opt_shards", 1) or 1)
+        return [_opt_part_name(stamp, k, parts) for k in range(parts)]
+    return [f"opt_state-{int(stamp)}.pkl"]
+
+
+def _index_key(index: Tuple, shape: Tuple[int, ...]) -> Tuple:
+    """Normalize a shard's index (tuple of slices) into a hashable,
+    sortable, picklable ((start, stop), ...) per axis."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _shard_plan(leaves: List[Any]):
+    """Decide the save layout for a flattened opt state.
+
+    Returns None when nothing is device-sharded (v1 single-pickle path),
+    else ``(parts, infos)`` where ``infos[i]`` is None for a
+    replicated/host leaf (written once, into part 0) or a list of
+    ``(part_ordinal, shard)`` for THIS process's owned (replica-0)
+    shards — part ordinal = the shard's rank along the sharded axis, so
+    part k holds every leaf's k-th owner shard and the part count is the
+    data-axis size of the save-time mesh. The shard→part mapping is
+    derived from the arrays' own shardings; nothing here assumes which
+    mesh axis (or how many) the state was sharded over.
+    """
+    infos: List[Any] = []
+    parts = 1
+    any_sharded = False
+    for leaf in leaves:
+        sharding = getattr(leaf, "sharding", None)
+        if (
+            not isinstance(leaf, jax.Array)
+            or sharding is None
+            or sharding.is_fully_replicated
+        ):
+            infos.append(None)
+            continue
+        index_map = sharding.devices_indices_map(tuple(leaf.shape))
+        unique = sorted({_index_key(ix, leaf.shape) for ix in index_map.values()})
+        if len(unique) <= 1:
+            infos.append(None)
+            continue
+        any_sharded = True
+        parts = max(parts, len(unique))
+        ordinal_of = {key: k for k, key in enumerate(unique)}
+        owned = [
+            (ordinal_of[_index_key(s.index, leaf.shape)], s)
+            for s in leaf.addressable_shards
+            if s.replica_id == 0
+        ]
+        infos.append(owned)
+    if not any_sharded:
+        return None
+    return parts, infos
+
+
+def _exchange_part_digests(
+    local: Dict[int, str], parts: int, process_count: int
+) -> Dict[int, str]:
+    """Collect every opt-state part's SHA-256 onto every rank.
+
+    Each part is written by exactly one process (its owner-shard's
+    devices' host); rank 0 needs all of them for the meta. Encoded as a
+    fixed-shape uint8 allgather (flag byte + 32 digest bytes per part)
+    so every rank contributes the same-shaped array."""
+    if process_count == 1:
+        missing = [k for k in range(parts) if k not in local]
+        if missing:
+            raise RuntimeError(
+                f"opt-state part(s) {missing} were not written (single "
+                "process must own every shard)"
+            )
+        return dict(local)
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros((parts, 33), np.uint8)
+    for k, hexdigest in local.items():
+        buf[k, 0] = 1
+        buf[k, 1:] = np.frombuffer(bytes.fromhex(hexdigest), np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(buf)).reshape(
+        -1, parts, 33
+    )
+    out: Dict[int, str] = {}
+    for p in range(gathered.shape[0]):
+        for k in range(parts):
+            if gathered[p, k, 0]:
+                out[k] = gathered[p, k, 1:].tobytes().hex()
+    missing = [k for k in range(parts) if k not in out]
+    if missing:
+        raise RuntimeError(
+            f"no process owned opt-state part(s) {missing} — mesh/sharding "
+            "changed mid-save?"
+        )
+    return out
+
+
 def gather_to_host(tree: Any) -> Any:
     """Fetch a (possibly cross-host-sharded) pytree to host numpy.
 
@@ -125,6 +252,64 @@ def load_params(path) -> Dict[str, Any]:
     return jax.tree_util.tree_map(jnp.asarray, _unflatten(flat))
 
 
+def _assemble_opt_parts(files: List[Path]) -> Any:
+    """Reassemble a format-v2 opt state from its digest-verified part
+    files into the canonical unsharded host tree. Part 0's header carries
+    the structure skeleton; every record fills (ordinal, index) into a
+    full-shape array. Any inconsistency raises
+    :class:`CheckpointCorrupt`."""
+    skeleton = None
+    n_leaves: Optional[int] = None
+    slots: Dict[int, np.ndarray] = {}
+    for f in files:
+        try:
+            with open(f, "rb") as fh:
+                header = pickle.load(fh)
+                if not isinstance(header, dict) or "n_leaves" not in header:
+                    raise CheckpointCorrupt(
+                        f"malformed opt-state part header in {f}"
+                    )
+                n_leaves = int(header["n_leaves"])
+                if "skeleton" in header:
+                    skeleton = header["skeleton"]
+                while True:
+                    try:
+                        rec = pickle.load(fh)
+                    except EOFError:
+                        break
+                    _tag, ordinal, index, gshape, dtype, piece = rec
+                    if index is None:
+                        slots[int(ordinal)] = np.asarray(piece)
+                    else:
+                        arr = slots.get(int(ordinal))
+                        if arr is None:
+                            arr = slots[int(ordinal)] = np.empty(
+                                tuple(gshape), np.dtype(dtype)
+                            )
+                        arr[tuple(slice(a, b) for a, b in index)] = piece
+        except CheckpointCorrupt:
+            raise
+        except Exception as e:
+            raise CheckpointCorrupt(
+                f"corrupt opt-state part {f}: {type(e).__name__}: {e}"
+            ) from e
+    if skeleton is None or n_leaves is None or len(slots) != n_leaves:
+        raise CheckpointCorrupt(
+            f"opt-state parts incomplete: have {len(slots)} of "
+            f"{n_leaves if n_leaves is not None else '?'} leaves "
+            f"(skeleton {'present' if skeleton is not None else 'MISSING'})"
+        )
+    try:
+        leaves = [slots[i] for i in range(n_leaves)]
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(skeleton), leaves
+        )
+    except KeyError as e:
+        raise CheckpointCorrupt(
+            f"opt-state parts missing leaf ordinal {e}"
+        ) from e
+
+
 def _gen_stamp(meta_path: Path) -> Optional[int]:
     """Stamp encoded in a per-generation meta filename, or None."""
     name = meta_path.name
@@ -171,13 +356,29 @@ class TrainCheckpoint:
 
         Gathers/serialization happen once; only the file writes sit inside
         the transient-I/O retry (tmp + os.replace makes them idempotent).
+
+        May be called from EVERY process of a multi-host run (rank gating
+        is internal): with device-sharded opt state each process writes
+        its OWN owner-shard part files (format v2) — no allgather of the
+        full state through any host — then part digests are exchanged
+        (one small collective) and rank 0 commits params + meta. Unsharded
+        state keeps the v1 single-pickle layout, written by rank 0.
         """
         import os
 
         path = Path(path)
         keep = max(int(keep), 1)
         stamp = int(step)
-        host_opt = gather_to_host(opt_state)
+        pidx = jax.process_index()
+        pcnt = jax.process_count()
+        opt_leaves, _ = jax.tree_util.tree_flatten(opt_state)
+        plan = _shard_plan(opt_leaves)
+        host_opt = None
+        if plan is None:
+            # v1: nothing sharded on device — ONE pickle of the host tree.
+            # On multi-host this gather is a collective; every rank calls
+            # save, so every rank reaches it.
+            host_opt = gather_to_host(opt_state)
         meta = {
             "step": int(step),
             "epoch": int(epoch),
@@ -187,6 +388,75 @@ class TrainCheckpoint:
             "extra": extra or {},
             "stamp": stamp,
         }
+
+        opt_digests: Dict[str, str] = {}
+        if plan is not None:
+            parts, infos = plan
+            meta["format"] = CHECKPOINT_FORMAT
+            meta["opt_shards"] = parts
+            # structure-only skeleton: load reassembles the canonical full
+            # tree by unflattening reassembled leaves into this treedef
+            skeleton = jax.tree_util.tree_map(lambda _: 0, opt_state)
+            by_part: Dict[int, List[Tuple[int, Any, Any]]] = {}
+            for ordinal, (leaf, info) in enumerate(zip(opt_leaves, infos)):
+                if info is None:
+                    # replicated (or host) leaf: written once, by rank 0,
+                    # into part 0
+                    if pidx == 0:
+                        by_part.setdefault(0, []).append((ordinal, None, leaf))
+                else:
+                    for k, shard in info:
+                        by_part.setdefault(k, []).append(
+                            (ordinal, _index_key(shard.index, leaf.shape), shard)
+                        )
+            local_digests: Dict[int, str] = {}
+
+            def write_opt_parts() -> None:
+                maybe_fail("checkpoint-write")
+                path.mkdir(parents=True, exist_ok=True)
+                local_digests.clear()
+                for k in sorted(by_part):
+                    name = _opt_part_name(stamp, k, parts)
+                    tmp = path / (name + ".tmp")
+                    h = hashlib.sha256()
+                    with open(tmp, "wb") as f:
+                        w = _HashingWriter(f, h)
+                        header: Dict[str, Any] = {
+                            "part": k, "parts": parts,
+                            "n_leaves": len(opt_leaves), "stamp": stamp,
+                        }
+                        if k == 0:
+                            header["skeleton"] = skeleton
+                        pickle.dump(header, w)
+                        for ordinal, index, data in by_part[k]:
+                            # materialize ONE shard at a time: peak extra
+                            # host memory is a single owner shard, never
+                            # the full state
+                            piece = np.asarray(
+                                data.data if index is not None else data
+                            )
+                            pickle.dump(
+                                (
+                                    "leaf", ordinal, index,
+                                    tuple(opt_leaves[ordinal].shape),
+                                    str(piece.dtype), piece,
+                                ),
+                                w,
+                            )
+                    os.replace(tmp, path / name)
+                    local_digests[k] = h.hexdigest()
+
+            retry_io("checkpoint-write", write_opt_parts)
+            # small collective: every rank learns every part's digest so
+            # rank 0 can stamp the meta (NOT inside retry_io — a retry on
+            # one rank only would desync the collective)
+            for k, digest in _exchange_part_digests(
+                local_digests, parts, pcnt
+            ).items():
+                opt_digests[_opt_part_name(stamp, k, parts)] = digest
+
+        if pidx != 0:
+            return
 
         def write_files() -> None:
             maybe_fail("checkpoint-write")
@@ -205,19 +475,24 @@ class TrainCheckpoint:
                 params_tmp.with_suffix(params_tmp.suffix + ".npz"),
                 path / f"params-{stamp}.npz",
             )
-            opt_tmp = path / f"opt_state-{stamp}.pkl.tmp"
-            opt_hash = hashlib.sha256()
-            with open(opt_tmp, "wb") as f:
-                # the opt state is the big file under ZeRO-1 — hash it
-                # while writing instead of a second full read
-                pickle.dump(host_opt, _HashingWriter(f, opt_hash))
-            os.replace(opt_tmp, path / f"opt_state-{stamp}.pkl")
+            digests = {
+                f"params-{stamp}.npz": _sha256_file(path / f"params-{stamp}.npz"),
+            }
+            if host_opt is not None:
+                opt_tmp = path / f"opt_state-{stamp}.pkl.tmp"
+                opt_hash = hashlib.sha256()
+                with open(opt_tmp, "wb") as f:
+                    # the opt state is the big file when state is big and
+                    # unsharded — hash it while writing instead of a
+                    # second full read
+                    pickle.dump(host_opt, _HashingWriter(f, opt_hash))
+                os.replace(opt_tmp, path / f"opt_state-{stamp}.pkl")
+                digests[f"opt_state-{stamp}.pkl"] = opt_hash.hexdigest()
+            else:
+                digests.update(opt_digests)
             # load() re-hashes exactly what it is about to read, so any
             # torn/truncated byte shows up
-            meta["digests"] = {
-                f"params-{stamp}.npz": _sha256_file(path / f"params-{stamp}.npz"),
-                f"opt_state-{stamp}.pkl": opt_hash.hexdigest(),
-            }
+            meta["digests"] = digests
             text = json.dumps(meta, indent=2)
             # per-generation meta first (enables fallback), pointer last
             # (atomic commit of "this is the newest generation")
@@ -250,8 +525,10 @@ class TrainCheckpoint:
         ):
             prefix = pattern.split("*", 1)[0]
             for old in path.glob(pattern):
+                core = old.name[len(prefix):-len(suffix)]
                 try:
-                    old_stamp = int(old.name[len(prefix):-len(suffix)])
+                    # "123" (v1) or "123.part0of8" (v2 opt shard)
+                    old_stamp = int(core.split(".", 1)[0])
                 except ValueError:
                     continue
                 if old_stamp not in retained:
@@ -283,22 +560,27 @@ class TrainCheckpoint:
     @staticmethod
     def _load_generation(path: Path, meta: Dict[str, Any]) -> Dict[str, Any]:
         """Load one generation described by ``meta``; verify digests when
-        present. EVERY failure mode — missing file, torn npz/pickle, digest
-        mismatch, missing meta key — raises :class:`CheckpointCorrupt`."""
+        present. Format v2 generations reassemble the opt state's owner-
+        shard part files back into the canonical unsharded layout (the
+        caller re-shards under whatever mesh the resuming run built —
+        mesh-shape-portable by construction). EVERY failure mode — missing
+        file/part, torn npz/pickle, digest mismatch, missing meta key —
+        raises :class:`CheckpointCorrupt`."""
         import jax.numpy as jnp
 
+        fmt = int(meta.get("format", 1) or 1)
         stamp = meta.get("stamp")
         if stamp is not None:
             params_file = path / f"params-{int(stamp)}.npz"
-            opt_file = path / f"opt_state-{int(stamp)}.pkl"
+            opt_files = [path / n for n in _opt_file_names(meta, int(stamp))]
         else:  # pre-stamping checkpoints (round <= 4 layouts): no digests
             params_file = path / "params.npz"
-            opt_file = path / "opt_state.pkl"
-        for f in (params_file, opt_file):
+            opt_files = [path / "opt_state.pkl"]
+        for f in (params_file, *opt_files):
             if not f.exists():
                 raise CheckpointCorrupt(f"checkpoint file missing: {f}")
         digests = meta.get("digests") or {}
-        for f in (params_file, opt_file):
+        for f in (params_file, *opt_files):
             expect = digests.get(f.name)
             if expect is not None and _sha256_file(f) != expect:
                 raise CheckpointCorrupt(
@@ -306,8 +588,11 @@ class TrainCheckpoint:
                 )
         try:
             params = load_params(params_file)
-            with open(opt_file, "rb") as fh:
-                opt_state = pickle.load(fh)
+            if stamp is not None and fmt >= 2:
+                opt_state = _assemble_opt_parts(opt_files)
+            else:
+                with open(opt_files[0], "rb") as fh:
+                    opt_state = pickle.load(fh)
             opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
             return {
                 "params": params,
@@ -468,7 +753,10 @@ class Checkpoints:
         digests = meta.get("digests") or {}
         files = [self.path / f"params-{int(stamp)}.npz"]
         if not params_only:
-            files.append(self.path / f"opt_state-{int(stamp)}.pkl")
+            # v1 single pickle or v2 owner-shard parts — the meta says which
+            files.extend(
+                self.path / n for n in _opt_file_names(meta, int(stamp))
+            )
         for f in files:
             if not f.exists():
                 raise CheckpointCorrupt(f"checkpoint file missing: {f}")

@@ -36,9 +36,15 @@ from ..pipeline.doc import Example
 from ..pipeline.language import Pipeline
 from ..registry import registry
 from ..parallel.mesh import build_mesh
-from ..parallel.step import make_train_step, place_batch, place_replicated, shard_opt_state
+from ..parallel.step import (
+    make_train_step,
+    place_batch,
+    place_replicated,
+    resolve_update_sharding,
+    shard_opt_state,
+    update_sharding_status,
+)
 from .batcher import bucket_batch_size, bucket_length, shard_stream
-from . import checkpoint as checkpoint_mod
 from . import resilience
 from .checkpoint import CheckpointCorrupt, TrainCheckpoint
 from .resilience import ShutdownCoordinator, Watchdog, log_event, maybe_fail
@@ -61,6 +67,18 @@ DEFAULT_TRAINING = {
     "train_corpus": "corpora.train",
     "score_weights": {},
     "zero1": False,
+    # update-phase sharding over the data axis (parallel/step.py):
+    # "replicated" = every replica applies the full optimizer update;
+    # "zero1" = optimizer STATE sharded (the old zero1=true, which stays
+    # as an accepted alias); "full" = the update COMPUTATION is sharded —
+    # each replica updates only its owned param shard and the result is
+    # allgathered (arXiv 2004.13336). "auto" = honor the zero1 alias,
+    # else arm "full" on accelerators with >1 data rank and stay
+    # "replicated" on CPU/single-replica (same gating discipline as
+    # fused_update). full == replicated bit-exactly (tested), so the knob
+    # can be flipped mid-lineage; checkpoints are mesh-shape portable
+    # either way. See TUNING.md §15 for when full loses.
+    "update_sharding": "auto",
     "mesh": {},  # {"n_model": .., "n_context": .., "n_pipe": ..} axis sizes
     # batches collated + transferred ahead on a background thread (single-
     # process only; 0/1 disables). Overlaps host work with the device step.
@@ -182,6 +200,10 @@ _TRAINING_TYPES: Dict[str, Tuple[Callable[[Any], bool], str]] = {
     "train_corpus": (lambda v: isinstance(v, str), "a dotted corpus name"),
     "score_weights": (lambda v: isinstance(v, dict), "a mapping of score -> weight"),
     "zero1": (lambda v: isinstance(v, bool), "a bool"),
+    "update_sharding": (
+        lambda v: v in ("auto", "replicated", "zero1", "full"),
+        'one of "auto", "replicated", "zero1", "full"',
+    ),
     "mesh": (lambda v: isinstance(v, dict), "a mapping of mesh axis sizes"),
     "prefetch_batches": (
         lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 0,
@@ -507,6 +529,22 @@ def train(
         n_pipe=int(mesh_cfg.get("n_pipe", 1)),
     )
     n_data = mesh.shape["data"]
+    # [training] update_sharding, resolved against THIS run's mesh/backend
+    # (the zero1 bool stays as an accepted alias — parallel/step.py)
+    zero1 = bool(T.get("zero1"))
+    update_sharding = resolve_update_sharding(
+        str(T.get("update_sharding", "auto")), zero1=zero1, n_data=int(n_data)
+    )
+    if update_sharding != "replicated":
+        import logging as _logging
+
+        log_event(
+            "update-sharding",
+            f"update phase: {update_sharding_status(update_sharding, mesh)}",
+            level=_logging.INFO,
+            mode=update_sharding,
+            n_data=int(n_data),
+        )
     tx = registry.resolve(T.get("optimizer") or {"@optimizers": "Adam.v1"})
     tx = _optimizers.mask_frozen(tx, nlp.params)  # skip frozen_ leaves entirely
     # [training] fused_update: rebuild a fusable chain as one traversal
@@ -518,7 +556,15 @@ def train(
     # floor"; the same platform-gating precedent as compute_dtype="auto").
     fused_mode = str(T.get("fused_update", "auto"))
     if fused_mode == "on" or (
-        fused_mode == "auto" and jax.default_backend() != "cpu"
+        fused_mode == "auto"
+        and (
+            jax.default_backend() != "cpu"
+            # full update-sharding prefers the fused transformation even on
+            # CPU: its partitioner-proof global norm (stable_global_norm)
+            # is what guarantees full == replicated to EQUALITY; the optax
+            # chain's in-chain clip norm is at the partitioner's mercy
+            or (update_sharding == "full" and int(n_data) > 1)
+        )
     ):
         fused_tx = _optimizers.fuse_optimizer(tx)
         if fused_tx is not None:
@@ -534,11 +580,10 @@ def train(
         or {"@batchers": "spacy.batch_by_words.v1", "size": 1000, "tolerance": 0.2}
     )
     accum = max(int(T.get("accumulate_gradient") or 1), 1)
-    zero1 = bool(T.get("zero1"))
 
     params = place_replicated(nlp.params, mesh)
     opt_state = tx.init(params)
-    opt_state = shard_opt_state(opt_state, mesh, zero1)
+    opt_state = shard_opt_state(opt_state, mesh, update_sharding)
 
     rng = jax.random.PRNGKey(seed)
     step = 0
@@ -581,8 +626,22 @@ def train(
                     "same state"
                 )
         if ckpt is not None:
+            # elastic resume: the checkpoint's canonical unsharded state is
+            # re-sharded under THIS run's mesh — the save-time mesh shape
+            # (recorded in extra) does not constrain the resume shape
+            saved_mesh = (ckpt.get("extra") or {}).get("mesh") or {}
+            saved_n_data = saved_mesh.get("n_data")
+            if saved_n_data is not None and int(saved_n_data) != int(n_data):
+                log_event(
+                    "elastic-resume",
+                    f"checkpoint was written on a {saved_n_data}-replica "
+                    f"data axis; re-sharding to this run's {int(n_data)} "
+                    f"(update_sharding={update_sharding})",
+                    saved_n_data=int(saved_n_data),
+                    n_data=int(n_data),
+                )
             params = place_replicated(ckpt["params"], mesh)
-            opt_state = shard_opt_state(ckpt["opt_state"], mesh, zero1)
+            opt_state = shard_opt_state(ckpt["opt_state"], mesh, update_sharding)
             step = ckpt["step"]
             epoch = ckpt["epoch"]
             rng = ckpt["rng"]
@@ -733,12 +792,14 @@ def train(
 
     loss_fn = nlp.make_loss_fn(dropout=float(T["dropout"]))
     update = make_train_step(
-        loss_fn, tx, mesh, accumulate_gradient=accum, zero1=zero1,
+        loss_fn, tx, mesh, accumulate_gradient=accum,
+        update_sharding=update_sharding,
         opt_state_template=opt_state, shadow=shadow is not None,
     )
     update_multi = (
         make_train_step(
-            loss_fn, tx, mesh, accumulate_gradient=accum, zero1=zero1,
+            loss_fn, tx, mesh, accumulate_gradient=accum,
+            update_sharding=update_sharding,
             opt_state_template=opt_state, shadow=shadow is not None,
             multi_dispatch=True,
         )
@@ -1102,7 +1163,6 @@ def train(
         nonlocal last_saved_step
         if output_path is None or step == last_saved_step:
             return
-        host_opt = checkpoint_mod.gather_to_host(opt_state)
         # every rank's data position, gathered on EVERY process (a
         # collective — all ranks reach this in lockstep); saved by rank 0
         # so each rank can fast-forward to its own exact position on resume
@@ -1124,32 +1184,42 @@ def train(
                 .reshape(-1, 3)
                 .tolist()
             )
-        if jax.process_index() == 0:
-            TrainCheckpoint.save(
-                Path(output_path) / "last-model",
-                params=jax.device_get(params),  # raw (not averaged): resume state
-                opt_state=host_opt,
-                step=step,
-                epoch=group["cur_epoch"],
-                # post-split rng, NOT this step's subkey: resume must
-                # continue the exact rng chain the uninterrupted run
-                # would have used
-                rng=rng,
-                best_score=best_score,
-                best_step=best_step,
-                extra={
-                    # the CONSUMED group's position tags, not the (possibly
-                    # prefetched-ahead) producer counters
-                    "batches_in_epoch": group["batches_in_epoch"],
-                    "corpus_epoch": group["corpus_epoch"],
-                    **(
-                        {"per_rank_positions": per_rank_pos}
-                        if per_rank_pos is not None
-                        else {}
-                    ),
+        # called on EVERY rank: with a sharded opt state each rank writes
+        # its OWN owner-shard part files (no allgather of the full state
+        # through any host — checkpoint.py format v2); rank gating for the
+        # params/meta/pointer writes is internal to save()
+        TrainCheckpoint.save(
+            Path(output_path) / "last-model",
+            params=params,  # raw (not averaged): resume state
+            opt_state=opt_state,
+            step=step,
+            epoch=group["cur_epoch"],
+            # post-split rng, NOT this step's subkey: resume must
+            # continue the exact rng chain the uninterrupted run
+            # would have used
+            rng=rng,
+            best_score=best_score,
+            best_step=best_step,
+            extra={
+                # the CONSUMED group's position tags, not the (possibly
+                # prefetched-ahead) producer counters
+                "batches_in_epoch": group["batches_in_epoch"],
+                "corpus_epoch": group["corpus_epoch"],
+                # save-time mesh shape + resolved sharding mode: purely
+                # informational (elastic resume re-shards to the CURRENT
+                # mesh), logged when the shapes differ
+                "mesh": {
+                    "n_data": int(n_data),
+                    "update_sharding": update_sharding,
                 },
-                keep=keep_checkpoints,
-            )
+                **(
+                    {"per_rank_positions": per_rank_pos}
+                    if per_rank_pos is not None
+                    else {}
+                ),
+            },
+            keep=keep_checkpoints,
+        )
         last_saved_step = step  # on every rank: the skip must stay aligned
 
     last_consumed_epoch = epoch

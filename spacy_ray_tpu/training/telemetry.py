@@ -778,6 +778,65 @@ def device_peak_flops() -> Tuple[Optional[float], str]:
 
 
 # ----------------------------------------------------------------------
+# Update-phase attribution (grad-reduce / apply / allgather)
+# ----------------------------------------------------------------------
+
+# the three phases the weight-update step decomposes into under
+# cross-replica update sharding (arXiv 2004.13336): sum the per-replica
+# partial gradients, apply the optimizer to the owned shard, gather the
+# updated params back to the replicated layout
+UPDATE_PHASES = ("grad_reduce", "apply", "allgather")
+
+
+def update_phase_block(
+    grad_reduce_s: Optional[float],
+    apply_s: Optional[float],
+    allgather_s: Optional[float],
+    *,
+    trace: Optional["TraceBuffer"] = None,
+    t0: Optional[float] = None,
+) -> Dict[str, Any]:
+    """The canonical update-phase attribution block bench records carry.
+
+    HONESTY CONTRACT: inside the fused one-program train step the three
+    phases are not separately host-observable (XLA overlaps them); these
+    numbers come from separately-jitted phase programs (bench.py
+    ``--update-only --sharded``), so they are an attribution of where a
+    mode's time CAN go, measured in isolation — the one-program
+    ``update_seconds`` on the same record is the end-to-end truth. A
+    ``None`` phase means the mode has no such phase (e.g. no allgather
+    under replicated) and stays None rather than a fake zero.
+
+    When ``trace``/``t0`` are given, each phase is also emitted as a
+    back-to-back Chrome-trace span so a Perfetto view can show the split.
+    """
+    secs = {
+        "grad_reduce": grad_reduce_s,
+        "apply": apply_s,
+        "allgather": allgather_s,
+    }
+    block: Dict[str, Any] = {
+        f"{name}_s": (round(float(v), 6) if v is not None else None)
+        for name, v in secs.items()
+    }
+    total = sum(float(v) for v in secs.values() if v is not None)
+    block["total_s"] = round(total, 6)
+    if total > 0:
+        block["apply_share"] = round(float(secs["apply"] or 0.0) / total, 4)
+    if trace is not None and t0 is not None:
+        at = t0
+        for name in UPDATE_PHASES:
+            v = secs[name]
+            if v is None:
+                continue
+            trace.add_span(
+                f"update_{name}", at, float(v), cat="update", force=True
+            )
+            at += float(v)
+    return block
+
+
+# ----------------------------------------------------------------------
 # Anomaly detection
 # ----------------------------------------------------------------------
 

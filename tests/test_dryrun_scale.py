@@ -45,3 +45,31 @@ def test_dryrun_multichip_16_devices():
     # 2x2x2); OrderedDict reprs differ across Python versions, so accept
     # both the 3.12+ dict-style and the older pair-list form
     assert "'data': 4" in out or "('data', 4)" in out, out
+
+
+def test_dryrun_elastic_resume_16_devices():
+    """Elastic-resume matrix at the scale-out device count: 16 -> 8 -> 1
+    data ranks with full update sharding, state round-tripped through
+    owner-shard checkpoints at every mesh change, asserted bit-identical
+    to the uninterrupted same-shape-schedule run (__graft_entry__
+    dryrun_elastic_resume)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "from __graft_entry__ import dryrun_elastic_resume; "
+            "dryrun_elastic_resume(16)",
+        ],
+        cwd=str(Path(__file__).parent.parent),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    assert "dryrun_elastic_resume(16): OK" in out, out
+    assert "shapes=[16, 8, 1]" in out, out
