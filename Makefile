@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry serving fleet live bench baseline profile step-perf serve-perf update-shard dryrun
+.PHONY: test test-fast test-slow resilience telemetry observability serving fleet live bench baseline profile step-perf serve-perf update-shard dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -19,6 +19,17 @@ resilience:
 # detectors, the telemetry-enabled smoke train (docs/OBSERVABILITY.md)
 telemetry:
 	python -m pytest tests/test_telemetry.py -q
+
+# cross-process observability plane (docs/OBSERVABILITY.md): Prometheus
+# exposition golden-format + bucket merge, request-id propagation +
+# concurrent-load header equality, trace-collector clock-anchor merge,
+# slow-request exemplars, trainer /metrics endpoint, `telemetry top`,
+# serving-row summarize — then the real-fleet tracing acceptance (the
+# sigterm test carries it: one request's spans across router + replica
+# tracks in one merged Perfetto file)
+observability:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py tests/test_telemetry.py -q -m "not slow"
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -k sigterm
 
 # online-serving suite: batcher/engine/HTTP correctness under load,
 # SIGTERM graceful drain, SLO telemetry, bench records (docs/SERVING.md);

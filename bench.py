@@ -1636,10 +1636,23 @@ class _ParseSession:
     idle) is retried once on a fresh dial before counting as a failure —
     ``/v1/parse`` is pure, so the resend is safe."""
 
+    # request-id echo accounting (class-wide, reset per bench phase):
+    # every request sends a unique X-SRT-Request-Id and the response
+    # header must return the SAME id — the tracing contract verified
+    # under real load, not just in unit tests
+    echo_failures = 0
+
     def __init__(self, host: str, port: int, timeout_s: float = 30.0):
         import threading
 
+        from spacy_ray_tpu.serving.batcher import (
+            REQUEST_ID_HEADER,
+            mint_request_id,
+        )
+
         self.host, self.port, self.timeout_s = host, port, timeout_s
+        self._id_header = REQUEST_ID_HEADER
+        self._mint = mint_request_id
         self._lock = threading.Lock()
         self._idle: List[Any] = []
 
@@ -1647,7 +1660,11 @@ class _ParseSession:
         import http.client
 
         body = json.dumps({"texts": texts}).encode("utf8")
-        headers = {"Content-Type": "application/json"}
+        request_id = self._mint()
+        headers = {
+            "Content-Type": "application/json",
+            self._id_header: request_id,
+        }
         t0 = time.perf_counter()
         with self._lock:
             conn = self._idle.pop() if self._idle else None
@@ -1674,6 +1691,9 @@ class _ParseSession:
             else:
                 with self._lock:
                     self._idle.append(conn)
+            if resp.getheader(self._id_header) != request_id:
+                with self._lock:
+                    _ParseSession.echo_failures += 1
             return resp.status, time.perf_counter() - t0
 
     def close(self) -> None:
@@ -1684,6 +1704,30 @@ class _ParseSession:
                 conn.close()
             except OSError:
                 pass
+
+
+def _prometheus_scrape_lines(host: str, port: int) -> Optional[int]:
+    """GET /metrics?format=prometheus and count sample lines — the
+    bench-record proof that a standard scraper gets a real exposition
+    from the serving endpoint (None = scrape failed)."""
+    import http.client
+
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=10.0)
+        try:
+            conn.request("GET", "/metrics?format=prometheus")
+            resp = conn.getresponse()
+            text = resp.read().decode("utf8", "replace")
+        finally:
+            conn.close()
+    except OSError:
+        return None
+    if resp.status != 200:
+        return None
+    return sum(
+        1 for line in text.splitlines()
+        if line and not line.startswith("#")
+    )
 
 
 def _latency_stats(lat: List[float]) -> Dict[str, Any]:
@@ -1808,9 +1852,15 @@ def run_serving(
         # _drive_closed/_drive_open harness as the fleet specs (pooled
         # keep-alive clients), so single-engine vs fleet comparisons
         # measure the topology, not the client's connection handling.
+        _ParseSession.echo_failures = 0
         wall, counts, latencies = _drive_closed(
             host, port, duration_s, clients, texts_pool
         )
+        echo_failures = _ParseSession.echo_failures
+        # off-the-shelf scraper proof through the real listener: the
+        # exposition endpoint must answer non-trivially under the same
+        # server the load just hit
+        prom_lines = _prometheus_scrape_lines(host, port)
         occ = occupancy_snapshot(tel)
         closed_rps = counts["ok"] / wall
         rec = {
@@ -1834,6 +1884,8 @@ def run_serving(
             "max_wait_ms": max_wait_ms,
             "warmed_buckets": len(engine.warmed),
             "warmup_seconds": round(warmup_seconds, 2),
+            "request_id_echo_failures": echo_failures,
+            "prometheus_scrape_lines": prom_lines,
             **_engine_labels(engine),
             **occ,
             **_latency_stats(latencies),
@@ -1853,6 +1905,7 @@ def run_serving(
         # that phase's occupancy into this record.
         tel_open = ServingTelemetry()
         engine.tel = tel_open
+        _ParseSession.echo_failures = 0
         if open_rate:
             rate, rate_source = float(open_rate), "cli"
         else:
@@ -1886,6 +1939,7 @@ def run_serving(
             "texts_per_request": texts_per_request,
             "max_batch_docs": max_batch,
             "max_wait_ms": max_wait_ms,
+            "request_id_echo_failures": _ParseSession.echo_failures,
             **_engine_labels(engine),
             **occupancy_snapshot(tel_open),
             **_latency_stats(latencies2),

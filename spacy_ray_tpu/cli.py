@@ -148,6 +148,15 @@ def train_command(argv: List[str]) -> int:
                         help="enable telemetry: metrics.jsonl + Chrome trace "
                         "+ anomaly detectors land here (overrides "
                         "[training] metrics_dir; see docs/OBSERVABILITY.md)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        dest="metrics_port",
+                        help="serve the trainer's telemetry over HTTP on "
+                        "this port (/metrics JSON or ?format=prometheus, "
+                        "/healthz clock anchor, /trace) — requires "
+                        "telemetry on via --metrics-dir/[training] "
+                        "metrics_dir; overrides [training] metrics_port. "
+                        "Binds 127.0.0.1 unless [training] metrics_host "
+                        "(or --training.metrics_host) says otherwise")
     parser.add_argument("--verbose", "-V", action="store_true")
     args, extra = parser.parse_known_args(argv)
 
@@ -183,6 +192,7 @@ def train_command(argv: List[str]) -> int:
         resume=args.resume,
         profile_dir=args.profile,
         metrics_dir=args.metrics_dir,
+        metrics_port=args.metrics_port,
     )
     if result.interrupted:
         from .training.resilience import RC_PREEMPTED
@@ -1437,31 +1447,103 @@ def benchmark_command(argv: List[str]) -> int:
 
 
 def telemetry_command(argv: List[str]) -> int:
-    """``telemetry summarize <metrics.jsonl>`` — offline digest of a
-    telemetry run: per-stage time breakdown, step-time percentiles,
-    device gauges (HBM / compile count), anomaly digest. Reads only the
-    file — no jax, no accelerator, safe on any host."""
-    if not argv or argv[0] != "summarize":
-        print("Usage: spacy_ray_tpu telemetry summarize <metrics.jsonl>",
-              file=sys.stderr)
-        return 1
-    parser = argparse.ArgumentParser(prog="spacy_ray_tpu telemetry summarize")
-    parser.add_argument("metrics_path", type=Path,
-                        help="metrics.jsonl written by a [training] "
-                        "metrics_dir / train --metrics-dir run")
-    args = parser.parse_args(argv[1:])
+    """``telemetry`` — offline and live observability tools, all jax-free
+    (safe on any host):
 
-    from .training.telemetry import summarize_metrics
+    * ``summarize <metrics.jsonl>`` — digest a telemetry file: training
+      rows (step-time percentiles, device gauges, per-stage breakdown)
+      AND serving rows (SLO window, rejects, by-generation split),
+      anomaly digest;
+    * ``top <url>...`` — live terminal dashboard polling ``/metrics`` on
+      replica / router / trainer endpoints (req/s, window p50/p99,
+      occupancy, queue depth, generation, swap count, anomalies);
+    * ``collect-trace <url>... --out FILE`` — merge the Perfetto trace
+      buffers of router, replicas (auto-discovered from a router URL),
+      and trainer into ONE timeline file via their /healthz clock
+      anchors (docs/OBSERVABILITY.md "Distributed tracing").
+    """
+    usage = ("Usage: spacy_ray_tpu telemetry "
+             "{summarize <metrics.jsonl> | top <url>... | "
+             "collect-trace <url>... --out FILE}")
+    if not argv or argv[0] not in ("summarize", "top", "collect-trace"):
+        print(usage, file=sys.stderr)
+        return 1
+    sub, rest = argv[0], argv[1:]
+    if sub == "summarize":
+        parser = argparse.ArgumentParser(
+            prog="spacy_ray_tpu telemetry summarize"
+        )
+        parser.add_argument("metrics_path", type=Path,
+                            help="metrics.jsonl written by a [training] "
+                            "metrics_dir / train --metrics-dir run or a "
+                            "serve --metrics-dir run")
+        args = parser.parse_args(rest)
 
-    try:
-        print(summarize_metrics(args.metrics_path))
-    except OSError as e:
-        # FileNotFound, IsADirectory (passing the metrics DIR), permissions
-        print(f"Cannot read {args.metrics_path}: {e}", file=sys.stderr)
+        from .training.telemetry import summarize_metrics
+
+        try:
+            print(summarize_metrics(args.metrics_path))
+        except OSError as e:
+            # FileNotFound, IsADirectory (the metrics DIR), permissions
+            print(f"Cannot read {args.metrics_path}: {e}", file=sys.stderr)
+            return 1
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        return 0
+    if sub == "top":
+        parser = argparse.ArgumentParser(prog="spacy_ray_tpu telemetry top")
+        parser.add_argument("urls", nargs="+", metavar="URL",
+                            help="endpoint base URLs (router, replica, or "
+                            "trainer --metrics-port), e.g. "
+                            "http://127.0.0.1:8090")
+        parser.add_argument("--interval-s", type=float, default=2.0)
+        parser.add_argument("--iterations", type=int, default=None,
+                            help="stop after N refreshes (default: until "
+                            "Ctrl-C)")
+        args = parser.parse_args(rest)
+
+        from .top import run_top
+
+        return run_top(
+            args.urls, interval_s=args.interval_s,
+            iterations=args.iterations,
+        )
+    parser = argparse.ArgumentParser(
+        prog="spacy_ray_tpu telemetry collect-trace"
+    )
+    parser.add_argument("urls", nargs="+", metavar="URL",
+                        help="endpoint base URLs; a fleet router URL "
+                        "auto-discovers its replicas")
+    parser.add_argument("--out", type=Path, required=True,
+                        help="merged Chrome-trace JSON output path "
+                        "(open in ui.perfetto.dev)")
+    parser.add_argument("--no-discover", action="store_true",
+                        help="do not expand a router URL into its "
+                        "replicas")
+    args = parser.parse_args(rest)
+
+    from .serving.tracecollect import collect_fleet_traces, write_merged_trace
+
+    merged = collect_fleet_traces(args.urls, discover=not args.no_discover)
+    info = merged.get("otherData") or {}
+    if not info.get("merged_from"):
+        print(
+            "no traces collected "
+            f"(skipped: {info.get('skipped')}) — are the endpoints up "
+            "with telemetry enabled?",
+            file=sys.stderr,
+        )
         return 1
-    except ValueError as e:
-        print(str(e), file=sys.stderr)
-        return 1
+    path = write_merged_trace(merged, args.out)
+    n = sum(
+        1 for e in merged["traceEvents"] if e.get("ph") != "M"
+    )
+    print(
+        f"merged {n} event(s) from {len(info['merged_from'])} process(es) "
+        f"into {path}"
+        + (f" (skipped: {info['skipped']})" if info.get("skipped") else "")
+    )
     return 0
 
 
@@ -1602,15 +1684,28 @@ def serve_command(argv: List[str]) -> int:
     rc = server.run(warmup_engine=not args.no_warmup)
     if tel is not None and args.metrics_dir is not None:
         import json
+        import time as _time
 
         args.metrics_dir.mkdir(parents=True, exist_ok=True)
         tel.trace.flush(args.metrics_dir / "serving_trace.json")
         from .training.telemetry import sanitize_json
 
+        snap = tel.snapshot()
+        snap["generation"] = engine.serving_generation
+        snap["swap_count"] = engine.swap_count
         (args.metrics_dir / "serving_metrics.json").write_text(
-            json.dumps(sanitize_json(tel.snapshot()), indent=2) + "\n",
+            json.dumps(sanitize_json(snap), indent=2) + "\n",
             encoding="utf8",
         )
+        # the same snapshot as a `kind: "serving"` row in metrics.jsonl,
+        # so `telemetry summarize` digests serving runs with the exact
+        # file contract training runs use
+        with open(
+            args.metrics_dir / "metrics.jsonl", "a", encoding="utf8"
+        ) as f:
+            f.write(json.dumps(sanitize_json(
+                {"kind": "serving", "unix_time": _time.time(), **snap}
+            )) + "\n")
         print(f"serving telemetry written to {args.metrics_dir}", flush=True)
     if rc == 0:
         print("drained; exiting 0", flush=True)

@@ -12,7 +12,11 @@ Three layers (see each module's docstring for the contracts):
   ``/metrics``, ``/admin/swap``, ``/admin/rollback``) and SIGTERM
   graceful drain;
 * :mod:`.live` — continuous learning: checkpoint watcher, hot-swap
-  orchestration, canary guard (docs/SERVING.md "Continuous learning").
+  orchestration, canary guard (docs/SERVING.md "Continuous learning");
+* :mod:`.tracecollect` — cross-process trace collector: merges the
+  router's, every replica's, and the trainer's Perfetto buffers into
+  one timeline via /healthz clock anchors (docs/OBSERVABILITY.md
+  "Distributed request tracing").
 
 Entry point: ``spacy-ray-tpu serve <model_dir>`` (cli.py).
 """
@@ -23,10 +27,13 @@ from .batcher import (
     DynamicBatcher,
     NotReady,
     QueueFull,
+    REQUEST_ID_HEADER,
     RequestTooLarge,
     ServeRequest,
     ServingError,
     SwapFailed,
+    clean_request_id,
+    mint_request_id,
 )
 from .engine import (
     InferenceEngine,
@@ -53,6 +60,9 @@ __all__ = [
     "SwapFailed",
     "ServeRequest",
     "DynamicBatcher",
+    "REQUEST_ID_HEADER",
+    "mint_request_id",
+    "clean_request_id",
     "InferenceEngine",
     "ServingTelemetry",
     "SERVING_DEFAULTS",

@@ -43,8 +43,10 @@ Contract:
 
 from __future__ import annotations
 
+import re
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional
 
@@ -58,7 +60,37 @@ __all__ = [
     "SwapFailed",
     "ServeRequest",
     "DynamicBatcher",
+    "REQUEST_ID_HEADER",
+    "mint_request_id",
+    "clean_request_id",
 ]
+
+
+# Distributed request tracing (docs/OBSERVABILITY.md): every /v1/parse
+# request carries ONE id from the edge to the device dispatch that
+# served it. The router mints it (honoring a client-supplied header),
+# forwards it to the replica, and both echo it back in the response —
+# so a client, the router's trace, the replica's trace, and the
+# slow-request exemplar ring all name the same request the same way.
+REQUEST_ID_HEADER = "X-SRT-Request-Id"
+
+# client-supplied ids are echoed into response headers and trace args:
+# accept only sane header-token characters, bounded — anything else is
+# replaced by a minted id rather than reflected
+_REQUEST_ID_RE = re.compile(r"\A[A-Za-z0-9._:-]{1,128}\Z")  # \Z, not $:
+# $ would also match before a trailing newline, letting "id\n" echo into
+# a response header
+
+
+def mint_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def clean_request_id(raw: Optional[str]) -> Optional[str]:
+    """The validated client-supplied id, or None (caller mints)."""
+    if isinstance(raw, str) and _REQUEST_ID_RE.match(raw):
+        return raw
+    return None
 
 
 class ServingError(Exception):
@@ -132,13 +164,32 @@ class ServeRequest:
 
     __slots__ = (
         "docs", "deadline", "enqueued_at", "started_at", "dispatched_at",
-        "_done", "error", "batch_info",
+        "_done", "error", "batch_info", "request_id", "latency_s",
+        "device_s",
     )
 
-    def __init__(self, docs: List[Any], deadline: float, enqueued_at: float):
+    def __init__(
+        self,
+        docs: List[Any],
+        deadline: float,
+        enqueued_at: float,
+        request_id: Optional[str] = None,
+    ):
         self.docs = docs
         self.deadline = float(deadline)
         self.enqueued_at = float(enqueued_at)
+        # trace identity: minted at the edge (router or server) or
+        # client-supplied; every span/exemplar/response header for this
+        # request carries it
+        self.request_id = request_id or mint_request_id()
+        # admission→completion seconds, stamped by submit_docs when the
+        # wait ends (the exemplar recorder reads it after the fact)
+        self.latency_s: Optional[float] = None
+        # predict wall time of the batch this request rode in — kept on
+        # the request, NOT in batch_info: the response body must stay
+        # deterministic per (params, texts) so rollback byte-identity
+        # holds, while the exemplar breakdown still gets its device stage
+        self.device_s: Optional[float] = None
         # started_at: picked out of the queue into a batch (time-in-queue
         # ends); dispatched_at: the assembled batch is handed to the
         # device (time-to-first-dispatch ends). In window mode the gap

@@ -31,6 +31,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..training.batcher import (
@@ -146,21 +147,36 @@ class ServingTelemetry:
         process_index: int = 0,
         trace_max_events: int = 100_000,
         slo_window_s: float = SERVING_DEFAULTS["slo_window_s"],
+        exemplar_capacity: int = 64,
     ) -> None:
-        from ..training.telemetry import MetricsRegistry, TraceBuffer
+        from ..training.telemetry import (
+            LATENCY_BUCKETS,
+            OCCUPANCY_BUCKETS,
+            MetricsRegistry,
+            TraceBuffer,
+        )
 
         self.registry = MetricsRegistry(clock=clock)
         self.trace = TraceBuffer(
             clock=clock, pid=int(process_index), max_events=trace_max_events
         )
+        # the SLO histograms carry cumulative Prometheus bucket tables
+        # (telemetry.py LATENCY_BUCKETS — shared repo-wide so replica
+        # series sum exactly at the router/scraper) on top of the
+        # percentile sample ring
         self._latency = self.registry.histogram(
-            "request_latency_seconds", 2048, window_s=slo_window_s or None
+            "request_latency_seconds", 2048, window_s=slo_window_s or None,
+            buckets=LATENCY_BUCKETS,
         )
-        self._queue_wait = self.registry.histogram("queue_wait_seconds", 2048)
+        self._queue_wait = self.registry.histogram(
+            "queue_wait_seconds", 2048, buckets=LATENCY_BUCKETS
+        )
         self._dispatch_wait = self.registry.histogram(
-            "dispatch_wait_seconds", 2048
+            "dispatch_wait_seconds", 2048, buckets=LATENCY_BUCKETS
         )
-        self._occupancy = self.registry.histogram("batch_occupancy", 1024)
+        self._occupancy = self.registry.histogram(
+            "batch_occupancy", 1024, buckets=OCCUPANCY_BUCKETS
+        )
         self._queue_depth = self.registry.gauge("queue_depth")
         self._last_occ = self.registry.gauge("last_batch_occupancy")
         self._requests = self.registry.counter("requests")
@@ -183,6 +199,21 @@ class ServingTelemetry:
         self._swap_stage = self.registry.histogram("swap_stage_seconds", 256)
         self._swap_flip = self.registry.histogram("swap_flip_seconds", 256)
         self._generation = self.registry.gauge("serving_generation")
+        # slow-request exemplars (docs/OBSERVABILITY.md): a bounded ring
+        # of p99-outlier requests with their per-stage breakdown, keyed
+        # by request id — the bridge from "p99 got worse" to "THIS
+        # request spent 80ms waiting for dispatch". The threshold is the
+        # latency ring's p99, refreshed every _EXEMPLAR_REFRESH
+        # completions (sorting 2048 samples per request would be hot-path
+        # work for a diagnostic).
+        self._exemplars: "deque" = deque(maxlen=int(exemplar_capacity))
+        self._exemplar_count = self.registry.counter("slow_exemplars")
+        self._exemplar_lock = threading.Lock()
+        self._exemplar_seen = 0
+        self._exemplar_threshold: Optional[float] = None
+
+    _EXEMPLAR_REFRESH = 64
+    _EXEMPLAR_MIN_SAMPLES = 100
 
     def now(self) -> float:
         return self.trace.now()
@@ -192,7 +223,9 @@ class ServingTelemetry:
         self._docs.inc(n_docs)
         self._queue_depth.set(queue_depth)
 
-    def request_rejected(self, error: ServingError) -> None:
+    def request_rejected(
+        self, error: ServingError, request_id: Optional[str] = None
+    ) -> None:
         if isinstance(error, Draining):
             self._rej_drain.inc()
         elif isinstance(error, DeadlineExceeded):
@@ -201,9 +234,10 @@ class ServingTelemetry:
             self._rej_full.inc()
         else:
             self._errors.inc()
-        self.trace.add_instant(
-            f"reject:{error.code}", cat="serve", args={"error": str(error)}
-        )
+        args = {"error": str(error)}
+        if request_id is not None:
+            args["request_id"] = request_id
+        self.trace.add_instant(f"reject:{error.code}", cat="serve", args=args)
 
     def request_completed(
         self,
@@ -213,9 +247,10 @@ class ServingTelemetry:
         t0: Optional[float],
         error: Optional[ServingError],
         dispatch_wait_s: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> None:
         if error is not None:
-            self.request_rejected(error)
+            self.request_rejected(error, request_id)
         else:
             self._latency.observe(latency_s)
             if queue_wait_s is not None:
@@ -223,21 +258,90 @@ class ServingTelemetry:
             if dispatch_wait_s is not None:
                 self._dispatch_wait.observe(dispatch_wait_s)
         if t0 is not None:
+            args: Dict[str, Any] = {
+                "error": error.code if error is not None else None
+            }
+            if request_id is not None:
+                args["request_id"] = request_id
             self.trace.add_span(
                 "request",
                 t0,
                 max(self.now() - t0, 0.0),
                 cat="serve",
-                args={"error": error.code if error is not None else None},
+                args=args,
             )
 
-    def batch_span(self, occupancy: int, B: int, T: int):
+    def batch_span(
+        self,
+        occupancy: int,
+        B: int,
+        T: int,
+        request_ids: Optional[List[str]] = None,
+    ):
         self._batches.inc()
         self._occupancy.observe(occupancy)
         self._last_occ.set(occupancy)
-        return self.trace.span(
-            "serve_batch", cat="serve", occupancy=occupancy, B=B, T=T
-        )
+        kwargs: Dict[str, Any] = {"occupancy": occupancy, "B": B, "T": T}
+        if request_ids:
+            # a batch holds at most max_batch_docs requests — small
+            # enough to name them all, making every dispatch span
+            # attributable to the requests it served
+            kwargs["request_ids"] = request_ids
+        return self.trace.span("serve_batch", cat="serve", **kwargs)
+
+    # -- slow-request exemplars ----------------------------------------
+    def consider_exemplar(
+        self,
+        *,
+        request_id: str,
+        latency_s: float,
+        stages: Dict[str, Optional[float]],
+        **meta: Any,
+    ) -> bool:
+        """Record this completed request in the exemplar ring iff it is
+        a p99 outlier (latency STRICTLY ABOVE the latency ring's p99,
+        once at least ``_EXEMPLAR_MIN_SAMPLES`` completions exist —
+        before that there is no tail to be an outlier of). ``stages`` is
+        the per-stage breakdown (queue_wait/dispatch_wait/device/
+        serialize seconds, None = stage unobserved). Returns True when
+        recorded."""
+        with self._exemplar_lock:
+            self._exemplar_seen += 1
+            if (
+                self._exemplar_threshold is None
+                or self._exemplar_seen % self._EXEMPLAR_REFRESH == 0
+            ):
+                if self._latency.count >= self._EXEMPLAR_MIN_SAMPLES:
+                    self._exemplar_threshold = self._latency.percentile(0.99)
+            threshold = self._exemplar_threshold
+            # strictly ABOVE p99: in a flat distribution p99 equals every
+            # sample, and "everything is an outlier" is no exemplar at all
+            if threshold is None or latency_s <= threshold:
+                return False
+            self._exemplars.append(
+                {
+                    "request_id": request_id,
+                    "latency_s": round(float(latency_s), 6),
+                    "t": round(self.now(), 6),
+                    "stages": {
+                        k: (round(float(v), 6) if v is not None else None)
+                        for k, v in stages.items()
+                    },
+                    **meta,
+                }
+            )
+        self._exemplar_count.inc()
+        return True
+
+    def exemplars(self) -> Dict[str, Any]:
+        """The /admin/exemplars payload: the ring (newest last) plus the
+        threshold that admitted its members."""
+        with self._exemplar_lock:
+            return {
+                "threshold_s": self._exemplar_threshold,
+                "count": len(self._exemplars),
+                "exemplars": list(self._exemplars),
+            }
 
     def set_queue_depth(self, depth: int) -> None:
         self._queue_depth.set(depth)
@@ -423,13 +527,19 @@ class InferenceEngine:
 
     # -- submission (handler threads) -----------------------------------
     def submit_texts(
-        self, texts: Sequence[str], timeout_s: Optional[float] = None
+        self,
+        texts: Sequence[str],
+        timeout_s: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> ServeRequest:
         docs = [self.nlp.tokenizer(t) for t in texts]
-        return self.submit_docs(docs, timeout_s=timeout_s)
+        return self.submit_docs(docs, timeout_s=timeout_s, request_id=request_id)
 
     def submit_docs(
-        self, docs: List[Any], timeout_s: Optional[float] = None
+        self,
+        docs: List[Any],
+        timeout_s: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> ServeRequest:
         timeout = self.timeout_s if timeout_s is None else float(timeout_s)
         too_long = [i for i, d in enumerate(docs) if len(d) > self.max_doc_len]
@@ -439,16 +549,19 @@ class InferenceEngine:
                 "tokens (the warmed shape cap) — split or truncate"
             )
             if self.tel is not None:
-                self.tel.request_rejected(err)
+                self.tel.request_rejected(err, request_id)
             raise err
         now = self.clock()
-        req = ServeRequest(docs, deadline=now + timeout, enqueued_at=now)
+        req = ServeRequest(
+            docs, deadline=now + timeout, enqueued_at=now,
+            request_id=request_id,
+        )
         t0 = self.tel.now() if self.tel is not None else None
         try:
             self.batcher.submit(req)
         except ServingError as e:
             if self.tel is not None:
-                self.tel.request_rejected(e)
+                self.tel.request_rejected(e, req.request_id)
             raise
         if self.tel is not None:
             self.tel.request_admitted(len(docs), self.batcher.queue_depth())
@@ -456,6 +569,7 @@ class InferenceEngine:
         # is the one that times the request out, not this wait
         req.wait(timeout + 1.0)
         latency = self.clock() - req.enqueued_at
+        req.latency_s = latency
         queue_wait = (
             req.started_at - req.enqueued_at
             if req.started_at is not None
@@ -472,7 +586,8 @@ class InferenceEngine:
             )
             if self.tel is not None:
                 self.tel.request_completed(
-                    latency_s=latency, queue_wait_s=queue_wait, t0=t0, error=err
+                    latency_s=latency, queue_wait_s=queue_wait, t0=t0,
+                    error=err, request_id=req.request_id,
                 )
             raise err
         if self.tel is not None:
@@ -482,6 +597,7 @@ class InferenceEngine:
                 t0=t0,
                 error=req.error,
                 dispatch_wait_s=dispatch_wait,
+                request_id=req.request_id,
             )
         if req.error is not None:
             raise req.error
@@ -522,10 +638,12 @@ class InferenceEngine:
         dispatched_at = self.clock()  # assembly over, handed to the device
         for r in requests:
             r.dispatched_at = dispatched_at
+        request_ids = [r.request_id for r in requests]
         info = {"occupancy": n, "B": B, "T": T, "generation": generation}
+        t_dev = self.clock()
         try:
             if self.tel is not None:
-                with self.tel.batch_span(n, B, T):
+                with self.tel.batch_span(n, B, T, request_ids):
                     self.nlp.predict_docs(
                         docs, params=serve_params,
                         batch_size=n, pad_batch_to=B, pad_len_to=T,
@@ -542,13 +660,19 @@ class InferenceEngine:
                 f"dispatch of {n} docs (B={B}, T={T}) failed: "
                 f"{type(e).__name__}: {e}",
                 occupancy=n,
+                request_ids=request_ids,
             )
             err = ServingError(f"inference failed: {type(e).__name__}: {e}")
             for r in requests:
                 r.batch_info = dict(info)
                 r.complete(err)
             return
+        # the device stage of the per-request breakdown (exemplars):
+        # predict wall time for the batch this request rode in — on the
+        # request, not batch_info (the response body stays deterministic)
+        dev_s = round(self.clock() - t_dev, 6)
         for r in requests:
+            r.device_s = dev_s
             r.batch_info = dict(info)
             r.complete()
 

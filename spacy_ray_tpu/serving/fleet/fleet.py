@@ -6,7 +6,8 @@ Topology (one fleet process, N replica processes)::
             clients
                |
         RouterHTTPServer (:port)         <- this process
-         /v1/parse  /healthz  /metrics
+         /v1/parse  /healthz  /metrics[?format=prometheus]
+         /trace  /admin/exemplars
                |
         Router (least-outstanding, health-probed, retry-on-crash)
           |         |          |

@@ -163,6 +163,7 @@ class ReplicaHandle:
                 "slot": self.slot,
                 "alive": self.alive,
                 "ready": self.ready,
+                "host": self.host,
                 "port": self.port,
                 "pid": proc.pid if proc is not None else None,
                 "outstanding": self.outstanding,

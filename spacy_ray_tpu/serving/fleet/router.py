@@ -41,9 +41,16 @@ import time
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
 
 from ...training.resilience import log_event
-from ..batcher import Draining, ServingError
+from ..batcher import (
+    Draining,
+    REQUEST_ID_HEADER,
+    ServingError,
+    clean_request_id,
+    mint_request_id,
+)
 from .replica import ReplicaHandle
 
 __all__ = [
@@ -151,12 +158,16 @@ class RouterTelemetry:
         clock: Callable[[], float] = time.perf_counter,
         trace_max_events: int = 100_000,
     ) -> None:
-        from ...training.telemetry import MetricsRegistry, TraceBuffer
+        from ...training.telemetry import (
+            LATENCY_BUCKETS,
+            MetricsRegistry,
+            TraceBuffer,
+        )
 
         self.registry = MetricsRegistry(clock=clock)
         self.trace = TraceBuffer(clock=clock, max_events=trace_max_events)
         self._latency = self.registry.histogram(
-            "router_latency_seconds", 2048
+            "router_latency_seconds", 2048, buckets=LATENCY_BUCKETS
         )
         self._requests = self.registry.counter("requests")
         self._routed = self.registry.counter("routed")
@@ -166,34 +177,73 @@ class RouterTelemetry:
         self._cache_hits = self.registry.counter("cache_hits")
         self._ready = self.registry.gauge("ready_replicas")
         self._replicas = self.registry.gauge("replicas")
+        # satellite of the fleet /metrics contract: a ready replica
+        # whose scrape fails is COUNTED, not silently dropped from the
+        # aggregate — a fleet view quietly missing its slowest replica
+        # is how an SLO breach hides
+        self._scrape_failures = self.registry.counter("scrape_failures")
         # generation-split accounting: how many picks went to the canary
         # vs baseline side while a rollout was in flight — the exact
         # ratio the deterministic accumulator promises is auditable here
         self._canary_picks = self.registry.counter("routed_canary")
         self._baseline_picks = self.registry.counter("routed_baseline")
 
+    def now(self) -> float:
+        return self.trace.now()
+
     def request(self) -> None:
         self._requests.inc()
 
-    def routed(self, latency_s: float) -> None:
+    def routed(
+        self,
+        latency_s: float,
+        *,
+        request_id: Optional[str] = None,
+        t0: Optional[float] = None,
+        replica_id: Optional[int] = None,
+    ) -> None:
         self._routed.inc()
         self._latency.observe(latency_s)
+        if t0 is not None:
+            # the router-side half of the distributed request trace: one
+            # ``route`` span per forwarded request, carrying the SAME
+            # request id the replica's ``request`` span carries — the
+            # collector's merged timeline shows the hop
+            args: Dict[str, Any] = {}
+            if request_id is not None:
+                args["request_id"] = request_id
+            if replica_id is not None:
+                args["replica"] = replica_id
+            self.trace.add_span(
+                "route", t0, max(self.now() - t0, 0.0), cat="fleet",
+                args=args or None,
+            )
 
-    def retry(self, replica_id: int, error: str) -> None:
+    def retry(
+        self, replica_id: int, error: str, request_id: Optional[str] = None
+    ) -> None:
         self._retries.inc()
-        self.trace.add_instant(
-            "reroute", cat="fleet",
-            args={"replica": replica_id, "error": error},
-        )
+        args = {"replica": replica_id, "error": error}
+        if request_id is not None:
+            args["request_id"] = request_id
+        self.trace.add_instant("reroute", cat="fleet", args=args)
 
-    def rejected(self, error: ServingError) -> None:
+    def rejected(
+        self, error: ServingError, request_id: Optional[str] = None
+    ) -> None:
         if isinstance(error, Draining):
             self._rej_draining.inc()
         else:
             self._rej_no_replica.inc()
+        args = {"error": str(error)}
+        if request_id is not None:
+            args["request_id"] = request_id
         self.trace.add_instant(
-            f"reject:{error.code}", cat="fleet", args={"error": str(error)}
+            f"reject:{error.code}", cat="fleet", args=args
         )
+
+    def scrape_failed(self, replica_id: int) -> None:
+        self._scrape_failures.inc()
 
     def cache_hit(self) -> None:
         self._cache_hits.inc()
@@ -260,6 +310,11 @@ class Router:
         self._split_acc = 0.0
         self._stop = threading.Event()
         self._prober: Optional[threading.Thread] = None
+        # per-replica scrape-failure ledger (fleet /metrics): replica_id
+        # -> failed scrape count, alongside the telemetry counter — the
+        # aggregate names WHO it is missing, not just that it is missing
+        self._scrape_lock = threading.Lock()
+        self.scrape_failures: Dict[int, int] = {}
         # drain gate + in-flight accounting for the fleet's own drain
         self.draining = False
         self._inflight_lock = threading.Lock()
@@ -409,12 +464,18 @@ class Router:
 
     # -- forwarding --------------------------------------------------------
     def forward_parse(
-        self, body: bytes, timeout_s: Optional[float] = None
-    ) -> Tuple[int, bytes]:
+        self,
+        body: bytes,
+        timeout_s: Optional[float] = None,
+        request_id: Optional[str] = None,
+    ) -> Tuple[int, bytes, Optional[int]]:
         """Route one ``/v1/parse`` body: pick → forward → on socket
         failure mark the replica unready and retry on another. The retry
         budget is one attempt per distinct ready replica (+1): a body
         that fails everywhere means the fleet is down, not the request.
+        Returns ``(status, payload, replica_id)``; ``request_id`` (when
+        given) is forwarded in the ``X-SRT-Request-Id`` header so the
+        replica's spans and response carry the router's id.
 
         Replica-level HTTP errors (429/504/...) are passed through
         verbatim — they are per-replica admission decisions the client
@@ -445,6 +506,7 @@ class Router:
                     status, payload = self._post(
                         h, addr, "/v1/parse", body,
                         timeout_s or self.forward_timeout_s,
+                        request_id=request_id,
                     )
                     if status == 503 and self._replica_unavailable(payload):
                         # the replica itself says it can't take traffic
@@ -456,16 +518,20 @@ class Router:
                         )
                         self._mark_unready(h, "replica 503 draining/warming")
                         if self.tel is not None:
-                            self.tel.retry(h.replica_id, "Replica503")
+                            self.tel.retry(
+                                h.replica_id, "Replica503", request_id
+                            )
                         continue
-                    return status, payload
+                    return status, payload, h.replica_id
                 except OSError as e:
                     # crashed or restarting mid-request: out of rotation
                     # NOW; the prober re-adds it when /healthz recovers
                     last_err = e
                     self._mark_unready(h, f"forward failed: {e!r}")
                     if self.tel is not None:
-                        self.tel.retry(h.replica_id, type(e).__name__)
+                        self.tel.retry(
+                            h.replica_id, type(e).__name__, request_id
+                        )
                 finally:
                     with h.lock:
                         h.outstanding -= 1
@@ -496,7 +562,7 @@ class Router:
     @staticmethod
     def _post(
         h: ReplicaHandle, addr: Tuple[str, int], path: str, body: bytes,
-        timeout_s: float,
+        timeout_s: float, request_id: Optional[str] = None,
     ) -> Tuple[int, bytes]:
         """POST over a pooled keep-alive connection to the replica.
 
@@ -510,6 +576,8 @@ class Router:
         ``forward_parse``'s replica-level retry loop keys on).
         """
         headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers[REQUEST_ID_HEADER] = request_id
         conn = h.checkout_conn()
         while True:
             fresh = conn is None
@@ -585,21 +653,144 @@ class Router:
             deadline = time.monotonic() + self.probe_timeout_s + 1.0
             for t in threads:
                 t.join(timeout=max(deadline - time.monotonic(), 0.0))
-        return [snap for snap in results if snap is not None]
+        # snapshot the results ONCE past the join deadline: a straggler
+        # thread landing its payload after this point must not be merged
+        # while also being counted as a failure — the ledger and the
+        # aggregate have to tell the same story about who was present
+        final = list(results)
+        # a READY replica that failed its scrape is an observability
+        # gap, not a routine miss: count it per replica (and in the
+        # scrape_failures counter) so the aggregate says whose numbers
+        # it is missing instead of silently shrinking the fleet view
+        for h, snap in zip(handles, final):
+            if snap is None:
+                with self._scrape_lock:
+                    self.scrape_failures[h.replica_id] = (
+                        self.scrape_failures.get(h.replica_id, 0) + 1
+                    )
+                if self.tel is not None:
+                    self.tel.scrape_failed(h.replica_id)
+        return [snap for snap in final if snap is not None]
+
+    def scrape_failure_stats(self) -> Dict[str, int]:
+        with self._scrape_lock:
+            return {str(k): v for k, v in sorted(self.scrape_failures.items())}
+
+    def scrape_replica_exemplars(self) -> List[Dict[str, Any]]:
+        """GET /admin/exemplars from every ready replica (best-effort,
+        sequential — this is a diagnostic pull, not the hot path);
+        each replica's payload is tagged with its id."""
+        out: List[Dict[str, Any]] = []
+        for h in self.ready_handles():
+            addr = h.address
+            if addr is None:
+                continue
+            try:
+                conn = http.client.HTTPConnection(
+                    addr[0], addr[1], timeout=self.probe_timeout_s
+                )
+                try:
+                    conn.request("GET", "/admin/exemplars")
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                finally:
+                    conn.close()
+                if resp.status == 200:
+                    payload = json.loads(raw)
+                    if isinstance(payload, dict):
+                        payload["replica_id"] = h.replica_id
+                        out.append(payload)
+            except (OSError, ValueError):
+                continue
+        return out
 
     def fleet_metrics(self) -> Dict[str, Any]:
         """The aggregated /metrics payload: per-replica snapshots merged
-        into one fleet view + the router's own counters + cache stats."""
+        into one fleet view + the router's own counters + cache stats +
+        the per-replica scrape-failure ledger (a replica missing from
+        the merge is NAMED, never silently dropped)."""
         from ...training.telemetry import merge_serving_snapshots
 
         merged = merge_serving_snapshots(self.scrape_replica_metrics())
         out: Dict[str, Any] = {"fleet": merged}
         out["replicas"] = [h.describe() for h in self.replicas()]
+        out["scrape_failures"] = self.scrape_failure_stats()
         if self.tel is not None:
             out["router"] = self.tel.snapshot()
         if self.cache is not None:
             out["cache"] = self.cache.stats()
         return out
+
+    def prometheus_metrics(self) -> str:
+        """The router's ``/metrics?format=prometheus`` body, assembled
+        from three honest layers:
+
+        * per-replica serving series labeled ``replica_id`` — counters
+          and cumulative ``_bucket`` histograms are exact per replica,
+          and a scraper may sum them across replicas exactly (the
+          aggregation story Prometheus is built for);
+        * fleet-level percentile gauges from the count-weighted
+          ``merge_serving_snapshots`` view (``_worst`` alongside) —
+          percentiles do NOT sum, so the merge rule is applied here and
+          labeled as the fleet view, with the generation-split window
+          p99s carrying a ``generation`` label (the canary signal);
+        * the router's own counters/gauges under ``srt_router``,
+          including ``srt_router_replica_scrape_failures_total`` per
+          replica.
+        """
+        from ...training.prometheus import PromFamilies
+        from ...training.telemetry import merge_serving_snapshots
+
+        snaps = self.scrape_replica_metrics()
+        merged = merge_serving_snapshots(snaps)
+        fam = PromFamilies()
+        for snap in snaps:
+            labels = {"replica_id": snap.get("replica_id")}
+            fam.add_snapshot(snap, prefix="srt_serving", labels=labels)
+            gen = snap.get("generation")
+            if gen is not None:
+                fam.add("srt_serving_generation_id", "gauge", gen, labels)
+        win = merged.get("slo_window")
+        if isinstance(win, dict):
+            for q in ("p50", "p95", "p99"):
+                for suffix in ("", "_worst"):
+                    fam.add(
+                        "srt_fleet_request_latency_window_seconds",
+                        "gauge",
+                        win.get(f"request_latency_{q}{suffix}"),
+                        {
+                            "quantile": q.replace("p", "0."),
+                            "aggregate": (
+                                "worst_replica" if suffix
+                                else "count_weighted_mean"
+                            ),
+                        },
+                    )
+        by_gen = merged.get("by_generation")
+        if isinstance(by_gen, dict):
+            for gen_key, sub in sorted(by_gen.items()):
+                sub_win = (sub or {}).get("slo_window")
+                if isinstance(sub_win, dict):
+                    fam.add(
+                        "srt_fleet_generation_request_latency_window_seconds",
+                        "gauge",
+                        sub_win.get("request_latency_p99"),
+                        {"generation": gen_key, "quantile": "0.99"},
+                    )
+        if self.tel is not None:
+            fam.add_snapshot(
+                self.tel.snapshot(), prefix="srt_router"
+            )
+        for rid, n in self.scrape_failure_stats().items():
+            fam.add(
+                "srt_router_replica_scrape_failures_total", "counter", n,
+                {"replica_id": rid},
+            )
+        if self.cache is not None:
+            for key, v in self.cache.stats().items():
+                fam.add(f"srt_router_{key}", "gauge", v)
+        fam.add("srt_fleet_replicas", "gauge", merged.get("replicas"))
+        return fam.render()
 
     # -- drain -------------------------------------------------------------
     def begin_drain(self) -> None:
@@ -641,49 +832,96 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt: str, *args: Any) -> None:
         logger.debug("%s " + fmt, self.address_string(), *args)
 
-    def _reply_bytes(self, status: int, body: bytes) -> None:
+    def _reply_bytes(
+        self,
+        status: int,
+        body: bytes,
+        request_id: Optional[str] = None,
+        content_type: str = "application/json",
+    ) -> None:
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
+        if request_id is not None:
+            self.send_header(REQUEST_ID_HEADER, request_id)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
-    def _reply(self, status: int, payload: Dict[str, Any]) -> None:
-        self._reply_bytes(status, json.dumps(payload).encode("utf8"))
+    def _reply(
+        self,
+        status: int,
+        payload: Dict[str, Any],
+        request_id: Optional[str] = None,
+    ) -> None:
+        self._reply_bytes(
+            status, json.dumps(payload).encode("utf8"), request_id
+        )
 
-    def _reply_error(self, err: ServingError) -> None:
+    def _reply_error(
+        self, err: ServingError, request_id: Optional[str] = None
+    ) -> None:
         self._reply(
-            err.http_status, {"error": err.code, "message": str(err)}
+            err.http_status, {"error": err.code, "message": str(err)},
+            request_id,
         )
 
     # -- GET ------------------------------------------------------------
     def do_GET(self) -> None:  # noqa: N802
         router = self.server.router
+        parsed = urlparse(self.path)
+        self.path = parsed.path
         if self.path == "/healthz":
             replicas = [h.describe() for h in router.replicas()]
             n_ready = sum(1 for r in replicas if r["ready"])
+            payload: Dict[str, Any] = {"replicas": replicas}
+            if router.tel is not None:
+                # clock anchor for the cross-process trace collector —
+                # same contract as the replica/trainer /healthz
+                payload["anchor"] = router.tel.trace.anchor()
             if router.draining:
-                self._reply(
-                    503, {"status": "draining", "replicas": replicas}
-                )
+                self._reply(503, {"status": "draining", **payload})
             elif n_ready == 0:
                 self._reply(
                     503,
-                    {
-                        "status": "unavailable",
-                        "ready": 0,
-                        "replicas": replicas,
-                    },
+                    {"status": "unavailable", "ready": 0, **payload},
                 )
             else:
                 self._reply(
-                    200,
-                    {"status": "ok", "ready": n_ready, "replicas": replicas},
+                    200, {"status": "ok", "ready": n_ready, **payload}
                 )
         elif self.path == "/metrics":
+            fmt = (parse_qs(parsed.query).get("format") or [""])[0]
+            if fmt == "prometheus":
+                from ...training.prometheus import EXPOSITION_CONTENT_TYPE
+
+                self._reply_bytes(
+                    200,
+                    router.prometheus_metrics().encode("utf8"),
+                    content_type=EXPOSITION_CONTENT_TYPE,
+                )
+                return
             from ...training.telemetry import sanitize_json
 
             self._reply(200, sanitize_json(router.fleet_metrics()))
+        elif self.path == "/trace":
+            if router.tel is None:
+                self._reply(200, {"trace": "disabled"})
+                return
+            from ...training.telemetry import sanitize_json
+
+            payload = router.tel.trace.payload()
+            payload["anchor"] = router.tel.trace.anchor()
+            payload["role"] = "router"
+            self._reply(200, sanitize_json(payload))
+        elif self.path == "/admin/exemplars":
+            from ...training.telemetry import sanitize_json
+
+            self._reply(
+                200,
+                sanitize_json(
+                    {"replicas": router.scrape_replica_exemplars()}
+                ),
+            )
         else:
             self._reply(404, {"error": "not_found", "message": self.path})
 
@@ -708,13 +946,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if self.path != "/v1/parse":
             self._reply(404, {"error": "not_found", "message": self.path})
             return
+        # the router MINTS the fleet-wide request id (honoring a valid
+        # client-supplied one): the same id is forwarded to the replica,
+        # stamped on the router's route span, and echoed back in the
+        # response header whatever the outcome — the one key that joins
+        # client log, router trace, and replica trace
+        request_id = clean_request_id(
+            self.headers.get(REQUEST_ID_HEADER)
+        ) or mint_request_id()
         if router.tel is not None:
             router.tel.request()
         if router.draining:
             err = Draining("fleet is draining; not admitting requests")
             if router.tel is not None:
-                router.tel.rejected(err)
-            self._reply_error(err)
+                router.tel.rejected(err, request_id)
+            self._reply_error(err, request_id)
             return
         # response cache: only when enabled does the router parse JSON —
         # the disabled path stays a pure byte proxy
@@ -727,21 +973,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 if hit is not None:
                     if router.tel is not None:
                         router.tel.cache_hit()
-                    self._reply_bytes(200, hit)
+                    self._reply_bytes(200, hit, request_id)
                     return
         t0 = time.perf_counter()
+        span_t0 = router.tel.now() if router.tel is not None else None
         try:
-            status, payload = router.forward_parse(body)
+            status, payload, replica_id = router.forward_parse(
+                body, request_id=request_id
+            )
         except ServingError as e:
             if router.tel is not None:
-                router.tel.rejected(e)
-            self._reply_error(e)
+                router.tel.rejected(e, request_id)
+            self._reply_error(e, request_id)
             return
         if router.tel is not None:
-            router.tel.routed(time.perf_counter() - t0)
+            router.tel.routed(
+                time.perf_counter() - t0,
+                request_id=request_id,
+                t0=span_t0,
+                replica_id=replica_id,
+            )
         if status == 200 and cache_key is not None:
             router.cache.put(cache_key, payload)
-        self._reply_bytes(status, payload)
+        self._reply_bytes(status, payload, request_id)
 
     @staticmethod
     def _texts_from(body: bytes) -> Optional[List[str]]:

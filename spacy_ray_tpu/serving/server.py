@@ -31,11 +31,21 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
 
 from ..training.resilience import ShutdownCoordinator, log_event
-from .batcher import Draining, NotReady, ServingError, SwapFailed
+from .batcher import (
+    Draining,
+    NotReady,
+    REQUEST_ID_HEADER,
+    ServingError,
+    SwapFailed,
+    clean_request_id,
+    mint_request_id,
+)
 from .engine import InferenceEngine, ServingTelemetry
 
 __all__ = ["ServingHTTPServer", "Server"]
@@ -82,21 +92,52 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt: str, *args: Any) -> None:
         logger.debug("%s " + fmt, self.address_string(), *args)
 
-    def _reply(self, status: int, payload: Dict[str, Any]) -> None:
+    def _reply(
+        self,
+        status: int,
+        payload: Dict[str, Any],
+        request_id: Optional[str] = None,
+    ) -> None:
         body = json.dumps(payload).encode("utf8")
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
+        if request_id is not None:
+            # the trace identity rides the response on EVERY outcome —
+            # a 504 is exactly the response whose id gets looked up
+            self.send_header(REQUEST_ID_HEADER, request_id)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
-    def _reply_error(self, err: ServingError) -> None:
+    def _reply_text(self, status: int, text: str, content_type: str) -> None:
+        body = text.encode("utf8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_error(
+        self, err: ServingError, request_id: Optional[str] = None
+    ) -> None:
         self._reply(
-            err.http_status, {"error": err.code, "message": str(err)}
+            err.http_status, {"error": err.code, "message": str(err)},
+            request_id,
         )
 
     # -- GET ------------------------------------------------------------
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler naming)
+        parsed = urlparse(self.path)
+        self.path = parsed.path  # route on the bare path below
+        if self.path == "/metrics":
+            self._get_metrics(parse_qs(parsed.query))
+            return
+        if self.path == "/trace":
+            self._get_trace()
+            return
+        if self.path == "/admin/exemplars":
+            self._get_exemplars()
+            return
         if self.path == "/healthz":
             if self.server.draining:
                 self._reply(503, {"status": "draining"})
@@ -113,55 +154,124 @@ class _Handler(BaseHTTPRequestHandler):
                     },
                 )
             else:
-                self._reply(
-                    200,
-                    {
-                        "status": "ok",
-                        "pipeline": list(self.server.engine.nlp.pipe_names),
-                        "warmed_buckets": len(self.server.engine.warmed),
-                        "max_batch_docs": self.server.engine.max_batch_docs,
-                        "max_doc_len": self.server.engine.max_doc_len,
-                        # the engine's honest labels: admission discipline
-                        # and the precision the device actually runs —
-                        # operators and bench records read them here
-                        "batching": self.server.engine.batching,
-                        "precision": self.server.engine.overlay.resolved,
-                        "precision_label": self.server.engine.overlay.label,
-                        # live-serving identity: which checkpoint
-                        # generation the dispatch thread is serving (null
-                        # = the model as loaded from disk) and how many
-                        # flips got it there — the router's canary split
-                        # and the fleet's generation-tagged metrics key
-                        # on exactly this pair
-                        "generation": self.server.engine.serving_generation,
-                        "swap_count": self.server.engine.swap_count,
-                    },
-                )
-        elif self.path == "/metrics":
-            tel = self.server.tel
-            engine = self.server.engine
-            if tel is None:
-                self._reply(
-                    200,
-                    {
-                        "telemetry": "disabled",
-                        "generation": engine.serving_generation,
-                        "swap_count": engine.swap_count,
-                    },
-                )
-            else:
-                from ..training.telemetry import sanitize_json
-
-                snap = tel.snapshot()
-                # stamp the snapshot with the generation it describes:
-                # merge_serving_snapshots groups per-replica snapshots by
-                # this key, which is what makes fleet slo_window
-                # percentiles splittable by generation
-                snap["generation"] = engine.serving_generation
-                snap["swap_count"] = engine.swap_count
-                self._reply(200, sanitize_json(snap))
+                payload = {
+                    "status": "ok",
+                    "pipeline": list(self.server.engine.nlp.pipe_names),
+                    "warmed_buckets": len(self.server.engine.warmed),
+                    "max_batch_docs": self.server.engine.max_batch_docs,
+                    "max_doc_len": self.server.engine.max_doc_len,
+                    # the engine's honest labels: admission discipline
+                    # and the precision the device actually runs —
+                    # operators and bench records read them here
+                    "batching": self.server.engine.batching,
+                    "precision": self.server.engine.overlay.resolved,
+                    "precision_label": self.server.engine.overlay.label,
+                    # live-serving identity: which checkpoint
+                    # generation the dispatch thread is serving (null
+                    # = the model as loaded from disk) and how many
+                    # flips got it there — the router's canary split
+                    # and the fleet's generation-tagged metrics key
+                    # on exactly this pair
+                    "generation": self.server.engine.serving_generation,
+                    "swap_count": self.server.engine.swap_count,
+                }
+                if self.server.tel is not None:
+                    # monotonic-clock anchor for the cross-process trace
+                    # collector (docs/OBSERVABILITY.md "Distributed
+                    # tracing"): maps this replica's trace timestamps
+                    # onto the shared wall-clock timeline
+                    payload["anchor"] = self.server.tel.trace.anchor()
+                self._reply(200, payload)
         else:
             self._reply(404, {"error": "not_found", "message": self.path})
+
+    def _get_metrics(self, query: Dict[str, Any]) -> None:
+        tel = self.server.tel
+        engine = self.server.engine
+        fmt = (query.get("format") or [""])[0]
+        if tel is None:
+            if fmt == "prometheus":
+                from ..training.prometheus import EXPOSITION_CONTENT_TYPE
+
+                # comment-only exposition: a scraper sees an honest
+                # empty scrape, and the disabled path still constructs
+                # zero telemetry objects (test-enforced)
+                self._reply_text(
+                    200, "# srt telemetry disabled\n",
+                    EXPOSITION_CONTENT_TYPE,
+                )
+                return
+            self._reply(
+                200,
+                {
+                    "telemetry": "disabled",
+                    "generation": engine.serving_generation,
+                    "swap_count": engine.swap_count,
+                },
+            )
+            return
+        from ..training.telemetry import sanitize_json
+
+        snap = tel.snapshot()
+        # stamp the snapshot with the generation it describes:
+        # merge_serving_snapshots groups per-replica snapshots by
+        # this key, which is what makes fleet slo_window
+        # percentiles splittable by generation
+        snap["generation"] = engine.serving_generation
+        snap["swap_count"] = engine.swap_count
+        if fmt == "prometheus":
+            from ..training.prometheus import (
+                EXPOSITION_CONTENT_TYPE,
+                PromFamilies,
+            )
+
+            fam = PromFamilies()
+            fam.add_snapshot(snap, prefix="srt_serving")
+            # live-serving identity as explicit gauges (counters span
+            # generations, so the generation is NOT a label on them —
+            # it is its own series)
+            if engine.serving_generation is not None:
+                fam.add(
+                    "srt_serving_generation_id", "gauge",
+                    engine.serving_generation,
+                )
+            fam.add("srt_serving_swap_count", "gauge", engine.swap_count)
+            win = snap.get("slo_window")
+            if isinstance(win, dict):
+                for q in ("p50", "p95", "p99"):
+                    fam.add(
+                        "srt_serving_request_latency_window_seconds",
+                        "gauge",
+                        win.get(f"request_latency_{q}"),
+                        {
+                            "quantile": q.replace("p", "0."),
+                            "window_s": int(win.get("window_s") or 0),
+                        },
+                    )
+            self._reply_text(200, fam.render(), EXPOSITION_CONTENT_TYPE)
+            return
+        self._reply(200, sanitize_json(snap))
+
+    def _get_trace(self) -> None:
+        tel = self.server.tel
+        if tel is None:
+            self._reply(200, {"trace": "disabled"})
+            return
+        from ..training.telemetry import sanitize_json
+
+        payload = tel.trace.payload()
+        payload["anchor"] = tel.trace.anchor()
+        payload["role"] = "replica"
+        self._reply(200, sanitize_json(payload))
+
+    def _get_exemplars(self) -> None:
+        tel = self.server.tel
+        if tel is None:
+            self._reply(200, {"exemplars": "disabled"})
+            return
+        from ..training.telemetry import sanitize_json
+
+        self._reply(200, sanitize_json(tel.exemplars()))
 
     # -- POST -----------------------------------------------------------
     def do_POST(self) -> None:  # noqa: N802
@@ -189,19 +299,27 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/v1/parse":
             self._reply(404, {"error": "not_found", "message": self.path})
             return
+        # trace identity: honor a client/router-supplied id, mint one
+        # otherwise — every reply below (success AND typed errors)
+        # carries it back in the response header
+        request_id = clean_request_id(
+            self.headers.get(REQUEST_ID_HEADER)
+        ) or mint_request_id()
         if self.server.draining:
-            self._reply_error(Draining("server is draining"))
+            self._reply_error(Draining("server is draining"), request_id)
             return
         if not self.server.engine.ready:
             self._reply_error(
-                NotReady("bucket warmup in progress; not admitting yet")
+                NotReady("bucket warmup in progress; not admitting yet"),
+                request_id,
             )
             return
         try:
             payload = json.loads(body or b"{}")
         except ValueError:
             self._reply(
-                400, {"error": "bad_request", "message": "body is not JSON"}
+                400, {"error": "bad_request", "message": "body is not JSON"},
+                request_id,
             )
             return
         texts = payload.get("texts") if isinstance(payload, dict) else None
@@ -217,6 +335,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "message": 'body must be {"texts": [<non-empty list of '
                     'strings>], "timeout_ms": optional int}',
                 },
+                request_id,
             )
             return
         timeout_s: Optional[float] = None
@@ -225,13 +344,43 @@ class _Handler(BaseHTTPRequestHandler):
         from ..training.corpus import _doc_to_json
 
         try:
-            req = self.server.engine.submit_texts(texts, timeout_s=timeout_s)
+            req = self.server.engine.submit_texts(
+                texts, timeout_s=timeout_s, request_id=request_id
+            )
         except ServingError as e:
-            self._reply_error(e)
+            self._reply_error(e, request_id)
             return
+        t_ser = time.perf_counter()
+        docs_json = [_doc_to_json(d) for d in req.docs]
+        serialize_s = time.perf_counter() - t_ser
+        tel = self.server.tel
+        if tel is not None and req.latency_s is not None:
+            # slow-request exemplar: the per-stage breakdown that turns
+            # "p99 regressed" into "this request waited HERE"
+            tel.consider_exemplar(
+                request_id=req.request_id,
+                latency_s=req.latency_s,
+                stages={
+                    "queue_wait": (
+                        req.started_at - req.enqueued_at
+                        if req.started_at is not None else None
+                    ),
+                    "dispatch_wait": (
+                        req.dispatched_at - req.enqueued_at
+                        if req.dispatched_at is not None else None
+                    ),
+                    "device": req.device_s,
+                    "serialize": serialize_s,
+                },
+                n_docs=len(req.docs),
+                B=req.batch_info.get("B"),
+                T=req.batch_info.get("T"),
+                generation=req.batch_info.get("generation"),
+            )
         self._reply(
             200,
-            {"docs": [_doc_to_json(d) for d in req.docs], "batch": req.batch_info},
+            {"docs": docs_json, "batch": req.batch_info},
+            request_id,
         )
 
 

@@ -1,0 +1,250 @@
+"""Cross-process trace collection: merge the Perfetto buffers of the
+router, every replica, and the trainer into ONE timeline file.
+
+Each process's :class:`~..training.telemetry.TraceBuffer` stamps events
+in microseconds relative to its own construction origin on its own
+monotonic clock — perfect within a process, meaningless across two. The
+bridge is the clock ANCHOR every process exposes on ``/healthz`` and
+``/trace``: one simultaneous reading ``(origin, clock_now, unix_now)``
+of the buffer's clock against the wall clock. With it, any event maps to
+wall time as ``unix_now - (clock_now - (origin + ts/1e6))`` — no shared
+clock, no clock-sync protocol, just one exchange per process (the same
+trick Ray's timeline uses to line up per-worker event logs, PAPERS.md
+arXiv:1712.05889).
+
+The merged file keeps one Chrome-trace ``pid`` (= one Perfetto process
+track group) per source process, with ``process_name`` metadata, so a
+single request's spans — router ``route`` span, replica ``request`` +
+``serve_batch`` spans, all carrying the same ``request_id`` arg — render
+as one visible hop across tracks.
+
+Stdlib-only and jax-free: the collector runs anywhere (operator laptop,
+CI) against live endpoints.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+__all__ = [
+    "merge_process_traces",
+    "fetch_json",
+    "collect_fleet_traces",
+    "write_merged_trace",
+]
+
+
+def _anchor_offset_us(anchor: Optional[Dict[str, Any]]) -> Optional[float]:
+    """Microseconds to ADD to an event's relative ``ts`` to land on the
+    unix-epoch timeline; None when the anchor is absent/malformed (the
+    process cannot be placed honestly and is skipped, not guessed)."""
+    if not isinstance(anchor, dict):
+        return None
+    try:
+        origin = float(anchor["origin"])
+        clock_now = float(anchor["clock_now"])
+        unix_now = float(anchor["unix_now"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    return (unix_now - clock_now + origin) * 1e6
+
+
+def merge_process_traces(
+    processes: List[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Merge per-process trace payloads into one Chrome-trace object.
+
+    ``processes``: ``[{"name": str, "trace": {"traceEvents": [...]},
+    "anchor": {origin, clock_now, unix_now}}, ...]``. Each source gets
+    its own ``pid`` (0..N-1 in input order) and a ``process_name``
+    metadata row; event timestamps are re-based onto one shared timeline
+    whose zero is the earliest event across all sources. Sources with a
+    missing/malformed anchor are skipped and listed under
+    ``otherData.skipped`` — misplacing a track by an unknown offset
+    would be worse than omitting it.
+    """
+    shifted: List[Tuple[int, str, List[Dict[str, Any]]]] = []
+    skipped: List[str] = []
+    merged_names: List[str] = []
+    for proc in processes:
+        name = str(proc.get("name") or f"process-{len(shifted)}")
+        offset = _anchor_offset_us(proc.get("anchor"))
+        events = list((proc.get("trace") or {}).get("traceEvents") or [])
+        if offset is None:
+            skipped.append(name)
+            continue
+        pid = len(shifted)
+        out_events: List[Dict[str, Any]] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": name},
+            }
+        ]
+        for ev in events:
+            ev = dict(ev)
+            ev["pid"] = pid
+            if ev.get("ph") != "M" and isinstance(
+                ev.get("ts"), (int, float)
+            ):
+                ev["ts"] = float(ev["ts"]) + offset
+            out_events.append(ev)
+        shifted.append((pid, name, out_events))
+        merged_names.append(name)
+    all_ts = [
+        ev["ts"]
+        for _, _, events in shifted
+        for ev in events
+        if ev.get("ph") != "M" and isinstance(ev.get("ts"), (int, float))
+    ]
+    t0 = min(all_ts) if all_ts else 0.0
+    merged_events: List[Dict[str, Any]] = []
+    for _, _, events in shifted:
+        for ev in events:
+            if ev.get("ph") != "M" and isinstance(
+                ev.get("ts"), (int, float)
+            ):
+                ev["ts"] = round(ev["ts"] - t0, 1)
+            merged_events.append(ev)
+    return {
+        "traceEvents": merged_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": merged_names,
+            "skipped": skipped,
+            "epoch_origin_us": t0,
+        },
+    }
+
+
+def fetch_json(
+    base_url: str, path: str, timeout_s: float = 10.0
+) -> Tuple[int, Any]:
+    """GET ``base_url + path``, parse JSON. Raises OSError on transport
+    failure (or an unsupported scheme — silently speaking cleartext to
+    an https:// endpoint would be worse); returns (status,
+    payload-or-None)."""
+    parsed = urlparse(base_url if "//" in base_url else f"http://{base_url}")
+    host = parsed.hostname or "127.0.0.1"
+    scheme = parsed.scheme or "http"
+    try:
+        port = parsed.port
+    except ValueError as e:  # malformed port ("…:80x0") must surface as
+        # the transport failure callers already handle, not a traceback
+        raise OSError(f"invalid port in {base_url!r}: {e}")
+    if scheme == "https":
+        conn: http.client.HTTPConnection = http.client.HTTPSConnection(
+            host, port or 443, timeout=timeout_s
+        )
+    elif scheme == "http":
+        conn = http.client.HTTPConnection(
+            host, port or 80, timeout=timeout_s
+        )
+    else:
+        raise OSError(f"unsupported URL scheme {scheme!r} in {base_url!r}")
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        raw = resp.read()
+    finally:
+        conn.close()
+    try:
+        return resp.status, json.loads(raw)
+    except ValueError:
+        return resp.status, None
+
+
+def collect_fleet_traces(
+    base_urls: List[str],
+    *,
+    discover: bool = True,
+    timeout_s: float = 10.0,
+) -> Dict[str, Any]:
+    """Fetch ``/healthz`` (anchor) + ``/trace`` from every endpoint and
+    merge. When an endpoint's ``/healthz`` carries a ``replicas`` list
+    (the fleet router) and ``discover`` is on, each addressed replica is
+    scraped too — one router URL collects the whole fleet.
+
+    Endpoints that are unreachable or report no trace (telemetry
+    disabled) are skipped and recorded in ``otherData.skipped``."""
+    # (name, base_url, discovery-phase /healthz payload or None) — the
+    # health payload is reused as the anchor fallback below, so each
+    # endpoint pays exactly one /healthz round trip
+    targets: List[Tuple[str, str, Optional[Dict[str, Any]]]] = []
+    seen: set = set()
+    for base in base_urls:
+        if base in seen:
+            continue
+        seen.add(base)
+        name = base
+        replicas: List[Dict[str, Any]] = []
+        try:
+            _, health = fetch_json(base, "/healthz", timeout_s)
+        except OSError:
+            health = None
+        if isinstance(health, dict):
+            if isinstance(health.get("replicas"), list):
+                name = f"router {base}"
+                replicas = health["replicas"]
+            elif health.get("role"):
+                name = f"{health['role']} {base}"
+            else:
+                name = f"replica {base}"
+        targets.append((name, base, health if isinstance(health, dict) else None))
+        if discover:
+            parsed = urlparse(
+                base if "//" in base else f"http://{base}"
+            )
+            for row in replicas:
+                port = row.get("port")
+                if not isinstance(port, int):
+                    continue
+                host = row.get("host") or parsed.hostname or "127.0.0.1"
+                url = f"http://{host}:{port}"
+                if url not in seen:
+                    seen.add(url)
+                    targets.append(
+                        (f"replica-{row.get('id', '?')} {url}", url, None)
+                    )
+    processes: List[Dict[str, Any]] = []
+    unreachable: List[str] = []
+    for name, base, health in targets:
+        try:
+            if health is None:
+                _, health_raw = fetch_json(base, "/healthz", timeout_s)
+                health = (
+                    health_raw if isinstance(health_raw, dict) else None
+                )
+            _, trace = fetch_json(base, "/trace", timeout_s)
+        except OSError:
+            unreachable.append(name)
+            continue
+        if not isinstance(trace, dict) or "traceEvents" not in trace:
+            unreachable.append(name)
+            continue
+        anchor = trace.get("anchor")
+        if not isinstance(anchor, dict) and health is not None:
+            anchor = health.get("anchor")
+        processes.append(
+            {"name": name, "trace": trace, "anchor": anchor}
+        )
+    merged = merge_process_traces(processes)
+    merged["otherData"]["skipped"] = sorted(
+        set(merged["otherData"]["skipped"]) | set(unreachable)
+    )
+    return merged
+
+
+def write_merged_trace(merged: Dict[str, Any], path: Path) -> Path:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(merged), encoding="utf8")
+    tmp.replace(path)
+    return path

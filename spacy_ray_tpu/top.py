@@ -1,0 +1,267 @@
+"""``telemetry top`` — a live, stdlib-only terminal dashboard over the
+observability plane's HTTP endpoints.
+
+Polls each given base URL's ``/metrics`` (the JSON form — the same
+payload the autoscaler and canary guard consume) and renders one screen
+per refresh: request rate (derived from counter deltas between polls,
+the scraper's rate() in miniature), sliding-window p50/p99, batch
+occupancy, queue depth, serving generation + swap count, typed rejects,
+scrape failures, and — for a trainer endpoint — step rate, words/s and
+the anomaly count.
+
+Design for testability (the dashboard must not need a fleet to be
+verified): the clock, the fetch function, and the output stream are all
+injected; :func:`render` is a pure rows-in/text-out function and
+:class:`TopModel` is pure delta arithmetic — unit tests drive both with
+synthetic payloads and a fake clock (tests/test_observability.py).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, TextIO
+
+__all__ = ["TopModel", "classify_payload", "render", "run_top"]
+
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def classify_payload(payload: Dict[str, Any]) -> str:
+    """Which kind of endpoint answered: ``router`` (fleet view),
+    ``trainer`` (step histograms), or ``serving`` (a single replica)."""
+    if "fleet" in payload:
+        return "router"
+    hists = payload.get("histograms") or {}
+    if "step_seconds" in hists:
+        return "trainer"
+    return "serving"
+
+
+def _get(d: Optional[Dict[str, Any]], *keys: str) -> Any:
+    cur: Any = d
+    for k in keys:
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(k)
+    return cur
+
+
+def _fmt_ms(v: Any) -> str:
+    return f"{float(v) * 1e3:7.1f}ms" if isinstance(v, (int, float)) else "      -"
+
+
+def _fmt_rate(v: Optional[float]) -> str:
+    return f"{v:7.1f}/s" if isinstance(v, (int, float)) else "      -"
+
+
+def _fmt_int(v: Any) -> str:
+    return f"{int(v):,}" if isinstance(v, (int, float)) else "-"
+
+
+class TopModel:
+    """Holds the previous poll's counters per URL and turns the current
+    poll into a display row (rates = counter deltas / elapsed)."""
+
+    def __init__(self) -> None:
+        self._prev: Dict[str, Any] = {}  # url -> (t, counters dict)
+
+    def _rates(
+        self, url: str, counters: Dict[str, Any], now: float
+    ) -> Dict[str, Optional[float]]:
+        prev = self._prev.get(url)
+        self._prev[url] = (now, dict(counters))
+        if prev is None:
+            return {}
+        t_prev, prev_counters = prev
+        dt = now - t_prev
+        if dt <= 0:
+            return {}
+        out: Dict[str, Optional[float]] = {}
+        for key, value in counters.items():
+            if isinstance(value, (int, float)) and isinstance(
+                prev_counters.get(key), (int, float)
+            ):
+                out[key] = max(float(value) - float(prev_counters[key]), 0.0) / dt
+        return out
+
+    def update(
+        self, url: str, payload: Optional[Dict[str, Any]], now: float
+    ) -> Dict[str, Any]:
+        """One endpoint's display row. ``payload`` None = unreachable."""
+        if payload is None:
+            return {"url": url, "kind": "down"}
+        kind = classify_payload(payload)
+        if kind == "router":
+            fleet = payload.get("fleet") or {}
+            counters = dict(fleet.get("counters") or {})
+            router = payload.get("router") or {}
+            for k, v in (router.get("counters") or {}).items():
+                counters[f"router.{k}"] = v
+            rates = self._rates(url, counters, now)
+            replicas = payload.get("replicas") or []
+            return {
+                "url": url,
+                "kind": kind,
+                "req_s": rates.get("router.requests"),
+                "p50": _get(fleet, "slo_window", "request_latency_p50"),
+                "p99": _get(fleet, "slo_window", "request_latency_p99"),
+                "p99_worst": _get(
+                    fleet, "slo_window", "request_latency_p99_worst"
+                ),
+                "queue_depth": _get(fleet, "gauges", "queue_depth", "sum"),
+                "occupancy": _get(
+                    fleet, "histograms", "batch_occupancy", "p50"
+                ),
+                "ready": sum(1 for r in replicas if r.get("ready")),
+                "replicas": len(replicas),
+                "generations": sorted(
+                    {
+                        str(r.get("generation"))
+                        for r in replicas if r.get("ready")
+                    }
+                ),
+                "swaps": sum(
+                    int(r.get("swap_count") or 0) for r in replicas
+                ),
+                "reject_s": (
+                    (rates.get("router.rejected_no_replica") or 0.0)
+                    + (rates.get("router.rejected_draining") or 0.0)
+                    + (rates.get("rejected_queue_full") or 0.0)
+                    + (rates.get("deadline_exceeded") or 0.0)
+                ) if rates else None,
+                "scrape_failures": sum(
+                    int(v) for v in (payload.get("scrape_failures") or {}).values()
+                ),
+            }
+        if kind == "trainer":
+            counters = payload.get("counters") or {}
+            rates = self._rates(url, counters, now)
+            hists = payload.get("histograms") or {}
+            return {
+                "url": url,
+                "kind": kind,
+                "steps_s": rates.get("steps"),
+                "words_s": rates.get("words"),
+                "step_p50": _get(hists, "step_seconds", "p50"),
+                "step_p95": _get(hists, "step_seconds", "p95"),
+                "anomalies": counters.get("anomalies"),
+                "compiles": _get(payload, "gauges", "compile_count"),
+                "hbm_peak": _get(payload, "gauges", "hbm_peak_bytes"),
+            }
+        counters = payload.get("counters") or {}
+        rates = self._rates(url, counters, now)
+        return {
+            "url": url,
+            "kind": kind,
+            "req_s": rates.get("requests"),
+            "p50": _get(payload, "slo_window", "request_latency_p50"),
+            "p99": _get(payload, "slo_window", "request_latency_p99"),
+            "queue_depth": _get(payload, "gauges", "queue_depth"),
+            "occupancy": _get(payload, "gauges", "last_batch_occupancy"),
+            "generation": payload.get("generation"),
+            "swaps": payload.get("swap_count"),
+            "reject_s": (
+                (rates.get("rejected_queue_full") or 0.0)
+                + (rates.get("rejected_draining") or 0.0)
+                + (rates.get("deadline_exceeded") or 0.0)
+            ) if rates else None,
+            "exemplars": counters.get("slow_exemplars"),
+        }
+
+
+def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
+    """Rows → one dashboard screen (pure; no I/O, no clock)."""
+    lines = [f"srt telemetry top{('  ' + now_label) if now_label else ''}"]
+    for row in rows:
+        kind = row.get("kind")
+        if kind == "down":
+            lines.append(f"  {row['url']}: UNREACHABLE")
+            continue
+        if kind == "router":
+            gens = ",".join(row.get("generations") or []) or "-"
+            lines.append(
+                f"  router  {row['url']}  "
+                f"ready {row.get('ready')}/{row.get('replicas')}"
+            )
+            lines.append(
+                f"    req {_fmt_rate(row.get('req_s'))}  "
+                f"win p50 {_fmt_ms(row.get('p50'))}  "
+                f"p99 {_fmt_ms(row.get('p99'))}  "
+                f"worst {_fmt_ms(row.get('p99_worst'))}"
+            )
+            lines.append(
+                f"    queue {_fmt_int(row.get('queue_depth'))}  "
+                f"occ p50 {_fmt_int(row.get('occupancy'))}  "
+                f"gen [{gens}]  swaps {_fmt_int(row.get('swaps'))}  "
+                f"rej {_fmt_rate(row.get('reject_s'))}  "
+                f"scrape-fail {_fmt_int(row.get('scrape_failures'))}"
+            )
+        elif kind == "trainer":
+            lines.append(f"  trainer {row['url']}")
+            lines.append(
+                f"    steps {_fmt_rate(row.get('steps_s'))}  "
+                f"words {_fmt_rate(row.get('words_s'))}  "
+                f"step p50 {_fmt_ms(row.get('step_p50'))}  "
+                f"p95 {_fmt_ms(row.get('step_p95'))}"
+            )
+            lines.append(
+                f"    anomalies {_fmt_int(row.get('anomalies'))}  "
+                f"compiles {_fmt_int(row.get('compiles'))}"
+            )
+        else:
+            lines.append(
+                f"  replica {row['url']}  "
+                f"gen {row.get('generation') if row.get('generation') is not None else '-'}"
+                f"  swaps {_fmt_int(row.get('swaps'))}"
+            )
+            lines.append(
+                f"    req {_fmt_rate(row.get('req_s'))}  "
+                f"win p50 {_fmt_ms(row.get('p50'))}  "
+                f"p99 {_fmt_ms(row.get('p99'))}  "
+                f"queue {_fmt_int(row.get('queue_depth'))}  "
+                f"occ {_fmt_int(row.get('occupancy'))}  "
+                f"rej {_fmt_rate(row.get('reject_s'))}  "
+                f"slow-exemplars {_fmt_int(row.get('exemplars'))}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def _default_fetch(url: str, timeout_s: float) -> Optional[Dict[str, Any]]:
+    from .serving.tracecollect import fetch_json
+
+    try:
+        status, payload = fetch_json(url, "/metrics", timeout_s)
+    except OSError:
+        return None
+    return payload if status == 200 and isinstance(payload, dict) else None
+
+
+def run_top(
+    urls: List[str],
+    *,
+    interval_s: float = 2.0,
+    iterations: Optional[int] = None,
+    out: TextIO = sys.stdout,
+    fetch: Callable[[str, float], Optional[Dict[str, Any]]] = _default_fetch,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+    timeout_s: float = 5.0,
+) -> int:
+    """The poll-render loop. ``iterations=None`` runs until Ctrl-C."""
+    model = TopModel()
+    n = 0
+    try:
+        while iterations is None or n < iterations:
+            now = clock()
+            rows = [model.update(u, fetch(u, timeout_s), now) for u in urls]
+            label = time.strftime("%H:%M:%S")
+            out.write(CLEAR + render(rows, now_label=label))
+            out.flush()
+            n += 1
+            if iterations is not None and n >= iterations:
+                break
+            sleep(interval_s)
+    except KeyboardInterrupt:
+        out.write("\n")
+    return 0

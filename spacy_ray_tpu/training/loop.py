@@ -118,6 +118,20 @@ DEFAULT_TRAINING = {
     # step spans are recorded only inside it (eval/checkpoint/anomaly
     # spans always record) — bounds trace size on long runs
     "trace_steps": [0, 50],
+    # trainer-side telemetry HTTP endpoint (training/telemetry_http.py):
+    # /metrics (JSON or ?format=prometheus), /healthz (trace clock
+    # anchor), /trace — the trainer's leg of the cross-process
+    # observability plane (`telemetry top`, `telemetry collect-trace`,
+    # any Prometheus scraper). 0 (default) = no listener; requires
+    # metrics_dir (the endpoint serves the telemetry objects). Process 0
+    # only, like the telemetry files.
+    "metrics_port": 0,
+    # bind address for the metrics_port listener. The loopback default
+    # is the safe posture for a laptop run; a pod trainer scraped by an
+    # off-host Prometheus/`telemetry top` sets "0.0.0.0" (or the pod
+    # interface) — without this the endpoint only ever answers same-host
+    # scrapers.
+    "metrics_host": "127.0.0.1",
     # NaN/Inf-loss, loss-spike, step-time-regression, recompile-storm
     # detectors (only active when telemetry is on); they emit through
     # log_event so anomalies land in jsonl logger rows too
@@ -246,6 +260,15 @@ _TRAINING_TYPES: Dict[str, Tuple[Callable[[Any], bool], str]] = {
         "a [start, stop] pair of ints with 0 <= start <= stop",
     ),
     "anomaly_detection": (lambda v: isinstance(v, bool), "a bool"),
+    "metrics_port": (
+        lambda v: isinstance(v, int) and not isinstance(v, bool)
+        and 0 <= v <= 65535,
+        "a TCP port int in [0, 65535] (0 disables the endpoint)",
+    ),
+    "metrics_host": (
+        lambda v: isinstance(v, str) and bool(v),
+        "a non-empty bind address string",
+    ),
     "fused_update": (
         lambda v: v in ("auto", "on", "off"),
         'one of "auto", "on", "off"',
@@ -421,6 +444,7 @@ def train(
     stdout_log: bool = True,
     profile_dir: Optional[Path] = None,
     metrics_dir: Optional[Path] = None,
+    metrics_port: Optional[int] = None,
 ) -> Tuple[Pipeline, TrainResult]:
     """Run config-driven training. Returns (pipeline, result).
 
@@ -468,9 +492,23 @@ def train(
     from contextlib import nullcontext
 
     tel = None
+    tel_http = None
     tel_dir = str(metrics_dir) if metrics_dir is not None else str(
         T.get("metrics_dir") or ""
     )
+    if not tel_dir and (
+        metrics_port or T.get("metrics_port")
+    ) and jax.process_index() == 0:
+        # the endpoint serves the telemetry objects — with telemetry off
+        # there is nothing to serve, and silently dropping an explicit
+        # --metrics-port would leave the operator's scraper getting
+        # connection-refused with no hint why
+        log_event(
+            "telemetry-endpoint-skipped",
+            "--metrics-port/[training] metrics_port is set but telemetry "
+            "is disabled (no metrics_dir) — no endpoint started; set "
+            "--metrics-dir/[training] metrics_dir to enable it",
+        )
     if tel_dir and jax.process_index() == 0:
         from .telemetry import Telemetry, program_flops
 
@@ -481,6 +519,32 @@ def train(
             anomaly_detection=bool(T.get("anomaly_detection", True)),
             process_index=jax.process_index(),
         )
+        # trainer-side scrape endpoint ([training] metrics_port /
+        # train --metrics-port): /metrics (+?format=prometheus),
+        # /healthz clock anchor, /trace — the trainer's leg of the
+        # cross-process observability plane
+        tel_port = int(
+            metrics_port if metrics_port is not None
+            else T.get("metrics_port") or 0
+        )
+        if tel_port > 0:
+            import logging as _logging
+
+            from .telemetry_http import TelemetryHTTPServer
+
+            tel_http = TelemetryHTTPServer(
+                tel,
+                host=str(T.get("metrics_host") or "127.0.0.1"),
+                port=tel_port,
+            )
+            host, bound = tel_http.start()
+            log_event(
+                "telemetry-endpoint",
+                f"trainer telemetry on http://{host}:{bound} "
+                "(/metrics, /healthz, /trace)",
+                level=_logging.INFO,
+                port=bound,
+            )
 
     def _tspan(name: str, **args: Any):
         """Span context when telemetry is on, else a free nullcontext."""
@@ -1533,6 +1597,8 @@ def train(
         if watchdog is not None:
             watchdog.stop()
         shutdown.restore()
+        if tel_http is not None:
+            tel_http.stop()
         if tel is not None:
             # flush metric rows + trace even when a step/eval raised — a
             # crashed run's timeline is exactly the one worth reading

@@ -49,9 +49,10 @@ import json
 import math
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
 from pathlib import Path
-from typing import Any, Callable, Dict, IO, List, Optional, Tuple
+from typing import Any, Callable, Dict, IO, List, Optional, Sequence, Tuple
 
 __all__ = [
     "MetricsRegistry",
@@ -59,6 +60,9 @@ __all__ = [
     "AnomalyDetectors",
     "Telemetry",
     "TPU_PEAK_BF16",
+    "LATENCY_BUCKETS",
+    "STEP_SECONDS_BUCKETS",
+    "OCCUPANCY_BUCKETS",
     "install_compile_hook",
     "compile_count",
     "sample_device_telemetry",
@@ -68,6 +72,22 @@ __all__ = [
     "summarize_metrics",
     "merge_serving_snapshots",
 ]
+
+
+# Shared Prometheus-style bucket tables (upper bounds, seconds unless
+# noted). ONE table per quantity kind, used by every registry in the
+# repo, so the cross-process exposition (replica, router, trainer) is
+# mergeable by any scraper — summing `_bucket` series only means
+# something when the boundaries agree.
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+STEP_SECONDS_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0,
+)
+OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
 
 
 # ----------------------------------------------------------------------
@@ -225,6 +245,20 @@ def merge_serving_snapshots(
                 for e in entries
                 if isinstance(e.get(q), (int, float))
             ])
+        # cumulative buckets merge EXACTLY (counts are additive) — the
+        # one fleet histogram aggregate with no approximation caveat —
+        # but only when every replica counted against the same bounds;
+        # mismatched tables are dropped rather than summed dishonestly
+        bucketed = [e.get("buckets") for e in entries if e.get("buckets")]
+        if bucketed and len(bucketed) == len(
+            [e for e in entries if e.get("count") is not None]
+        ):
+            bounds = [tuple(float(b[0]) for b in bs) for bs in bucketed]
+            if all(b == bounds[0] for b in bounds):
+                out["buckets"] = [
+                    [le, sum(float(bs[i][1]) for bs in bucketed)]
+                    for i, le in enumerate(bounds[0])
+                ]
         merged["histograms"][key] = out
 
     slo_keys = {k for snap in snaps for k in (snap.get("slo") or {})}
@@ -316,11 +350,18 @@ class _Histogram:
     8 × ``max_samples`` entries as a memory bound; at rates that
     overflow the cap within the window, the window percentiles describe
     the most recent cap-sized slice (still the freshest data).
+
+    ``buckets`` (optional ascending upper bounds) arms Prometheus-style
+    cumulative bucket counting over the WHOLE run (unlike the bounded
+    percentile ring, bucket counts never forget) — the exact thing the
+    text exposition's ``_bucket`` series needs, and the one histogram
+    aggregate that merges exactly across replicas (counts are additive;
+    percentiles are not).
     """
 
     __slots__ = (
         "_lock", "_samples", "count", "sum", "max", "min",
-        "window_s", "_clock", "_timed",
+        "window_s", "_clock", "_timed", "buckets", "_bucket_counts",
     )
 
     def __init__(
@@ -329,6 +370,7 @@ class _Histogram:
         max_samples: int = 512,
         window_s: Optional[float] = None,
         clock: Callable[[], float] = time.perf_counter,
+        buckets: Optional[Sequence[float]] = None,
     ):
         self._lock = lock
         self._samples: "deque[float]" = deque(maxlen=max_samples)
@@ -341,6 +383,14 @@ class _Histogram:
         self._timed: "deque[Tuple[float, float]]" = deque(
             maxlen=8 * max_samples
         )
+        self.buckets: Optional[Tuple[float, ...]] = (
+            tuple(sorted(float(b) for b in buckets)) if buckets else None
+        )
+        # one bin per bound plus the +Inf overflow bin; cumulated at
+        # snapshot time so observe() stays a single increment
+        self._bucket_counts: Optional[List[int]] = (
+            [0] * (len(self.buckets) + 1) if self.buckets else None
+        )
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -350,6 +400,12 @@ class _Histogram:
             self.sum += v
             self.max = v if self.max is None else max(self.max, v)
             self.min = v if self.min is None else min(self.min, v)
+            if self._bucket_counts is not None:
+                # first bound >= v (le is inclusive); beyond the last
+                # bound lands in the +Inf bin
+                self._bucket_counts[
+                    bisect_left(self.buckets, v)
+                ] += 1
             if self.window_s is not None:
                 now = self._clock()
                 self._timed.append((now, v))
@@ -390,7 +446,11 @@ class _Histogram:
             samples = sorted(self._samples)
             count, total = self.count, self.sum
             mx, mn = self.max, self.min
-        return {
+            bins = (
+                list(self._bucket_counts)
+                if self._bucket_counts is not None else None
+            )
+        snap = {
             "count": count,
             "sum": round(total, 6),
             "min": mn,
@@ -401,6 +461,15 @@ class _Histogram:
             # window and nearest-rank convention as p50/p95
             "p99": _nearest_rank(samples, 0.99),
         }
+        if bins is not None:
+            # cumulative [le, count] pairs, Prometheus convention; the
+            # +Inf bin is implicit (== count) so JSON stays finite
+            cum, pairs = 0, []
+            for le, n in zip(self.buckets, bins):
+                cum += n
+                pairs.append([le, cum])
+            snap["buckets"] = pairs
+        return snap
 
 
 class MetricsRegistry:
@@ -436,12 +505,13 @@ class MetricsRegistry:
         name: str,
         max_samples: int = 512,
         window_s: Optional[float] = None,
+        buckets: Optional[Sequence[float]] = None,
     ) -> _Histogram:
         with self._lock:
             if name not in self._histograms:
                 self._histograms[name] = _Histogram(
                     self._lock, max_samples, window_s=window_s,
-                    clock=self._clock,
+                    clock=self._clock, buckets=buckets,
                 )
             return self._histograms[name]
 
@@ -607,8 +677,23 @@ class TraceBuffer:
         with self._lock:
             return len(self._events)
 
-    def flush(self, path: Path) -> int:
-        """Write the buffer as Chrome trace JSON; returns events written."""
+    def anchor(self) -> Dict[str, float]:
+        """The clock anchor a cross-process trace collector needs to put
+        this buffer's events on a shared timeline: event timestamps are
+        microseconds relative to ``origin`` on the buffer's own monotonic
+        clock, and ``(clock_now, unix_now)`` is one simultaneous reading
+        of that clock against the wall — enough to map any event to wall
+        time without the processes sharing a clock. Exposed on each
+        process's ``/healthz`` and ``/trace``."""
+        return {
+            "origin": self._origin,
+            "clock_now": self._clock(),
+            "unix_now": time.time(),
+        }
+
+    def payload(self) -> Dict[str, Any]:
+        """The Chrome trace JSON object (thread_name metadata + events)
+        — what ``flush`` writes and what the ``/trace`` endpoints serve."""
         with self._lock:
             events = list(self._events)
             names = dict(self._tid_names)
@@ -622,16 +707,24 @@ class TraceBuffer:
             }
             for tid, tname in sorted(names.items())
         ]
-        payload = {
+        return {
             "traceEvents": meta + events,
             "displayTimeUnit": "ms",
         }
+
+    def flush(self, path: Path) -> int:
+        """Write the buffer as Chrome trace JSON; returns events written."""
+        payload = self.payload()
+        # meta rows don't count toward the caller-visible event total
+        n_events = sum(
+            1 for e in payload["traceEvents"] if e.get("ph") != "M"
+        )
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
         tmp = path.with_name(path.name + ".tmp")
         tmp.write_text(json.dumps(payload), encoding="utf8")
         tmp.replace(path)
-        return len(events)
+        return n_events
 
 
 # ----------------------------------------------------------------------
@@ -999,9 +1092,12 @@ class Telemetry:
         install_compile_hook()
         self._compiles_at_start = compile_count()
         # hot-path instruments, resolved once
-        self._step_hist = self.registry.histogram("step_seconds")
+        self._step_hist = self.registry.histogram(
+            "step_seconds", buckets=STEP_SECONDS_BUCKETS
+        )
         self._words = self.registry.counter("words")
         self._steps = self.registry.counter("steps")
+        self._anomalies = self.registry.counter("anomalies")
         self._rows: List[Dict[str, Any]] = []
         self._rows_lock = threading.Lock()
         self._last_boundary: Optional[float] = None
@@ -1018,6 +1114,7 @@ class Telemetry:
         from .resilience import log_event
 
         log_event(event, message, **fields)
+        self._anomalies.inc()
         with self._rows_lock:
             self._rows.append(
                 {"kind": "anomaly", "anomaly": event, "message": message, **fields}
@@ -1257,10 +1354,82 @@ def _fmt_bytes(n: Optional[float]) -> str:
     return f"{n:.1f}TiB"
 
 
+def _fmt_ms(v: Any) -> str:
+    return f"{float(v) * 1e3:.1f}ms" if isinstance(v, (int, float)) else "-"
+
+
+def _summarize_serving_rows(servings: List[Dict[str, Any]]) -> List[str]:
+    """The serving section of ``telemetry summarize``: built from the
+    LAST ``kind: "serving"`` row (each row is a cumulative snapshot, so
+    the newest supersedes the rest) — request/reject totals, the SLO
+    percentiles (lifetime ring AND sliding window), and per-generation
+    rows when the snapshot carries a ``by_generation`` split."""
+    last = servings[-1]
+    counters = last.get("counters") or {}
+    lines: List[str] = []
+    reqs = int(counters.get("requests") or 0)
+    rejects = {
+        k: int(counters.get(k) or 0)
+        for k in (
+            "rejected_queue_full", "rejected_draining",
+            "deadline_exceeded", "errors",
+        )
+        if counters.get(k)
+    }
+    line = (
+        f"serving: requests {reqs:,}  docs {int(counters.get('docs') or 0):,}"
+        f"  batches {int(counters.get('batches') or 0):,}"
+    )
+    if counters.get("swaps"):
+        line += f"  swaps {int(counters['swaps'])}"
+    gen = last.get("generation")
+    if gen is not None:
+        line += f"  generation {gen}"
+    lines.append(line)
+    if rejects:
+        lines.append(
+            "  rejects: "
+            + "  ".join(f"{k} {v}" for k, v in sorted(rejects.items()))
+        )
+    else:
+        lines.append("  rejects: none")
+    slo = last.get("slo") or {}
+    if slo:
+        lines.append(
+            "  latency (lifetime ring): "
+            f"p50 {_fmt_ms(slo.get('request_latency_p50'))}  "
+            f"p95 {_fmt_ms(slo.get('request_latency_p95'))}  "
+            f"p99 {_fmt_ms(slo.get('request_latency_p99'))}"
+        )
+    win = last.get("slo_window")
+    if isinstance(win, dict):
+        lines.append(
+            f"  latency (last {float(win.get('window_s') or 0):.0f}s, "
+            f"{int(win.get('samples') or 0)} sample(s)): "
+            f"p50 {_fmt_ms(win.get('request_latency_p50'))}  "
+            f"p99 {_fmt_ms(win.get('request_latency_p99'))}"
+        )
+    by_gen = last.get("by_generation")
+    if isinstance(by_gen, dict) and by_gen:
+        lines.append("  by generation:")
+        for key in sorted(by_gen):
+            sub = by_gen[key] or {}
+            sub_counters = sub.get("counters") or {}
+            sub_win = sub.get("slo_window") or {}
+            lines.append(
+                f"    gen {key:>6s}: requests "
+                f"{int(sub_counters.get('requests') or 0):,}  window p99 "
+                f"{_fmt_ms(sub_win.get('request_latency_p99'))}"
+            )
+    return lines
+
+
 def summarize_metrics(path: Path) -> str:
-    """Digest a ``metrics.jsonl``: per-stage time breakdown, step-time
-    percentiles, device gauges, anomaly digest. Pure file-in/text-out so
-    the CLI subcommand and the round-trip test share one implementation.
+    """Digest a ``metrics.jsonl``: training rows (per-stage time
+    breakdown, step-time percentiles, device gauges) AND serving rows
+    (``kind: "serving"`` snapshots: SLO window, rejects, by-generation
+    split), plus the anomaly digest. Pure file-in/text-out so the CLI
+    subcommand and the round-trip test share one implementation.
 
     Raises ValueError when the file holds no telemetry rows (a wrong
     path must not print an empty-but-plausible report)."""
@@ -1268,6 +1437,7 @@ def summarize_metrics(path: Path) -> str:
     steps: List[Dict[str, Any]] = []
     evals: List[Dict[str, Any]] = []
     anomalies: List[Dict[str, Any]] = []
+    servings: List[Dict[str, Any]] = []
     with open(path, encoding="utf8") as f:
         for line in f:
             line = line.strip()
@@ -1284,10 +1454,14 @@ def summarize_metrics(path: Path) -> str:
                 evals.append(row)
             elif kind == "anomaly":
                 anomalies.append(row)
-    if not steps and not evals and not anomalies:
+            elif kind == "serving":
+                servings.append(row)
+    if not steps and not evals and not anomalies and not servings:
         raise ValueError(f"{path} contains no telemetry rows")
 
     lines: List[str] = [f"telemetry summary: {path}"]
+    if servings:
+        lines.extend(_summarize_serving_rows(servings))
     if steps:
         durs = sorted(float(s["step_seconds"]) for s in steps)
         words = sum(int(s.get("words") or 0) for s in steps)

@@ -1,0 +1,144 @@
+"""Trainer-side telemetry HTTP endpoint: ``/metrics`` (JSON or
+Prometheus exposition), ``/healthz`` (liveness + the monotonic-clock
+anchor a cross-process trace collector needs), and ``/trace`` (the live
+Chrome-trace buffer).
+
+Serving replicas and the fleet router already answer these on their
+listener ports; the training loop has no listener — this module gives it
+one, gated behind ``[training] metrics_port`` / ``train --metrics-port``
+(0 = off, the default). With it on, the trainer becomes the third
+scrape target of the observability plane: ``telemetry top`` polls its
+step rate, a Prometheus server scrapes its counters, and ``telemetry
+collect-trace`` merges its spans into the fleet timeline — the Ray-style
+"one timeline for the whole system" view (PAPERS.md).
+
+The handler thread only READS the telemetry objects (registry snapshot,
+trace payload) — it never touches the training loop's state, so the
+endpoint adds zero work to the hot path. With telemetry disabled the
+server is never constructed at all (the loop's zero-calls contract).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from .telemetry import Telemetry, sanitize_json
+
+__all__ = ["TelemetryHTTPServer"]
+
+logger = logging.getLogger("spacy_ray_tpu.training")
+
+
+class _TelemetryHTTPD(ThreadingHTTPServer):
+    daemon_threads = True
+    tel: Telemetry
+    role: str
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: _TelemetryHTTPD
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        logger.debug("%s " + fmt, self.address_string(), *args)
+
+    def _reply_json(self, status: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(sanitize_json(payload)).encode("utf8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_text(self, status: int, text: str, content_type: str) -> None:
+        body = text.encode("utf8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler naming)
+        tel = self.server.tel
+        parsed = urlparse(self.path)
+        if parsed.path == "/healthz":
+            self._reply_json(
+                200,
+                {
+                    "status": "ok",
+                    "role": self.server.role,
+                    "anchor": tel.trace.anchor(),
+                },
+            )
+        elif parsed.path == "/metrics":
+            fmt = (parse_qs(parsed.query).get("format") or [""])[0]
+            if fmt == "prometheus":
+                from .prometheus import (
+                    EXPOSITION_CONTENT_TYPE,
+                    render_snapshot,
+                )
+
+                self._reply_text(
+                    200,
+                    render_snapshot(
+                        tel.registry.snapshot(), prefix="srt_training"
+                    ),
+                    EXPOSITION_CONTENT_TYPE,
+                )
+            else:
+                self._reply_json(200, tel.registry.snapshot())
+        elif parsed.path == "/trace":
+            payload = tel.trace.payload()
+            payload["anchor"] = tel.trace.anchor()
+            payload["role"] = self.server.role
+            self._reply_json(200, payload)
+        else:
+            self._reply_json(
+                404, {"error": "not_found", "message": parsed.path}
+            )
+
+
+class TelemetryHTTPServer:
+    """Lifecycle wrapper: ``start()`` binds and serves on a daemon
+    thread, ``stop()`` tears down. Constructed only when telemetry is on
+    AND a port is configured."""
+
+    def __init__(
+        self,
+        telemetry: Telemetry,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        role: str = "trainer",
+    ) -> None:
+        self.httpd = _TelemetryHTTPD((host, int(port)), _Handler)
+        self.httpd.tel = telemetry
+        self.httpd.role = role
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        host, port = self.httpd.server_address[:2]
+        return str(host), int(port)
+
+    def start(self) -> Tuple[str, int]:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.2},
+            name="telemetry-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self.address
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -852,9 +852,15 @@ def _wait_fleet_ready(host, port, lines, want_ready=2, timeout=240.0):
     pytest.fail(f"fleet never became ready:\n{''.join(lines)}")
 
 
-def test_fleet_sigterm_drains_all_replicas_and_exits_zero(model_dir):
-    """Acceptance: SIGTERM against the fleet — router stops admitting,
-    the in-flight request (held in a replica's 600ms coalescing window)
+def test_fleet_sigterm_drains_all_replicas_and_exits_zero(model_dir, tmp_path):
+    """Acceptance (drain + observability, one real fleet spawn): a
+    request with a known ``X-SRT-Request-Id`` through the real fleet
+    (router + 2 replica subprocesses) returns the SAME id in the
+    response header, and ``collect_fleet_traces`` against the router
+    produces ONE merged Perfetto file whose spans for that id appear on
+    the router track AND a replica track; the Prometheus endpoints
+    answer valid exposition; then SIGTERM — router stops admitting, the
+    in-flight request (held in a replica's 600ms coalescing window)
     completes with 200, every replica drains and exits 0, the fleet
     exits 0."""
     proc = _spawn_fleet(model_dir, "--max-wait-ms", "600")
@@ -873,6 +879,73 @@ def test_fleet_sigterm_drains_all_replicas_and_exits_zero(model_dir):
         status, payload = _post(host, port, {"texts": ["the cat runs"]},
                                 timeout=60.0)
         assert status == 200 and payload["docs"][0]["tags"]
+
+        # ---- distributed tracing acceptance ----
+        # client-supplied request id: echoed back by the router, and the
+        # SAME id must land in the router's and the serving replica's
+        # trace buffers
+        rid = "acceptance-req-1"
+        conn = http.client.HTTPConnection(host, port, timeout=60.0)
+        try:
+            conn.request(
+                "POST", "/v1/parse",
+                json.dumps({"texts": ["a dog runs"]}).encode("utf8"),
+                {"Content-Type": "application/json",
+                 "X-SRT-Request-Id": rid},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            assert resp.getheader("X-SRT-Request-Id") == rid
+        finally:
+            conn.close()
+
+        from spacy_ray_tpu.serving.tracecollect import (
+            collect_fleet_traces,
+            write_merged_trace,
+        )
+
+        merged = collect_fleet_traces([f"http://{host}:{port}"])
+        # router + 2 replicas on the one merged timeline
+        assert len(merged["otherData"]["merged_from"]) == 3, (
+            merged["otherData"]
+        )
+        out = write_merged_trace(merged, tmp_path / "fleet_trace.json")
+        reloaded = json.loads(out.read_text(encoding="utf8"))
+        pids_with_rid = {
+            e["pid"]
+            for e in reloaded["traceEvents"]
+            if e.get("ph") == "X"
+            and (e.get("args") or {}).get("request_id") == rid
+        }
+        rid_in_batches = {
+            e["pid"]
+            for e in reloaded["traceEvents"]
+            if e.get("ph") == "X"
+            and rid in ((e.get("args") or {}).get("request_ids") or [])
+        }
+        # the request's spans cross a process boundary: the router's
+        # `route` span and the replica's `request`/`serve_batch` spans
+        # live on DIFFERENT tracks of the one file
+        assert len(pids_with_rid | rid_in_batches) >= 2, (
+            pids_with_rid, rid_in_batches
+        )
+
+        # ---- Prometheus exposition through the real listeners ----
+        conn = http.client.HTTPConnection(host, port, timeout=30.0)
+        try:
+            conn.request("GET", "/metrics?format=prometheus")
+            resp = conn.getresponse()
+            text = resp.read().decode("utf8")
+            assert resp.status == 200
+            assert resp.getheader("Content-Type").startswith("text/plain")
+        finally:
+            conn.close()
+        assert re.search(
+            r'^srt_serving_requests_total\{replica_id="\d+"\} \d+$',
+            text, re.M,
+        ), text[:800]
+        assert "_bucket{" in text
 
         # in-flight request: sits in a replica's 600ms coalescing window
         inflight = {}

@@ -16,6 +16,7 @@ import socket
 import subprocess
 import sys
 from pathlib import Path
+from typing import Optional, Tuple
 
 import pytest
 
@@ -31,8 +32,90 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# ----------------------------------------------------------------------
+# Capability gate: multi-process CPU collectives
+# ----------------------------------------------------------------------
+#
+# The whole test needs a jax build whose CPU backend implements
+# cross-process computations (a gloo/mpi collectives layer). Builds
+# without it fail every cross-host psum with "Multiprocess computations
+# aren't implemented on the CPU backend" — an environment capability
+# gap, not a regression in the code under test, so it must read as a
+# SKIP with the probe's evidence, not as a red test every full run
+# carries. The probe is the minimal form of the capability: two real
+# processes form a jax.distributed group and run one process_allgather.
+
+_PROBE_CHILD = """
+import sys
+import jax
+rank, port = int(sys.argv[1]), sys.argv[2]
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    f"127.0.0.1:{port}", num_processes=2, process_id=rank
+)
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+multihost_utils.process_allgather(jnp.ones((1,)))
+print("COLLECTIVES_OK")
+"""
+
+_collectives_probe: Optional[Tuple[bool, str]] = None
+
+
+def multiprocess_cpu_collectives_supported() -> Tuple[bool, str]:
+    """(supported, evidence) — cached per test session; the probe costs
+    two interpreter boots + one distributed init (~30s), paid at most
+    once and only when the slow tier actually reaches this module."""
+    global _collectives_probe
+    if _collectives_probe is not None:
+        return _collectives_probe
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _PROBE_CHILD, str(rank), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out = "<probe timeout>"
+        outs.append(out)
+    ok = all(p.returncode == 0 for p in procs) and all(
+        "COLLECTIVES_OK" in out for out in outs
+    )
+    if ok:
+        _collectives_probe = (True, "probe passed")
+    else:
+        # the probe's last traceback line is the capability evidence
+        # (e.g. "Multiprocess computations aren't implemented on the
+        # CPU backend")
+        tails = [
+            line for out in outs
+            for line in out.strip().splitlines()[-1:]
+        ]
+        _collectives_probe = (False, " | ".join(tails) or "probe failed")
+    return _collectives_probe
+
+
 @pytest.mark.slow
 def test_two_process_train(tmp_path):
+    supported, evidence = multiprocess_cpu_collectives_supported()
+    if not supported:
+        pytest.skip(
+            "this jax build lacks multi-process CPU collectives "
+            f"(capability probe: {evidence})"
+        )
     # Odd doc count -> unequal per-host shards -> the hosts' streams end on
     # different steps, forcing the collective-termination path to do real
     # work (a host that breaks alone deadlocks the other in psum).

@@ -1,0 +1,820 @@
+"""The cross-process observability plane (ISSUE 10): Prometheus text
+exposition (golden-format regex validation incl. histogram ``_bucket``/
+``_sum``/``_count``), cumulative-bucket exactness + fleet merge
+additivity, request-id minting/propagation (router stubs, concurrent
+load, response-header equality), the trace collector's clock-anchor
+merge under fake clocks, the slow-request exemplar ring, the trainer's
+telemetry HTTP endpoint, ``telemetry top``'s pure model/render, and
+``telemetry summarize`` over serving rows.
+
+Everything here is jax-free except the trainer-endpoint test (which
+constructs a real Telemetry); router tests run against stub replica HTTP
+servers, the pattern test_fleet.py established.
+"""
+
+import json
+import http.client
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from spacy_ray_tpu.serving.batcher import (
+    REQUEST_ID_HEADER,
+    ServeRequest,
+    clean_request_id,
+    mint_request_id,
+)
+from spacy_ray_tpu.serving.engine import ServingTelemetry
+from spacy_ray_tpu.serving.fleet import Router, RouterHTTPServer, RouterTelemetry
+from spacy_ray_tpu.serving.fleet.replica import ReplicaHandle
+from spacy_ray_tpu.serving.tracecollect import (
+    collect_fleet_traces,
+    merge_process_traces,
+)
+from spacy_ray_tpu.training.prometheus import (
+    PromFamilies,
+    metric_name,
+    render_snapshot,
+)
+from spacy_ray_tpu.training.telemetry import (
+    LATENCY_BUCKETS,
+    MetricsRegistry,
+    TraceBuffer,
+    merge_serving_snapshots,
+    summarize_metrics,
+)
+
+
+# ----------------------------------------------------------------------
+# Exposition format: the golden grammar test
+# ----------------------------------------------------------------------
+
+# one exposition sample line: name{labels} value  (value: int, float,
+# scientific, or +/-Inf/NaN)
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\n]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"\n]*")*\})?'
+    r" (-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$"
+)
+_TYPE_RE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram|summary)$"
+)
+
+
+def _assert_valid_exposition(text: str) -> None:
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("# "):
+            assert not line or _TYPE_RE.match(line), line
+            continue
+        assert _SAMPLE_RE.match(line), f"invalid exposition line: {line!r}"
+
+
+def _driven_serving_tel() -> ServingTelemetry:
+    t = [0.0]
+    tel = ServingTelemetry(clock=lambda: t[0])
+    for i in range(20):
+        t[0] += 0.05
+        tel.request_admitted(2, i % 4)
+        tel.request_completed(
+            latency_s=0.004 + 0.001 * i,
+            queue_wait_s=0.001,
+            t0=t[0] - 0.01,
+            error=None,
+            dispatch_wait_s=0.002,
+            request_id=f"req-{i}",
+        )
+        with tel.batch_span(2, 2, 16, [f"req-{i}"]):
+            t[0] += 0.003
+    return tel
+
+
+def test_prometheus_exposition_golden_format():
+    tel = _driven_serving_tel()
+    text = render_snapshot(tel.snapshot(), prefix="srt_serving")
+    _assert_valid_exposition(text)
+    # counters end _total and carry their value
+    assert "# TYPE srt_serving_requests_total counter" in text
+    assert re.search(r"^srt_serving_requests_total 20$", text, re.M)
+    # the latency histogram exposes real _bucket/_sum/_count series
+    assert "# TYPE srt_serving_request_latency_seconds histogram" in text
+    buckets = re.findall(
+        r'^srt_serving_request_latency_seconds_bucket\{le="([^"]+)"\} (\d+)$',
+        text, re.M,
+    )
+    assert len(buckets) == len(LATENCY_BUCKETS) + 1  # every bound + +Inf
+    assert buckets[-1][0] == "+Inf" and buckets[-1][1] == "20"
+    counts = [int(c) for _, c in buckets]
+    assert counts == sorted(counts), "bucket series must be cumulative"
+    assert re.search(
+        r"^srt_serving_request_latency_seconds_count 20$", text, re.M
+    )
+    assert re.search(
+        r"^srt_serving_request_latency_seconds_sum \d+(\.\d+)?([eE]-?\d+)?$",
+        text, re.M,
+    )
+    # an unbucketed histogram (swap_seconds) renders as a summary
+    assert "# TYPE srt_serving_swap_seconds summary" in text
+    assert re.search(r"^srt_serving_swap_seconds_count 0$", text, re.M)
+
+
+def test_prometheus_labels_and_none_gauges():
+    reg = MetricsRegistry()
+    reg.counter("hits").inc(3)
+    reg.gauge("present").set(1.5)
+    reg.gauge("absent").set(None)
+    text = render_snapshot(
+        reg.snapshot(), prefix="srt_x", labels={"replica_id": 7}
+    )
+    _assert_valid_exposition(text)
+    assert 'srt_x_hits_total{replica_id="7"} 3' in text
+    assert 'srt_x_present{replica_id="7"} 1.5' in text
+    # a None gauge is an ABSENT series, never a fake zero
+    assert "absent" not in text
+
+
+def test_prometheus_type_conflict_rejected():
+    fam = PromFamilies()
+    fam.add("srt_thing", "counter", 1)
+    with pytest.raises(ValueError):
+        fam.add("srt_thing", "gauge", 2)
+
+
+def test_metric_name_sanitization():
+    assert metric_name("srt", "a.b-c d") == "srt_a_b_c_d"
+    assert metric_name("srt", "ok_name") == "srt_ok_name"
+
+
+# ----------------------------------------------------------------------
+# Cumulative buckets: exactness + additive fleet merge
+# ----------------------------------------------------------------------
+
+
+def test_histogram_bucket_counts_exact():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.01, 0.02, 0.5, 2.0):
+        h.observe(v)
+    snap = h.snapshot()
+    # le is inclusive: 0.01 lands in the 0.01 bucket
+    assert snap["buckets"] == [[0.01, 2], [0.1, 3], [1.0, 4]]
+    assert snap["count"] == 5  # +Inf == count: the 2.0 observation
+
+
+def test_merged_buckets_are_additive():
+    snaps = []
+    for values in ((0.005, 0.02), (0.02, 0.5, 2.0)):
+        reg = MetricsRegistry()
+        h = reg.histogram("request_latency_seconds", buckets=(0.01, 0.1, 1.0))
+        for v in values:
+            h.observe(v)
+        snaps.append(reg.snapshot())
+    merged = merge_serving_snapshots(snaps)
+    assert merged["histograms"]["request_latency_seconds"]["buckets"] == [
+        [0.01, 1], [0.1, 3], [1.0, 4],
+    ]
+    assert merged["histograms"]["request_latency_seconds"]["count"] == 5
+
+
+def test_merged_buckets_dropped_on_boundary_mismatch():
+    snaps = []
+    for bounds in ((0.01, 0.1), (0.01, 0.5)):
+        reg = MetricsRegistry()
+        reg.histogram("h", buckets=bounds).observe(0.05)
+        snaps.append(reg.snapshot())
+    merged = merge_serving_snapshots(snaps)
+    assert "buckets" not in merged["histograms"]["h"]
+    assert merged["histograms"]["h"]["count"] == 2  # count still merges
+
+
+# ----------------------------------------------------------------------
+# Request-id minting / validation
+# ----------------------------------------------------------------------
+
+
+def test_request_id_mint_and_clean():
+    a, b = mint_request_id(), mint_request_id()
+    assert a != b and clean_request_id(a) == a
+    assert clean_request_id("client.id-42:x") == "client.id-42:x"
+    # header-injection / garbage shapes are refused (caller mints)
+    assert clean_request_id("bad id with spaces") is None
+    assert clean_request_id("x" * 200) is None
+    assert clean_request_id("evil\r\nheader") is None
+    assert clean_request_id(None) is None
+    req = ServeRequest([object()], deadline=1.0, enqueued_at=0.0)
+    assert clean_request_id(req.request_id) == req.request_id
+
+
+# ----------------------------------------------------------------------
+# Router propagation: stub replicas that echo the header, like server.py
+# ----------------------------------------------------------------------
+
+
+class _EchoStubHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _reply(self, status, payload, request_id=None):
+        body = json.dumps(payload).encode("utf8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        if request_id:
+            self.send_header(REQUEST_ID_HEADER, request_id)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/healthz":
+            self._reply(200, {"status": "ok"})
+        elif self.path == "/metrics":
+            stub = self.server.stub
+            if stub.fail_metrics:
+                self._reply(500, {"error": "boom"})
+            else:
+                self._reply(200, stub.snapshot)
+        else:
+            self._reply(404, {"error": "not_found"})
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(length)
+        rid = self.headers.get(REQUEST_ID_HEADER)
+        with self.server.stub.lock:
+            self.server.stub.seen_ids.append(rid)
+        self._reply(
+            200,
+            {"docs": [{"id_seen": rid}], "batch": {"occupancy": 1}},
+            request_id=rid,
+        )
+
+
+class EchoStub:
+    def __init__(self, snapshot=None):
+        self.lock = threading.Lock()
+        self.seen_ids = []
+        self.fail_metrics = False
+        self.snapshot = snapshot or {
+            "counters": {}, "gauges": {}, "histograms": {}, "slo": {},
+        }
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), _EchoStubHandler)
+        self.httpd.daemon_threads = True
+        self.httpd.stub = self
+        self.port = self.httpd.server_address[1]
+        threading.Thread(
+            target=self.httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        ).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _handle(replica_id, stub):
+    h = ReplicaHandle(replica_id)
+    h.set_address("127.0.0.1", stub.port)
+    h.ready = True
+    return h
+
+
+def _serve_router(router):
+    httpd = RouterHTTPServer(("127.0.0.1", 0), router)
+    threading.Thread(
+        target=httpd.serve_forever, kwargs={"poll_interval": 0.05},
+        daemon=True,
+    ).start()
+    host, port = httpd.server_address[:2]
+    return httpd, str(host), int(port)
+
+
+def _post_with_id(host, port, payload, request_id=None):
+    conn = http.client.HTTPConnection(host, port, timeout=30.0)
+    try:
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers[REQUEST_ID_HEADER] = request_id
+        conn.request(
+            "POST", "/v1/parse", json.dumps(payload).encode("utf8"), headers
+        )
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read()), resp.getheader(
+            REQUEST_ID_HEADER
+        )
+    finally:
+        conn.close()
+
+
+def test_router_mints_and_propagates_request_id():
+    stubs = [EchoStub(), EchoStub()]
+    handles = [_handle(i, s) for i, s in enumerate(stubs)]
+    tel = RouterTelemetry()
+    router = Router(lambda: handles, telemetry=tel)
+    httpd, host, port = _serve_router(router)
+    try:
+        # client-supplied id honored end to end: router -> replica ->
+        # response header
+        status, payload, rid = _post_with_id(
+            host, port, {"texts": ["x"]}, request_id="client-supplied-1"
+        )
+        assert status == 200 and rid == "client-supplied-1"
+        assert payload["docs"][0]["id_seen"] == "client-supplied-1"
+        # no client id: the router MINTS one, and it reaches the replica
+        status, payload, rid = _post_with_id(host, port, {"texts": ["x"]})
+        assert status == 200 and rid and clean_request_id(rid) == rid
+        assert payload["docs"][0]["id_seen"] == rid
+        # a garbage id is replaced, not reflected
+        status, _, rid = _post_with_id(
+            host, port, {"texts": ["x"]}, request_id="bad id ~~ !!"
+        )
+        assert status == 200 and rid != "bad id ~~ !!"
+        # the router's route span carries the id — the trace half of the
+        # propagation contract
+        events = tel.trace.payload()["traceEvents"]
+        route_ids = {
+            (e.get("args") or {}).get("request_id")
+            for e in events if e.get("name") == "route"
+        }
+        assert "client-supplied-1" in route_ids
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        for s in stubs:
+            s.close()
+
+
+def test_request_id_header_equality_under_concurrent_load():
+    stubs = [EchoStub(), EchoStub(), EchoStub()]
+    handles = [_handle(i, s) for i, s in enumerate(stubs)]
+    router = Router(lambda: handles, telemetry=RouterTelemetry())
+    httpd, host, port = _serve_router(router)
+    mismatches = []
+
+    def client(idx):
+        for i in range(10):
+            rid = f"c{idx}.r{i}.{mint_request_id()}"
+            status, payload, echoed = _post_with_id(
+                host, port, {"texts": ["x"]}, request_id=rid
+            )
+            if status != 200 or echoed != rid or (
+                payload["docs"][0]["id_seen"] != rid
+            ):
+                mismatches.append((rid, echoed, status))
+
+    try:
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert not mismatches, mismatches[:5]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        for s in stubs:
+            s.close()
+
+
+def test_router_counts_scrape_failures_per_replica():
+    good = EchoStub(snapshot={
+        "counters": {"requests": 5}, "gauges": {}, "histograms": {},
+        "slo": {},
+    })
+    bad = EchoStub()
+    bad.fail_metrics = True
+    handles = [_handle(0, good), _handle(1, bad)]
+    tel = RouterTelemetry()
+    router = Router(lambda: handles, telemetry=tel)
+    try:
+        snaps = router.scrape_replica_metrics()
+        assert [s["replica_id"] for s in snaps] == [0]
+        snaps = router.scrape_replica_metrics()
+        assert len(snaps) == 1
+        # the failing replica is NAMED with a count, not silently absent
+        assert router.scrape_failure_stats() == {"1": 2}
+        assert tel.snapshot()["counters"]["scrape_failures"] == 2
+        # fleet_metrics performs its own scrape pass (+1)
+        metrics = router.fleet_metrics()
+        assert metrics["scrape_failures"] == {"1": 3}
+        # and surfaces in the exposition (one more scrape pass again)
+        text = router.prometheus_metrics()
+        _assert_valid_exposition(text)
+        assert (
+            'srt_router_replica_scrape_failures_total{replica_id="1"} 4'
+            in text
+        )
+    finally:
+        good.close()
+        bad.close()
+
+
+def test_router_prometheus_exposition_with_replica_labels():
+    reg = MetricsRegistry()
+    reg.counter("requests").inc(4)
+    reg.histogram(
+        "request_latency_seconds", buckets=(0.01, 0.1)
+    ).observe(0.05)
+    snap = reg.snapshot()
+    snap["slo"] = {}
+    stub = EchoStub(snapshot=snap)
+    handles = [_handle(3, stub)]
+    router = Router(lambda: handles, telemetry=RouterTelemetry())
+    try:
+        text = router.prometheus_metrics()
+        _assert_valid_exposition(text)
+        assert 'srt_serving_requests_total{replica_id="3"} 4' in text
+        assert (
+            'srt_serving_request_latency_seconds_bucket{le="0.1",'
+            'replica_id="3"} 1'
+        ) in text
+        assert "srt_fleet_replicas 1" in text
+    finally:
+        stub.close()
+
+
+# ----------------------------------------------------------------------
+# Trace collector: clock-anchor merge under fake clocks
+# ----------------------------------------------------------------------
+
+
+def test_merge_process_traces_aligns_fake_clocks():
+    # process A: clock starts at 1000.0; its span begins at wall t=+10ms
+    clock_a = [1000.0]
+    buf_a = TraceBuffer(clock=lambda: clock_a[0])
+    clock_a[0] = 1000.010
+    buf_a.add_span("route", clock_a[0], 0.005, cat="fleet", force=True)
+    # process B: a DIFFERENT clock origin (7.0); its span begins at wall
+    # t=+12ms (inside A's span — a request hop)
+    clock_b = [7.0]
+    buf_b = TraceBuffer(clock=lambda: clock_b[0])
+    clock_b[0] = 7.012
+    buf_b.add_span("request", clock_b[0], 0.002, cat="serve", force=True)
+    # anchors taken "simultaneously" at wall time 500.0 (unix): A's
+    # clock reads 1000.020, B's reads 7.020 — i.e. A's span started 10ms
+    # before the anchor instant minus 10ms, etc.
+    anchor_a = {"origin": 1000.0, "clock_now": 1000.020, "unix_now": 500.0}
+    anchor_b = {"origin": 7.0, "clock_now": 7.020, "unix_now": 500.0}
+    merged = merge_process_traces([
+        {"name": "router", "trace": buf_a.payload(), "anchor": anchor_a},
+        {"name": "replica-0", "trace": buf_b.payload(), "anchor": anchor_b},
+    ])
+    events = {
+        e["name"]: e for e in merged["traceEvents"] if e.get("ph") == "X"
+    }
+    # A's span at wall 499.990 (+10ms - 20ms offset), B's at 499.992:
+    # on the merged timeline A starts at 0, B 2000us later
+    assert events["route"]["ts"] == 0.0
+    assert events["request"]["ts"] == pytest.approx(2000.0, abs=1.0)
+    # distinct pids + process_name metadata per source
+    assert events["route"]["pid"] != events["request"]["pid"]
+    names = {
+        (e["pid"], (e.get("args") or {}).get("name"))
+        for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert (events["route"]["pid"], "router") in names
+    assert (events["request"]["pid"], "replica-0") in names
+    assert merged["otherData"]["merged_from"] == ["router", "replica-0"]
+
+
+def test_merge_skips_unanchored_process():
+    buf = TraceBuffer()
+    buf.add_span("x", buf.now(), 0.001, force=True)
+    merged = merge_process_traces([
+        {"name": "anchored", "trace": buf.payload(),
+         "anchor": buf.anchor()},
+        {"name": "lost", "trace": buf.payload(), "anchor": None},
+    ])
+    assert merged["otherData"]["merged_from"] == ["anchored"]
+    assert merged["otherData"]["skipped"] == ["lost"]
+
+
+def test_collect_fleet_traces_from_live_endpoints():
+    """collect over HTTP: two processes' /healthz anchors + /trace
+    buffers -> one merged file (stub endpoints standing in for router
+    and replica)."""
+
+    class _TraceHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):  # noqa: N802
+            buf = self.server.buf
+            if self.path == "/healthz":
+                payload = {"status": "ok", "anchor": buf.anchor()}
+            elif self.path == "/trace":
+                payload = dict(buf.payload())
+                payload["anchor"] = buf.anchor()
+            else:
+                payload = {"error": "not_found"}
+            body = json.dumps(payload).encode("utf8")
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    servers = []
+    urls = []
+    for name in ("a", "b"):
+        buf = TraceBuffer()
+        buf.add_span(f"span-{name}", buf.now(), 0.001, force=True)
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), _TraceHandler)
+        httpd.daemon_threads = True
+        httpd.buf = buf
+        threading.Thread(
+            target=httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        ).start()
+        servers.append(httpd)
+        urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+    try:
+        merged = collect_fleet_traces(urls, discover=False)
+        assert len(merged["otherData"]["merged_from"]) == 2
+        span_names = {
+            e["name"] for e in merged["traceEvents"] if e.get("ph") == "X"
+        }
+        assert span_names == {"span-a", "span-b"}
+    finally:
+        for httpd in servers:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# ----------------------------------------------------------------------
+# Slow-request exemplars
+# ----------------------------------------------------------------------
+
+
+def test_exemplar_ring_catches_p99_outliers():
+    tel = ServingTelemetry(clock=lambda: 0.0)
+    # below the min-sample floor nothing records (no tail exists yet)
+    assert not tel.consider_exemplar(
+        request_id="early", latency_s=99.0, stages={}
+    )
+    for i in range(200):
+        tel.request_completed(
+            latency_s=0.010, queue_wait_s=0.001, t0=None, error=None
+        )
+    for _ in range(2):  # past the refresh cadence: threshold learned
+        tel.consider_exemplar(
+            request_id="fast", latency_s=0.010, stages={}
+        )
+    recorded = tel.consider_exemplar(
+        request_id="slow-1",
+        latency_s=0.5,
+        stages={"queue_wait": 0.4, "dispatch_wait": 0.45,
+                "device": 0.04, "serialize": 0.001},
+        n_docs=2, B=2, T=16, generation=None,
+    )
+    assert recorded
+    payload = tel.exemplars()
+    assert payload["count"] == 1
+    ex = payload["exemplars"][0]
+    assert ex["request_id"] == "slow-1"
+    assert ex["stages"]["queue_wait"] == 0.4
+    assert tel.snapshot()["counters"]["slow_exemplars"] == 1
+
+
+def test_exemplar_ring_bounded():
+    tel = ServingTelemetry(clock=lambda: 0.0, exemplar_capacity=4)
+    for _ in range(200):
+        tel.request_completed(
+            latency_s=0.010, queue_wait_s=None, t0=None, error=None
+        )
+    tel.consider_exemplar(request_id="seed", latency_s=0.010, stages={})
+    for i in range(10):
+        tel.consider_exemplar(
+            request_id=f"slow-{i}", latency_s=1.0, stages={}
+        )
+    payload = tel.exemplars()
+    assert payload["count"] == 4  # bounded ring, newest kept
+    assert payload["exemplars"][-1]["request_id"] == "slow-9"
+
+
+# ----------------------------------------------------------------------
+# Trainer telemetry endpoint
+# ----------------------------------------------------------------------
+
+
+def test_trainer_telemetry_http_endpoint(tmp_path):
+    from spacy_ray_tpu.training.telemetry import Telemetry
+    from spacy_ray_tpu.training.telemetry_http import TelemetryHTTPServer
+
+    tel = Telemetry(tmp_path / "metrics", anomaly_detection=False)
+    tel.registry.counter("words").inc(1234)
+    tel._step_hist.observe(0.25)
+    tel.trace.add_span("step", tel.trace.now(), 0.25, cat="step", force=True)
+    server = TelemetryHTTPServer(tel, port=0)
+    host, port = server.start()
+
+    def get(path):
+        conn = http.client.HTTPConnection(host, port, timeout=10.0)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read(), dict(resp.getheaders())
+        finally:
+            conn.close()
+
+    try:
+        status, body, _ = get("/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["role"] == "trainer"
+        anchor = health["anchor"]
+        assert {"origin", "clock_now", "unix_now"} <= set(anchor)
+        status, body, _ = get("/metrics")
+        snap = json.loads(body)
+        assert snap["counters"]["words"] == 1234
+        assert snap["histograms"]["step_seconds"]["buckets"]
+        status, body, headers = get("/metrics?format=prometheus")
+        text = body.decode("utf8")
+        _assert_valid_exposition(text)
+        assert "srt_training_words_total 1234" in text
+        assert re.search(
+            r'^srt_training_step_seconds_bucket\{le="0\.5"\} 1$', text, re.M
+        )
+        status, body, _ = get("/trace")
+        trace = json.loads(body)
+        assert trace["role"] == "trainer"
+        assert any(
+            e.get("name") == "step" for e in trace["traceEvents"]
+        )
+        assert "anchor" in trace
+    finally:
+        server.stop()
+        tel.finalize()
+
+
+# ----------------------------------------------------------------------
+# telemetry top: pure model + render
+# ----------------------------------------------------------------------
+
+
+def _router_payload(requests, ready=2):
+    return {
+        "fleet": {
+            "replicas": ready,
+            "counters": {"requests": requests, "deadline_exceeded": 0},
+            "gauges": {"queue_depth": {"sum": 5, "max": 3, "mean": 2.5}},
+            "histograms": {"batch_occupancy": {"p50": 4}},
+            "slo_window": {
+                "request_latency_p50": 0.012,
+                "request_latency_p99": 0.080,
+                "request_latency_p99_worst": 0.110,
+            },
+        },
+        "router": {"counters": {"requests": requests,
+                                "rejected_no_replica": 0,
+                                "rejected_draining": 0}},
+        "replicas": [
+            {"id": 0, "ready": True, "generation": 7, "swap_count": 2},
+            {"id": 1, "ready": True, "generation": 7, "swap_count": 2},
+        ],
+        "scrape_failures": {"1": 3},
+    }
+
+
+def test_top_model_rates_from_counter_deltas():
+    from spacy_ray_tpu.top import TopModel, render
+
+    model = TopModel()
+    row = model.update("http://r", _router_payload(100), now=10.0)
+    assert row["kind"] == "router" and row["req_s"] is None  # first poll
+    row = model.update("http://r", _router_payload(150), now=20.0)
+    assert row["req_s"] == pytest.approx(5.0)  # (150-100)/10s
+    assert row["p99"] == 0.080 and row["ready"] == 2
+    assert row["generations"] == ["7"] and row["swaps"] == 4
+    assert row["scrape_failures"] == 3
+    screen = render([row], now_label="12:00:00")
+    assert "router" in screen and "80.0ms" in screen and "5.0/s" in screen
+    assert "gen [7]" in screen
+
+
+def test_top_model_serving_and_trainer_rows():
+    from spacy_ray_tpu.top import TopModel, classify_payload, render
+
+    serving = {
+        "counters": {"requests": 10, "slow_exemplars": 1},
+        "gauges": {"queue_depth": 2, "last_batch_occupancy": 3},
+        "histograms": {},
+        "slo_window": {"request_latency_p50": 0.004,
+                       "request_latency_p99": 0.020},
+        "generation": 5,
+        "swap_count": 1,
+    }
+    trainer = {
+        "counters": {"steps": 40, "words": 80_000, "anomalies": 2},
+        "gauges": {"compile_count": 12},
+        "histograms": {"step_seconds": {"p50": 0.5, "p95": 0.9}},
+    }
+    assert classify_payload(serving) == "serving"
+    assert classify_payload(trainer) == "trainer"
+    model = TopModel()
+    model.update("s", serving, now=0.0)
+    model.update("t", trainer, now=0.0)
+    srow = model.update(
+        "s", {**serving, "counters": {"requests": 30, "slow_exemplars": 1}},
+        now=10.0,
+    )
+    trow = model.update(
+        "t",
+        {**trainer, "counters": {"steps": 60, "words": 120_000,
+                                 "anomalies": 2}},
+        now=10.0,
+    )
+    assert srow["req_s"] == pytest.approx(2.0)
+    assert srow["generation"] == 5
+    assert trow["steps_s"] == pytest.approx(2.0)
+    assert trow["words_s"] == pytest.approx(4000.0)
+    down = {"url": "x", "kind": "down"}
+    screen = render([srow, trow, down])
+    assert "replica s" in screen and "trainer t" in screen
+    assert "UNREACHABLE" in screen
+    assert "anomalies 2" in screen
+
+
+def test_run_top_injected_loop():
+    from spacy_ray_tpu.top import run_top
+    import io
+
+    payloads = iter([_router_payload(0), _router_payload(40)])
+    out = io.StringIO()
+    clock = iter([0.0, 2.0])
+    rc = run_top(
+        ["http://r"],
+        interval_s=0.0,
+        iterations=2,
+        out=out,
+        fetch=lambda url, timeout_s: next(payloads),
+        clock=lambda: next(clock),
+        sleep=lambda s: None,
+    )
+    assert rc == 0
+    text = out.getvalue()
+    assert "20.0/s" in text  # (40-0)/2s on the second screen
+
+
+# ----------------------------------------------------------------------
+# telemetry summarize over serving rows
+# ----------------------------------------------------------------------
+
+
+def test_summarize_digests_serving_rows(tmp_path):
+    tel = _driven_serving_tel()
+    snap = tel.snapshot()
+    snap["generation"] = 7
+    snap["by_generation"] = {
+        "7": {
+            "counters": {"requests": 15},
+            "slo_window": {"request_latency_p99": 0.018},
+        },
+        "none": {
+            "counters": {"requests": 5},
+            "slo_window": {"request_latency_p99": 0.025},
+        },
+    }
+    path = tmp_path / "metrics.jsonl"
+    with open(path, "w", encoding="utf8") as f:
+        f.write(json.dumps({"kind": "serving", **snap}) + "\n")
+    out = summarize_metrics(path)
+    assert "serving: requests 20" in out
+    assert "generation 7" in out
+    assert "rejects: none" in out
+    assert "latency (last 30s" in out
+    assert "gen      7: requests 15  window p99 18.0ms" in out
+    assert "gen   none: requests 5" in out
+
+
+def test_summarize_serving_rejects_and_empty_behavior(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    row = {
+        "kind": "serving",
+        "counters": {"requests": 9, "rejected_queue_full": 2,
+                     "deadline_exceeded": 1, "docs": 18, "batches": 5},
+        "slo": {"request_latency_p50": 0.004,
+                "request_latency_p95": 0.008,
+                "request_latency_p99": 0.009},
+    }
+    path.write_text(json.dumps(row) + "\n", encoding="utf8")
+    out = summarize_metrics(path)
+    assert "rejected_queue_full 2" in out and "deadline_exceeded 1" in out
+    assert "p99 9.0ms" in out
+    # the wrong-path/empty-file ValueError contract is preserved
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("", encoding="utf8")
+    with pytest.raises(ValueError):
+        summarize_metrics(empty)
+    junk = tmp_path / "junk.jsonl"
+    junk.write_text('{"kind": "unrelated"}\n', encoding="utf8")
+    with pytest.raises(ValueError):
+        summarize_metrics(junk)

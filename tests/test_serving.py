@@ -562,6 +562,40 @@ def test_disabled_telemetry_makes_zero_calls(serve_nlp, monkeypatch):
         assert status == 200 and metrics == {
             "telemetry": "disabled", "generation": None, "swap_count": 0,
         }
+        # the distributed-tracing surfaces make zero telemetry calls on
+        # the disabled path too: request IDs are protocol (the header
+        # still echoes), but spans/exemplars/trace buffers must not
+        # exist — the monkeypatched constructors above prove it by
+        # raising on any construction
+        import http.client as _hc
+
+        conn = _hc.HTTPConnection(host, port, timeout=30.0)
+        try:
+            conn.request(
+                "POST", "/v1/parse",
+                json.dumps({"texts": [TEXTS[0]]}).encode("utf8"),
+                {"Content-Type": "application/json",
+                 "X-SRT-Request-Id": "client-id-42"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            assert resp.getheader("X-SRT-Request-Id") == "client-id-42"
+        finally:
+            conn.close()
+        status, exemplars = _get(host, port, "/admin/exemplars")
+        assert status == 200 and exemplars == {"exemplars": "disabled"}
+        status, trace = _get(host, port, "/trace")
+        assert status == 200 and trace == {"trace": "disabled"}
+        conn = _hc.HTTPConnection(host, port, timeout=30.0)
+        try:
+            conn.request("GET", "/metrics?format=prometheus")
+            resp = conn.getresponse()
+            body = resp.read().decode("utf8")
+            assert resp.status == 200
+            assert body == "# srt telemetry disabled\n"
+        finally:
+            conn.close()
     finally:
         server.request_shutdown()
         assert server.wait() == 0
@@ -587,6 +621,7 @@ def test_sigterm_graceful_drain_subprocess(model_dir):
     signal) when the signal lands, so the drain provably finishes
     admitted-but-not-dispatched work."""
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    metrics_dir = model_dir.parent / "serve_metrics"
     proc = subprocess.Popen(
         [
             sys.executable, "-m", "spacy_ray_tpu", "serve", str(model_dir),
@@ -594,6 +629,7 @@ def test_sigterm_graceful_drain_subprocess(model_dir):
             "--max-batch", "4", "--batching", "window",
             "--max-wait-ms", "600",
             "--max-doc-len", "16", "--drain-timeout-s", "30",
+            "--metrics-dir", str(metrics_dir),
         ],
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
@@ -673,6 +709,22 @@ def test_sigterm_graceful_drain_subprocess(model_dir):
         rc = proc.wait(timeout=60.0)
         assert rc == 0, f"drain exit {rc}:\n{''.join(lines)}"
         assert any("drained; exiting 0" in l for l in lines), lines
+
+        # --metrics-dir shutdown artifacts: the serving snapshot lands as
+        # a `kind: "serving"` metrics.jsonl row that `telemetry
+        # summarize` digests with the training-file contract
+        from spacy_ray_tpu.training.telemetry import summarize_metrics
+
+        rows = [
+            json.loads(l)
+            for l in open(metrics_dir / "metrics.jsonl", encoding="utf8")
+        ]
+        serving_rows = [r for r in rows if r.get("kind") == "serving"]
+        assert serving_rows, rows
+        assert serving_rows[-1]["counters"]["requests"] >= 1
+        summary = summarize_metrics(metrics_dir / "metrics.jsonl")
+        assert "serving: requests" in summary
+        assert (metrics_dir / "serving_trace.json").exists()
     finally:
         if proc.poll() is None:
             proc.kill()

@@ -332,6 +332,9 @@ def test_recompile_after_warmup_detector():
         ("profile_window", "5-15"),
         ("metrics_dir", 5),
         ("anomaly_detection", "yes"),
+        ("metrics_port", "8080"),
+        ("metrics_port", -1),
+        ("metrics_port", 70000),
     ],
 )
 def test_mistyped_telemetry_knobs_rejected(key, value):
@@ -346,6 +349,7 @@ def test_valid_telemetry_knobs_pass():
             "trace_steps": [0, 100],
             "profile_window": [2, 4],
             "anomaly_detection": False,
+            "metrics_port": 9100,
         }
     )
 
@@ -478,6 +482,86 @@ def test_telemetry_smoke_train_roundtrip(
     from spacy_ray_tpu.cli import main as cli_main
 
     assert cli_main(["telemetry", "summarize", str(metrics_path)]) == 0
+
+
+def test_trainer_metrics_port_serves_during_training(
+    tagger_config_text, data_dir, tmp_path
+):
+    """[training] metrics_port wires the trainer's telemetry HTTP
+    endpoint through a REAL train(): a poller thread scrapes /metrics
+    (JSON + prometheus) and /healthz (clock anchor) while the loop runs;
+    the listener is gone after train() returns (stopped in finally)."""
+    import http.client
+    import socket
+    import threading
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    tel_dir = tmp_path / "tel"
+    cfg = _config(
+        tagger_config_text,
+        data_dir,
+        **{
+            "training.metrics_dir": str(tel_dir),
+            "training.metrics_port": port,
+        },
+    )
+    scraped = {}
+    stop = threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=5.0
+                )
+                try:
+                    conn.request("GET", "/healthz")
+                    health = json.loads(conn.getresponse().read())
+                finally:
+                    conn.close()
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=5.0
+                )
+                try:
+                    conn.request("GET", "/metrics?format=prometheus")
+                    text = conn.getresponse().read().decode("utf8")
+                finally:
+                    conn.close()
+                if "srt_training_steps_total" in text:
+                    scraped["health"] = health
+                    scraped["prometheus"] = text
+                    return
+            except OSError:
+                pass
+            stop.wait(0.05)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    try:
+        _, result = train(cfg, n_workers=1, stdout_log=False)
+    finally:
+        stop.set()
+        poller.join(timeout=10.0)
+    assert result.final_step == 8
+    assert "prometheus" in scraped, "endpoint never answered mid-train"
+    assert scraped["health"]["role"] == "trainer"
+    assert {"origin", "clock_now", "unix_now"} <= set(
+        scraped["health"]["anchor"]
+    )
+    assert "# TYPE srt_training_steps_total counter" in scraped["prometheus"]
+    # the listener died with the run
+    import errno
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2.0)
+    try:
+        with pytest.raises(OSError) as exc_info:
+            conn.request("GET", "/healthz")
+            conn.getresponse()
+        assert exc_info.value.errno in (errno.ECONNREFUSED, None)
+    finally:
+        conn.close()
 
 
 def test_telemetry_via_pooled_collation(tagger_config_text, data_dir, tmp_path):
