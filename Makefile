@@ -24,12 +24,15 @@ telemetry:
 # exposition golden-format + bucket merge, request-id propagation +
 # concurrent-load header equality, trace-collector clock-anchor merge,
 # slow-request exemplars, trainer /metrics endpoint, `telemetry top`,
-# serving-row summarize — then the real-fleet tracing acceptance (the
-# sigterm test carries it: one request's spans across router + replica
-# tracks in one merged Perfetto file)
+# serving-row summarize — plus the PR 12 diagnosis layer (alert engine
+# burn-rate/threshold/absence matrix under a fake clock, flight
+# recorder, crash bundles, `telemetry postmortem`) — then the real-fleet
+# acceptance pair: the sigterm test (one request's spans across router +
+# replica tracks in one merged Perfetto file) and the sigkill test (a
+# killed replica's crash postmortem bundle)
 observability:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py tests/test_telemetry.py -q -m "not slow"
-	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -k sigterm
+	JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py tests/test_telemetry.py tests/test_alerting.py tests/test_incidents.py -q -m "not slow"
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -k "sigterm or sigkill"
 
 # online-serving suite: batcher/engine/HTTP correctness under load,
 # SIGTERM graceful drain, SLO telemetry, bench records (docs/SERVING.md);

@@ -1461,14 +1461,68 @@ def telemetry_command(argv: List[str]) -> int:
       buffers of router, replicas (auto-discovered from a router URL),
       and trainer into ONE timeline file via their /healthz clock
       anchors (docs/OBSERVABILITY.md "Distributed tracing").
+    * ``postmortem <dir>`` — render an incident bundle (an alert-fired
+      flight-recorder dump or a crash postmortem) as a human-readable
+      report: exit status/signal, config, stderr tail, alert states,
+      metric digest, and a merged cross-process timeline built with the
+      same clock-anchor merge collect-trace uses. Given the incidents
+      ROOT, renders the newest bundle.
     """
     usage = ("Usage: spacy_ray_tpu telemetry "
              "{summarize <metrics.jsonl> | top <url>... | "
-             "collect-trace <url>... --out FILE}")
-    if not argv or argv[0] not in ("summarize", "top", "collect-trace"):
+             "collect-trace <url>... --out FILE | "
+             "postmortem <bundle-or-incidents-dir>}")
+    if not argv or argv[0] not in (
+        "summarize", "top", "collect-trace", "postmortem"
+    ):
         print(usage, file=sys.stderr)
         return 1
     sub, rest = argv[0], argv[1:]
+    if sub == "postmortem":
+        parser = argparse.ArgumentParser(
+            prog="spacy_ray_tpu telemetry postmortem"
+        )
+        parser.add_argument("bundle", type=Path,
+                            help="an incident bundle directory "
+                            "(incidents/<stamp>-<source>/) or the "
+                            "incidents root (newest bundle is rendered)")
+        parser.add_argument("--trace-out", type=Path, default=None,
+                            help="also write the bundle's merged "
+                            "cross-process Chrome trace here (open in "
+                            "ui.perfetto.dev)")
+        args = parser.parse_args(rest)
+
+        from .incidents import (
+            find_bundle,
+            load_bundle,
+            merged_bundle_trace,
+            render_bundle,
+        )
+
+        try:
+            # load ONCE: the report and the optional --trace-out merge
+            # share the same loaded bundle (flight files can be MBs)
+            bundle = load_bundle(find_bundle(args.bundle))
+            print(render_bundle(bundle))
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        except (OSError, ValueError) as e:
+            print(f"Cannot render {args.bundle}: {e}", file=sys.stderr)
+            return 1
+        if args.trace_out is not None:
+            from .serving.tracecollect import write_merged_trace
+
+            try:
+                merged = merged_bundle_trace(bundle)
+                path = write_merged_trace(merged, args.trace_out)
+            except OSError as e:
+                print(
+                    f"Cannot write {args.trace_out}: {e}", file=sys.stderr
+                )
+                return 1
+            print(f"merged bundle trace written to {path}")
+        return 0
     if sub == "summarize":
         parser = argparse.ArgumentParser(
             prog="spacy_ray_tpu telemetry summarize"
@@ -1635,6 +1689,28 @@ def serve_command(argv: List[str]) -> int:
     parser.add_argument("--metrics-dir", type=Path, default=None,
                         help="write serving_trace.json + a final metrics "
                         "snapshot here on shutdown")
+    parser.add_argument("--incidents-dir", type=Path, default=None,
+                        help="arm the flight recorder (docs/OBSERVABILITY.md "
+                        "'Alerting & incidents'): when an alert fires, the "
+                        "recent metric-snapshot ring + span ring are dumped "
+                        "to <dir>/<utc-stamp>-<source>/ for `telemetry "
+                        "postmortem`; alert transitions append to "
+                        "<dir>/alerts.jsonl")
+    parser.add_argument("--blackbox", type=Path, default=None,
+                        help="persist the flight-recorder payload to this "
+                        "file (atomic replace, rate-limited to ~10s between "
+                        "rewrites — crash evidence may lag by up to that) — "
+                        "the SIGKILL-survivable copy a fleet supervisor "
+                        "folds into the crash postmortem bundle")
+    parser.add_argument("--alert-p99-ms", type=float, default=500.0,
+                        help="sliding-window p99 target the default "
+                        "'serving-latency-slo' alert rule fires against "
+                        "(the error-budget burn-rate rule is independent "
+                        "of it)")
+    parser.add_argument("--observe-interval-s", type=float, default=2.0,
+                        help="cadence of the diagnosis tick (alert rule "
+                        "evaluation, flight-recorder ring feed, black-box "
+                        "persistence)")
     parser.add_argument("--verbose", "-V", action="store_true")
     args = parser.parse_args(argv)
 
@@ -1673,10 +1749,46 @@ def serve_command(argv: List[str]) -> int:
         watcher = CheckpointWatcher(
             args.watch, _swap, interval_s=args.watch_interval_s
         )
+    # diagnosis layer: AlertEngine always rides along with telemetry
+    # (alert state is a handful of floats); the FlightRecorder only when
+    # an incidents dir / black box is configured. With --no-telemetry
+    # NEITHER is constructed — zero rule evaluations, zero ring writes,
+    # zero incident I/O (guard-tested).
+    alerts = None
+    recorder = None
+    if tel is not None:
+        from .alerting import AlertEngine, default_serving_rules
+        from .incidents import FlightRecorder
+
+        if args.incidents_dir is not None or args.blackbox is not None:
+            recorder = FlightRecorder(
+                incident_dir=args.incidents_dir,
+                blackbox_path=args.blackbox,
+                process_name=f"replica-pid{os.getpid()}",
+            )
+        alerts = AlertEngine(
+            default_serving_rules(p99_target_s=args.alert_p99_ms / 1e3),
+            sink_path=(
+                args.incidents_dir / "alerts.jsonl"
+                if args.incidents_dir is not None else None
+            ),
+            on_firing=(
+                recorder.alert_hook() if recorder is not None else None
+            ),
+            source="replica",
+        )
+        if recorder is not None:
+            recorder.attach(
+                trace=tel.trace,
+                alerts_fn=alerts.states,
+                exemplars_fn=tel.exemplars,
+            )
     server = Server(
         engine, args.host, args.port,
         telemetry=tel, drain_timeout_s=args.drain_timeout_s,
         watcher=watcher, swap_dirs=[str(d) for d in args.swap_dirs],
+        alerts=alerts, recorder=recorder,
+        observe_interval_s=args.observe_interval_s,
     )
     # listener-first: the banner (and thus the bound port) appears before
     # the warmup sweep, so a fleet supervisor can probe /healthz — which
@@ -1836,6 +1948,18 @@ def serve_fleet_command(argv: List[str]) -> int:
                         help="fleet drain budget: router in-flight wait + "
                         "per-replica graceful stop")
     parser.add_argument("--ready-timeout-s", type=float, default=300.0)
+    parser.add_argument("--incidents-dir", type=Path, default=None,
+                        help="arm the fleet-wide flight recorder "
+                        "(docs/OBSERVABILITY.md 'Alerting & incidents'): "
+                        "alert firings dump router/replica flight bundles "
+                        "here, every replica persists a SIGKILL-survivable "
+                        "black box under <dir>/blackbox/, and a crashed "
+                        "replica leaves a crash postmortem bundle (exit "
+                        "signal, stderr tail, config, generation, pre-crash "
+                        "span ring) readable via `telemetry postmortem`")
+    parser.add_argument("--observe-interval-s", type=float, default=2.0,
+                        help="cadence of the diagnosis tick (alert rule "
+                        "evaluation + flight-recorder ring feed)")
     parser.add_argument("--no-telemetry", action="store_true",
                         help="disable router + replica telemetry (zero "
                         "telemetry calls fleet-wide)")
@@ -1910,6 +2034,11 @@ def serve_fleet_command(argv: List[str]) -> int:
         cooldown_s=args.cooldown_s,
         drain_timeout_s=args.drain_timeout_s,
         ready_timeout_s=args.ready_timeout_s,
+        incidents_dir=(
+            str(args.incidents_dir)
+            if args.incidents_dir is not None else None
+        ),
+        observe_interval_s=args.observe_interval_s,
         telemetry=not args.no_telemetry,
     )
     rc = Fleet(config).run()

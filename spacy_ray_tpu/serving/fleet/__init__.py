@@ -21,6 +21,7 @@ from .autoscaler import (
 from .fleet import Fleet, FleetConfig
 from .replica import ReplicaHandle, ReplicaSupervisor, build_serve_cmd
 from .router import (
+    GENERATION_MIXED,
     NoReplicaAvailable,
     ResponseCache,
     Router,
@@ -39,6 +40,7 @@ __all__ = [
     "build_serve_cmd",
     "NoReplicaAvailable",
     "ResponseCache",
+    "GENERATION_MIXED",
     "Router",
     "RouterHTTPServer",
     "RouterTelemetry",

@@ -111,6 +111,20 @@ class FleetConfig:
     up_consecutive: int = 3
     down_consecutive: int = 10
     cooldown_s: float = 30.0
+    # diagnosis layer (docs/OBSERVABILITY.md "Alerting & incidents"):
+    # incidents_dir arms the flight recorder fleet-wide — the router
+    # keeps a snapshot ring and dumps it when an alert fires; every
+    # replica gets --incidents-dir (its own alert-triggered dumps) and
+    # --blackbox <incidents_dir>/blackbox/slot-N.json (the
+    # SIGKILL-survivable copy the crash postmortem reads; rewrites are
+    # rate-limited to ~10s, so it may lag the crash by that much); a dead
+    # replica produces a crash bundle with exit status, stderr tail,
+    # config, generation, health history, and both processes' span
+    # rings. None = recorder off; the AlertEngine itself runs whenever
+    # telemetry is on (alert state costs nothing to keep).
+    incidents_dir: Optional[str] = None
+    observe_interval_s: float = 2.0
+    alert_slo: float = 0.99
     # lifecycle
     drain_timeout_s: float = 60.0
     ready_timeout_s: float = 300.0
@@ -136,6 +150,9 @@ class FleetConfig:
             else:
                 mask = self.cpu_cores[slot % len(self.cpu_cores)]
                 prefix = [taskset, "-c", mask]
+        incidents = (
+            self.incidents_dir if self.telemetry else None
+        )
         return prefix + build_serve_cmd(
             self.model_path,
             device=self.device,
@@ -150,8 +167,25 @@ class FleetConfig:
             batching=self.batching,
             precision=self.precision,
             swap_dir=self.watch_dir,
+            incidents_dir=incidents,
+            blackbox=(
+                self.blackbox_path(slot) if incidents is not None else None
+            ),
+            observe_interval_s=(
+                self.observe_interval_s if incidents is not None else None
+            ),
             no_telemetry=not self.telemetry,
             extra_args=self.extra_replica_args,
+        )
+
+    def blackbox_path(self, slot: int) -> str:
+        """One black-box file per resource SLOT (slots recycle with the
+        core/port layout, so a successor's recorder takes over exactly
+        the file its predecessor's crash bundle was copied from)."""
+        from pathlib import Path
+
+        return str(
+            Path(self.incidents_dir) / "blackbox" / f"slot-{int(slot)}.json"
         )
 
     def build_env(self, slot: int) -> Dict[str, str]:
@@ -175,10 +209,55 @@ class Fleet:
     def __init__(self, config: FleetConfig) -> None:
         self.config = config
         self.tel = RouterTelemetry() if config.telemetry else None
+        # diagnosis layer: alert engine whenever telemetry is on, flight
+        # recorder + crash postmortems only with an incidents_dir. With
+        # telemetry OFF neither exists — zero rule evaluations, zero
+        # ring writes, zero incident I/O, even if incidents_dir is set
+        # (guard-tested).
+        self.alerts = None
+        self.recorder = None
+        on_crash = None
+        if config.telemetry:
+            from pathlib import Path
+
+            from ...alerting import AlertEngine, default_router_rules
+            from ...incidents import FlightRecorder
+
+            inc_dir = (
+                Path(config.incidents_dir)
+                if config.incidents_dir else None
+            )
+            if inc_dir is not None:
+                self.recorder = FlightRecorder(
+                    incident_dir=inc_dir,
+                    process_name="router",
+                )
+            self.alerts = AlertEngine(
+                default_router_rules(
+                    p99_target_s=config.p99_target_ms / 1e3,
+                    slo=config.alert_slo,
+                ),
+                sink_path=(
+                    inc_dir / "alerts.jsonl" if inc_dir is not None else None
+                ),
+                on_firing=(
+                    self.recorder.alert_hook()
+                    if self.recorder is not None
+                    else None
+                ),
+                source="router",
+            )
+            if self.recorder is not None:
+                self.recorder.attach(
+                    trace=self.tel.trace,
+                    alerts_fn=self.alerts.states,
+                )
+                on_crash = self._on_replica_crash
         self.supervisor = ReplicaSupervisor(
             config.build_cmd,
             build_env=config.build_env,
             grace_s=config.replica_drain_timeout_s + 15.0,
+            on_crash=on_crash,
         )
         self.router = Router(
             self.supervisor.handles,
@@ -220,10 +299,62 @@ class Fleet:
                 down_consecutive=config.down_consecutive,
                 cooldown_s=config.cooldown_s,
             )
+        self.router.alerts = self.alerts
+        self.router.recorder = self.recorder
         self.httpd = RouterHTTPServer((config.host, config.port), self.router)
         self._stop = threading.Event()
         self._serve_thread: Optional[threading.Thread] = None
         self._autoscale_thread: Optional[threading.Thread] = None
+        self._observer_thread: Optional[threading.Thread] = None
+
+    # -- diagnosis layer -------------------------------------------------
+    def _on_replica_crash(self, handle: Any, rc: int) -> None:
+        """Supervisor crash hook: one bundle per dead replica — exit
+        status + signal, output tail, effective argv, generation, the
+        router's last health payloads, the replica's black box (its
+        pre-crash span ring), and the router's own flight payload so
+        the postmortem timeline crosses the process boundary."""
+        from ...incidents import write_crash_bundle
+
+        write_crash_bundle(
+            self.config.incidents_dir,
+            process_name=f"replica-{handle.replica_id}",
+            rc=rc,
+            argv=self.config.build_cmd(handle.slot),
+            output_tail=list(handle.tail),
+            generation=handle.generation,
+            health_history=list(handle.health_history),
+            blackbox_path=self.config.blackbox_path(handle.slot),
+            process_started_unix=handle.spawned_at_unix,
+            extra_flights={"router": self.recorder.payload()},
+            replica_id=handle.replica_id,
+            slot=handle.slot,
+        )
+
+    def observe_tick(self) -> None:
+        """One diagnosis tick (callable directly by tests): feed the
+        router-side flight ring and evaluate the router rule set over a
+        composite snapshot — router telemetry plus the replica roster.
+        No replica scrapes here: everything these rules read, the
+        router already knows."""
+        snap = {
+            "router": self.tel.snapshot(),
+            "replicas": [h.describe() for h in self.supervisor.handles()],
+            "scrape_failures": self.router.scrape_failure_stats(),
+        }
+        if self.recorder is not None:
+            self.recorder.record(snap)
+        if self.alerts is not None:
+            self.alerts.evaluate(snap)
+
+    def _observe_loop(self) -> None:
+        while True:
+            try:
+                self.observe_tick()
+            except Exception:  # the diagnosis loop must survive anything
+                logger.exception("fleet observer tick failed")
+            if self._stop.wait(self.config.observe_interval_s):
+                return
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -248,6 +379,13 @@ class Fleet:
                 daemon=True,
             )
             self._autoscale_thread.start()
+        if self.alerts is not None or self.recorder is not None:
+            self._observer_thread = threading.Thread(
+                target=self._observe_loop,
+                name="fleet-observer",
+                daemon=True,
+            )
+            self._observer_thread.start()
         if self.controller is not None:
             self.controller.start()
         return self.address

@@ -87,6 +87,12 @@ class ReplicaHandle:
         self.restarts = 0
         self.stopping = False
         self.tail: "deque[str]" = deque(maxlen=40)  # crash diagnostics
+        # router-maintained: the last few /healthz payloads this replica
+        # answered — a crash postmortem's "what did the fleet last know"
+        self.health_history: "deque[Dict[str, Any]]" = deque(maxlen=8)
+        # supervisor-maintained: when the CURRENT process incarnation
+        # was spawned (unix time; None for externally-managed handles)
+        self.spawned_at_unix: Optional[float] = None
         # router-side pool of idle keep-alive connections to THIS replica.
         # A TCP handshake + thread spawn per forwarded request costs more
         # than small parses themselves; reuse makes the router hop cheap.
@@ -207,6 +213,7 @@ class ReplicaSupervisor:
         popen: Callable[..., "subprocess.Popen"] = subprocess.Popen,
         clock: Callable[[], float] = time.monotonic,
         monitor_poll_s: float = 0.2,
+        on_crash: Optional[Callable[[ReplicaHandle, int], None]] = None,
     ) -> None:
         self.build_cmd = build_cmd
         self.build_env = build_env
@@ -218,6 +225,11 @@ class ReplicaSupervisor:
         self.popen = popen
         self.clock = clock
         self.monitor_poll_s = float(monitor_poll_s)
+        # crash-postmortem hook (docs/OBSERVABILITY.md "Alerting &
+        # incidents"): called once per observed crash, BEFORE the handle
+        # is wiped for restart — the callback still sees the generation,
+        # output tail, and health history the dead process had
+        self.on_crash = on_crash
         self._lock = threading.Lock()
         self._handles: List[ReplicaHandle] = []
         self._next_id = 0
@@ -243,6 +255,11 @@ class ReplicaSupervisor:
             env=env,
         )
         handle.proc = proc
+        # wall-clock birth of THIS incarnation: the crash-bundle writer
+        # compares it against the black box's written_unix so a
+        # crash-looping successor can't inherit its predecessor's final
+        # state as its own forensics
+        handle.spawned_at_unix = time.time()
         log_event(
             "replica-spawn",
             f"replica {handle.replica_id} spawned (pid {proc.pid})",
@@ -328,6 +345,17 @@ class ReplicaSupervisor:
                 if due is None:
                     # fresh crash: schedule the restart after backoff
                     rc = proc.returncode
+                    if self.on_crash is not None:
+                        # forensics FIRST: clear_address() below wipes
+                        # the generation; the bundle writer needs the
+                        # handle as the dead process left it
+                        try:
+                            self.on_crash(handle, rc)
+                        except Exception:
+                            logger.exception(
+                                "crash-incident hook failed for replica %d",
+                                handle.replica_id,
+                            )
                     handle.clear_address()
                     handle.restarts += 1
                     if handle.restarts > self.max_restarts_per_replica:
@@ -483,6 +511,9 @@ def build_serve_cmd(
     batching: Optional[str] = None,
     precision: Optional[str] = None,
     swap_dir: Optional[str] = None,
+    incidents_dir: Optional[str] = None,
+    blackbox: Optional[str] = None,
+    observe_interval_s: Optional[float] = None,
     no_telemetry: bool = False,
     extra_args: Sequence[str] = (),
 ) -> List[str]:
@@ -512,6 +543,17 @@ def build_serve_cmd(
         # the ONE directory this replica's /admin/swap may load from —
         # the fleet controller's rollouts; anything else is 403
         cmd += ["--swap-dir", str(swap_dir)]
+    if incidents_dir is not None:
+        # the replica's own alert firings dump flight-recorder bundles
+        # into the fleet-shared incidents directory
+        cmd += ["--incidents-dir", str(incidents_dir)]
+    if blackbox is not None:
+        # SIGKILL-survivable state: the replica persists its span ring +
+        # metric snapshots here every observer tick; the supervisor
+        # copies it into the crash bundle when this process dies
+        cmd += ["--blackbox", str(blackbox)]
+    if observe_interval_s is not None:
+        cmd += ["--observe-interval-s", str(float(observe_interval_s))]
     if no_telemetry:
         cmd.append("--no-telemetry")
     cmd += list(extra_args)

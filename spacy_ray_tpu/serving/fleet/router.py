@@ -56,6 +56,7 @@ from .replica import ReplicaHandle
 __all__ = [
     "NoReplicaAvailable",
     "ResponseCache",
+    "GENERATION_MIXED",
     "RouterTelemetry",
     "Router",
     "RouterHTTPServer",
@@ -75,29 +76,47 @@ class NoReplicaAvailable(ServingError):
     code = "no_replica"
 
 
+# sentinel for "the ready replicas straddle generations" (mid-rollout /
+# mid-promotion): no single generation can vouch for a cached body, so
+# the cache is bypassed entirely until the fleet converges
+GENERATION_MIXED = object()
+
+
 class ResponseCache:
     """Byte-capped LRU of successful ``/v1/parse`` response bodies,
-    keyed by a digest of the request's input texts.
+    keyed by a digest of the request's input texts AND stamped with the
+    checkpoint generation that produced them.
 
     Unlike the input pipeline's ``CollateCache`` (which keys on object
     identity because the corpus re-yields the same Examples), the edge
     sees texts by VALUE over the wire — so the key is a content hash.
-    Responses are deterministic given the loaded params (same model →
-    same annotations), so a hit is exact, with one honest caveat: the
-    cached ``batch`` shape info reflects the batch the ORIGINAL request
-    ran in. Entries are only stored for status-200 bodies.
+    Responses are deterministic given the loaded params — which is
+    exactly why the generation stamp exists: a PR 8 hot-swap promotion
+    CHANGES the loaded params, and a hit is only exact *for the
+    generation that computed it*. ``get`` therefore takes the
+    generation the caller expects (the one every ready replica serves);
+    an entry stamped with any other generation is dropped on access and
+    counted as a stale invalidation, never served. ``flush`` clears the
+    whole cache (the promotion hook — versioned keys make staleness
+    impossible, the flush just reclaims the dead generation's bytes).
+    The cached ``batch`` shape info still reflects the batch the
+    ORIGINAL request ran in. Entries are only stored for status-200
+    bodies.
 
-    Thread-safe; hit/miss/eviction counters feed ``/metrics``.
+    Thread-safe; hit/miss/eviction/stale/flush counters feed
+    ``/metrics``.
     """
 
     def __init__(self, max_bytes: int) -> None:
         self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self._entries: "OrderedDict[bytes, Tuple[Any, bytes]]" = OrderedDict()
         self._nbytes = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.stale_invalidations = 0
+        self.flushes = 0
 
     @staticmethod
     def key_for(texts: List[str]) -> bytes:
@@ -107,28 +126,55 @@ class ResponseCache:
             h.update(b"\x00")  # unambiguous: ["ab"] != ["a","b"]
         return h.digest()
 
-    def get(self, key: bytes) -> Optional[bytes]:
+    def get(self, key: bytes, generation: Any = None) -> Optional[bytes]:
         with self._lock:
-            body = self._entries.get(key)
-            if body is None:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            stored_gen, body = entry
+            if stored_gen != generation:
+                # a promotion happened since this body was cached: it
+                # holds the OLD generation's annotations — drop it, so
+                # the miss path re-parses on the new weights
+                del self._entries[key]
+                self._nbytes -= len(body)
+                self.stale_invalidations += 1
                 self.misses += 1
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
             return body
 
-    def put(self, key: bytes, body: bytes) -> None:
+    def put(self, key: bytes, body: bytes, generation: Any = None) -> None:
         if len(body) > self.max_bytes:
             return  # one oversized response must not flush the cache
         with self._lock:
             if key in self._entries:
-                return
-            self._entries[key] = body
+                old_gen, old_body = self._entries[key]
+                if old_gen == generation:
+                    return
+                # same texts, newer generation: replace the stale entry
+                self._nbytes -= len(old_body)
+                del self._entries[key]
+            self._entries[key] = (generation, body)
             self._nbytes += len(body)
             while self._nbytes > self.max_bytes and len(self._entries) > 1:
-                _, evicted = self._entries.popitem(last=False)
+                _, (_, evicted) = self._entries.popitem(last=False)
                 self._nbytes -= len(evicted)
                 self.evictions += 1
+
+    def flush(self) -> int:
+        """Drop every entry; returns how many. Called on promotion —
+        the old generation's bodies can never hit again (their stamp no
+        longer matches), so their bytes are reclaimed eagerly."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._nbytes = 0
+            if n:
+                self.flushes += 1
+        return n
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -136,6 +182,8 @@ class ResponseCache:
                 "cache_hits": self.hits,
                 "cache_misses": self.misses,
                 "cache_evictions": self.evictions,
+                "cache_stale_invalidations": self.stale_invalidations,
+                "cache_flushes": self.flushes,
                 "cache_entries": len(self._entries),
                 "cache_bytes": self._nbytes,
             }
@@ -308,6 +356,12 @@ class Router:
         self.canary_generation: Optional[int] = None
         self._split_lock = threading.Lock()
         self._split_acc = 0.0
+        # diagnosis layer (docs/OBSERVABILITY.md "Alerting & incidents"):
+        # the Fleet wires an AlertEngine (served on /admin/alerts and in
+        # the /metrics alerts block) and a FlightRecorder here when
+        # telemetry is on; both stay None otherwise (zero-calls contract)
+        self.alerts: Optional[Any] = None
+        self.recorder: Optional[Any] = None
         self._stop = threading.Event()
         self._prober: Optional[threading.Thread] = None
         # per-replica scrape-failure ledger (fleet /metrics): replica_id
@@ -363,6 +417,14 @@ class Router:
                         h.generation = gen if isinstance(gen, int) else None
                         if isinstance(swaps, int):
                             h.swap_count = swaps
+                        # short health history: a crash postmortem's
+                        # "what did the router last know about it"
+                        h.health_history.append(
+                            {
+                                "unix_time": round(time.time(), 3),
+                                "health": health,
+                            }
+                        )
                 self._mark_ready(h)
                 n_ready += 1
             else:
@@ -419,6 +481,38 @@ class Router:
             self._prober = None
         for h in self.replicas():
             h.close_conns()
+
+    # -- response cache generation discipline ---------------------------
+    def cache_generation(self) -> Any:
+        """The generation a cache hit must match: the ONE generation
+        every ready replica serves (learned from /healthz; None = the
+        disk model is itself a valid generation). When ready replicas
+        straddle generations — a canary rollout, a mid-promotion window,
+        a crash-restarted straggler — returns :data:`GENERATION_MIXED`
+        and the caller bypasses the cache: no single stamp could vouch
+        for which replica a forward would hit."""
+        gens = {h.generation for h in self.ready_handles()}
+        if len(gens) == 1:
+            return next(iter(gens))
+        return GENERATION_MIXED
+
+    def flush_cache(self, reason: str = "") -> int:
+        """Drop the whole response cache (the promotion hook — the live
+        controller calls this whenever the fleet's current generation
+        changes). No-op without a cache."""
+        if self.cache is None:
+            return 0
+        n = self.cache.flush()
+        if n:
+            log_event(
+                "cache-flush",
+                f"response cache flushed ({n} entr(ies))"
+                + (f": {reason}" if reason else ""),
+                level=logging.INFO,
+                entries=n,
+                reason=reason,
+            )
+        return n
 
     # -- balancing -------------------------------------------------------
     def ready_handles(self) -> List[ReplicaHandle]:
@@ -717,6 +811,8 @@ class Router:
         out["scrape_failures"] = self.scrape_failure_stats()
         if self.tel is not None:
             out["router"] = self.tel.snapshot()
+        if self.alerts is not None:
+            out["alerts"] = self.alerts.summary()
         if self.cache is not None:
             out["cache"] = self.cache.stats()
         return out
@@ -786,6 +882,8 @@ class Router:
                 "srt_router_replica_scrape_failures_total", "counter", n,
                 {"replica_id": rid},
             )
+        if self.alerts is not None:
+            self.alerts.add_prometheus(fam)
         if self.cache is not None:
             for key, v in self.cache.stats().items():
                 fam.add(f"srt_router_{key}", "gauge", v)
@@ -922,6 +1020,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     {"replicas": router.scrape_replica_exemplars()}
                 ),
             )
+        elif self.path == "/admin/alerts":
+            if router.alerts is None:
+                self._reply(200, {"alerts": "disabled"})
+                return
+            from ...training.telemetry import sanitize_json
+
+            self._reply(
+                200, sanitize_json({"alerts": router.alerts.states()})
+            )
         else:
             self._reply(404, {"error": "not_found", "message": self.path})
 
@@ -963,18 +1070,26 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._reply_error(err, request_id)
             return
         # response cache: only when enabled does the router parse JSON —
-        # the disabled path stays a pure byte proxy
+        # the disabled path stays a pure byte proxy. Generation
+        # discipline (ROADMAP 3b): a hit must match the one generation
+        # every ready replica serves; while the fleet straddles
+        # generations (rollout/promotion in flight) the cache is
+        # bypassed entirely — a stale cached annotation must never
+        # outlive a promotion
         cache_key: Optional[bytes] = None
+        cache_gen: Any = GENERATION_MIXED
         if router.cache is not None:
-            texts = self._texts_from(body)
-            if texts is not None:
-                cache_key = ResponseCache.key_for(texts)
-                hit = router.cache.get(cache_key)
-                if hit is not None:
-                    if router.tel is not None:
-                        router.tel.cache_hit()
-                    self._reply_bytes(200, hit, request_id)
-                    return
+            cache_gen = router.cache_generation()
+            if cache_gen is not GENERATION_MIXED:
+                texts = self._texts_from(body)
+                if texts is not None:
+                    cache_key = ResponseCache.key_for(texts)
+                    hit = router.cache.get(cache_key, cache_gen)
+                    if hit is not None:
+                        if router.tel is not None:
+                            router.tel.cache_hit()
+                        self._reply_bytes(200, hit, request_id)
+                        return
         t0 = time.perf_counter()
         span_t0 = router.tel.now() if router.tel is not None else None
         try:
@@ -994,7 +1109,25 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 replica_id=replica_id,
             )
         if status == 200 and cache_key is not None:
-            router.cache.put(cache_key, payload)
+            # stamp the entry with the serving replica's probe-learned
+            # generation (a handle lookup, NOT a parse of the response
+            # body — responses dwarf requests and the router must stay a
+            # byte proxy on the hot path). Probe freshness caveat: a
+            # swap landing between the last probe and this forward can
+            # stamp a NEWER body with the old generation — the entry
+            # then serves the new weights' annotations until the next
+            # probe drops it, and the promotion flush clears any such
+            # residue; it can never serve STALE (pre-promotion)
+            # annotations, which is the contract that matters.
+            gen = next(
+                (
+                    h.generation
+                    for h in router.replicas()
+                    if h.replica_id == replica_id
+                ),
+                cache_gen,
+            )
+            router.cache.put(cache_key, payload, gen)
         self._reply_bytes(status, payload, request_id)
 
     @staticmethod

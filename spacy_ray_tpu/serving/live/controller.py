@@ -163,6 +163,10 @@ class LiveFleetController:
             if ok:
                 self.current = stamp
                 self.rollouts += 1
+                # generation changed fleet-wide: versioned cache keys
+                # already make the old entries unhittable; the flush
+                # reclaims their bytes eagerly (ROADMAP 3b)
+                self.router.flush_cache(f"direct rollout to gen {stamp}")
                 log_event(
                     "live-rollout-direct",
                     f"generation {stamp} rolled out to all {n} replica(s) "
@@ -264,6 +268,11 @@ class LiveFleetController:
                 self._swap_one(h, stamp)
         self.current = stamp
         self.promotes += 1
+        # promotion hook (ROADMAP 3b): old-generation response-cache
+        # entries are dead the moment the fleet converges on `stamp` —
+        # generation-stamped keys guarantee they can't hit, the flush
+        # reclaims their bytes
+        self.router.flush_cache(f"promoted gen {stamp}")
         self._finish_rollout()
         log_event(
             "live-promote",

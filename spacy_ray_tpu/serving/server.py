@@ -71,6 +71,11 @@ class ServingHTTPServer(ThreadingHTTPServer):
         super().__init__(addr, _Handler)
         self.engine = engine
         self.tel = telemetry
+        # optional diagnosis layer (docs/OBSERVABILITY.md "Alerting &
+        # incidents"): the in-process AlertEngine whose states
+        # /admin/alerts and the /metrics alerts block serve. None unless
+        # telemetry is on AND the CLI wired one (zero-calls contract).
+        self.alerts = None
         self.draining = False
         # checkpoint directories /admin/swap may load from. EMPTY means
         # the admin swap surface is OFF (403): accepting an arbitrary
@@ -137,6 +142,19 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/admin/exemplars":
             self._get_exemplars()
+            return
+        if self.path == "/admin/alerts":
+            # read-only (like /admin/exemplars): alert STATE is
+            # diagnosis, not control, so it is not swap-gated
+            if self.server.alerts is None:
+                self._reply(200, {"alerts": "disabled"})
+            else:
+                from ..training.telemetry import sanitize_json
+
+                self._reply(
+                    200,
+                    sanitize_json({"alerts": self.server.alerts.states()}),
+                )
             return
         if self.path == "/healthz":
             if self.server.draining:
@@ -219,6 +237,10 @@ class _Handler(BaseHTTPRequestHandler):
         # percentiles splittable by generation
         snap["generation"] = engine.serving_generation
         snap["swap_count"] = engine.swap_count
+        if self.server.alerts is not None:
+            # the compact alert block `telemetry top` renders; full
+            # per-rule states live on /admin/alerts
+            snap["alerts"] = self.server.alerts.summary()
         if fmt == "prometheus":
             from ..training.prometheus import (
                 EXPOSITION_CONTENT_TYPE,
@@ -248,6 +270,10 @@ class _Handler(BaseHTTPRequestHandler):
                             "window_s": int(win.get("window_s") or 0),
                         },
                     )
+            if self.server.alerts is not None:
+                # srt_alert_state{alert,severity} 0/1/2 + fired totals —
+                # the scraper-side view of the in-process state machine
+                self.server.alerts.add_prometheus(fam)
             self._reply_text(200, fam.render(), EXPOSITION_CONTENT_TYPE)
             return
         self._reply(200, sanitize_json(snap))
@@ -519,9 +545,22 @@ class Server:
         drain_timeout_s: float = 30.0,
         watcher: Optional[Any] = None,
         swap_dirs: Optional[list] = None,
+        alerts: Optional[Any] = None,
+        recorder: Optional[Any] = None,
+        observe_interval_s: float = 2.0,
     ) -> None:
         self.engine = engine
         self.tel = telemetry
+        # the diagnosis layer (docs/OBSERVABILITY.md "Alerting &
+        # incidents"): an AlertEngine and/or FlightRecorder, both fed by
+        # one observer ticker off the hot path. Only ever constructed by
+        # the CLI when telemetry is on — with telemetry off there is no
+        # ticker, zero rule evaluations, zero ring writes (guard-tested).
+        self.alerts = alerts
+        self.recorder = recorder
+        self.observe_interval_s = float(observe_interval_s)
+        self._observer: Optional[threading.Thread] = None
+        self._observer_stop = threading.Event()
         self.drain_timeout_s = float(drain_timeout_s)
         # optional live-serving CheckpointWatcher (serve --watch): started
         # only after the engine is ready (swapping mid-warmup would race
@@ -529,6 +568,7 @@ class Server:
         # nobody)
         self.watcher = watcher
         self.httpd = ServingHTTPServer((host, port), engine, telemetry)
+        self.httpd.alerts = alerts
         # /admin/swap allowlist: the watched dir plus any explicit
         # --swap-dir entries; empty = admin swaps 403 (see
         # ServingHTTPServer.allowed_swap_dirs)
@@ -552,7 +592,36 @@ class Server:
             daemon=True,
         )
         self._serve_thread.start()
+        if self.tel is not None and (
+            self.alerts is not None or self.recorder is not None
+        ):
+            self._observer = threading.Thread(
+                target=self._observe_loop,
+                name="serve-observer",
+                daemon=True,
+            )
+            self._observer.start()
         return self.address
+
+    def _observe_loop(self) -> None:
+        """The diagnosis ticker: snapshot the telemetry registry every
+        ``observe_interval_s``, feed the flight-recorder ring (which
+        also persists the black box, the SIGKILL-survivable copy), and
+        evaluate the alert rules. First tick runs immediately so a
+        replica that dies young still leaves a black box."""
+        while True:
+            try:
+                snap = self.tel.snapshot()
+                snap["generation"] = self.engine.serving_generation
+                snap["swap_count"] = self.engine.swap_count
+                if self.recorder is not None:
+                    self.recorder.record(snap)
+                if self.alerts is not None:
+                    self.alerts.evaluate(snap)
+            except Exception:
+                logger.exception("observer tick failed")
+            if self._observer_stop.wait(self.observe_interval_s):
+                return
 
     def request_shutdown(self, signum: Optional[int] = None) -> None:
         """Safe from a signal handler: flag writes and an Event set only
@@ -571,6 +640,10 @@ class Server:
         had to be abandoned at the timeout."""
         self._stop.wait()
         self.httpd.draining = True
+        self._observer_stop.set()
+        if self._observer is not None:
+            self._observer.join(timeout=5.0)
+            self._observer = None
         if self.watcher is not None:
             self.watcher.stop()
         self.engine.batcher.begin_drain()

@@ -133,6 +133,7 @@ class TopModel:
                 "scrape_failures": sum(
                     int(v) for v in (payload.get("scrape_failures") or {}).values()
                 ),
+                "alerts": payload.get("alerts"),
             }
         if kind == "trainer":
             counters = payload.get("counters") or {}
@@ -148,6 +149,7 @@ class TopModel:
                 "anomalies": counters.get("anomalies"),
                 "compiles": _get(payload, "gauges", "compile_count"),
                 "hbm_peak": _get(payload, "gauges", "hbm_peak_bytes"),
+                "alerts": payload.get("alerts"),
             }
         counters = payload.get("counters") or {}
         rates = self._rates(url, counters, now)
@@ -167,7 +169,26 @@ class TopModel:
                 + (rates.get("deadline_exceeded") or 0.0)
             ) if rates else None,
             "exemplars": counters.get("slow_exemplars"),
+            "alerts": payload.get("alerts"),
         }
+
+
+def _fmt_alerts(block: Any) -> str:
+    """The alert column: ``FIRING name[+k]`` when anything is firing,
+    ``pending n`` while confirming, ``ok`` when the endpoint runs an
+    alert engine with nothing active, ``-`` when it has none."""
+    if not isinstance(block, dict):
+        return "-"
+    firing = int(block.get("firing") or 0)
+    pending = int(block.get("pending") or 0)
+    if firing:
+        names = block.get("firing_names") or []
+        first = names[0] if names else "?"
+        more = f"+{firing - 1}" if firing > 1 else ""
+        return f"FIRING {first}{more}"
+    if pending:
+        return f"pending {pending}"
+    return "ok"
 
 
 def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
@@ -195,7 +216,8 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 f"occ p50 {_fmt_int(row.get('occupancy'))}  "
                 f"gen [{gens}]  swaps {_fmt_int(row.get('swaps'))}  "
                 f"rej {_fmt_rate(row.get('reject_s'))}  "
-                f"scrape-fail {_fmt_int(row.get('scrape_failures'))}"
+                f"scrape-fail {_fmt_int(row.get('scrape_failures'))}  "
+                f"alerts {_fmt_alerts(row.get('alerts'))}"
             )
         elif kind == "trainer":
             lines.append(f"  trainer {row['url']}")
@@ -207,7 +229,8 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
             )
             lines.append(
                 f"    anomalies {_fmt_int(row.get('anomalies'))}  "
-                f"compiles {_fmt_int(row.get('compiles'))}"
+                f"compiles {_fmt_int(row.get('compiles'))}  "
+                f"alerts {_fmt_alerts(row.get('alerts'))}"
             )
         else:
             lines.append(
@@ -222,7 +245,8 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 f"queue {_fmt_int(row.get('queue_depth'))}  "
                 f"occ {_fmt_int(row.get('occupancy'))}  "
                 f"rej {_fmt_rate(row.get('reject_s'))}  "
-                f"slow-exemplars {_fmt_int(row.get('exemplars'))}"
+                f"slow-exemplars {_fmt_int(row.get('exemplars'))}  "
+                f"alerts {_fmt_alerts(row.get('alerts'))}"
             )
     return "\n".join(lines) + "\n"
 

@@ -136,6 +136,21 @@ DEFAULT_TRAINING = {
     # detectors (only active when telemetry is on); they emit through
     # log_event so anomalies land in jsonl logger rows too
     "anomaly_detection": True,
+    # in-process alert engine (spacy_ray_tpu/alerting.py, only active
+    # when telemetry is on): the default training rule set —
+    # training-stalled (step counter unchanged for 300s, the watchdog's
+    # signal visible BEFORE the watchdog's hard exit) and anomaly-burst —
+    # evaluated on a rate-limited boundary hook PLUS a slow wall-clock
+    # ticker thread (a wedged loop stops reaching boundaries; the ticker
+    # is what lets the stall rule still fire); transitions land in
+    # <metrics_dir>/alerts.jsonl and the /metrics endpoint's alert state
+    "alerting": True,
+    # flight recorder (spacy_ray_tpu/incidents.py): directory for
+    # incident bundles — when an anomaly detector trips or an alert
+    # fires, the recent metric-snapshot ring + the live span ring are
+    # dumped to <incident_dir>/<utc-stamp>-<source>/ for `telemetry
+    # postmortem`. "" (default) = recorder off; requires metrics_dir.
+    "incident_dir": "",
     # fused optimizer update (ops/fused_update.py): the whole Adam/RAdam
     # chain + apply_updates as ONE traversal (pallas kernel on TPU when
     # the startup probe passes). "auto" = fuse on accelerators when the
@@ -260,6 +275,12 @@ _TRAINING_TYPES: Dict[str, Tuple[Callable[[Any], bool], str]] = {
         "a [start, stop] pair of ints with 0 <= start <= stop",
     ),
     "anomaly_detection": (lambda v: isinstance(v, bool), "a bool"),
+    "alerting": (lambda v: isinstance(v, bool), "a bool"),
+    "incident_dir": (
+        lambda v: isinstance(v, str),
+        "a directory path string (empty string disables the flight "
+        "recorder)",
+    ),
     "metrics_port": (
         lambda v: isinstance(v, int) and not isinstance(v, bool)
         and 0 <= v <= 65535,
@@ -518,6 +539,11 @@ def train(
             trace_steps=(int(trace_steps[0]), int(trace_steps[1])),
             anomaly_detection=bool(T.get("anomaly_detection", True)),
             process_index=jax.process_index(),
+            alerting=bool(T.get("alerting", True)),
+            incident_dir=(
+                Path(str(T.get("incident_dir")))
+                if T.get("incident_dir") else None
+            ),
         )
         # trainer-side scrape endpoint ([training] metrics_port /
         # train --metrics-port): /metrics (+?format=prometheus),

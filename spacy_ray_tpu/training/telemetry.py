@@ -1075,6 +1075,10 @@ class Telemetry:
         clock: Callable[[], float] = time.perf_counter,
         process_index: int = 0,
         detector_kwargs: Optional[Dict[str, Any]] = None,
+        alerting: bool = True,
+        alert_rules: Optional[List[Any]] = None,
+        alert_interval_s: float = 5.0,
+        incident_dir: Optional[Path] = None,
     ):
         self.metrics_dir = Path(metrics_dir)
         self.metrics_dir.mkdir(parents=True, exist_ok=True)
@@ -1089,6 +1093,60 @@ class Telemetry:
             self.detectors = AnomalyDetectors(
                 self._emit_anomaly, clock=clock, **(detector_kwargs or {})
             )
+        # diagnosis layer (docs/OBSERVABILITY.md "Alerting & incidents"):
+        # the trainer's alert engine runs on a rate-limited boundary hook
+        # (no extra thread, one comparison per step), and an optional
+        # flight recorder dumps an incident bundle when an anomaly
+        # detector trips or an alert fires. Both live INSIDE Telemetry:
+        # with telemetry disabled neither exists (the zero-calls
+        # contract the disabled-path guard test enforces).
+        self.recorder = None
+        if incident_dir:
+            from ..incidents import FlightRecorder
+
+            self.recorder = FlightRecorder(
+                incident_dir=Path(incident_dir),
+                process_name="trainer",
+                clock=clock,
+            )
+        self.alerts = None
+        self.alert_interval_s = float(alert_interval_s)
+        self._last_alert_eval: Optional[float] = None
+        if alerting:
+            from ..alerting import AlertEngine, default_training_rules
+
+            self.alerts = AlertEngine(
+                alert_rules
+                if alert_rules is not None
+                else default_training_rules(),
+                clock=clock,
+                sink_path=self.metrics_dir / "alerts.jsonl",
+                on_firing=(
+                    self.recorder.alert_hook()
+                    if self.recorder is not None
+                    else None
+                ),
+                source="trainer",
+            )
+        if self.recorder is not None:
+            self.recorder.attach(
+                trace=self.trace,
+                alerts_fn=(
+                    self.alerts.states if self.alerts is not None else None
+                ),
+            )
+        # the boundary hook alone cannot page on a WEDGED loop: a hung
+        # step never reaches the next boundary, and every boundary that
+        # does run has just moved the steps counter — so the
+        # training-stalled AbsenceRule would be unreachable exactly in
+        # the failure mode it exists for. A slow daemon ticker keeps
+        # evaluating on wall time while the loop is stuck (the firing
+        # lands in the log + alerts.jsonl BEFORE the watchdog's
+        # os._exit); it shares the boundary hook's rate limit, so it
+        # adds nothing while the loop is healthy and fake-clock tests
+        # stay deterministic (an unadvanced clock rate-limits it out).
+        self._alert_stop = threading.Event()
+        self._alert_ticker: Optional[threading.Thread] = None
         install_compile_hook()
         self._compiles_at_start = compile_count()
         # hot-path instruments, resolved once
@@ -1108,6 +1166,29 @@ class Telemetry:
         self._peak_kind: Optional[str] = None
         self._handle: Optional[IO[str]] = None
         self._finalized = False
+        # ticker starts LAST: it snapshots the registry, so every
+        # instrument above must exist before its first pass
+        if self.alerts is not None:
+            self._alert_ticker = threading.Thread(
+                target=self._alert_tick_loop,
+                name="telemetry-alerts",
+                daemon=True,
+            )
+            self._alert_ticker.start()
+
+    def _alert_tick_loop(self) -> None:
+        import logging
+
+        logger = logging.getLogger("spacy_ray_tpu.training")
+        while not self._alert_stop.wait(self.alert_interval_s):
+            try:
+                self.maybe_evaluate_alerts()
+            except Exception:
+                # survive anything, but LOUDLY: a silently-dead ticker
+                # means the stall rule — whose whole purpose is the
+                # wedged-loop case only this thread can catch — is gone
+                # with zero operator-visible evidence
+                logger.exception("telemetry alert ticker pass failed")
 
     # -- emit plumbing -------------------------------------------------
     def _emit_anomaly(self, event: str, message: str, **fields: Any) -> None:
@@ -1120,6 +1201,35 @@ class Telemetry:
                 {"kind": "anomaly", "anomaly": event, "message": message, **fields}
             )
         self.trace.add_instant(event, args={"message": message})
+        if self.recorder is not None:
+            # retroactive forensics: a detector firing is exactly the
+            # moment the last N seconds are worth keeping (rate-limited
+            # inside the recorder — a NaN storm writes ONE bundle)
+            self.recorder.trip(f"anomaly-{event}", message, step=fields.get("step"))
+
+    def maybe_evaluate_alerts(self, *, force: bool = False) -> None:
+        """Rate-limited alert pass: at most one rule evaluation per
+        ``alert_interval_s`` no matter how fast steps complete (the hot
+        path pays one clock compare), plus a forced pass at eval
+        boundaries. The background ticker calls this too — its passes
+        share the same rate limit, and it is what keeps the stall rule
+        evaluating when the loop stops reaching boundaries at all. Also
+        feeds the flight-recorder snapshot ring at the same cadence."""
+        if self.alerts is None and self.recorder is None:
+            return
+        now = self.clock()
+        if (
+            not force
+            and self._last_alert_eval is not None
+            and now - self._last_alert_eval < self.alert_interval_s
+        ):
+            return
+        self._last_alert_eval = now
+        snap = self.registry.snapshot()
+        if self.recorder is not None:
+            self.recorder.record(snap)
+        if self.alerts is not None:
+            self.alerts.evaluate(snap)
 
     def _append_row(self, row: Dict[str, Any]) -> None:
         with self._rows_lock:
@@ -1200,6 +1310,7 @@ class Telemetry:
                 self._append_row(row)
                 if self.detectors is not None:
                     self.detectors.check_step_time(step_i, dur)
+        self.maybe_evaluate_alerts()
         # gate the span firehose to the configured step window (rare
         # events — eval/checkpoint/anomaly — bypass with force=True).
         # Ordering matters: the step span ABOVE was gated by the flag set
@@ -1295,6 +1406,7 @@ class Telemetry:
             row["input_pipeline"] = input_pipeline
         self._append_row(row)
         self._flush_rows()
+        self.maybe_evaluate_alerts(force=True)
         snapshot = {
             "step_seconds_p50": p50,
             "step_seconds_p95": p95,
@@ -1331,6 +1443,10 @@ class Telemetry:
         if self._finalized:
             return
         self._finalized = True
+        self._alert_stop.set()
+        if self._alert_ticker is not None:
+            self._alert_ticker.join(timeout=2.0)
+            self._alert_ticker = None
         self._flush_rows()
         self.trace.flush(self.trace_path)
         if self._handle is not None:

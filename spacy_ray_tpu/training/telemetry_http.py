@@ -77,21 +77,33 @@ class _Handler(BaseHTTPRequestHandler):
             )
         elif parsed.path == "/metrics":
             fmt = (parse_qs(parsed.query).get("format") or [""])[0]
+            alerts = getattr(tel, "alerts", None)
             if fmt == "prometheus":
                 from .prometheus import (
                     EXPOSITION_CONTENT_TYPE,
-                    render_snapshot,
+                    PromFamilies,
                 )
 
-                self._reply_text(
-                    200,
-                    render_snapshot(
-                        tel.registry.snapshot(), prefix="srt_training"
-                    ),
-                    EXPOSITION_CONTENT_TYPE,
+                fam = PromFamilies()
+                fam.add_snapshot(
+                    tel.registry.snapshot(), prefix="srt_training"
                 )
+                if alerts is not None:
+                    alerts.add_prometheus(fam)
+                self._reply_text(200, fam.render(), EXPOSITION_CONTENT_TYPE)
             else:
-                self._reply_json(200, tel.registry.snapshot())
+                snap = tel.registry.snapshot()
+                if alerts is not None:
+                    # the compact block `telemetry top` renders; full
+                    # per-rule states live on /admin/alerts
+                    snap["alerts"] = alerts.summary()
+                self._reply_json(200, snap)
+        elif parsed.path == "/admin/alerts":
+            alerts = getattr(tel, "alerts", None)
+            if alerts is None:
+                self._reply_json(200, {"alerts": "disabled"})
+            else:
+                self._reply_json(200, {"alerts": alerts.states()})
         elif parsed.path == "/trace":
             payload = tel.trace.payload()
             payload["anchor"] = tel.trace.anchor()

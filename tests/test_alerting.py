@@ -1,0 +1,685 @@
+"""In-process alert engine (spacy_ray_tpu/alerting.py): burn-rate
+window-pair matrix under a fake clock (fast-fires, slow-confirms,
+both-windows gate, resolve-on-recovery), threshold for-duration
+lifecycle, signal-absence, the scrape-failure page (PR 10's counter
+grown into a first-class rule), the JSONL sink + Prometheus export, and
+the acceptance path: a synthetic SLO breach driven pending → firing →
+resolved with the state visible in Prometheus exposition, /admin/alerts
+over real HTTP, and `telemetry top`.
+"""
+
+import json
+import threading
+
+import pytest
+
+from spacy_ray_tpu.alerting import (
+    AbsenceRule,
+    AlertEngine,
+    BurnRateRule,
+    SnapshotHistory,
+    ThresholdRule,
+    default_router_rules,
+    default_serving_rules,
+    default_training_rules,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def _counters(**kw):
+    return {"counters": dict(kw)}
+
+
+def _drive(engine, clock, steps, dt, make_snapshot):
+    """Advance `steps` ticks of `dt` seconds, evaluating after each."""
+    for i in range(steps):
+        clock.advance(dt)
+        engine.evaluate(make_snapshot(i))
+
+
+# ----------------------------------------------------------------------
+# SnapshotHistory
+# ----------------------------------------------------------------------
+
+
+def test_history_delta_requires_window_span():
+    h = SnapshotHistory(["counters.x"])
+    h.append(0.0, _counters(x=10))
+    h.append(5.0, _counters(x=20))
+    # history spans only 5s: a 60s delta would overstate freshness
+    assert h.delta("counters.x", 60.0, 5.0) is None
+    assert h.delta("counters.x", 5.0, 5.0) == 10.0
+    # counter reset clamps to zero, never a negative burn
+    h.append(10.0, _counters(x=3))
+    assert h.delta("counters.x", 5.0, 10.0) == 0.0
+
+
+def test_history_value_reads_full_snapshot_paths():
+    h = SnapshotHistory(["counters.x"])
+    h.append(0.0, {"slo_window": {"p99": 0.25}, "counters": {"x": 1}})
+    assert h.value("slo_window.p99") == 0.25
+    assert h.value("slo_window.missing") is None
+
+
+# ----------------------------------------------------------------------
+# Threshold: pending -> firing -> resolved under for-duration
+# ----------------------------------------------------------------------
+
+
+def test_threshold_for_duration_lifecycle():
+    clock = FakeClock()
+    eng = AlertEngine(
+        [ThresholdRule("p99-slo", "slo_window.p99", ">", 0.5, for_s=30.0)],
+        clock=clock,
+    )
+    eng.evaluate({"slo_window": {"p99": 0.1}})
+    assert eng.states()[0]["state"] == "inactive"
+    clock.advance(10.0)
+    eng.evaluate({"slo_window": {"p99": 0.9}})  # breach begins
+    assert eng.states()[0]["state"] == "pending"
+    clock.advance(10.0)
+    eng.evaluate({"slo_window": {"p99": 0.9}})  # 10s < for_s
+    assert eng.states()[0]["state"] == "pending"
+    clock.advance(25.0)
+    eng.evaluate({"slo_window": {"p99": 0.9}})  # 35s >= for_s: confirmed
+    st = eng.states()[0]
+    assert st["state"] == "firing" and st["fired_count"] == 1
+    clock.advance(5.0)
+    eng.evaluate({"slo_window": {"p99": 0.2}})  # recovery resolves
+    st = eng.states()[0]
+    assert st["state"] == "inactive"
+    assert st["last_resolved"] == clock.t
+
+
+def test_threshold_pending_cancelled_by_recovery_never_fires():
+    clock = FakeClock()
+    eng = AlertEngine(
+        [ThresholdRule("p99-slo", "slo_window.p99", ">", 0.5, for_s=30.0)],
+        clock=clock,
+    )
+    eng.evaluate({"slo_window": {"p99": 0.9}})
+    assert eng.states()[0]["state"] == "pending"
+    clock.advance(10.0)
+    eng.evaluate({"slo_window": {"p99": 0.1}})  # blip, not an incident
+    st = eng.states()[0]
+    assert st["state"] == "inactive" and st["fired_count"] == 0
+
+
+def test_threshold_no_signal_is_inactive():
+    clock = FakeClock()
+    eng = AlertEngine(
+        [ThresholdRule("p99-slo", "slo_window.p99", ">", 0.5)], clock=clock
+    )
+    eng.evaluate({})  # the path does not exist: no signal, no alert
+    st = eng.states()[0]
+    assert st["state"] == "inactive" and "no signal" in st["detail"]
+
+
+def test_threshold_window_delta_mode():
+    """window_s turns the rule into an event-rate condition: counter
+    increase over the trailing window vs the bound."""
+    clock = FakeClock()
+    eng = AlertEngine(
+        [ThresholdRule("burst", "counters.x", ">=", 3.0, window_s=60.0)],
+        clock=clock,
+    )
+    x = 0
+    # quiet minute to span the window
+    for _ in range(7):
+        clock.advance(10.0)
+        eng.evaluate(_counters(x=x))
+    assert eng.states()[0]["state"] == "inactive"
+    x += 3  # three events inside one window
+    clock.advance(10.0)
+    eng.evaluate(_counters(x=x))
+    assert eng.states()[0]["state"] == "firing"
+    # the window slides past the burst: resolves
+    for _ in range(7):
+        clock.advance(10.0)
+        eng.evaluate(_counters(x=x))
+    assert eng.states()[0]["state"] == "inactive"
+
+
+# ----------------------------------------------------------------------
+# Absence: the signal-died failure mode
+# ----------------------------------------------------------------------
+
+
+def test_absence_fires_on_stalled_counter_and_resolves():
+    clock = FakeClock()
+    eng = AlertEngine(
+        [AbsenceRule("stalled", "counters.steps", stale_s=60.0)],
+        clock=clock,
+    )
+    eng.evaluate(_counters(steps=1))
+    for _ in range(5):
+        clock.advance(10.0)
+        eng.evaluate(_counters(steps=1))  # unchanged 50s: not yet stale
+    assert eng.states()[0]["state"] == "inactive"
+    clock.advance(15.0)
+    eng.evaluate(_counters(steps=1))  # 65s unchanged
+    assert eng.states()[0]["state"] == "firing"
+    clock.advance(1.0)
+    eng.evaluate(_counters(steps=2))  # progress resolves instantly
+    assert eng.states()[0]["state"] == "inactive"
+
+
+def test_absence_never_observed_is_no_signal():
+    clock = FakeClock()
+    eng = AlertEngine(
+        [AbsenceRule("stalled", "counters.steps", stale_s=60.0)],
+        clock=clock,
+    )
+    clock.advance(500.0)
+    eng.evaluate({})  # the subsystem never ran: silence is not a stall
+    assert eng.states()[0]["state"] == "inactive"
+
+
+# ----------------------------------------------------------------------
+# Burn rate: the window-pair matrix, fake clock
+# ----------------------------------------------------------------------
+
+FAST = (300.0, 60.0, 14.4)
+SLOW = (1800.0, 300.0, 6.0)
+
+
+def _burn_engine(clock, windows, slo=0.99):
+    return AlertEngine(
+        [
+            BurnRateRule(
+                "budget-burn",
+                total="counters.requests",
+                bad="counters.errors",
+                slo=slo,
+                windows=windows,
+            )
+        ],
+        clock=clock,
+    )
+
+
+class _Traffic:
+    """Deterministic request/error stream: rate per tick, error fraction
+    switchable mid-run."""
+
+    def __init__(self):
+        self.requests = 0
+        self.errors = 0
+
+    def tick(self, n=100, error_frac=0.0):
+        bad = int(n * error_frac)
+        self.requests += n
+        self.errors += bad
+        return _counters(requests=self.requests, errors=self.errors)
+
+
+def test_burn_fast_pair_fires_on_total_outage():
+    clock = FakeClock()
+    eng = _burn_engine(clock, (FAST,))
+    tr = _Traffic()
+    _drive(eng, clock, 35, 10.0, lambda i: tr.tick())  # clean 350s
+    assert eng.states()[0]["state"] == "inactive"
+    # 100% errors: burn = 100x budget >> 14.4 in BOTH windows fast
+    _drive(eng, clock, 7, 10.0, lambda i: tr.tick(error_frac=1.0))
+    st = eng.states()[0]
+    assert st["state"] == "firing", st
+    assert st["value"] > 14.4
+
+
+def test_burn_below_factor_never_fires_fast_pair():
+    clock = FakeClock()
+    eng = _burn_engine(clock, (FAST,))
+    tr = _Traffic()
+    # 8% errors = 8x budget: real burn, but under the 14.4x page bar
+    _drive(eng, clock, 80, 10.0, lambda i: tr.tick(error_frac=0.08))
+    assert eng.states()[0]["state"] == "inactive"
+
+
+def test_burn_slow_pair_confirms_moderate_sustained_burn():
+    """The 8x burn the fast pair ignores (8 < 14.4) is exactly what the
+    slow pair exists for: it fires — but only once its SHORT window
+    (300s) is spanned, never from a young process's first bad ticks."""
+    clock = FakeClock()
+    eng = _burn_engine(clock, (FAST, SLOW))
+    tr = _Traffic()
+    fired_at = None
+    for i in range(200):  # 2000s at 10s ticks
+        clock.advance(10.0)
+        eng.evaluate(tr.tick(error_frac=0.08))
+        if eng.states()[0]["state"] == "firing" and fired_at is None:
+            fired_at = (i + 1) * 10.0
+    assert fired_at is not None, "slow pair never confirmed"
+    # gated on the slow pair's short window (300s); the sustained-burn
+    # ratio over the partial long window is what confirms it
+    assert 300.0 <= fired_at <= 700.0, fired_at
+
+
+def test_burn_boot_time_outage_pages_after_short_window():
+    """Early-life semantics: a replica failing EVERYTHING from boot must
+    page once the fast pair's short window is spanned — not sit
+    page-blind for the long window's full 300s."""
+    clock = FakeClock()
+    eng = _burn_engine(clock, (FAST,))
+    tr = _Traffic()
+    fired_at = None
+    for i in range(12):  # 120s at 10s ticks, 100% errors throughout
+        clock.advance(10.0)
+        eng.evaluate(tr.tick(error_frac=1.0))
+        if eng.states()[0]["state"] == "firing" and fired_at is None:
+            fired_at = (i + 1) * 10.0
+    assert fired_at is not None and 60.0 <= fired_at <= 90.0, fired_at
+    # ...but before the short window is spanned: no signal, no page
+    clock2 = FakeClock()
+    eng2 = _burn_engine(clock2, (FAST,))
+    tr2 = _Traffic()
+    clock2.advance(10.0)
+    eng2.evaluate(tr2.tick(error_frac=1.0))  # one bad tick, 10s old
+    st = eng2.states()[0]
+    assert st["state"] == "inactive" and "no signal" in st["detail"]
+
+
+def test_burn_short_burst_does_not_sustain_long_window():
+    """Both windows must burn: a 60s error burst inside an otherwise
+    clean 300s long window lights the short window only — no page."""
+    clock = FakeClock()
+    eng = _burn_engine(clock, ((300.0, 60.0, 50.0),))
+    tr = _Traffic()
+    _drive(eng, clock, 35, 10.0, lambda i: tr.tick())
+    # 60s at 60% errors: short burn 60x >= 50, long burn ~12x < 50
+    _drive(eng, clock, 6, 10.0, lambda i: tr.tick(error_frac=0.6))
+    assert eng.states()[0]["state"] == "inactive"
+
+
+def test_burn_resolves_on_recovery_while_long_window_still_hot():
+    """The short window is the resolve lever: once the bleeding stops,
+    the alert clears within ~short_s even though the long window still
+    remembers the incident."""
+    clock = FakeClock()
+    eng = _burn_engine(clock, (FAST,))
+    tr = _Traffic()
+    _drive(eng, clock, 35, 10.0, lambda i: tr.tick())
+    _drive(eng, clock, 12, 10.0, lambda i: tr.tick(error_frac=1.0))
+    assert eng.states()[0]["state"] == "firing"
+    resolved_after = None
+    for i in range(30):
+        clock.advance(10.0)
+        eng.evaluate(tr.tick())
+        if eng.states()[0]["state"] == "inactive":
+            resolved_after = (i + 1) * 10.0
+            break
+    assert resolved_after is not None
+    # within roughly the short window, NOT the long one
+    assert resolved_after <= 120.0, resolved_after
+    # the long window alone is indeed still over the factor right then
+    rule = eng.rules[0]
+    assert rule._burn(eng.history, 300.0, clock.t) >= 14.4
+
+
+def test_burn_zero_traffic_is_no_signal():
+    clock = FakeClock()
+    eng = _burn_engine(clock, (FAST,))
+    for _ in range(40):
+        clock.advance(10.0)
+        eng.evaluate(_counters(requests=0, errors=0))
+    st = eng.states()[0]
+    assert st["state"] == "inactive" and "no signal" in st["detail"]
+
+
+def test_burn_rule_validation():
+    with pytest.raises(ValueError):
+        BurnRateRule("x", total="a", bad="b", slo=1.5)
+    with pytest.raises(ValueError):
+        BurnRateRule("x", total="a", bad="b", windows=())
+    with pytest.raises(ValueError):
+        BurnRateRule("x", total="a", bad="b", windows=((60.0, 300.0, 2.0),))
+    with pytest.raises(ValueError):
+        BurnRateRule("x", total="a", bad="b", windows=((300.0, 60.0, 0.0),))
+
+
+# ----------------------------------------------------------------------
+# Engine: sink, hooks, export
+# ----------------------------------------------------------------------
+
+
+def test_engine_rejects_duplicate_rule_names():
+    with pytest.raises(ValueError):
+        AlertEngine(
+            [
+                ThresholdRule("dup", "a", ">", 1.0),
+                AbsenceRule("dup", "b", stale_s=1.0),
+            ]
+        )
+
+
+def test_engine_sink_rows_record_every_transition(tmp_path):
+    clock = FakeClock()
+    sink = tmp_path / "alerts.jsonl"
+    eng = AlertEngine(
+        [ThresholdRule("slo", "gauges.v", ">", 1.0, for_s=10.0)],
+        clock=clock,
+        sink_path=sink,
+        source="test",
+    )
+    eng.evaluate({"gauges": {"v": 5.0}})  # -> pending
+    clock.advance(15.0)
+    eng.evaluate({"gauges": {"v": 5.0}})  # -> firing
+    clock.advance(5.0)
+    eng.evaluate({"gauges": {"v": 0.0}})  # -> resolved
+    rows = [
+        json.loads(line)
+        for line in sink.read_text(encoding="utf8").splitlines()
+    ]
+    assert [(r["from"], r["to"]) for r in rows] == [
+        ("inactive", "pending"),
+        ("pending", "firing"),
+        ("firing", "inactive"),
+    ]
+    assert all(r["kind"] == "alert" and r["source"] == "test" for r in rows)
+
+
+def test_on_firing_hook_may_reenter_engine_without_deadlock():
+    """Regression: the production wiring points on_firing at the flight
+    recorder, whose dump captures the alert states via states() — which
+    takes the engine lock. The hook therefore MUST run outside the
+    evaluation lock, or the first real firing self-deadlocks the
+    observer thread (and every /metrics reader behind it)."""
+    clock = FakeClock()
+    captured = []
+    eng = AlertEngine(
+        [ThresholdRule("slo", "gauges.v", ">", 1.0)],
+        clock=clock,
+        on_firing=lambda rule, st: captured.append(
+            (eng.states(), eng.summary())  # re-enters the engine
+        ),
+    )
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(eng.evaluate({"gauges": {"v": 5.0}}))
+    )
+    t.start()
+    t.join(timeout=10.0)
+    assert done, "evaluate() deadlocked inside the on_firing hook"
+    states, summary = captured[0]
+    assert states[0]["state"] == "firing" and summary["firing"] == 1
+
+
+def test_on_firing_hook_called_once_per_firing():
+    clock = FakeClock()
+    fired = []
+    eng = AlertEngine(
+        [ThresholdRule("slo", "gauges.v", ">", 1.0)],
+        clock=clock,
+        on_firing=lambda rule, st: fired.append(rule.name),
+    )
+    for v in (5.0, 5.0, 5.0):  # stays firing: hook fires once
+        clock.advance(1.0)
+        eng.evaluate({"gauges": {"v": v}})
+    eng.evaluate({"gauges": {"v": 0.0}})
+    clock.advance(1.0)
+    eng.evaluate({"gauges": {"v": 5.0}})  # re-fires after resolve
+    assert fired == ["slo", "slo"]
+
+
+def test_prometheus_export_states_and_fired_totals():
+    from spacy_ray_tpu.training.prometheus import PromFamilies
+
+    clock = FakeClock()
+    eng = AlertEngine(
+        [
+            ThresholdRule("hot", "gauges.v", ">", 1.0),
+            ThresholdRule("cold", "gauges.v", "<", -1.0),
+        ],
+        clock=clock,
+    )
+    eng.evaluate({"gauges": {"v": 5.0}})
+    fam = PromFamilies()
+    eng.add_prometheus(fam)
+    text = fam.render()
+    assert 'srt_alert_state{alert="hot",severity="page"} 2' in text
+    assert 'srt_alert_state{alert="cold",severity="page"} 0' in text
+    assert 'srt_alert_fired_total{alert="hot"} 1' in text
+
+
+def test_summary_block_shape():
+    clock = FakeClock()
+    eng = AlertEngine(
+        [
+            ThresholdRule("hot", "gauges.v", ">", 1.0),
+            ThresholdRule("warm", "gauges.v", ">", 1.0, for_s=60.0),
+        ],
+        clock=clock,
+    )
+    eng.evaluate({"gauges": {"v": 5.0}})
+    s = eng.summary()
+    assert s["rules"] == 2 and s["firing"] == 1 and s["pending"] == 1
+    assert s["firing_names"] == ["hot"]
+
+
+# ----------------------------------------------------------------------
+# Satellite: the scrape-failure counter grown into a first-class page
+# ----------------------------------------------------------------------
+
+
+def _router_snap(*, requests=0, no_replica=0, draining=0, ready=2,
+                 scrape_failures=0, p99=None):
+    return {
+        "router": {
+            "counters": {
+                "requests": requests,
+                "rejected_no_replica": no_replica,
+                "rejected_draining": draining,
+                "scrape_failures": scrape_failures,
+            },
+            "gauges": {"ready_replicas": ready},
+            "slo": {"router_latency_p99": p99},
+        },
+    }
+
+
+def test_scrape_failure_rule_pages_on_repeated_failures():
+    clock = FakeClock()
+    eng = AlertEngine(default_router_rules(), clock=clock)
+
+    def state(name):
+        return next(r for r in eng.states() if r["alert"] == name)
+
+    failures = 0
+    # quiet 130s so the 120s delta window is spanned
+    for _ in range(13):
+        clock.advance(10.0)
+        eng.evaluate(_router_snap(scrape_failures=failures))
+    assert state("replica-unscrapable")["state"] == "inactive"
+    # one transient failed scrape: increments, but no page
+    failures += 1
+    clock.advance(10.0)
+    eng.evaluate(_router_snap(scrape_failures=failures))
+    assert state("replica-unscrapable")["state"] == "inactive"
+    # a replica that KEEPS failing its scrape: 3 within the window pages
+    for _ in range(2):
+        failures += 1
+        clock.advance(10.0)
+        eng.evaluate(_router_snap(scrape_failures=failures))
+    assert state("replica-unscrapable")["state"] == "firing"
+    # failures stop; the window slides past them and the page resolves
+    for _ in range(15):
+        clock.advance(10.0)
+        eng.evaluate(_router_snap(scrape_failures=failures))
+    assert state("replica-unscrapable")["state"] == "inactive"
+
+
+def test_no_ready_replica_rule_arms_after_first_ready():
+    """A fleet cold start legitimately has zero ready replicas for the
+    whole bucket-warmup sweep (minutes): the rule must NOT page on
+    boot, only once the fleet has been ready at least once."""
+    clock = FakeClock()
+    eng = AlertEngine(default_router_rules(), clock=clock)
+
+    def state(name):
+        return next(r for r in eng.states() if r["alert"] == name)
+
+    # cold start: minutes of ready=0 never arm a page
+    for _ in range(20):
+        clock.advance(15.0)
+        eng.evaluate(_router_snap(ready=0))
+    st = state("no-ready-replica")
+    assert st["state"] == "inactive" and "not armed" in st["detail"]
+    # fleet becomes ready: the rule arms
+    clock.advance(5.0)
+    eng.evaluate(_router_snap(ready=2))
+    assert state("no-ready-replica")["state"] == "inactive"
+    # NOW a total loss of ready replicas pages after the for-duration
+    clock.advance(5.0)
+    eng.evaluate(_router_snap(ready=0))
+    assert state("no-ready-replica")["state"] == "pending"
+    clock.advance(15.0)
+    eng.evaluate(_router_snap(ready=0))
+    assert state("no-ready-replica")["state"] == "firing"
+    clock.advance(1.0)
+    eng.evaluate(_router_snap(ready=2))
+    assert state("no-ready-replica")["state"] == "inactive"
+
+
+def test_serving_burn_pages_on_full_rejection_outage():
+    """Regression: `requests` only counts ADMITTED requests — a replica
+    rejecting 100% of its traffic with queue-full 429s increments only
+    the reject counter. The denominator includes it, so the outage burns
+    instead of reading as 'no traffic'."""
+    clock = FakeClock()
+    eng = AlertEngine(default_serving_rules(), clock=clock)
+
+    def state(name):
+        return next(r for r in eng.states() if r["alert"] == name)
+
+    admitted, rejected = 0, 0
+    # healthy minute+ to span the fast pair's short window
+    for _ in range(8):
+        clock.advance(10.0)
+        admitted += 100
+        eng.evaluate(
+            {"counters": {"requests": admitted,
+                          "rejected_queue_full": rejected}}
+        )
+    assert state("serving-error-budget-burn")["state"] == "inactive"
+    # total outage: zero admissions, every request rejected 429
+    for _ in range(7):
+        clock.advance(10.0)
+        rejected += 100
+        eng.evaluate(
+            {"counters": {"requests": admitted,
+                          "rejected_queue_full": rejected}}
+        )
+    assert state("serving-error-budget-burn")["state"] == "firing"
+
+
+def test_default_rule_sets_construct():
+    # every documented default set builds and carries unique names
+    for rules in (
+        default_serving_rules(),
+        default_router_rules(),
+        default_training_rules(),
+    ):
+        AlertEngine(rules)
+
+
+# ----------------------------------------------------------------------
+# Acceptance: synthetic SLO breach, state visible on every surface
+# ----------------------------------------------------------------------
+
+
+def test_synthetic_slo_breach_visible_everywhere(tmp_path):
+    """The ISSUE 12 acceptance path: a fake-clock-driven latency-SLO
+    breach runs pending → firing → resolved, and while firing the state
+    is readable in (a) Prometheus exposition, (b) /admin/alerts over a
+    real router listener, and (c) the `telemetry top` rendering."""
+    from spacy_ray_tpu.serving.fleet import Router, RouterHTTPServer
+    from spacy_ray_tpu.top import TopModel, render
+
+    clock = FakeClock()
+    eng = AlertEngine(
+        default_router_rules(p99_target_s=0.5), clock=clock,
+        sink_path=tmp_path / "alerts.jsonl",
+    )
+    router = Router(lambda: [])
+    router.alerts = eng
+
+    # breach: window p99 3x the target, confirmed over for_s
+    eng.evaluate(_router_snap(p99=1.5))
+    assert any(r["state"] == "pending" for r in eng.states())
+    clock.advance(31.0)
+    eng.evaluate(_router_snap(p99=1.5))
+    firing = [r for r in eng.states() if r["state"] == "firing"]
+    assert [r["alert"] for r in firing] == ["fleet-latency-slo"]
+
+    httpd = RouterHTTPServer(("127.0.0.1", 0), router)
+    threading.Thread(
+        target=httpd.serve_forever, kwargs={"poll_interval": 0.05},
+        daemon=True,
+    ).start()
+    host, port = httpd.server_address[:2]
+    try:
+        import http.client
+
+        conn = http.client.HTTPConnection(host, port, timeout=10.0)
+        try:
+            conn.request("GET", "/admin/alerts")
+            resp = conn.getresponse()
+            payload = json.loads(resp.read())
+        finally:
+            conn.close()
+        assert resp.status == 200
+        row = payload["alerts"][0]  # firing sorts first
+        assert row["alert"] == "fleet-latency-slo"
+        assert row["state"] == "firing"
+
+        conn = http.client.HTTPConnection(host, port, timeout=10.0)
+        try:
+            conn.request("GET", "/metrics?format=prometheus")
+            resp = conn.getresponse()
+            text = resp.read().decode("utf8")
+        finally:
+            conn.close()
+        assert (
+            'srt_alert_state{alert="fleet-latency-slo",severity="page"} 2'
+            in text
+        )
+
+        # telemetry top renders the alert column from the /metrics JSON
+        conn = http.client.HTTPConnection(host, port, timeout=10.0)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            metrics = json.loads(resp.read())
+        finally:
+            conn.close()
+        assert metrics["alerts"]["firing_names"] == ["fleet-latency-slo"]
+        model = TopModel()
+        screen = render([model.update("http://x", metrics, 0.0)])
+        assert "FIRING fleet-latency-slo" in screen
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+    # recovery resolves
+    clock.advance(5.0)
+    eng.evaluate(_router_snap(p99=0.1))
+    assert all(r["state"] == "inactive" for r in eng.states())
+    rows = [
+        json.loads(line)
+        for line in (tmp_path / "alerts.jsonl").read_text().splitlines()
+        if json.loads(line)["alert"] == "fleet-latency-slo"
+    ]
+    assert [(r["from"], r["to"]) for r in rows] == [
+        ("inactive", "pending"),
+        ("pending", "firing"),
+        ("firing", "inactive"),
+    ]

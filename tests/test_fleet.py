@@ -68,7 +68,10 @@ class _StubHandler(BaseHTTPRequestHandler):
             if stub.warming:
                 self._reply(503, {"status": "warming"})
             else:
-                self._reply(200, {"status": "ok"})
+                payload = {"status": "ok", "swap_count": stub.swap_count}
+                if stub.generation is not None:
+                    payload["generation"] = stub.generation
+                self._reply(200, payload)
         elif self.path == "/metrics":
             self._reply(200, stub.snapshot)
         else:
@@ -88,8 +91,14 @@ class _StubHandler(BaseHTTPRequestHandler):
             return
         if stub.latency_s:
             time.sleep(stub.latency_s)
+        batch = {"occupancy": 1}
+        if stub.generation is not None:
+            # the real server stamps every response with the serving
+            # generation; the cache's put-time stamp reads it from here
+            batch["generation"] = stub.generation
         self._reply(
-            200, {"docs": [{"stub": stub.tag}], "batch": {"occupancy": 1}}
+            200, {"docs": [{"stub": stub.tag, "gen": stub.generation}],
+                  "batch": batch}
         )
 
 
@@ -98,10 +107,12 @@ class StubReplica:
     (``warming`` flips readiness, ``close()`` simulates a crash)."""
 
     def __init__(self, *, warming=False, latency_s=0.0, snapshot=None,
-                 tag="stub"):
+                 tag="stub", generation=None):
         self.warming = warming
         self.draining = False
         self.latency_s = latency_s
+        self.generation = generation
+        self.swap_count = 0
         self.snapshot = snapshot or {"counters": {}, "gauges": {},
                                      "histograms": {}, "slo": {}}
         self.tag = tag
@@ -353,6 +364,122 @@ def test_router_cache_off_by_default():
         httpd.shutdown()
         httpd.server_close()
         stub.close()
+
+
+def test_response_cache_generation_stamp_and_stale_invalidation():
+    """ROADMAP 3b: entries are stamped with the generation that computed
+    them; a get expecting any other generation drops the entry (counted)
+    instead of serving a stale annotation."""
+    cache = ResponseCache(1 << 20)
+    k = ResponseCache.key_for
+    cache.put(k(["a"]), b"gen1-body", 1)
+    assert cache.get(k(["a"]), 1) == b"gen1-body"
+    # promotion happened: expecting gen 2 must never yield gen 1's body
+    assert cache.get(k(["a"]), 2) is None
+    assert cache.stats()["cache_stale_invalidations"] == 1
+    assert len(cache) == 0  # dropped on access, bytes reclaimed
+    # re-cached under the new generation
+    cache.put(k(["a"]), b"gen2-body", 2)
+    assert cache.get(k(["a"]), 2) == b"gen2-body"
+    # put under a NEWER generation replaces a same-key stale entry
+    cache.put(k(["a"]), b"gen3-body", 3)
+    assert cache.get(k(["a"]), 3) == b"gen3-body"
+    # flush clears everything and counts
+    assert cache.flush() == 1
+    assert cache.get(k(["a"]), 3) is None
+    assert cache.stats()["cache_flushes"] == 1
+
+
+def test_router_cache_promotion_never_serves_stale_annotation():
+    """The regression the satellite demands: fill the cache on gen 1,
+    hot-swap the fleet to gen 2 (healthz now reports it), and the SAME
+    request body must come back with gen 2's annotations — never the
+    cached gen-1 body."""
+    stub = StubReplica(tag="origin", generation=1)
+    handle = make_handle(0, stub)
+    router = Router(lambda: [handle], cache_bytes=1 << 20)
+    httpd, host, port = serve_router(router)
+    try:
+        router.probe_once()  # learn generation 1 from /healthz
+        body = {"texts": ["the cat runs"]}
+        status, payload = _post(host, port, body)
+        assert status == 200 and payload["docs"][0]["gen"] == 1
+        status, payload = _post(host, port, body)
+        assert status == 200 and payload["docs"][0]["gen"] == 1
+        assert stub.parse_calls == 1  # second answer was the cached body
+
+        # promotion: the replica now serves generation 2
+        stub.generation = 2
+        stub.swap_count = 1
+        router.probe_once()  # the router learns it exactly as live fleets do
+        status, payload = _post(host, port, body)
+        assert status == 200
+        assert payload["docs"][0]["gen"] == 2, (
+            "promotion served a stale cached annotation"
+        )
+        assert stub.parse_calls == 2  # forwarded, not cached
+        assert router.cache.stats()["cache_stale_invalidations"] == 1
+        # and the new generation's body caches normally again
+        status, payload = _post(host, port, body)
+        assert status == 200 and payload["docs"][0]["gen"] == 2
+        assert stub.parse_calls == 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        stub.close()
+
+
+def test_router_cache_bypassed_while_generations_mixed():
+    """Mid-rollout the ready set straddles generations: no single stamp
+    can vouch for which replica a forward hits, so the cache is bypassed
+    entirely (no hits, no stores) until the fleet converges."""
+    from spacy_ray_tpu.serving.fleet.router import GENERATION_MIXED
+
+    s1 = StubReplica(tag="old", generation=1)
+    s2 = StubReplica(tag="new", generation=2)
+    h1, h2 = make_handle(0, s1), make_handle(1, s2)
+    router = Router(lambda: [h1, h2], cache_bytes=1 << 20)
+    httpd, host, port = serve_router(router)
+    try:
+        router.probe_once()
+        assert router.cache_generation() is GENERATION_MIXED
+        body = {"texts": ["same text"]}
+        _post(host, port, body)
+        _post(host, port, body)
+        assert s1.parse_calls + s2.parse_calls == 2  # nothing cached
+        assert len(router.cache) == 0
+        # fleet converges on gen 2: caching resumes
+        s1.generation = 2
+        router.probe_once()
+        assert router.cache_generation() == 2
+        _post(host, port, body)
+        _post(host, port, body)
+        assert len(router.cache) == 1
+        assert router.cache.stats()["cache_hits"] == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        s1.close()
+        s2.close()
+
+
+def test_controller_finish_flushes_cache_on_promote(tmp_path):
+    """The live controller's promotion hook: a promote (generation
+    change fleet-wide) flushes the response cache eagerly."""
+    from spacy_ray_tpu.serving.live import LiveFleetController
+
+    stub = StubReplica(generation=7)
+    handle = make_handle(0, stub)
+    router = Router(lambda: [handle], cache_bytes=1 << 20)
+    router.cache.put(ResponseCache.key_for(["x"]), b"old", 6)
+    ctl = LiveFleetController(tmp_path, router, canary_fraction=0.25)
+    ctl.target = 7
+    ctl.canary_ids = [0]
+    ctl.phase = "canary"
+    assert ctl._promote() == "promote"
+    assert len(router.cache) == 0
+    assert router.cache.stats()["cache_flushes"] == 1
+    stub.close()
 
 
 # ----------------------------------------------------------------------
@@ -1041,6 +1168,119 @@ def test_fleet_replica_crash_under_real_load_recovers(model_dir):
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait(timeout=10.0)
+
+
+def test_fleet_sigkill_replica_writes_incident_postmortem(
+    model_dir, tmp_path
+):
+    """ISSUE 12 acceptance: SIGKILL a replica mid-load in a REAL
+    2-replica fleet with the flight recorder armed. The dead process
+    cannot dump anything — the forensics must come from the black box
+    it persisted while alive plus what the supervisor/router knew. The
+    crash bundle must hold the exit signal, the stderr tail, the
+    effective config, the generation, and a NON-EMPTY pre-crash span
+    ring, and `telemetry postmortem` must render it."""
+    inc_dir = tmp_path / "incidents"
+    proc = _spawn_fleet(
+        model_dir, "--max-wait-ms", "2",
+        "--incidents-dir", str(inc_dir),
+        "--observe-interval-s", "0.25",
+    )
+    lines = []
+    try:
+        host, port = _read_fleet_banner(proc, lines)
+        health = _wait_fleet_ready(host, port, lines)
+        victim = health["replicas"][0]
+        victim_pid, victim_slot = victim["pid"], victim["slot"]
+        blackbox = inc_dir / "blackbox" / f"slot-{victim_slot}.json"
+
+        # load: clients hammer the fleet so the victim's span ring and
+        # black box fill with real request/batch spans
+        stop_at = [time.monotonic() + 30.0]
+        failures = []
+
+        def client():
+            while time.monotonic() < stop_at[0]:
+                try:
+                    status, _ = _post(host, port, {"texts": ["the cat"]},
+                                      timeout=60.0)
+                except OSError:
+                    continue
+                if status >= 500 and status != 503:
+                    failures.append(status)
+
+        threads = [threading.Thread(target=client) for _ in range(2)]
+        for t in threads:
+            t.start()
+        # the black box must exist and contain post-traffic spans before
+        # the kill — that is the artifact the postmortem depends on
+        assert _wait_until(
+            lambda: blackbox.is_file()
+            and (json.loads(blackbox.read_text()).get("trace") or {}).get(
+                "traceEvents"
+            ),
+            timeout=60.0,
+        ), "replica black box never persisted a span ring"
+        time.sleep(0.6)  # one more persist cycle under load
+
+        os.kill(victim_pid, signal.SIGKILL)
+
+        def bundle_dirs():
+            if not inc_dir.is_dir():
+                return []
+            return [
+                d for d in inc_dir.iterdir()
+                if d.is_dir() and "crash-replica" in d.name
+            ]
+
+        assert _wait_until(lambda: bundle_dirs(), timeout=60.0), (
+            "no crash bundle appeared"
+        )
+        stop_at[0] = 0.0  # stop the load
+        for t in threads:
+            t.join(timeout=60.0)
+        assert not failures, failures[:5]
+
+        bundle = bundle_dirs()[0]
+        inc = json.loads((bundle / "incident.json").read_text())
+        assert inc["exit_code"] == -9
+        assert inc["exit_signal"] == "SIGKILL"
+        assert "generation" in inc  # disk model: honestly null
+        assert any("serve" in str(a) for a in inc["argv"])
+        tail = (bundle / "stderr.txt").read_text()
+        assert "serving on http://" in tail  # the replica's last words
+        # the pre-crash span ring, recovered from the black box
+        flights = list(bundle.glob("flight-*.json"))
+        assert flights, "no flight payload in the crash bundle"
+        replica_flights = [
+            json.loads(f.read_text()) for f in flights
+            if "replica" in f.name
+        ]
+        assert replica_flights
+        spans = [
+            e
+            for fl in replica_flights
+            for e in (fl.get("trace") or {}).get("traceEvents") or []
+            if e.get("ph") == "X"
+        ]
+        assert spans, "pre-crash span ring is empty"
+        # router health knowledge rode along
+        assert (bundle / "health.json").is_file()
+
+        # and the postmortem renders, with the kill signal named
+        from spacy_ray_tpu.incidents import render_postmortem
+
+        report = render_postmortem(bundle)
+        assert "killed by SIGKILL" in report
+        assert "timeline" in report
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=120.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10.0)
 
 
 @pytest.mark.slow

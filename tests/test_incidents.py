@@ -1,0 +1,516 @@
+"""Flight recorder + incident bundles (spacy_ray_tpu/incidents.py):
+ring bounds/pruning, black-box persistence, trip rate-limiting, crash
+bundles with exit-signal decoding, the clock-anchor cross-process
+postmortem timeline, the `telemetry postmortem` CLI, and the
+disabled-telemetry zero-incident-I/O guard at fleet scope.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from spacy_ray_tpu.incidents import (
+    FlightRecorder,
+    exit_signal_name,
+    find_bundle,
+    load_bundle,
+    merged_bundle_trace,
+    render_postmortem,
+    write_crash_bundle,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+# ----------------------------------------------------------------------
+# FlightRecorder: ring, black box, trip
+# ----------------------------------------------------------------------
+
+
+def test_ring_prunes_by_window_and_caps_by_capacity():
+    clock = FakeClock()
+    rec = FlightRecorder(capacity=4, window_s=25.0, clock=clock)
+    for i in range(10):
+        clock.advance(10.0)
+        rec.record({"i": i})
+    snaps = rec.payload()["snapshots"]
+    # capacity 4 bounds it; the 25s window then prunes to the last 3
+    assert [s["snapshot"]["i"] for s in snaps] == [7, 8, 9]
+    assert rec.records == 10
+
+
+def test_blackbox_persists_atomically(tmp_path):
+    bb = tmp_path / "bb.json"
+    rec = FlightRecorder(
+        blackbox_path=bb, process_name="replica-7", blackbox_interval_s=0.0
+    )
+    rec.record({"counters": {"requests": 1}})
+    first = json.loads(bb.read_text(encoding="utf8"))
+    assert first["process"] == "replica-7"
+    assert len(first["snapshots"]) == 1
+    rec.record({"counters": {"requests": 2}})
+    second = json.loads(bb.read_text(encoding="utf8"))
+    assert len(second["snapshots"]) == 2
+    assert not bb.with_name(bb.name + ".tmp").exists()
+
+
+def test_blackbox_rewrite_rate_limited_vs_ring(tmp_path):
+    """The ring feeds every tick; the black-box FILE (a full payload
+    serialization) rewrites at most every blackbox_interval_s — crash
+    evidence needs to be recent, not tick-fresh."""
+    clock = FakeClock()
+    bb = tmp_path / "bb.json"
+    rec = FlightRecorder(
+        blackbox_path=bb, blackbox_interval_s=10.0, clock=clock
+    )
+    rec.record({"i": 0})  # first record always persists
+    assert len(json.loads(bb.read_text())["snapshots"]) == 1
+    for i in range(1, 5):  # 4 more ticks inside the interval
+        clock.advance(2.0)
+        rec.record({"i": i})
+    assert len(json.loads(bb.read_text())["snapshots"]) == 1  # not rewritten
+    clock.advance(3.0)  # 11s since last persist
+    rec.record({"i": 5})
+    assert len(json.loads(bb.read_text())["snapshots"]) == 6  # caught up
+    assert rec.records == 6  # the in-memory ring missed nothing
+
+
+def test_trip_writes_bundle_and_rate_limits(tmp_path):
+    clock = FakeClock()
+    rec = FlightRecorder(
+        incident_dir=tmp_path, min_trip_interval_s=30.0, clock=clock
+    )
+    rec.record({"counters": {"requests": 3}})
+    bundle = rec.trip("alert-slo", "p99 over budget", severity="page")
+    assert bundle is not None and (bundle / "incident.json").is_file()
+    inc = json.loads((bundle / "incident.json").read_text())
+    assert inc["source"] == "alert-slo" and inc["severity"] == "page"
+    flights = list(bundle.glob("flight-*.json"))
+    assert len(flights) == 1
+    payload = json.loads(flights[0].read_text())
+    assert payload["snapshots"][0]["snapshot"]["counters"]["requests"] == 3
+    # a storm inside the interval is suppressed: ONE bundle holds it
+    clock.advance(5.0)
+    assert rec.trip("alert-slo", "again") is None
+    assert rec.suppressed == 1 and rec.trips == 1
+    # past the interval a new incident dumps again
+    clock.advance(30.0)
+    assert rec.trip("alert-slo", "later") is not None
+    assert rec.trips == 2
+
+
+def test_trip_without_incident_dir_is_noop(tmp_path):
+    rec = FlightRecorder()  # in-memory ring only
+    rec.record({"x": 1})
+    assert rec.trip("alert", "x") is None
+    assert rec.trips == 0
+
+
+def test_same_second_same_source_bundles_never_clobber(tmp_path):
+    clock = FakeClock()
+    unix = FakeClock(1000.0)
+    rec = FlightRecorder(
+        incident_dir=tmp_path, min_trip_interval_s=0.0,
+        clock=clock, unix=unix,
+    )
+    a = rec.trip("alert-x", "one")
+    b = rec.trip("alert-x", "two")
+    assert a != b and a.is_dir() and b.is_dir()
+
+
+# ----------------------------------------------------------------------
+# Crash bundles
+# ----------------------------------------------------------------------
+
+
+def test_exit_signal_name_decodes_popen_convention():
+    assert exit_signal_name(-9) == "SIGKILL"
+    assert exit_signal_name(-15) == "SIGTERM"
+    assert exit_signal_name(0) is None
+    assert exit_signal_name(1) is None
+    assert exit_signal_name(None) is None
+
+
+def _fake_flight(name, *, events, unix_base):
+    """A flight payload whose trace is anchored so event k lands at
+    unix_base + k seconds on the merged wall-clock timeline."""
+    return {
+        "process": name,
+        "snapshots": [],
+        "trace": {
+            "traceEvents": [
+                {
+                    "name": ev,
+                    "ph": "X",
+                    "ts": k * 1e6,  # µs relative to origin
+                    "dur": 1000.0,
+                    "pid": 0,
+                    "tid": 0,
+                }
+                for k, ev in enumerate(events)
+            ],
+            "anchor": {
+                "origin": 0.0,
+                "clock_now": 0.0,
+                "unix_now": unix_base,
+            },
+        },
+    }
+
+
+def test_crash_bundle_fields_and_postmortem(tmp_path):
+    bb = tmp_path / "bb.json"
+    bb.write_text(
+        json.dumps(
+            _fake_flight(
+                "replica-3", events=["serve_batch", "request"],
+                unix_base=100.0,
+            )
+        ),
+        encoding="utf8",
+    )
+    bundle = write_crash_bundle(
+        tmp_path / "incidents",
+        process_name="replica-3",
+        rc=-9,
+        argv=["python", "-m", "spacy_ray_tpu", "serve", "model"],
+        output_tail=["serving on http://127.0.0.1:1234", "warmed 12"],
+        generation=5,
+        health_history=[
+            {"unix_time": 99.0, "health": {"status": "ok", "generation": 5}}
+        ],
+        blackbox_path=bb,
+        extra_flights={
+            "router": _fake_flight(
+                "router", events=["route"], unix_base=101.5
+            )
+        },
+        replica_id=3,
+        slot=1,
+    )
+    inc = json.loads((bundle / "incident.json").read_text())
+    assert inc["exit_code"] == -9
+    assert inc["exit_signal"] == "SIGKILL"
+    assert inc["generation"] == 5
+    assert inc["replica_id"] == 3 and inc["slot"] == 1
+    assert "serve" in inc["argv"]
+    assert "serving on" in (bundle / "stderr.txt").read_text()
+    assert json.loads((bundle / "health.json").read_text())[0][
+        "health"
+    ]["generation"] == 5
+    # both flights present: the dead replica's black box + the router's
+    names = sorted(p.name for p in bundle.glob("flight-*.json"))
+    assert names == ["flight-replica-3.json", "flight-router.json"]
+
+    # the merged timeline crosses the process boundary with correct
+    # wall-clock interleaving: replica events at 100s and 101s bracket
+    # the router's at 101.5s
+    merged = merged_bundle_trace(load_bundle(bundle))
+    assert sorted(merged["otherData"]["merged_from"]) == [
+        "replica-3", "router",
+    ]
+    spans = sorted(
+        (
+            (e["ts"], (e.get("args") or {}).get("name") or e["name"])
+            for e in merged["traceEvents"]
+            if e.get("ph") == "X"
+        ),
+    )
+    assert [name for _, name in spans] == [
+        "serve_batch", "request", "route",
+    ]
+
+    report = render_postmortem(bundle)
+    assert "killed by SIGKILL" in report
+    assert "generation: 5" in report
+    assert "serving on http://127.0.0.1:1234" in report
+    assert "[router] route" in report  # cross-process timeline rendered
+    assert "[replica-3] serve_batch" in report
+
+
+def test_crash_bundle_skips_stale_predecessor_blackbox(tmp_path):
+    """Regression: a crash-looping successor that dies before its first
+    black-box persist leaves its PREDECESSOR's file on the slot — the
+    bundle must not present that as the dead process's final state."""
+    bb = tmp_path / "bb.json"
+    stale = _fake_flight("replica-old", events=["x"], unix_base=100.0)
+    stale["written_unix"] = 100.0  # written by the previous incarnation
+    bb.write_text(json.dumps(stale), encoding="utf8")
+    bundle = write_crash_bundle(
+        tmp_path / "inc", process_name="replica-0", rc=1,
+        blackbox_path=bb, process_started_unix=500.0,  # born AFTER
+    )
+    inc = json.loads((bundle / "incident.json").read_text())
+    assert inc["blackbox"].startswith("stale-skipped")
+    assert not list(bundle.glob("flight-replica*"))
+    # a fresh black box (written after spawn) is kept and labeled ok
+    fresh = dict(stale, written_unix=600.0)
+    bb.write_text(json.dumps(fresh), encoding="utf8")
+    bundle2 = write_crash_bundle(
+        tmp_path / "inc", process_name="replica-0", rc=1,
+        blackbox_path=bb, process_started_unix=500.0,
+    )
+    inc2 = json.loads((bundle2 / "incident.json").read_text())
+    assert inc2["blackbox"] == "ok"
+    assert list(bundle2.glob("flight-replica*"))
+
+
+def test_crash_bundle_without_blackbox_is_still_honest(tmp_path):
+    bundle = write_crash_bundle(
+        tmp_path,
+        process_name="replica-0",
+        rc=1,
+        output_tail=["Traceback", "ValueError: boom"],
+    )
+    report = render_postmortem(bundle)
+    assert "exit:   code 1" in report and "killed by" not in report
+    assert "ValueError: boom" in report
+    assert "no trace in bundle" in report
+
+
+def test_find_bundle_resolves_newest_from_root(tmp_path):
+    old = write_crash_bundle(
+        tmp_path, process_name="a", rc=1, unix=lambda: 1000.0
+    )
+    new = write_crash_bundle(
+        tmp_path, process_name="b", rc=2, unix=lambda: 2000.0
+    )
+    assert find_bundle(tmp_path) == new
+    assert find_bundle(old) == old
+    with pytest.raises(FileNotFoundError):
+        find_bundle(tmp_path / "nope")
+
+
+def test_postmortem_cli_renders_and_writes_trace(tmp_path, capsys):
+    from spacy_ray_tpu.cli import telemetry_command
+
+    bb = tmp_path / "bb.json"
+    bb.write_text(
+        json.dumps(_fake_flight("replica-1", events=["x"], unix_base=50.0)),
+        encoding="utf8",
+    )
+    write_crash_bundle(
+        tmp_path / "incidents", process_name="replica-1", rc=-9,
+        output_tail=["boom"], blackbox_path=bb, replica_id=1, slot=0,
+    )
+    out_trace = tmp_path / "merged.json"
+    rc = telemetry_command(
+        ["postmortem", str(tmp_path / "incidents"),
+         "--trace-out", str(out_trace)]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "killed by SIGKILL" in out
+    reloaded = json.loads(out_trace.read_text(encoding="utf8"))
+    assert reloaded["otherData"]["merged_from"] == ["replica-1"]
+    # a bad path is a usage error, not a traceback
+    assert telemetry_command(["postmortem", str(tmp_path / "absent")]) == 1
+
+
+# ----------------------------------------------------------------------
+# Trainer wiring: anomaly trip + stall alert through Telemetry
+# ----------------------------------------------------------------------
+
+
+def test_trainer_anomaly_trips_flight_recorder_once_per_storm(tmp_path):
+    from spacy_ray_tpu.training.telemetry import Telemetry
+
+    clock = FakeClock()
+    inc = tmp_path / "inc"
+    tel = Telemetry(
+        tmp_path / "tel", clock=clock, incident_dir=inc
+    )
+    assert tel.recorder is not None and tel.alerts is not None
+    tel.detectors.check_loss(3, float("nan"))
+
+    def bundles():
+        return sorted(
+            d for d in inc.iterdir()
+            if d.is_dir() and d.name.endswith("anomaly-nan-loss")
+        )
+
+    assert len(bundles()) == 1
+    manifest = json.loads((bundles()[0] / "incident.json").read_text())
+    assert manifest["source"] == "anomaly-nan-loss"
+    assert manifest["step"] == 3
+    # a NaN storm inside the trip interval writes ONE bundle, not N
+    tel.detectors.check_loss(4, float("nan"))
+    tel.detectors.check_loss(5, float("nan"))
+    assert len(bundles()) == 1
+    assert tel.recorder.suppressed == 2
+    tel.finalize()
+
+
+def test_trainer_stall_alert_fires_through_boundary_hook(tmp_path):
+    from spacy_ray_tpu.training.telemetry import Telemetry
+
+    clock = FakeClock()
+    tel = Telemetry(
+        tmp_path / "tel", clock=clock, anomaly_detection=False
+    )
+    tel.maybe_evaluate_alerts(force=True)  # steps counter observed at 0
+    evals0 = tel.alerts.evaluations
+    # rate limit: a burst of boundary hooks inside alert_interval_s
+    # costs ONE clock compare each, zero evaluations
+    for _ in range(50):
+        tel.maybe_evaluate_alerts()
+    assert tel.alerts.evaluations == evals0
+    clock.advance(400.0)  # no step progress for > stall_s (300s default)
+    tel.maybe_evaluate_alerts()
+    states = {r["alert"]: r["state"] for r in tel.alerts.states()}
+    assert states["training-stalled"] == "firing"
+    # progress resolves
+    clock.advance(10.0)
+    tel.registry.counter("steps").inc()
+    tel.maybe_evaluate_alerts(force=True)
+    states = {r["alert"]: r["state"] for r in tel.alerts.states()}
+    assert states["training-stalled"] == "inactive"
+    # transitions landed in the JSONL sink next to metrics.jsonl
+    rows = [
+        json.loads(line)
+        for line in (tmp_path / "tel" / "alerts.jsonl")
+        .read_text(encoding="utf8").splitlines()
+    ]
+    assert [(r["from"], r["to"]) for r in rows] == [
+        ("inactive", "firing"),
+        ("firing", "inactive"),
+    ]
+    tel.finalize()
+
+
+def test_trainer_stall_alert_fires_while_loop_is_wedged(tmp_path):
+    """Regression: a WEDGED loop never reaches another step boundary,
+    so the boundary hook alone could never evaluate the stall rule —
+    the background ticker must fire it on wall time with zero calls
+    from the (stuck) training thread."""
+    from spacy_ray_tpu.alerting import AbsenceRule
+    from spacy_ray_tpu.training.telemetry import Telemetry
+
+    tel = Telemetry(
+        tmp_path / "tel",
+        anomaly_detection=False,
+        alert_rules=[
+            AbsenceRule("training-stalled", "counters.steps", stale_s=0.3)
+        ],
+        alert_interval_s=0.05,
+    )
+    try:
+        tel.maybe_evaluate_alerts(force=True)  # last boundary ever reached
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            states = {r["alert"]: r["state"] for r in tel.alerts.states()}
+            if states["training-stalled"] == "firing":
+                break
+            time.sleep(0.05)
+        assert states["training-stalled"] == "firing", states
+    finally:
+        tel.finalize()
+    # finalize stops the ticker
+    assert tel._alert_ticker is None
+
+
+def test_flight_payload_bounds_trace_tail():
+    """Regression: the black box is rewritten every tick — a full
+    100k-event span ring would serialize tens of MB each time. The
+    payload keeps thread-name metadata plus a bounded span tail and
+    says how much it dropped."""
+    from spacy_ray_tpu.training.telemetry import TraceBuffer
+
+    tb = TraceBuffer()
+    for i in range(50):
+        tb.add_span(f"s{i}", tb.now(), 0.001, force=True)
+    rec = FlightRecorder(trace_tail_events=10)
+    rec.attach(trace=tb)
+    trace = rec.payload()["trace"]
+    spans = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+    assert len(spans) == 10
+    assert spans[-1]["name"] == "s49"  # the newest survive
+    assert trace["truncated_events"] == 40
+    # metadata (thread names) still present for the Perfetto render
+    assert any(e.get("ph") == "M" for e in trace["traceEvents"])
+
+
+def test_telemetry_alerting_off_constructs_no_engine(tmp_path, monkeypatch):
+    from spacy_ray_tpu import alerting as alerting_mod
+    from spacy_ray_tpu.training.telemetry import Telemetry
+
+    def _boom(*a, **k):
+        raise AssertionError("AlertEngine constructed with alerting off")
+
+    monkeypatch.setattr(alerting_mod.AlertEngine, "__init__", _boom)
+    tel = Telemetry(tmp_path / "tel", alerting=False)
+    assert tel.alerts is None
+    tel.maybe_evaluate_alerts(force=True)  # no-op, no raise
+    tel.finalize()
+
+
+# ----------------------------------------------------------------------
+# Zero-call guard at fleet scope: telemetry off = no diagnosis layer,
+# even with an incidents dir configured
+# ----------------------------------------------------------------------
+
+
+def test_fleet_disabled_telemetry_builds_no_alerts_or_recorder(
+    tmp_path, monkeypatch
+):
+    from spacy_ray_tpu import alerting as alerting_mod
+    from spacy_ray_tpu import incidents as incidents_mod
+    from spacy_ray_tpu.serving.fleet import Fleet, FleetConfig
+
+    def _boom(*a, **k):
+        raise AssertionError(
+            "diagnosis layer constructed on the disabled-telemetry path"
+        )
+
+    monkeypatch.setattr(alerting_mod.AlertEngine, "__init__", _boom)
+    monkeypatch.setattr(incidents_mod.FlightRecorder, "__init__", _boom)
+    fleet = Fleet(
+        FleetConfig(
+            model_path="unused",
+            port=0,
+            telemetry=False,
+            incidents_dir=str(tmp_path / "incidents"),
+        )
+    )
+    try:
+        assert fleet.alerts is None and fleet.recorder is None
+        assert fleet.supervisor.on_crash is None
+        # the replica argv must not arm the replica-side recorder either
+        cmd = fleet.config.build_cmd(0)
+        assert "--incidents-dir" not in cmd and "--blackbox" not in cmd
+        assert not (tmp_path / "incidents").exists()
+    finally:
+        fleet.httpd.server_close()
+
+
+def test_server_without_diagnosis_layer_starts_no_observer():
+    """Server(alerts=None, recorder=None) — the --no-telemetry wiring —
+    must not spawn the observer ticker at all."""
+    from spacy_ray_tpu.serving.server import Server
+
+    class _Engine:
+        ready = True
+        serving_generation = None
+        swap_count = 0
+
+    server = Server(_Engine(), "127.0.0.1", 0)
+    try:
+        server.start()
+        assert server._observer is None
+        assert not any(
+            t.name == "serve-observer" for t in threading.enumerate()
+        )
+    finally:
+        server.httpd.shutdown()
+        server.httpd.server_close()

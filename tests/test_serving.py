@@ -546,6 +546,13 @@ def test_disabled_telemetry_makes_zero_calls(serve_nlp, monkeypatch):
 
     monkeypatch.setattr(telemetry_mod.MetricsRegistry, "__init__", _boom)
     monkeypatch.setattr(telemetry_mod.TraceBuffer, "__init__", _boom)
+    # PR 12's diagnosis layer obeys the same contract: no telemetry =
+    # no alert engine, no flight recorder, no observer ticker
+    from spacy_ray_tpu import alerting as alerting_mod
+    from spacy_ray_tpu import incidents as incidents_mod
+
+    monkeypatch.setattr(alerting_mod.AlertEngine, "__init__", _boom)
+    monkeypatch.setattr(incidents_mod.FlightRecorder, "__init__", _boom)
     engine = InferenceEngine(
         serve_nlp, max_batch_docs=4, max_wait_s=0.01, max_doc_len=32
     )

@@ -392,6 +392,14 @@ def test_disabled_telemetry_constructs_nothing(
 
     monkeypatch.setattr(telemetry_mod.Telemetry, "__init__", _boom)
     monkeypatch.setattr(telemetry_mod.MetricsRegistry, "__init__", _boom)
+    # the PR 12 diagnosis layer rides inside Telemetry: with telemetry
+    # off there must be zero rule evaluations, zero flight-ring writes,
+    # zero incident I/O — any construction raises
+    from spacy_ray_tpu import alerting as alerting_mod
+    from spacy_ray_tpu import incidents as incidents_mod
+
+    monkeypatch.setattr(alerting_mod.AlertEngine, "__init__", _boom)
+    monkeypatch.setattr(incidents_mod.FlightRecorder, "__init__", _boom)
     cfg = _config(tagger_config_text, data_dir, **{"training.max_steps": 2})
     _, result = train(cfg, n_workers=1, stdout_log=False)
     assert result.final_step == 2
