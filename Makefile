@@ -73,15 +73,21 @@ step-perf:
 	JAX_PLATFORMS=cpu python bench.py --update-only
 	JAX_PLATFORMS=cpu python bin/profile_trf.py --sweep
 
-# per-replica serving speed A/Bs (PERF.md round 9): window vs continuous
-# admission and f32 vs bf16 precision overlay, each open-loop at FIXED
-# offered rates (committed baseline + saturation points); records append
-# to BENCH_SESSION.jsonl with honest batching/precision labels. The
-# tier-1 smoke of the same harness lives in tests/test_serving.py; the
-# sustained variants are slow-marked.
+# per-replica serving speed A/Bs (PERF.md rounds 9 + 13): window vs
+# continuous admission, and the f32 vs bf16 vs int8 precision-overlay
+# arms (the int8 arm self-forces SRT_PALLAS_INT8=1 on CPU so the pallas
+# kernel runs interpret-mode with an honest "forced" label), each
+# open-loop at FIXED offered rates (committed baseline + saturation
+# points) — then the Zipfian edge-cache spec through the real fleet at
+# the armed cache default (hit-rate x window p99, zero rejects/5xx).
+# Records append to BENCH_SESSION.jsonl with honest batching/precision
+# labels. The tier-1 smoke of the same harness lives in
+# tests/test_serving.py; interpret-mode int8 kernel tests run in tier-1
+# (tests/test_int8.py, CPU-only, fast) like the other pallas suites.
 serve-perf:
 	JAX_PLATFORMS=cpu python bench.py --serving-ab
 	JAX_PLATFORMS=cpu python bench.py --serving
+	JAX_PLATFORMS=cpu python bench.py --serving --zipfian
 
 # cross-replica update sharding (PERF.md "Update sharding (round 11)"):
 # the full==replicated equality suite + v2 owner-shard checkpoint format +

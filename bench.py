@@ -2039,12 +2039,16 @@ def run_serving_ab(
     window (SERVING_DEFAULTS max_wait_s), not the bench's 2 ms, because
     the A/B claim is about the shipped configuration.
 
-    Pair 2 — f32 vs bf16 precision overlay (tiny trf: the cnn model has
-    no trunk and the overlay honestly refuses it). Same fixed rate both
-    arms. On CPU the bf16 arm must be FORCED (auto resolves f32 — the
-    PR 5 policy) and its record label says so; the honest-labeling
-    contract is the point of the record, not a CPU speedup (XLA CPU
-    emulates bf16 — PERF.md)."""
+    Pair 2 — f32 vs bf16 vs int8 precision overlay (tiny trf: the cnn
+    model has no trunk and the overlay honestly refuses it). Same fixed
+    rate for every arm. On CPU the bf16 arm must be FORCED (auto
+    resolves f32 — the PR 5 policy) and the int8 arm must be forced too
+    (SRT_PALLAS_INT8=1 runs the pallas kernel interpret-mode — the CPU
+    auto policy keeps the overlay OFF, same shape as bf16's); both
+    record labels say so. The honest-labeling contract is the point of
+    the CPU record, not a speedup (interpret-mode pallas is an
+    emulation; the bandwidth win the int8 overlay exists for — weights
+    streaming at 1/4 the f32 bytes — is a TPU property, PERF.md)."""
     from spacy_ray_tpu.serving.engine import SERVING_DEFAULTS
 
     records: List[Dict[str, Any]] = []
@@ -2147,17 +2151,35 @@ def run_serving_ab(
         prate_src = "measured_f32_closed_x0.6"
     print(f"# precision A/B: fixed {prate:.1f} req/s ({prate_src})",
           flush=True)
-    for precision in ("f32", "bf16"):
-        fields, labels = _run_one_open_arm(
-            trf_nlp,
-            engine_kwargs={
-                "max_batch_docs": 8,
-                "max_doc_len": 32,
-                "timeout_s": 30.0,
-                "precision": precision,
-            },
-            rate=prate, duration_s=duration_s, texts_pool=texts_pool,
-        )
+    import jax
+
+    import spacy_ray_tpu.ops.int8_matmul as _i8
+
+    for precision in ("f32", "bf16", "int8"):
+        saved_int8 = os.environ.get("SRT_PALLAS_INT8")
+        if precision == "int8" and jax.default_backend() != "tpu":
+            # the forced arm: without this the CPU probe honestly
+            # refuses and the record would just be a third f32 arm
+            os.environ["SRT_PALLAS_INT8"] = "1"
+            _i8._PROBE_CACHE.clear()
+        try:
+            fields, labels = _run_one_open_arm(
+                trf_nlp,
+                engine_kwargs={
+                    "max_batch_docs": 8,
+                    "max_doc_len": 32,
+                    "timeout_s": 30.0,
+                    "precision": precision,
+                },
+                rate=prate, duration_s=duration_s, texts_pool=texts_pool,
+            )
+        finally:
+            if precision == "int8":
+                if saved_int8 is None:
+                    os.environ.pop("SRT_PALLAS_INT8", None)
+                else:
+                    os.environ["SRT_PALLAS_INT8"] = saved_int8
+                _i8._PROBE_CACHE.clear()
         rec = {
             "name": "serving_precision_open",
             "metric": (
@@ -2687,6 +2709,214 @@ def run_serving_fleet(
     return records
 
 
+def zipf_ranks(
+    n_keys: int, n_samples: int, s: float = 1.1, seed: int = 0
+) -> List[int]:
+    """Zipfian key indices: P(rank r) ∝ 1/r^s over ``n_keys`` distinct
+    keys — the standard model for heavy web/serving traffic (a few keys
+    dominate, a long tail trickles). Deterministic given the seed, so
+    the committed record's offered key sequence is reproducible. Pure
+    function (unit-tested without a fleet)."""
+    import random
+
+    weights = [1.0 / (r ** s) for r in range(1, n_keys + 1)]
+    rng = random.Random(seed)
+    return rng.choices(range(n_keys), weights=weights, k=n_samples)
+
+
+def run_serving_zipfian(
+    platform: str,
+    *,
+    replicas: int = 1,
+    duration_s: float = 8.0,
+    open_rate: Optional[float] = None,
+    zipf_s: float = 1.1,
+    n_keys: int = 64,
+    max_batch: int = 16,
+    max_wait_ms: float = 2.0,
+    texts_per_request: int = 2,
+) -> Dict[str, Any]:
+    """``--serving --zipfian``: open-loop load with a ZIPFIAN key
+    distribution through the REAL fleet (router + serve subprocesses)
+    with the response cache at its armed-by-default budget — the
+    ROADMAP 3b proof. Uniform replay (every request distinct) can only
+    show the cache's overhead; real heavy traffic is Zipfian, and the
+    headline is hit-rate x window-p99: what fraction of requests never
+    touched a replica, and what the requests that DID touch one saw.
+
+    The record requires zero rejects and zero 5xx (the cache must be a
+    pure win at the committed rate), reads the hit/miss/bypass ledger
+    from the router's /metrics ``cache`` block (the same surface
+    ``telemetry top`` and the srt_router_cache_* Prometheus series
+    read), and carries both latency views: client end-to-end
+    percentiles (hits included — the user experience) and the fleet's
+    merged sliding-window p99 (replica-side, misses only — the SLO the
+    autoscaler watches)."""
+    import tempfile
+
+    from spacy_ray_tpu.serving.fleet import Fleet, FleetConfig
+
+    nlp = _serving_nlp()
+    tmpdir = tempfile.mkdtemp(prefix="srt_zipf_bench_")
+    model_dir = Path(tmpdir) / "model"
+    nlp.to_disk(model_dir)
+    del nlp
+
+    device = "cpu" if platform == "cpu" else platform
+    cpu_cores: Optional[List[str]] = None
+    if device == "cpu":
+        cpu_cores = [str(c) for c in sorted(os.sched_getaffinity(0))]
+    # cache_mb deliberately NOT set: the spec proves the armed DEFAULT
+    # (FleetConfig.cache_mb > 0 since this round), not a bench-only knob
+    config = FleetConfig(
+        model_path=str(model_dir),
+        host="127.0.0.1",
+        port=0,
+        device=device,
+        replicas=replicas,
+        min_replicas=replicas,
+        max_replicas=replicas,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        queue_size=max(8 * max_batch, 128),
+        timeout_ms=30_000.0,
+        max_doc_len=64,
+        cpu_cores=cpu_cores,
+        autoscale=False,
+        telemetry=True,
+    )
+    cache_mb = float(config.cache_mb)
+    if open_rate:
+        rate, rate_source = float(open_rate), "cli"
+    else:
+        committed = _committed_session_value(
+            "serving_zipfian_open", platform=platform, replicas=replicas,
+            zipf_s=zipf_s, zipf_keys=n_keys,
+        ) or _committed_session_value(
+            "serving_fleet_open", platform=platform, replicas=replicas,
+            max_batch_docs=max_batch, texts_per_request=texts_per_request,
+        )
+        rate, rate_source = committed or (18.0, "fallback:18rps")
+
+    # the key space: n_keys distinct request bodies, replayed with
+    # Zipfian frequency — same text lengths as every other serving spec
+    key_pool = [_serving_texts(texts_per_request, seed=i)
+                for i in range(n_keys)]
+    n_requests = max(int(duration_s * rate), 1)
+    ranks = zipf_ranks(n_keys, n_requests, s=zipf_s, seed=1)
+    texts_seq = [key_pool[r] for r in ranks]
+    unique_offered = len(set(ranks))
+
+    fleet = Fleet(config)
+    try:
+        t0 = time.perf_counter()
+        host, port = fleet.start()
+        if not fleet.wait_ready(replicas, timeout_s=600.0):
+            ready = len(fleet.router.ready_handles())
+            print(f"# zipfian bench: only {ready}/{replicas} replicas "
+                  "ready — recording a skip", flush=True)
+            _append_session(
+                {"name": "serving_zipfian_open", "skipped": True,
+                 "reason": f"{ready}/{replicas} replicas ready in 600s"},
+                platform,
+            )
+            return {}
+        ready_seconds = time.perf_counter() - t0
+        print(f"# zipfian bench: {replicas} replica(s) ready in "
+              f"{ready_seconds:.1f}s; {rate:.1f} req/s ({rate_source}), "
+              f"zipf s={zipf_s} over {n_keys} keys "
+              f"({unique_offered} offered), cache {cache_mb:.0f}MB "
+              "(fleet default)", flush=True)
+        wall, shots = _drive_open_timed(
+            host, port, duration_s, rate, texts_seq
+        )
+        # the ledger + the fleet window, from the same endpoint the
+        # dashboards scrape
+        try:
+            status, metrics = _get_json(host, port, "/metrics")
+        except OSError:
+            status, metrics = 0, {}
+        cache_stats = (metrics or {}).get("cache") or {}
+        win = ((metrics or {}).get("fleet") or {}).get("slo_window") or {}
+        prom_lines = _prometheus_scrape_lines(host, port)
+    finally:
+        fleet.request_shutdown()
+        fleet.wait()
+
+    ok = [(t, dt) for t, dt, st in shots if st == 200]
+    rejected = sum(1 for _, _, st in shots if st == 429)
+    http_5xx = sum(1 for _, _, st in shots if st >= 500)
+    failed = sum(1 for _, _, st in shots if st < 0)
+    hits = int(cache_stats.get("cache_hits") or 0)
+    misses = int(cache_stats.get("cache_misses") or 0)
+    hit_rate = round(hits / (hits + misses), 4) if hits + misses else None
+    ms = lambda v: round(v * 1e3, 2) if isinstance(v, (int, float)) else None  # noqa: E731
+    client = _latency_stats([dt for _, dt in ok])
+    rec = {
+        "name": "serving_zipfian_open",
+        "metric": (
+            f"zipfian_cache_hit_rate_x_window_p99 (fixed {rate:.0f} req/s "
+            f"offered, zipf s={zipf_s} over {n_keys} keys, {replicas} "
+            "replica(s), edge cache at the armed default, HTTP)"
+        ),
+        "value": hit_rate,
+        "unit": "cache hit rate",
+        "platform": platform,
+        "mode": "open",
+        "replicas": replicas,
+        "offered_rps": round(rate, 1),
+        "offered_rate_source": rate_source,
+        "duration_s": round(wall, 2),
+        "requests_ok": len(ok),
+        "rejected": rejected,
+        "failed": failed,
+        "http_5xx": http_5xx,
+        "zipf_s": zipf_s,
+        "zipf_keys": n_keys,
+        "zipf_unique_offered": unique_offered,
+        "texts_per_request": texts_per_request,
+        "max_batch_docs": max_batch,
+        "cache_mb_default": cache_mb,
+        "cache_hit_rate": hit_rate,
+        "cache_hits": hits,
+        "cache_misses": misses,
+        "cache_stale_invalidations": int(
+            cache_stats.get("cache_stale_invalidations") or 0
+        ),
+        "cache_mixed_generation_bypasses": int(
+            cache_stats.get("cache_mixed_generation_bypasses") or 0
+        ),
+        "cache_entries": int(cache_stats.get("cache_entries") or 0),
+        "cache_bytes": int(cache_stats.get("cache_bytes") or 0),
+        # replica-side sliding-window percentiles: misses only (a hit
+        # never reaches a replica), the autoscaler's signal
+        "window_p99_ms": ms(win.get("request_latency_p99")),
+        "window_p50_ms": ms(win.get("request_latency_p50")),
+        "window_samples": win.get("samples"),
+        "prometheus_scrape_lines": prom_lines,
+        "ready_seconds": round(ready_seconds, 1),
+        "cpu_cores": cpu_cores,
+        **client,
+    }
+    bad = rejected + http_5xx + failed
+    if bad:
+        # the committed record REQUIRES zero rejects/5xx (the cache must
+        # be a pure win at the committed rate) — a dirty run still lands
+        # in the session log as evidence, but marked skipped so it can
+        # never become the committed rate source for later rounds
+        rec["skipped"] = True
+        rec["reason"] = (
+            f"contract violated: {rejected} reject(s), {http_5xx} 5xx, "
+            f"{failed} transport failure(s) — the zipfian record "
+            "requires zero of each"
+        )
+        print(f"# zipfian bench: {rec['reason']}; recording a skip",
+              flush=True)
+    print(json.dumps(rec), flush=True)
+    _append_session(rec, platform)
+    return rec
+
+
 def _accelerator_reachable(timeout: float = 180.0) -> bool:
     """Probe the default (accelerator) backend in a THROWAWAY subprocess.
 
@@ -2990,6 +3220,25 @@ def main() -> None:
         "so the scaling curve lives in BENCH_SESSION.jsonl",
     )
     parser.add_argument(
+        "--zipfian", action="store_true",
+        help="--serving: run the Zipfian edge-cache spec instead — "
+        "open-loop load whose key distribution is Zipf(--zipf-s) over "
+        "--zipf-keys distinct request bodies, through the real fleet "
+        "(router + replicas) with the response cache at its armed "
+        "default; the record commits cache hit-rate x window p99 and "
+        "requires zero rejects/5xx; lands in BENCH_SESSION.jsonl",
+    )
+    parser.add_argument(
+        "--zipf-s", type=float, default=1.1,
+        help="--serving --zipfian: Zipf exponent (1.0-1.2 is typical "
+        "web traffic; higher = more skew = higher hit rate)",
+    )
+    parser.add_argument(
+        "--zipf-keys", type=int, default=64,
+        help="--serving --zipfian: distinct request bodies in the key "
+        "space",
+    )
+    parser.add_argument(
         "--swap", action="store_true",
         help="--serving: run the live hot-swap spec instead — open-loop "
         "load at the committed offered rate while forcing --swap-count "
@@ -3051,6 +3300,19 @@ def main() -> None:
                 swaps=int(args.swap_count),
                 open_rate=float(args.serving_rate) or None,
             )
+        elif args.zipfian:
+            counts = [
+                int(c) for c in args.replicas.split(",") if c.strip()
+            ] or [1]
+            for n in counts:  # one record per replica count, fleet-spec style
+                run_serving_zipfian(
+                    jax.default_backend(),
+                    replicas=n,
+                    duration_s=max(float(args.serving_duration), 6.0),
+                    open_rate=float(args.serving_rate) or None,
+                    zipf_s=float(args.zipf_s),
+                    n_keys=int(args.zipf_keys),
+                )
         elif args.replicas.strip():
             counts = [
                 int(c) for c in args.replicas.split(",") if c.strip()

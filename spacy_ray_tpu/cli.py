@@ -1053,6 +1053,36 @@ def info_command(argv: List[str]) -> int:
                 print(f"accelerator      reachable: {platform_name} x{n}")
                 if len(lines) > 1:
                     print(f"update_sharding  auto -> {lines[1].strip()}")
+                # the int8 precision-overlay resolution is evidence, not
+                # policy (the probe COMPILES + validates the pallas
+                # matmul on the probed backend) — so it gets its OWN
+                # child and timeout: a slow kernel compile must not
+                # swallow the reachability/update_sharding lines above,
+                # and its timeout must not read as "backend unreachable"
+                p2 = subprocess.Popen(
+                    [sys.executable, "-c",
+                     "import jax; d = jax.devices(); "
+                     "from spacy_ray_tpu.serving.overlay import "
+                     "resolve_precision as rp; "
+                     "res = rp('int8', d[0].platform); "
+                     "print(res[0] + ' (' + res[1] + ')')"],
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True,
+                )
+                try:
+                    out2, _ = p2.communicate(timeout=60)
+                    if p2.returncode == 0 and out2.strip():
+                        print("precision        int8 -> "
+                              f"{out2.strip().splitlines()[-1].strip()}")
+                    else:
+                        print("precision        int8 -> unresolved "
+                              "(probe child failed)")
+                except subprocess.TimeoutExpired:
+                    from .training.resilience import terminate_with_grace
+
+                    terminate_with_grace(p2, grace_s=SHUTDOWN_GRACE_S)
+                    print("precision        int8 -> unresolved "
+                          "(kernel probe exceeded 60s)")
             else:
                 print("accelerator      UNREACHABLE (backend init failed)")
         except subprocess.TimeoutExpired:
@@ -1647,9 +1677,11 @@ def serve_command(argv: List[str]) -> int:
                         "'auto' arms a bf16 trunk overlay on accelerators "
                         "and resolves f32 on CPU (emulated bf16 is a "
                         "measured pessimization there); 'bf16' forces the "
-                        "overlay; 'int8' is probe-gated (refuses — and "
-                        "serves f32 with an honest label — until an int8 "
-                        "serving kernel exists)")
+                        "overlay; 'int8' arms the weight-only pallas "
+                        "dequant-in-kernel overlay where the probe "
+                        "passes (TPU; CPU only under SRT_PALLAS_INT8=1, "
+                        "interpret-mode) and serves f32 with an honest "
+                        "refusal label everywhere else")
     parser.add_argument("--queue-size", type=int,
                         default=SERVING_DEFAULTS["max_queue_docs"],
                         help="bounded admission queue (docs); beyond it "
@@ -1895,10 +1927,12 @@ def serve_fleet_command(argv: List[str]) -> int:
                         "the serve default, auto — bf16 on accelerators, "
                         "f32 on CPU)")
     # router knobs
-    parser.add_argument("--cache-mb", type=float, default=0.0,
+    parser.add_argument("--cache-mb", type=float, default=32.0,
                         help="router response cache budget in MB, keyed by "
-                        "input-text hash (0 = off); hit/miss counters in "
-                        "/metrics")
+                        "input-text hash and stamped with the serving "
+                        "generation (default ON at 32MB — heavy real "
+                        "traffic is Zipfian; 0 = off); hit/miss/stale/"
+                        "bypass counters in /metrics")
     parser.add_argument("--probe-interval-s", type=float, default=0.5,
                         help="how often the router re-probes each "
                         "replica's /healthz")

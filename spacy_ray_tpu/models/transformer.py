@@ -152,6 +152,16 @@ TRUNK_F32_LEAF_NAMES = frozenset({
     "ln1_g", "ln1_b", "ln2_g", "ln2_b", "router_W",
 })
 
+# Leaves the int8 weight-only serving overlay quantizes: the DENSE 2-D
+# matmul weights (the bandwidth-bound operands a small serving batch
+# re-streams from HBM every dispatch). Biases stay f32 (weight-only),
+# and the MoE expert weights are deliberately NOT covered — they flow
+# through einsum contractions the int8 kernel does not implement, and
+# an "int8" label over a trunk whose parameter mass stays f32 would be
+# a false claim (the overlay REFUSES MoE trunks instead; test-enforced).
+INT8_LEAF_NAMES = frozenset({"qkv_W", "o_W", "ffn_W1", "ffn_W2"})
+INT8_UNSUPPORTED_LEAF_NAMES = frozenset({"e_W1", "e_W2"})
+
 
 def shadow_coverage(params) -> "Tuple[int, List[str]]":
     """Audit a param tree against the shadow scheme: returns
@@ -207,6 +217,76 @@ def build_param_shadow(params, dtype=jnp.bfloat16):
         return out
 
     return rec(params, False) or None
+
+
+def int8_unsupported_leaves(params) -> "List[str]":
+    """Paths of trunk leaves the int8 overlay cannot cover (MoE expert
+    weights). Non-empty means :func:`build_int8_overlay` must not run:
+    the overlay would quantize the dense shell of a model whose weight
+    mass lives in the experts, and the label would lie."""
+    out: List[str] = []
+
+    def rec(node, in_layer, path):
+        for k, v in node.items():
+            if isinstance(v, dict):
+                rec(v, in_layer or str(k).startswith("layer_"), path + (str(k),))
+            elif in_layer and k in INT8_UNSUPPORTED_LEAF_NAMES:
+                out.append("/".join(path + (str(k),)))
+
+    rec(params, False, ())
+    return out
+
+
+def build_int8_overlay(params) -> "Tuple[Any, int]":
+    """The int8 weight-only serving overlay: a copy of ``params`` where
+    every f32 INT8_LEAF_NAMES leaf under a ``layer_i`` dict is replaced
+    by ``{"q8": int8 [K, N], "scale": f32 [N]}`` (per-output-channel
+    symmetric quantization, ops/int8_matmul.py). Everything else — LNs,
+    biases, embeddings, heads — is the SAME array object as the master
+    tree (no copies). Returns ``(tree, n_quantized)``.
+
+    The layer forward consumes these dict leaves through ``_wdot``; the
+    dict structure is part of the jit trace, so a hot-swap that
+    re-quantizes a new generation (same structure, same dtypes) reuses
+    every warmed program — zero post-swap compiles, test-enforced."""
+    from ..ops.int8_matmul import quantize_int8
+
+    n = 0
+
+    def rec(node, in_layer):
+        nonlocal n
+        out = {}
+        for k, v in node.items():
+            if isinstance(v, dict):
+                out[k] = rec(v, in_layer or str(k).startswith("layer_"))
+            elif (
+                in_layer
+                and k in INT8_LEAF_NAMES
+                and jnp.asarray(v).dtype == jnp.float32
+            ):
+                q8, scale = quantize_int8(v)
+                out[k] = {"q8": q8, "scale": scale}
+                n += 1
+            else:
+                out[k] = v
+        return out
+
+    return rec(params, False), n
+
+
+def _wdot(h: jnp.ndarray, leaf, compute_dtype) -> jnp.ndarray:
+    """Trunk weight matmul that understands the two leaf encodings: a
+    plain array (cast to the compute dtype — the training/bf16 path) or
+    an int8 serving-overlay dict (``{"q8", "scale"}`` — dequantize-in-
+    kernel pallas matmul, f32 accumulation, downcast to the compute
+    dtype so the surrounding arithmetic is dtype-identical either way).
+    The isinstance check runs at trace time: each param-tree structure
+    compiles once, exactly like a dtype change would."""
+    if isinstance(leaf, dict):
+        from ..ops.int8_matmul import int8_matmul
+
+        return int8_matmul(h, leaf["q8"], leaf["scale"]).astype(compute_dtype)
+    return h @ leaf.astype(compute_dtype)
 
 
 def pipeline_shadow_dtype(nlp) -> Optional[Any]:
@@ -274,7 +354,7 @@ def apply_transformer_layer(
     # ---- attention ----
     h = O.layer_norm(X, p["ln1_g"], p["ln1_b"])
     h16 = h.astype(compute_dtype)
-    qkv = h16 @ p["qkv_W"].astype(compute_dtype) + p["qkv_b"].astype(compute_dtype)
+    qkv = _wdot(h16, p["qkv_W"], compute_dtype) + p["qkv_b"].astype(compute_dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
     def heads(x):
@@ -299,7 +379,7 @@ def apply_transformer_layer(
 
         attn = attention(q, k, v, mask)
     attn = attn.reshape(B, T, D)
-    out = attn @ p["o_W"].astype(compute_dtype) + p["o_b"].astype(compute_dtype)
+    out = _wdot(attn, p["o_W"], compute_dtype) + p["o_b"].astype(compute_dtype)
     out = out.astype(jnp.float32)
     if use_dropout:
         out = O.dropout(rng1, out, dropout, True)
@@ -319,10 +399,10 @@ def apply_transformer_layer(
         out = out2d.reshape(B, T, D)
     else:
         h16 = h.astype(compute_dtype)
-        inner = h16 @ p["ffn_W1"].astype(compute_dtype) + p["ffn_b1"].astype(compute_dtype)
+        inner = _wdot(h16, p["ffn_W1"], compute_dtype) + p["ffn_b1"].astype(compute_dtype)
         inner = _maybe_shard(inner, P("data", "context", "model"))
         inner = O.gelu(inner)
-        out = inner @ p["ffn_W2"].astype(compute_dtype) + p["ffn_b2"].astype(compute_dtype)
+        out = _wdot(inner, p["ffn_W2"], compute_dtype) + p["ffn_b2"].astype(compute_dtype)
         out = out.astype(jnp.float32)
     if use_dropout:
         out = O.dropout(rng2, out, dropout, True)

@@ -1,0 +1,279 @@
+"""Pallas int8 weight-only matmul for the serving precision overlay.
+
+The serving overlay's ``--precision int8`` knob existed since PR 7 with
+an honestly-refusing probe (``serving/overlay.py:_probe_int8`` — "no
+int8 serving kernel on <backend>"). This module is that kernel: the
+weights of the transformer trunk's dense matmuls are quantized ONCE at
+overlay build time to int8 with per-output-channel symmetric scales
+(``quantize_int8``), and the forward consumes them through this
+pallas_call — the int8 block is dequantized IN-KERNEL (int8 -> f32 on
+the VPU, one multiply by the channel scale after the dot) so HBM streams
+the weights at 1/4 of their f32 byte volume while the MXU still
+accumulates in f32. Activations stay in the compute dtype (weight-only
+quantization: the activation distribution is input-dependent and NOT
+quantized — SURVEY.md's serving-precision ladder, and the standard
+weight-only serving recipe).
+
+Why the memory shape matters: serving batches are small (continuous
+admission dispatches at occupancy 2-8 on the committed records), so the
+trunk matmuls are BANDWIDTH-bound — every dispatched batch re-streams
+the whole weight matrix from HBM. Quartering the weight bytes is the
+per-replica multiplier ROADMAP item 3a names; the arithmetic itself was
+never the bottleneck at these occupancies.
+
+Honesty rules (the flash-attention/fused-update discipline, verbatim):
+
+* enabled ONLY by :func:`int8_probe` — compile + numeric validation vs
+  the f32-dequant reference on the current backend; ``SRT_PALLAS_INT8=1``
+  forces on (interpret-mode on non-TPU backends, so CPU tests and the
+  forced bench arm run the REAL kernel body, interpreted), ``=0`` forces
+  off; default auto-enables on TPU only.
+* the probe's reason string is the overlay label's source of truth:
+  "active (pallas)" only when the compiled kernel runs, "active (pallas
+  interpret-mode, forced)" when interpreted, a typed refusal otherwise.
+* shapes whose per-block VMEM working set exceeds the budget fall back
+  to the jnp dequant matmul (same numbers, no kernel) — the same
+  host-side guard as ``flash_attention.attention_vmem_ok``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "quantize_int8",
+    "dequantize_int8",
+    "reference_int8_matmul",
+    "int8_matmul",
+    "int8_matmul_enabled",
+    "int8_probe",
+]
+
+BM = 128   # activation rows per grid step (MXU-aligned)
+BN = 128   # output-channel block (lane-aligned)
+KP = 128   # contraction dim padded to a lane multiple
+# VMEM budget for one grid step: x block (f32) + w block (int8) + out +
+# scale. K stays fully resident per step (encoder trunk K <= ~4k).
+VMEM_INT8_BUDGET = 10 * 1024 * 1024
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_IMPORTED = True
+except Exception:  # pragma: no cover
+    _PALLAS_IMPORTED = False
+
+
+# ------------------------------------------------------------ quantization
+
+
+def quantize_int8(w: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-output-channel symmetric int8 quantization of a weight array
+    whose LAST axis is the output channel: returns ``(q8, scale)`` with
+    ``q8`` int8 in [-127, 127] and ``scale`` f32 per channel, such that
+    ``q8 * scale ~= w`` with per-element error bounded by ``scale / 2``
+    (round-to-nearest; test-enforced). Symmetric (no zero point): the
+    dequant epilogue stays one multiply, and trunk weight distributions
+    are zero-centered (glorot/normal init, weight decay)."""
+    w = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(range(w.ndim - 1))
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes)
+    scale = jnp.maximum(absmax / 127.0, 1e-12).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q8: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """``q8 [..., N] int8, scale [N] f32 -> f32`` — the reference
+    reconstruction the kernel's in-VMEM dequant must match."""
+    return q8.astype(jnp.float32) * scale
+
+
+def reference_int8_matmul(
+    x: jnp.ndarray, q8: jnp.ndarray, scale: jnp.ndarray
+) -> jnp.ndarray:
+    """jnp fallback/reference: ``x [..., K] @ dequant(q8 [K, N]) -> [..., N]``
+    in f32 — what the pallas kernel is validated against."""
+    return x.astype(jnp.float32) @ dequantize_int8(q8, scale)
+
+
+# ----------------------------------------------------------------- kernel
+
+
+def _kernel(x_ref, wq_ref, s_ref, o_ref):
+    # x [BM, K] f32, wq [K, BN] int8, s [1, BN] f32 -> o [BM, BN] f32.
+    # Dequantize-in-kernel: the int8 block upcasts on the VPU; the scale
+    # multiply lands on the f32 accumulator AFTER the dot (exact: scale
+    # is constant per output column, so (x @ q) * s == x @ (q * s)).
+    x = x_ref[...]
+    w = wq_ref[...].astype(jnp.float32)
+    acc = jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    o_ref[...] = acc * s_ref[...]
+
+
+_INTERPRET = False  # tests flip this to run the kernel body on CPU
+
+
+def _pad_axis(a: jnp.ndarray, axis: int, mult: int, value=0) -> jnp.ndarray:
+    pad = (-a.shape[axis]) % mult
+    if not pad:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(a, widths, constant_values=value)
+
+
+def _int8_matmul_raw(
+    x2: jnp.ndarray, q8: jnp.ndarray, scale: jnp.ndarray,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """[M, K] f32, [K, N] int8, [N] f32 -> [M, N] f32. Pads M/N/K to the
+    block grid (zero rows/columns contribute nothing; padded scale
+    columns are sliced away with their outputs)."""
+    if interpret is None:
+        # forced-on non-TPU backends (CPU tests, the forced bench arm)
+        # run the same kernel body through the pallas interpreter — the
+        # numbers are the kernel's, only the execution engine differs
+        interpret = _INTERPRET or jax.default_backend() != "tpu"
+    M, K = x2.shape
+    N = q8.shape[1]
+    xp = _pad_axis(_pad_axis(x2, 0, BM), 1, KP)
+    wp = _pad_axis(_pad_axis(q8, 0, KP), 1, BN)
+    sp = _pad_axis(scale.reshape(1, -1), 1, BN, value=1.0)
+    Mp, Kp = xp.shape
+    Np = wp.shape[1]
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((Mp, Np), jnp.float32),
+        grid=(Mp // BM, Np // BN),
+        in_specs=[
+            pl.BlockSpec((BM, Kp), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Kp, BN), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BN), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((BM, BN), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(xp, wp, sp)
+    return out[:M, :N]
+
+
+def int8_vmem_ok(K: int) -> bool:
+    """Whether one grid step's working set (x block f32 + w block int8 +
+    out block f32 + scale row) fits the VMEM budget for contraction dim
+    ``K`` (kept fully resident per step)."""
+    Kp = ((K + KP - 1) // KP) * KP
+    need = BM * Kp * 4 + Kp * BN * 1 + BM * BN * 4 + BN * 4
+    return need <= VMEM_INT8_BUDGET
+
+
+def int8_matmul(
+    x: jnp.ndarray, q8: jnp.ndarray, scale: jnp.ndarray
+) -> jnp.ndarray:
+    """Weight-only int8 matmul: ``x [..., K]`` (any float dtype) times a
+    quantized weight ``q8 [K, N] int8`` with per-channel ``scale [N]``;
+    returns f32 ``[..., N]``. Uses the pallas kernel (compiled on TPU,
+    interpreted where the probe armed it that way); contraction dims past
+    the VMEM budget fall back to the jnp dequant matmul — identical
+    numbers, no kernel."""
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    x2 = x.reshape(-1, K).astype(jnp.float32)
+    if not int8_vmem_ok(K):
+        return reference_int8_matmul(x2, q8, scale).reshape(*lead, q8.shape[1])
+    out = _int8_matmul_raw(x2, q8, scale)
+    return out.reshape(*lead, q8.shape[1])
+
+
+# ------------------------------------------------------------------ probe
+
+
+# (env value, backend) -> (ok, reason); the env is part of the key so a
+# test that flips SRT_PALLAS_INT8 re-probes instead of reading a stale
+# verdict (the flash/fused probes cache one bool; this probe's verdict
+# is backend- AND force-dependent because of interpret mode)
+_PROBE_CACHE: dict = {}
+
+
+def _numeric_probe(interpret: bool) -> bool:
+    """Compile (interpret=False) or interpret (True) + validate the
+    kernel against the dequant reference. The flag is EXPLICIT: the
+    unforced TPU gate must prove the COMPILED kernel — letting the
+    interpret fallback answer for it would pass the probe on hosts
+    where the real kernel cannot lower."""
+    r = jax.random.split(jax.random.PRNGKey(0), 2)
+    w = jax.random.normal(r[0], (96, 160), jnp.float32) * 0.05
+    x = jax.random.normal(r[1], (33, 96), jnp.float32)
+    q8, scale = quantize_int8(w)
+    got = jax.jit(
+        lambda x_, q_, s_: _int8_matmul_raw(x_, q_, s_, interpret=interpret)
+    )(x, q8, scale)
+    want = reference_int8_matmul(x, q8, scale)
+    return bool(jnp.allclose(got, want, atol=1e-4, rtol=1e-4))
+
+
+def int8_probe(backend: Optional[str] = None) -> Tuple[bool, str]:
+    """The serving overlay's int8 gate: ``(ok, reason)`` where the
+    reason string is exactly what the overlay label carries.
+
+    Policy (mirrors the bf16 auto policy's shape — accelerator-armed,
+    CPU off unless forced — and the pallas probes' force knob):
+
+    * ``SRT_PALLAS_INT8=0`` — refused everywhere.
+    * ``SRT_PALLAS_INT8=1`` — probe runs anywhere; non-TPU backends run
+      the kernel interpret-mode (the forced label says so).
+    * unset — TPU only: the compiled kernel is probed and must validate;
+      any other backend refuses (the CPU auto-OFF rule, test-enforced
+      like bf16's).
+    """
+    if backend is None:
+        backend = jax.default_backend()
+    env = os.environ.get("SRT_PALLAS_INT8")
+    key = (env, backend)
+    if key in _PROBE_CACHE:
+        return _PROBE_CACHE[key]
+    if env == "0":
+        ok, why = False, "SRT_PALLAS_INT8=0 — probe refused"
+    elif not _PALLAS_IMPORTED:
+        ok, why = False, f"pallas unavailable on {backend} — probe refused"
+    elif env != "1" and backend != "tpu":
+        ok, why = False, (
+            f"int8 overlay OFF on {backend} unless forced "
+            "(SRT_PALLAS_INT8=1 runs the interpret-mode kernel) — "
+            "probe refused"
+        )
+    else:
+        forced = env == "1"
+        interpret = _INTERPRET or (forced and jax.default_backend() != "tpu")
+        try:
+            numerics_ok = _numeric_probe(interpret)
+        except Exception:
+            numerics_ok = False
+        if not numerics_ok:
+            ok, why = False, (
+                f"int8 kernel probe failed on {backend} — probe refused"
+            )
+        elif interpret:
+            ok, why = True, (
+                "int8 kernel active (pallas interpret-mode, forced) "
+                f"on {backend}"
+            )
+        else:
+            ok, why = True, f"int8 kernel active (pallas) on {backend}"
+    _PROBE_CACHE[key] = (ok, why)
+    return ok, why
+
+
+def int8_matmul_enabled() -> bool:
+    """Convenience view of :func:`int8_probe` on the default backend."""
+    return int8_probe()[0]

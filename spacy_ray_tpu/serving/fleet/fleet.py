@@ -88,7 +88,11 @@ class FleetConfig:
     # "auto" in the CLI resolves to one core per replica round-robin.
     cpu_cores: Optional[List[str]] = None
     # router
-    cache_mb: float = 0.0             # 0 = response cache off
+    # router response cache: ARMED by default since PR 13 (generation
+    # correctness landed in PR 11 — stamped entries, mixed-generation
+    # bypass, promotion flush — and the Zipfian open-loop record proves
+    # the hit-rate x p99 win on skewed traffic; 0 = off)
+    cache_mb: float = 32.0
     probe_interval_s: float = 0.5
     # live continuous learning (docs/SERVING.md "Continuous learning"):
     # watch_dir = a TrainCheckpoint directory a training run writes into;

@@ -369,6 +369,14 @@ class Router:
         # aggregate names WHO it is missing, not just that it is missing
         self._scrape_lock = threading.Lock()
         self.scrape_failures: Dict[int, int] = {}
+        # mixed-generation cache bypasses: requests that skipped the
+        # cache because the ready replicas straddled generations (a
+        # rollout/promotion window). Counted at the ROUTER (the bypass
+        # is a routing decision, not a cache event), surfaced next to
+        # the cache's own hit/miss ledger in /metrics and as
+        # ``srt_router_cache_mixed_generation_bypasses_total``.
+        self._cache_bypass_lock = threading.Lock()
+        self.cache_mixed_bypasses = 0
         # drain gate + in-flight accounting for the fleet's own drain
         self.draining = False
         self._inflight_lock = threading.Lock()
@@ -495,6 +503,22 @@ class Router:
         if len(gens) == 1:
             return next(iter(gens))
         return GENERATION_MIXED
+
+    def count_cache_bypass(self) -> None:
+        with self._cache_bypass_lock:
+            self.cache_mixed_bypasses += 1
+
+    def cache_stats(self) -> Optional[Dict[str, int]]:
+        """The cache's own counters plus the router-side mixed-generation
+        bypass count — ONE ledger for every surface (JSON /metrics,
+        the Prometheus ``srt_router_cache_*`` series, ``telemetry top``,
+        and the Zipfian bench record all read this)."""
+        if self.cache is None:
+            return None
+        stats = self.cache.stats()
+        with self._cache_bypass_lock:
+            stats["cache_mixed_generation_bypasses"] = self.cache_mixed_bypasses
+        return stats
 
     def flush_cache(self, reason: str = "") -> int:
         """Drop the whole response cache (the promotion hook — the live
@@ -813,8 +837,9 @@ class Router:
             out["router"] = self.tel.snapshot()
         if self.alerts is not None:
             out["alerts"] = self.alerts.summary()
-        if self.cache is not None:
-            out["cache"] = self.cache.stats()
+        cache_stats = self.cache_stats()
+        if cache_stats is not None:
+            out["cache"] = cache_stats
         return out
 
     def prometheus_metrics(self) -> str:
@@ -874,9 +899,13 @@ class Router:
                         {"generation": gen_key, "quantile": "0.99"},
                     )
         if self.tel is not None:
-            fam.add_snapshot(
-                self.tel.snapshot(), prefix="srt_router"
-            )
+            tel_snap = self.tel.snapshot()
+            if self.cache is not None:
+                # the cache's own ledger below is the canonical
+                # srt_router_cache_* source; dropping the telemetry twin
+                # avoids a duplicate unlabeled series in the same family
+                (tel_snap.get("counters") or {}).pop("cache_hits", None)
+            fam.add_snapshot(tel_snap, prefix="srt_router")
         for rid, n in self.scrape_failure_stats().items():
             fam.add(
                 "srt_router_replica_scrape_failures_total", "counter", n,
@@ -884,9 +913,22 @@ class Router:
             )
         if self.alerts is not None:
             self.alerts.add_prometheus(fam)
-        if self.cache is not None:
-            for key, v in self.cache.stats().items():
-                fam.add(f"srt_router_{key}", "gauge", v)
+        cache_stats = self.cache_stats()
+        if cache_stats is not None:
+            # event tallies are counters (scrapers may rate() them —
+            # the Zipfian hit-rate signal); entry/byte occupancy stays a
+            # gauge (a level, not an event count)
+            for key in (
+                "cache_hits", "cache_misses", "cache_evictions",
+                "cache_stale_invalidations", "cache_flushes",
+                "cache_mixed_generation_bypasses",
+            ):
+                fam.add(
+                    f"srt_router_{key}_total", "counter",
+                    cache_stats.get(key),
+                )
+            for key in ("cache_entries", "cache_bytes"):
+                fam.add(f"srt_router_{key}", "gauge", cache_stats.get(key))
         fam.add("srt_fleet_replicas", "gauge", merged.get("replicas"))
         return fam.render()
 
@@ -1080,9 +1122,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
         cache_gen: Any = GENERATION_MIXED
         if router.cache is not None:
             cache_gen = router.cache_generation()
-            if cache_gen is not GENERATION_MIXED:
-                texts = self._texts_from(body)
-                if texts is not None:
+            # parsing happens on BOTH generation verdicts: the bypass
+            # counter must only tally requests the cache would actually
+            # have served (a texts-free/malformed body skips the cache
+            # on the converged path too, so it is not a "bypass"), and
+            # the parse cost during a rollout window equals what the
+            # converged path already pays per cacheable request
+            texts = self._texts_from(body)
+            if texts is not None:
+                if cache_gen is GENERATION_MIXED:
+                    # the bypass the generation discipline mandates —
+                    # and a counted event, so a rollout window's
+                    # cache-miss cost is attributable in /metrics
+                    # rather than looking like an unexplained hit-rate
+                    # dip. Counted ONLY when ready replicas actually
+                    # straddle generations: an empty ready set also
+                    # yields GENERATION_MIXED, but that request is
+                    # about to be rejected no_replica — tallying it as
+                    # a "rollout window" would inflate the counter
+                    # during startup and outages with bypasses that
+                    # never happened.
+                    if router.ready_handles():
+                        router.count_cache_bypass()
+                else:
                     cache_key = ResponseCache.key_for(texts)
                     hit = router.cache.get(cache_key, cache_gen)
                     if hit is not None:

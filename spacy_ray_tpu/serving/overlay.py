@@ -28,11 +28,16 @@ Honesty rules (the same discipline as every pallas kernel claim):
   (``shadow_coverage``): a half-covered tree must not ship under a
   "bf16" label.
 * ``int8`` is probe-gated like the pallas kernels: it resolves to an
-  int8 overlay only where a working int8 serving path exists on the
-  current backend. No such kernel exists in this repo yet, so the probe
-  refuses everywhere and the engine serves f32 with the refusal named
-  in the label — the knob is plumbed end-to-end so the kernel can land
-  without another API change.
+  int8 weight-only overlay only where the pallas dequant-in-kernel
+  matmul (ops/int8_matmul.py) compiles AND validates on the current
+  backend — auto-armed on TPU, OFF on CPU unless ``SRT_PALLAS_INT8=1``
+  forces the interpret-mode kernel (tests, drills, the forced bench
+  arm), the same auto policy shape as bf16. The overlay quantizes the
+  trunk's dense matmul weights per-output-channel
+  (``models/transformer.py build_int8_overlay``) and REFUSES — f32
+  served, refusal in the label — on unknown trunk leaves, trunk-less
+  models, or MoE trunks (expert weights are outside the kernel's
+  coverage; an "int8" label over mostly-f32 weight mass would lie).
 
 Every refusal/downgrade is also a structured ``log_event`` row, and the
 resolved label travels into ``/healthz``, bench records, and PERF.md —
@@ -65,7 +70,7 @@ class OverlayResult:
     """What the engine actually serves, with the paper trail attached."""
 
     requested: str       # the knob as given ("auto" | "f32" | "bf16" | "int8")
-    resolved: str        # what the device runs: "f32" | "bf16"
+    resolved: str        # what the device runs: "f32" | "bf16" | "int8"
     label: str           # honest record label, e.g. "bf16 (overlay: 16 leaves)"
     reason: str          # why resolved != requested, or the auto decision
     params: Any          # the tree predict_docs should consume
@@ -73,19 +78,23 @@ class OverlayResult:
 
 
 def _probe_int8(backend: str) -> Tuple[bool, str]:
-    """Int8 serving-kernel probe. There is no int8 matmul path in this
-    repo yet (no pallas kernel, no weight-only dequant epilogue), so the
-    probe refuses on every backend — the honest gate that lets the CLI
-    knob exist before the kernel does, exactly how SRT_PALLAS_FUSED
-    landed before a TPU window measured it."""
-    return False, f"no int8 serving kernel on {backend} — probe refused"
+    """Int8 serving-kernel probe: defers to ``ops/int8_matmul.int8_probe``
+    — compile (or interpret, when forced) + numeric validation of the
+    pallas dequant-in-kernel matmul on the current backend, with the
+    CPU-auto-OFF / SRT_PALLAS_INT8 force policy. The reason string is
+    the label's source of truth: "active (pallas)" only when the kernel
+    actually runs."""
+    from ..ops.int8_matmul import int8_probe
+
+    return int8_probe(backend)
 
 
 def resolve_precision(
     requested: str, backend: Optional[str] = None
 ) -> Tuple[str, str]:
     """Map the requested precision knob to what this backend will run.
-    Returns ``(resolved, reason)`` where resolved is "f32" or "bf16".
+    Returns ``(resolved, reason)`` where resolved is "f32", "bf16", or
+    "int8" (the last only when the kernel probe passed).
 
     The auto policy is PR 5's, verbatim: accelerators arm the overlay,
     CPU resolves OFF (emulated bf16 is a measured pessimization there —
@@ -110,7 +119,7 @@ def resolve_precision(
         ok, why = _probe_int8(backend)
         if not ok:
             return "f32", why
-        return "int8", f"int8 probe passed on {backend}"  # pragma: no cover
+        return "int8", why
     # auto
     if backend == "cpu":
         return "f32", (
@@ -145,31 +154,64 @@ def build_params_overlay(params: Any, precision: str = "auto") -> OverlayResult:
             reason=reason, params=params, n_overlaid=0,
         )
 
-    from ..models.transformer import build_param_shadow, shadow_coverage
+    from ..models.transformer import (
+        build_int8_overlay,
+        build_param_shadow,
+        int8_unsupported_leaves,
+        shadow_coverage,
+    )
     from ..parallel.step import overlay_shadow
+
+    def _refuse(reason: str, level: int = logging.INFO, **extra):
+        log_event("serving-overlay-refused", reason, level=level, **extra)
+        return OverlayResult(
+            requested=precision, resolved="f32", label=f"f32 ({reason})",
+            reason=reason, params=params, n_overlaid=0,
+        )
 
     eligible, unknown = shadow_coverage(params)
     if unknown:
-        reason = (
+        return _refuse(
             f"overlay refused: {len(unknown)} trunk leaf(s) unknown to the "
             f"shadow scheme ({', '.join(unknown[:4])}"
-            + (", ..." if len(unknown) > 4 else "") + ")"
-        )
-        log_event("serving-overlay-refused", reason, level=logging.WARNING,
-                  unknown=unknown[:16])
-        return OverlayResult(
-            requested=precision, resolved="f32", label=f"f32 ({reason})",
-            reason=reason, params=params, n_overlaid=0,
+            + (", ..." if len(unknown) > 4 else "") + ")",
+            level=logging.WARNING,
+            unknown=unknown[:16],
         )
     if eligible == 0:
-        reason = (
+        return _refuse(
             "overlay refused: no shadow-eligible trunk leaves "
             "(no transformer trunk in the pipeline)"
         )
-        log_event("serving-overlay-refused", reason, level=logging.INFO)
+    if resolved == "int8":
+        # the int8 kernel covers the dense matmul weights only: a trunk
+        # whose FFNs are MoE experts would ship its weight mass f32
+        # under an "int8" label — refuse instead (the probe passing is
+        # necessary, not sufficient; coverage is per MODEL)
+        moe = int8_unsupported_leaves(params)
+        if moe:
+            return _refuse(
+                f"overlay refused: {len(moe)} MoE expert weight leaf(s) "
+                "outside int8 coverage "
+                f"({', '.join(moe[:4])}"
+                + (", ..." if len(moe) > 4 else "") + ")"
+            )
+        served, n_q = build_int8_overlay(params)
+        label = (
+            f"int8 (overlay: {n_q} trunk weights quantized per-channel; "
+            f"{reason})"
+        )
+        log_event(
+            "serving-overlay-armed",
+            f"serving params carry an int8 weight-only overlay of {n_q} "
+            f"trunk weight(s) ({reason})",
+            level=logging.INFO,
+            leaves=n_q,
+            requested=precision,
+        )
         return OverlayResult(
-            requested=precision, resolved="f32", label=f"f32 ({reason})",
-            reason=reason, params=params, n_overlaid=0,
+            requested=precision, resolved="int8", label=label,
+            reason=reason, params=served, n_overlaid=n_q,
         )
     shadow = build_param_shadow(params)
     assert shadow is not None  # eligible > 0 guarantees it

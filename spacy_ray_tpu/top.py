@@ -98,6 +98,18 @@ class TopModel:
             router = payload.get("router") or {}
             for k, v in (router.get("counters") or {}).items():
                 counters[f"router.{k}"] = v
+            # the edge cache's ledger (router /metrics "cache" block —
+            # the same surface the Zipfian bench record reads): lifetime
+            # hit rate over hits+misses; None when the cache is off
+            cache = payload.get("cache")
+            cache_hit_rate = None
+            if isinstance(cache, dict):
+                hits = cache.get("cache_hits") or 0
+                misses = cache.get("cache_misses") or 0
+                if hits + misses > 0:
+                    cache_hit_rate = hits / (hits + misses)
+                else:
+                    cache_hit_rate = 0.0
             rates = self._rates(url, counters, now)
             replicas = payload.get("replicas") or []
             return {
@@ -132,6 +144,11 @@ class TopModel:
                 ) if rates else None,
                 "scrape_failures": sum(
                     int(v) for v in (payload.get("scrape_failures") or {}).values()
+                ),
+                "cache_hit_rate": cache_hit_rate,
+                "cache_bypasses": (
+                    cache.get("cache_mixed_generation_bypasses")
+                    if isinstance(cache, dict) else None
                 ),
                 "alerts": payload.get("alerts"),
             }
@@ -211,11 +228,14 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 f"p99 {_fmt_ms(row.get('p99'))}  "
                 f"worst {_fmt_ms(row.get('p99_worst'))}"
             )
+            hr = row.get("cache_hit_rate")
+            cache_s = f"{hr * 100:.0f}%" if isinstance(hr, float) else "-"
             lines.append(
                 f"    queue {_fmt_int(row.get('queue_depth'))}  "
                 f"occ p50 {_fmt_int(row.get('occupancy'))}  "
                 f"gen [{gens}]  swaps {_fmt_int(row.get('swaps'))}  "
                 f"rej {_fmt_rate(row.get('reject_s'))}  "
+                f"cache {cache_s}  "
                 f"scrape-fail {_fmt_int(row.get('scrape_failures'))}  "
                 f"alerts {_fmt_alerts(row.get('alerts'))}"
             )

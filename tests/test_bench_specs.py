@@ -443,3 +443,21 @@ def test_parent_double_rc4_records_skip_for_accel_only(tmp_path, monkeypatch,
     # ran on CPU rather than being dispatched at a dead relay
     assert calls[2] == ("plain", True, False)
     assert len(calls) == 3
+
+
+def test_zipf_ranks_deterministic_and_skewed():
+    """The Zipfian sampler behind the edge-cache spec: deterministic
+    given the seed (committed records are reproducible), full index
+    range, and actually Zipf-skewed (rank 1 dominates; the top decile
+    of keys draws the majority of requests at s=1.1)."""
+    a = bench.zipf_ranks(64, 5000, s=1.1, seed=1)
+    b = bench.zipf_ranks(64, 5000, s=1.1, seed=1)
+    assert a == b
+    assert min(a) >= 0 and max(a) < 64
+    counts = [a.count(r) for r in range(64)]
+    assert counts[0] == max(counts)  # rank 1 is the hottest key
+    top = sum(sorted(counts, reverse=True)[:7])  # top ~10% of 64 keys
+    assert top / len(a) > 0.4, "distribution not meaningfully skewed"
+    # higher exponent = more skew
+    hot = bench.zipf_ranks(64, 5000, s=2.0, seed=1)
+    assert hot.count(0) > a.count(0)

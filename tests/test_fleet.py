@@ -354,12 +354,56 @@ def test_router_cache_off_by_default():
     handle = make_handle(0, stub)
     router = Router(lambda: [handle])
     assert router.cache is None
+    assert router.cache_stats() is None
     httpd, host, port = serve_router(router)
     try:
         body = {"texts": ["same text"]}
         _post(host, port, body)
         _post(host, port, body)
         assert stub.parse_calls == 2  # every request forwarded
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        stub.close()
+
+
+def test_fleet_config_arms_cache_by_default():
+    """ROADMAP 3b's remaining half: the Router primitive stays opt-in
+    (cache_bytes=0 — library callers decide), but the FLEET ships with
+    the generation-correct cache armed; 0 still turns it off."""
+    from spacy_ray_tpu.serving.fleet import FleetConfig
+
+    assert FleetConfig(model_path="m").cache_mb > 0
+    assert FleetConfig(model_path="m", cache_mb=0.0).cache_mb == 0.0
+
+
+def test_router_prometheus_cache_counter_series():
+    """The srt_router_cache_* exposition: event tallies as counters
+    (rate()-able — the Zipfian hit-rate signal), occupancy as gauges,
+    and exactly ONE unlabeled sample per family (the telemetry twin of
+    cache_hits must not duplicate the ledger's series)."""
+    stub = StubReplica(tag="origin")
+    handle = make_handle(0, stub)
+    tel = RouterTelemetry()
+    router = Router(lambda: [handle], telemetry=tel, cache_bytes=1 << 20)
+    httpd, host, port = serve_router(router)
+    try:
+        body = {"texts": ["the cat runs"]}
+        _post(host, port, body)  # miss + store
+        _post(host, port, body)  # hit
+        text = router.prometheus_metrics()
+        assert "# TYPE srt_router_cache_hits_total counter" in text
+        assert "srt_router_cache_hits_total 1" in text
+        assert "srt_router_cache_misses_total 1" in text
+        assert "srt_router_cache_mixed_generation_bypasses_total 0" in text
+        assert "# TYPE srt_router_cache_entries gauge" in text
+        assert "srt_router_cache_entries 1" in text
+        # no duplicate unlabeled sample in the hits family
+        assert text.count("srt_router_cache_hits_total 1") == 1
+        assert len(
+            [ln for ln in text.splitlines()
+             if ln.startswith("srt_router_cache_hits_total")]
+        ) == 1
     finally:
         httpd.shutdown()
         httpd.server_close()
@@ -448,6 +492,23 @@ def test_router_cache_bypassed_while_generations_mixed():
         _post(host, port, body)
         assert s1.parse_calls + s2.parse_calls == 2  # nothing cached
         assert len(router.cache) == 0
+        # each bypass is a COUNTED routing decision (srt_router_cache_
+        # mixed_generation_bypasses_total), not a silent hit-rate dip
+        assert router.cache_stats()["cache_mixed_generation_bypasses"] == 2
+        # ...but an EMPTY ready set (startup/outage) is not a rollout
+        # window: those requests reject no_replica without inflating
+        # the counter
+        h1.ready = h2.ready = False
+        assert router.cache_generation() is GENERATION_MIXED
+        status, _ = _post(host, port, body)
+        assert status == 503
+        assert router.cache_stats()["cache_mixed_generation_bypasses"] == 2
+        h1.ready = h2.ready = True
+        # ...and a body the cache could never serve (no texts) is not a
+        # bypass either — the converged path skips the cache for it too
+        status, _ = _post(host, port, {"not_texts": 1})
+        assert status == 200
+        assert router.cache_stats()["cache_mixed_generation_bypasses"] == 2
         # fleet converges on gen 2: caching resumes
         s1.generation = 2
         router.probe_once()

@@ -97,11 +97,27 @@ def test_auto_arms_bf16_on_accelerators():
         assert resolved == "bf16"
 
 
-def test_int8_probe_refuses_with_honest_reason():
-    for backend in ("cpu", "tpu"):
-        resolved, reason = resolve_precision("int8", backend)
-        assert resolved == "f32"
-        assert "probe refused" in reason
+def test_int8_cpu_auto_off_unless_forced(monkeypatch):
+    """The int8 auto-resolution policy mirrors bf16's shape: OFF on CPU
+    (typed refusal, f32 served) unless SRT_PALLAS_INT8=1 forces the
+    interpret-mode kernel. Enforced here like the bf16 policy above."""
+    from spacy_ray_tpu.ops.int8_matmul import _PROBE_CACHE
+
+    monkeypatch.delenv("SRT_PALLAS_INT8", raising=False)
+    _PROBE_CACHE.clear()
+    resolved, reason = resolve_precision("int8", "cpu")
+    assert resolved == "f32"
+    assert "probe refused" in reason and "OFF on cpu" in reason
+    # requesting the tpu resolution from a CPU host must fail the
+    # COMPILED-kernel probe, never pass via the interpret fallback
+    resolved, reason = resolve_precision("int8", "tpu")
+    assert resolved == "f32" and "probe refused" in reason
+    monkeypatch.setenv("SRT_PALLAS_INT8", "1")
+    _PROBE_CACHE.clear()
+    resolved, reason = resolve_precision("int8", "cpu")
+    assert resolved == "int8"
+    assert "active (pallas interpret-mode, forced)" in reason
+    _PROBE_CACHE.clear()
 
 
 def test_unknown_precision_rejected():
@@ -192,6 +208,80 @@ def test_overlay_refused_without_trunk(cnn_nlp):
     ov = build_serving_overlay(cnn_nlp, "bf16")
     assert ov.resolved == "f32" and ov.n_overlaid == 0
     assert "refused" in ov.label
+
+
+@pytest.fixture
+def forced_int8(monkeypatch):
+    from spacy_ray_tpu.ops.int8_matmul import _PROBE_CACHE
+
+    monkeypatch.setenv("SRT_PALLAS_INT8", "1")
+    _PROBE_CACHE.clear()
+    yield
+    _PROBE_CACHE.clear()
+
+
+def test_int8_overlay_output_within_tolerance(trf_nlp, forced_int8):
+    """Forced-int8 overlay forward stays within the SAME documented
+    envelope as the bf16 suite above on fixture docs. Tolerance
+    rationale: per-channel symmetric int8 bounds each weight element's
+    error by scale/2 = absmax(channel)/254; through a K-dim contraction
+    the logit error concentrates well under the bf16 bound (measured
+    ~4e-4 on these fixtures vs bf16's ~1e-1 envelope) — so int8 reuses
+    the bf16 envelope rather than inventing a looser one. And the
+    argmax decisions (the served tags) must not flip."""
+    egs = synth_corpus(16, "tagger", seed=3)
+    batch = trf_nlp.collate(egs[:8], pad_batch_to=8, pad_len_to=16)
+    fwd = jax.jit(trf_nlp.make_forward_fn())
+    out_f32 = fwd(trf_nlp.params, batch["tokens"])
+    ov = build_serving_overlay(trf_nlp, "int8")
+    assert ov.resolved == "int8"
+    assert ov.n_overlaid == 8  # 2 layers x 4 dense matmul weights
+    assert "active (pallas interpret-mode, forced)" in ov.label
+    out_i8 = fwd(ov.params, batch["tokens"])
+    logits_f32 = np.asarray(out_f32["tagger"].X)
+    logits_i8 = np.asarray(out_i8["tagger"].X)
+    span = float(logits_f32.max() - logits_f32.min())
+    max_abs = float(np.max(np.abs(logits_f32 - logits_i8)))
+    assert max_abs <= max(0.15, 0.02 * span), (
+        f"int8 overlay drifted {max_abs} from f32 (range {span})"
+    )
+    assert np.array_equal(
+        logits_f32.argmax(-1), logits_i8.argmax(-1)
+    ), "served tags flipped under the int8 overlay on fixture docs"
+
+
+def test_int8_engine_reports_honest_labels(trf_nlp, forced_int8):
+    """The engine path: serve_params carry the quantized dicts and the
+    /healthz-bound label says exactly how the kernel runs."""
+    from spacy_ray_tpu.serving import InferenceEngine
+
+    engine = InferenceEngine(
+        trf_nlp, max_batch_docs=2, max_doc_len=8, precision="int8"
+    )
+    try:
+        assert engine.overlay.resolved == "int8"
+        layer = engine.serve_params["transformer"]["layer_0"]
+        assert layer["qkv_W"]["q8"].dtype == jnp.int8
+        assert layer["qkv_b"].dtype == jnp.float32  # weight-only
+        engine.start(warmup=True)
+        req = engine.submit_texts(["the cat runs fast"])
+        assert req.docs[0].tags
+    finally:
+        engine.stop()
+
+
+def test_int8_engine_auto_refuses_on_cpu_unforced(trf_nlp, monkeypatch):
+    from spacy_ray_tpu.ops.int8_matmul import _PROBE_CACHE
+    from spacy_ray_tpu.serving import InferenceEngine
+
+    monkeypatch.delenv("SRT_PALLAS_INT8", raising=False)
+    _PROBE_CACHE.clear()
+    engine = InferenceEngine(
+        trf_nlp, max_batch_docs=2, max_doc_len=8, precision="int8"
+    )
+    assert engine.overlay.resolved == "f32"
+    assert "probe refused" in engine.overlay.label
+    assert engine.serve_params is trf_nlp.params
 
 
 # ----------------------------------------------------------------------
