@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry observability serving fleet live bench baseline profile step-perf serve-perf update-shard dryrun
+.PHONY: test test-fast test-slow resilience telemetry observability serving fleet live train-fleet bench baseline profile step-perf serve-perf update-shard dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -56,6 +56,19 @@ fleet:
 live:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_live.py -q -m "not slow"
 	JAX_PLATFORMS=cpu python bench.py --serving --swap
+
+# asynchronous trainer fleet (docs/TUNING.md §19, RESILIENCE.md "Trainer
+# fleet crash semantics"): ownership/wire/quorum/staleness units + the
+# thread-driven 2-worker integration and v2 owner-part round trip, then
+# the subprocess drills — the real CLI fleet, the SIGKILL
+# crash-and-rejoin recovery, and the bounded-staleness convergence
+# acceptance (S∈{0,1,2} vs the synchronous loop) — then the 1/2/4-worker
+# pinned scaling spec (records land in BENCH_SESSION.jsonl with the
+# per-phase breakdown and the discard-counter ledger)
+train-fleet:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_training_fleet.py -q -m "not slow"
+	JAX_PLATFORMS=cpu python -m pytest tests/test_training_fleet.py -q -m slow
+	JAX_PLATFORMS=cpu python bench.py --training-fleet
 
 bench:
 	python bench.py

@@ -3010,6 +3010,208 @@ def _record_is_clean(rec: Dict[str, Any]) -> bool:
     return ratio is None or ratio >= CLEAN_REPROBE_RATIO
 
 
+TRAINING_FLEET_CFG = """
+[paths]
+train = null
+dev = null
+
+[nlp]
+lang = "en"
+pipeline = ["tok2vec","tagger"]
+
+[components]
+
+[components.tok2vec]
+factory = "tok2vec"
+
+[components.tok2vec.model]
+@architectures = "spacy.HashEmbedCNN.v2"
+width = 96
+depth = 4
+embed_size = 2000
+
+[components.tagger]
+factory = "tagger"
+
+[components.tagger.model]
+@architectures = "spacy.Tagger.v2"
+
+[components.tagger.model.tok2vec]
+@architectures = "spacy.Tok2VecListener.v1"
+width = ${components.tok2vec.model.width}
+
+[corpora]
+
+[corpora.train]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${paths.train}
+
+[corpora.dev]
+@readers = "spacy.JsonlCorpus.v1"
+path = ${paths.dev}
+
+[training]
+seed = 0
+dropout = 0.1
+patience = 0
+max_epochs = 0
+eval_frequency = 1000
+
+[training.optimizer]
+@optimizers = "Adam.v1"
+learn_rate = 0.001
+
+[training.batcher]
+@batchers = "spacy.batch_by_words.v1"
+size = 600
+tolerance = 0.2
+
+[training.score_weights]
+tag_acc = 1.0
+"""
+
+
+def run_training_fleet(
+    platform: str,
+    *,
+    worker_counts: List[int],
+    steps: int = 120,
+    quorum: int = 0,
+    max_staleness: int = 1,
+    base_port: int = 47340,
+) -> None:
+    """``--training-fleet``: the async trainer-fleet scaling spec — the
+    REAL ``train --fleet-workers N`` path (coordinator → N pinned worker
+    subprocesses exchanging gradients/params over HTTP with quorum apply
+    + staleness discard, training/fleet/) on a synthetic tagger corpus,
+    one record per worker count. Words/s = every worker's trained words
+    over the slowest worker's wall clock; each record carries the HONEST
+    per-phase breakdown (data / pull / grad compute / push / apply-wait)
+    summed across workers plus the discard-counter ledger, so where the
+    async plane spends its time is on the record, not inferred.
+
+    On CPU each worker is taskset-pinned to one core round-robin over
+    this process's affinity set (the PR 6 fleet idiom). When the
+    affinity set is SMALLER than the worker count the workers time-slice
+    the same cores — the record stamps ``cores_available`` and
+    ``contended: true`` so a flat scaling curve reads as a capability
+    limit of the host, not of the fleet (the same honest-refusal
+    discipline as the TPU-gated kernel claims)."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    from spacy_ray_tpu.util import write_synth_jsonl
+
+    tmpdir = Path(tempfile.mkdtemp(prefix="srt_train_fleet_"))
+    write_synth_jsonl(tmpdir / "train.jsonl", 400, kind="tagger", seed=0)
+    write_synth_jsonl(tmpdir / "dev.jsonl", 40, kind="tagger", seed=1)
+    cfg_path = tmpdir / "fleet.cfg"
+    cfg_path.write_text(TRAINING_FLEET_CFG, encoding="utf8")
+
+    cores = sorted(os.sched_getaffinity(0))
+    baseline_wps: Optional[float] = None
+    for idx, n in enumerate(worker_counts):
+        out_dir = tmpdir / f"out-w{n}"
+        cmd = [
+            sys.executable, "-m", "spacy_ray_tpu", "train", str(cfg_path),
+            "--device", "cpu",
+            "--fleet-workers", str(n),
+            "--quorum", str(quorum),
+            "--max-staleness", str(max_staleness),
+            "--fleet-base-port", str(base_port + idx * 16),
+            "--cpu-cores", "auto",
+            "--output", str(out_dir),
+            f"--paths.train={tmpdir / 'train.jsonl'}",
+            f"--paths.dev={tmpdir / 'dev.jsonl'}",
+            f"--training.max_steps={int(steps)}",
+        ]
+        print(f"# training fleet: {n} worker(s), {steps} steps each, "
+              f"quorum {quorum or 'auto'}, staleness {max_staleness}",
+              flush=True)
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=1800,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+        except subprocess.TimeoutExpired:
+            # a wedged fleet must cost a skip record, not the rest of
+            # the sweep (the rc!=0 path's discipline)
+            print(f"# training fleet {n}w TIMED OUT after 1800s",
+                  flush=True)
+            _append_session(
+                {"name": "training_fleet", "workers": n, "skipped": True,
+                 "reason": "timeout after 1800s"},
+                platform,
+            )
+            continue
+        wall = time.perf_counter() - t0
+        ledgers = []
+        for k in range(n):
+            ledger_path = out_dir / f"fleet-worker-{k}.json"
+            if ledger_path.exists():
+                ledgers.append(json.loads(ledger_path.read_text("utf8")))
+        if proc.returncode != 0 or len(ledgers) != n:
+            print(f"# training fleet {n}w FAILED rc={proc.returncode} "
+                  f"({len(ledgers)}/{n} ledgers)\n{proc.stderr[-2000:]}",
+                  flush=True)
+            _append_session(
+                {"name": "training_fleet", "workers": n, "skipped": True,
+                 "reason": f"rc={proc.returncode}, "
+                           f"{len(ledgers)}/{n} worker ledgers"},
+                platform,
+            )
+            continue
+        total_words = sum(l["words_seen"] for l in ledgers)
+        loop_seconds = max(l["seconds"] for l in ledgers)
+        wps = total_words / loop_seconds if loop_seconds > 0 else 0.0
+        phases: Dict[str, float] = {}
+        counters: Dict[str, int] = {}
+        for l in ledgers:
+            for p, v in (l.get("phases") or {}).items():
+                phases[p] = round(phases.get(p, 0.0) + float(v), 3)
+            for c, v in (l.get("counters") or {}).items():
+                counters[c] = counters.get(c, 0) + int(v)
+        if n == worker_counts[0]:
+            baseline_wps = wps
+        contended = len(cores) < n
+        rec = {
+            "name": "training_fleet",
+            "metric": (
+                f"train_words_per_sec ({n} async fleet worker processes, "
+                f"quorum {ledgers[0].get('quorum')}, "
+                f"staleness {max_staleness}, cnn tagger w96d4, 1-core "
+                "taskset pinning, grads/params over HTTP)"
+            ),
+            "value": round(wps, 1),
+            "unit": "words/s",
+            "platform": platform,
+            "workers": n,
+            "quorum": ledgers[0].get("quorum"),
+            "max_staleness": max_staleness,
+            "steps_per_worker": int(steps),
+            "total_words": int(total_words),
+            "loop_seconds": round(loop_seconds, 2),
+            "wall_seconds": round(wall, 2),
+            "phase_seconds": phases,
+            "counters": counters,
+            "versions": [l.get("version") for l in ledgers],
+            "cores_available": len(cores),
+            "contended": contended,
+            "scaling_vs_first": (
+                round(wps / baseline_wps, 2)
+                if baseline_wps and n != worker_counts[0] else None
+            ),
+        }
+        _append_session(rec, platform)
+        print(json.dumps(rec), flush=True)
+    # outside the loop on purpose: a skipped count must not strand the
+    # synthetic corpus, and a crash mid-sweep only leaves a tmpdir
+    shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def _print_headline_summary(
     session_mark: int, platforms: List[str], run_id: Optional[str] = None
 ) -> None:
@@ -3269,7 +3471,48 @@ def main() -> None:
         "the CPU fallback — for a background campaign that must not "
         "contend with a separate CPU bench run at round end",
     )
+    parser.add_argument(
+        "--training-fleet", action="store_true",
+        help="async trainer-fleet scaling spec: real `train "
+        "--fleet-workers N` subprocesses (1-core pinned, grads/params "
+        "over HTTP, quorum apply + staleness discard) at each "
+        "--fleet-workers count; words/s + per-phase breakdown + discard "
+        "ledger land in BENCH_SESSION.jsonl",
+    )
+    parser.add_argument(
+        "--fleet-workers", default="1,2,4",
+        help="--training-fleet: comma-separated worker-process counts",
+    )
+    parser.add_argument(
+        "--fleet-steps", type=int, default=120,
+        help="--training-fleet: steps per worker per record",
+    )
+    parser.add_argument(
+        "--fleet-quorum", type=int, default=0,
+        help="--training-fleet: quorum knob (0 = auto: all-but-one)",
+    )
+    parser.add_argument(
+        "--fleet-staleness", type=int, default=1,
+        help="--training-fleet: max accepted gradient staleness S",
+    )
     args = parser.parse_args()
+
+    if args.training_fleet:
+        # subprocess fan-out (the coordinator children own jax); the
+        # parent only writes corpora/configs and reads worker ledgers
+        counts = [
+            int(c) for c in str(args.fleet_workers).split(",") if c.strip()
+        ] or [1, 2, 4]
+        # worker processes are spawned --device cpu (one pinned core
+        # each — the fleet's CPU topology); the records are CPU records
+        run_training_fleet(
+            "cpu",
+            worker_counts=counts,
+            steps=int(args.fleet_steps),
+            quorum=int(args.fleet_quorum),
+            max_staleness=int(args.fleet_staleness),
+        )
+        return
 
     if args.serving or args.serving_ab:
         # host+device online path; resolve the backend like --input-pipeline

@@ -291,12 +291,30 @@ class AbsenceRule(AlertRule):
     """Fires when the watched counter has not CHANGED for ``stale_s`` —
     the signal-died failure mode. A path that was never observed at all
     is no-signal (the subsystem may simply not be running); staleness
-    only starts counting once the signal has existed."""
+    only starts counting once the signal has existed.
 
-    def __init__(self, name: str, path: str, stale_s: float, **kw: Any) -> None:
+    ``arm_above``: stay no-signal until the value has EVER exceeded this
+    bound — ThresholdRule's ``arm_when`` gate for the absence shape. The
+    fleet push-stalled rule uses it: a topology that legitimately never
+    pushes to peers (a fleet of one; peers that own no shards) exports a
+    counter frozen at 0, and "it must have moved once before its freeze
+    is an incident" is the difference between that and a wedged peer
+    loop. Arming is persistent."""
+
+    def __init__(
+        self,
+        name: str,
+        path: str,
+        stale_s: float,
+        *,
+        arm_above: Optional[float] = None,
+        **kw: Any,
+    ) -> None:
         super().__init__(name, **kw)
         self.path = str(path)
         self.stale_s = float(stale_s)
+        self.arm_above = float(arm_above) if arm_above is not None else None
+        self._armed = arm_above is None
         self._last_value: Optional[float] = None
         self._last_change: Optional[float] = None
 
@@ -307,6 +325,13 @@ class AbsenceRule(AlertRule):
         self, history: SnapshotHistory, now: float
     ) -> Tuple[Optional[bool], Optional[float], str]:
         v = history.value(self.path)
+        if not self._armed:
+            if v is not None and v > self.arm_above:
+                self._armed = True
+            else:
+                return None, v, (
+                    f"{self.path}: not armed (never > {self.arm_above:g})"
+                )
         if v is not None and v != self._last_value:
             self._last_value = v
             self._last_change = now
@@ -787,13 +812,37 @@ def default_router_rules(
 
 
 def default_training_rules(
-    *, stall_s: float = 300.0, anomaly_burst: int = 5
+    *,
+    stall_s: float = 300.0,
+    anomaly_burst: int = 5,
+    fleet: bool = False,
+    push_stall_s: float = 120.0,
+    discard_rate: float = 0.30,
+    discard_window_s: float = 120.0,
 ) -> List[AlertRule]:
     """The trainer's defaults, evaluated over its registry snapshot at
     (rate-limited) step boundaries: a stalled step counter — the
     watchdog's signal, visible BEFORE the watchdog's hard exit — and an
-    anomaly-detector burst."""
-    return [
+    anomaly-detector burst.
+
+    ``fleet=True`` (each trainer-fleet worker's engine) adds the async
+    plane's two failure modes:
+
+    * ``fleet-grad-push-stalled`` — this worker's grad-push counter
+      stopped moving: a wedged peer loop pages on wall time BEFORE the
+      watchdog's rc-79 hard exit (the same before-the-watchdog
+      discipline as training-stalled, but on the fleet's own signal —
+      a worker can be stepping-by-the-clock yet pushing nothing when
+      its peers are gone).
+    * ``fleet-discard-burn`` — the stale-gradient discard RATE burns
+      past ``discard_rate`` (default >30% of received gradients
+      discarded inside ``discard_window_s``): the quorum/staleness
+      knobs are mis-set for this fleet's speed skew, and most of the
+      compute is being thrown away. Expressed as a single-pair
+      burn-rate rule (the ratio machinery) with budget ``discard_rate``
+      and factor 1.0 — burn ≥ 1 ⟺ discards/received ≥ the threshold.
+    """
+    rules: List[AlertRule] = [
         AbsenceRule(
             "training-stalled",
             "counters.steps",
@@ -809,3 +858,34 @@ def default_training_rules(
             severity="ticket",
         ),
     ]
+    if fleet:
+        rules.extend(
+            [
+                AbsenceRule(
+                    "fleet-grad-push-stalled",
+                    "counters.grad_pushed",
+                    stale_s=float(push_stall_s),
+                    # counts PEER deliveries only (self-submit excluded —
+                    # worker.py), so a frozen value means this worker
+                    # stopped talking to its fleet; arm_above keeps a
+                    # topology that never pushes (fleet of one) silent
+                    arm_above=0.0,
+                    severity="page",
+                ),
+                BurnRateRule(
+                    "fleet-discard-burn",
+                    total=["counters.grad_received"],
+                    bad=["counters.grad_discarded"],
+                    slo=1.0 - float(discard_rate),
+                    windows=(
+                        (
+                            float(discard_window_s),
+                            float(discard_window_s) / 4.0,
+                            1.0,
+                        ),
+                    ),
+                    severity="page",
+                ),
+            ]
+        )
+    return rules

@@ -95,18 +95,7 @@ def _supervise_train(argv: List[str], max_restarts: int) -> int:
     a grace period — the same helper the relay probe uses."""
     from .training.resilience import Supervisor
 
-    child_args: List[str] = []
-    skip_next = False
-    for a in argv:
-        if skip_next:
-            skip_next = False
-            continue
-        if a == "--max-restarts":
-            skip_next = True
-            continue
-        if a.startswith("--max-restarts="):
-            continue
-        child_args.append(a)
+    child_args = _strip_flags(argv, ["--max-restarts"])
 
     def build_cmd(attempt: int) -> List[str]:
         cmd = [sys.executable, "-m", "spacy_ray_tpu", "train"] + child_args
@@ -115,6 +104,52 @@ def _supervise_train(argv: List[str], max_restarts: int) -> int:
         return cmd
 
     return Supervisor(build_cmd, max_restarts, grace_s=SHUTDOWN_GRACE_S).run()
+
+
+def _strip_flags(argv: List[str], flags: List[str]) -> List[str]:
+    """Remove ``--flag value`` / ``--flag=value`` pairs from an argv."""
+    out: List[str] = []
+    skip_next = False
+    for a in argv:
+        if skip_next:
+            skip_next = False
+            continue
+        if a in flags:
+            skip_next = True
+            continue
+        if any(a.startswith(f + "=") for f in flags):
+            continue
+        out.append(a)
+    return out
+
+
+def _run_fleet_coordinator(argv: List[str], args) -> int:
+    """``train --fleet-workers N`` (no worker id): this process never
+    touches jax — it spawns N pinned worker subprocesses (each rerunning
+    this argv plus ``--fleet-worker-id k``) and supervises restarts with
+    ``--resume`` (training/fleet/coordinator.py)."""
+    from .training.fleet.coordinator import run_fleet
+
+    # coordinator-only flags must not reach the children: --max-restarts
+    # would nest a per-child supervisor chain, --cpu-cores is resolved
+    # HERE into per-worker taskset masks
+    child_argv = _strip_flags(argv, ["--max-restarts", "--cpu-cores"])
+    cpu_cores: Optional[List[str]] = None
+    if args.cpu_cores and args.device == "cpu":
+        if args.cpu_cores.strip().lower() == "auto":
+            cpu_cores = [str(c) for c in sorted(os.sched_getaffinity(0))]
+        else:
+            cpu_cores = [
+                m.strip() for m in args.cpu_cores.split(",") if m.strip()
+            ]
+    return run_fleet(
+        child_argv,
+        n_workers=args.fleet_workers,
+        max_restarts=args.max_restarts,
+        cpu_cores=cpu_cores,
+        # fleet default, NOT the 10s serving grace: a preemption must
+        # outlive worker 0's distributed checkpoint commit
+    )
 
 
 def train_command(argv: List[str]) -> int:
@@ -157,6 +192,39 @@ def train_command(argv: List[str]) -> int:
                         "metrics_dir; overrides [training] metrics_port. "
                         "Binds 127.0.0.1 unless [training] metrics_host "
                         "(or --training.metrics_host) says otherwise")
+    parser.add_argument("--fleet-workers", type=int, default=0,
+                        dest="fleet_workers",
+                        help="asynchronous trainer fleet: spawn N worker "
+                        "PROCESSES exchanging gradients/params over HTTP "
+                        "with parameter ownership, quorum apply, and "
+                        "staleness discard (training/fleet/; TUNING.md "
+                        "§19). 0 = the in-mesh synchronous loop")
+    parser.add_argument("--quorum", type=int, default=0,
+                        help="fleet: gradients from this many distinct "
+                        "workers trigger an owner's optimizer apply "
+                        "(0 = auto: all-but-one, min 1 — one crashed "
+                        "peer cannot stall the fleet)")
+    parser.add_argument("--max-staleness", type=int, default=1,
+                        dest="max_staleness",
+                        help="fleet: accept gradients stamped up to S "
+                        "shard versions behind the owner's current; "
+                        "staler pushes are discarded and counted "
+                        "(srt_training_grad_discarded_total)")
+    parser.add_argument("--fleet-base-port", type=int, default=None,
+                        dest="fleet_base_port",
+                        help="fleet: worker k's peer+telemetry endpoint "
+                        "binds base+k (default 47200)")
+    parser.add_argument("--fleet-worker-id", type=int, default=None,
+                        dest="fleet_worker_id",
+                        help="(internal) run as fleet worker K — the "
+                        "coordinator appends this; setting it by hand "
+                        "runs one worker of a hand-assembled fleet")
+    parser.add_argument("--cpu-cores", type=str, default="auto",
+                        dest="cpu_cores",
+                        help="fleet coordinator on --device cpu: taskset "
+                        "-c core masks cycled per worker ('auto' = "
+                        "round-robin over this process's affinity set, "
+                        "'' = unpinned)")
     parser.add_argument("--verbose", "-V", action="store_true")
     args, extra = parser.parse_known_args(argv)
 
@@ -167,6 +235,13 @@ def train_command(argv: List[str]) -> int:
     logging.getLogger("spacy_ray_tpu.training").setLevel(
         logging.INFO if args.verbose else logging.WARNING
     )
+
+    if args.fleet_workers > 0 and args.fleet_worker_id is None:
+        # fleet coordinator mode: jax-free parent spawning N pinned
+        # worker subprocesses, each rerunning this argv with its own
+        # --fleet-worker-id; --max-restarts becomes the PER-WORKER
+        # restart cap (crashed workers rejoin with --resume)
+        return _run_fleet_coordinator(argv, args)
 
     if args.max_restarts > 0:
         # supervisor mode: this process never touches jax — it only spawns,
@@ -185,6 +260,24 @@ def train_command(argv: List[str]) -> int:
 
     from .training.loop import train
 
+    fleet_kwargs = None
+    if args.fleet_worker_id is not None:
+        if args.fleet_workers <= 0:
+            parser.error("--fleet-worker-id requires --fleet-workers N")
+        from .training.fleet.worker import DEFAULT_FLEET_BASE_PORT
+
+        fleet_kwargs = {
+            "worker_id": args.fleet_worker_id,
+            "n_workers": args.fleet_workers,
+            "quorum": args.quorum,
+            "max_staleness": args.max_staleness,
+            "base_port": (
+                args.fleet_base_port
+                if args.fleet_base_port is not None
+                else DEFAULT_FLEET_BASE_PORT
+            ),
+        }
+
     nlp, result = train(
         config,
         output_path=args.output,
@@ -193,6 +286,7 @@ def train_command(argv: List[str]) -> int:
         profile_dir=args.profile,
         metrics_dir=args.metrics_dir,
         metrics_port=args.metrics_port,
+        fleet=fleet_kwargs,
     )
     if result.interrupted:
         from .training.resilience import RC_PREEMPTED
@@ -208,10 +302,20 @@ def train_command(argv: List[str]) -> int:
                 f"(no --output given); progress is lost (exit {RC_PREEMPTED})"
             )
         return RC_PREEMPTED
-    print(
-        f"Done. steps={result.final_step} best_score={result.best_score:.4f} "
-        f"(step {result.best_step}) words/sec={result.wps:,.0f}"
-    )
+    if fleet_kwargs is not None and fleet_kwargs["worker_id"] != 0:
+        # non-lead fleet workers don't evaluate — a best_score of -1
+        # here would read as a failed run
+        fl = getattr(result, "fleet", {}) or {}
+        print(
+            f"Done. fleet worker {fleet_kwargs['worker_id']}: "
+            f"steps={result.final_step} shard version={fl.get('version')} "
+            f"words/sec={result.wps:,.0f}"
+        )
+    else:
+        print(
+            f"Done. steps={result.final_step} best_score={result.best_score:.4f} "
+            f"(step {result.best_step}) words/sec={result.wps:,.0f}"
+        )
     for comp_name in nlp.pipe_names:
         stats = getattr(nlp.components[comp_name], "oracle_stats", None)
         if stats and (stats["projectivized"] or stats["skipped"]):

@@ -520,6 +520,44 @@ def make_update_only(
     return run
 
 
+def make_shard_apply(tx: Any, *, donate: bool = True) -> Callable:
+    """Jitted single-shard optimizer apply: ``(params, opt_state, grads)
+    -> (params, opt_state)`` over ONE owner's slice tree, no mesh.
+
+    This is the trainer fleet's apply entry point (training/fleet/): the
+    cross-process analogue of ``make_update_only`` where the "shard" is
+    the nested slice tree a fleet worker owns (ownership.py) rather than
+    a mesh-sharded leaf — the owner applies the optimizer to exactly the
+    parameters it owns, at quorum, and nothing else (PAPER.md §L3
+    owner-applies-the-update). ``tx`` may be the fused transformation
+    (``applies_updates`` — ops/fused_update.py on the owned slice, as in
+    the in-mesh "full" mode) or a plain optax chain. State and params
+    are donated: the owner holds exactly one live copy of its shard.
+    """
+    applies_updates = bool(getattr(tx, "applies_updates", False))
+
+    def update(params, opt_state, grads):
+        if applies_updates:
+            new_params, new_opt_state = tx.update(grads, opt_state, params)
+        else:
+            import optax as _optax
+
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = _optax.apply_updates(params, updates)
+        return new_params, new_opt_state
+
+    jit_kwargs: Dict[str, Any] = {}
+    if donate:
+        jit_kwargs["donate_argnums"] = (0, 1)
+    jitted = jax.jit(update, **jit_kwargs)
+
+    def run(params, opt_state, grads):
+        return jitted(params, opt_state, grads)
+
+    run.update_sharding = "fleet-owner-shard"
+    return run
+
+
 def place_batch(batch_tree: Any, mesh: Mesh, accum: bool = False) -> Any:
     """Place batch leaves with the batch dim sharded over the ``data`` axis.
 

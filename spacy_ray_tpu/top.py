@@ -29,11 +29,16 @@ CLEAR = "\x1b[2J\x1b[H"
 
 def classify_payload(payload: Dict[str, Any]) -> str:
     """Which kind of endpoint answered: ``router`` (fleet view),
-    ``trainer`` (step histograms), or ``serving`` (a single replica)."""
+    ``trainer`` (step histograms, or a trainer-fleet worker's ledger —
+    a telemetry-off fleet worker serves counters + a ``fleet_worker``
+    gauge and no histograms at all), or ``serving`` (a single
+    replica)."""
     if "fleet" in payload:
         return "router"
     hists = payload.get("histograms") or {}
     if "step_seconds" in hists:
+        return "trainer"
+    if (payload.get("gauges") or {}).get("fleet_worker") is not None:
         return "trainer"
     return "serving"
 
@@ -156,6 +161,17 @@ class TopModel:
             counters = payload.get("counters") or {}
             rates = self._rates(url, counters, now)
             hists = payload.get("histograms") or {}
+            # fleet workers (training/fleet/) are trainers with a worker
+            # id, a shard version, and the async plane's push/discard
+            # counters — each worker is its own scrape URL, so the
+            # per-worker columns come for free from per-row rates
+            worker = _get(payload, "gauges", "fleet_worker")
+            discard_rate = None
+            push_s = rates.get("grad_pushed")
+            recv_s = rates.get("grad_received")
+            disc_s = rates.get("grad_discarded")
+            if isinstance(recv_s, float) and isinstance(disc_s, float):
+                discard_rate = disc_s / recv_s if recv_s > 0 else 0.0
             return {
                 "url": url,
                 "kind": kind,
@@ -167,6 +183,11 @@ class TopModel:
                 "compiles": _get(payload, "gauges", "compile_count"),
                 "hbm_peak": _get(payload, "gauges", "hbm_peak_bytes"),
                 "alerts": payload.get("alerts"),
+                "worker": worker,
+                "version": _get(payload, "gauges", "param_version"),
+                "push_s": push_s,
+                "discard_s": disc_s,
+                "discard_rate": discard_rate,
             }
         counters = payload.get("counters") or {}
         rates = self._rates(url, counters, now)
@@ -240,13 +261,28 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 f"alerts {_fmt_alerts(row.get('alerts'))}"
             )
         elif kind == "trainer":
-            lines.append(f"  trainer {row['url']}")
+            worker = row.get("worker")
+            tag = (
+                f"  [fleet worker {int(worker)}]"
+                if isinstance(worker, (int, float))
+                else ""
+            )
+            lines.append(f"  trainer {row['url']}{tag}")
             lines.append(
                 f"    steps {_fmt_rate(row.get('steps_s'))}  "
                 f"words {_fmt_rate(row.get('words_s'))}  "
                 f"step p50 {_fmt_ms(row.get('step_p50'))}  "
                 f"p95 {_fmt_ms(row.get('step_p95'))}"
             )
+            if isinstance(worker, (int, float)):
+                dr = row.get("discard_rate")
+                dr_s = f"{dr * 100:.0f}%" if isinstance(dr, float) else "-"
+                lines.append(
+                    f"    ver {_fmt_int(row.get('version'))}  "
+                    f"push {_fmt_rate(row.get('push_s'))}  "
+                    f"disc {_fmt_rate(row.get('discard_s'))}  "
+                    f"disc-rate {dr_s}"
+                )
             lines.append(
                 f"    anomalies {_fmt_int(row.get('anomalies'))}  "
                 f"compiles {_fmt_int(row.get('compiles'))}  "

@@ -310,6 +310,189 @@ def _assemble_opt_parts(files: List[Path]) -> Any:
         ) from e
 
 
+def _write_params_npz(path: Path, stamp: int, params: Any) -> str:
+    """Write ``params-{stamp}.npz`` via tmp + atomic replace; returns its
+    SHA-256 (np.savez seeks back to patch zip headers, so the digest is a
+    read-back of the final file — see :class:`_HashingWriter`)."""
+    import os
+
+    # np.savez ALWAYS appends .npz to a non-.npz name, so the written
+    # file is deterministically params-{stamp}.npz.tmp.npz — never branch
+    # on exists(): a stale literal .tmp left by other tooling would be
+    # promoted over the freshly written file
+    params_tmp = path / f"params-{stamp}.npz.tmp"
+    save_params(params_tmp, params)
+    os.replace(
+        params_tmp.with_suffix(params_tmp.suffix + ".npz"),
+        path / f"params-{stamp}.npz",
+    )
+    return _sha256_file(path / f"params-{stamp}.npz")
+
+
+def _commit_meta(path: Path, stamp: int, meta: Dict[str, Any]) -> None:
+    """Per-generation meta first (enables fallback), pointer last (atomic
+    commit of "this is the newest generation")."""
+    import os
+
+    text = json.dumps(meta, indent=2)
+    gen_tmp = path / f"train_meta-{stamp}.json.tmp"
+    gen_tmp.write_text(text, encoding="utf8")
+    os.replace(gen_tmp, path / f"train_meta-{stamp}.json")
+    tmp = path / "train_meta.json.tmp"
+    tmp.write_text(text, encoding="utf8")
+    os.replace(tmp, path / "train_meta.json")
+
+
+def _retention_sweep(path: Path, stamp: int, keep: int) -> None:
+    """Retention: the generation just written plus the newest ``keep``-1
+    committed generations BELOW it. Stamps ABOVE the one just written are
+    an abandoned lineage (a restart WITHOUT --resume re-counts steps from
+    0 into the same directory) — retaining them would let load()'s
+    newest-stamp-first fallback silently resume the abandoned run's
+    state, so they are deleted. Also sweeps tmp stragglers from crashed
+    earlier saves. A crash before this cleanup only leaves extra files
+    behind."""
+    committed = sorted(
+        s
+        for s in (_gen_stamp(p) for p in path.glob("train_meta-*.json"))
+        if s is not None and s < stamp
+    )
+    retained = set(committed[-(keep - 1):]) if keep > 1 else set()
+    retained.add(stamp)
+    for pattern, suffix in (
+        ("params-*.npz", ".npz"),
+        ("opt_state-*.pkl", ".pkl"),
+        ("train_meta-*.json", ".json"),
+    ):
+        prefix = pattern.split("*", 1)[0]
+        for old in path.glob(pattern):
+            core = old.name[len(prefix):-len(suffix)]
+            try:
+                # "123" (v1) or "123.part0of8" (v2 opt shard)
+                old_stamp = int(core.split(".", 1)[0])
+            except ValueError:
+                continue
+            if old_stamp not in retained:
+                old.unlink(missing_ok=True)
+    # tmp stragglers (params-*.npz.tmp.npz, *.pkl.tmp, *.json.tmp): the
+    # completed save's own tmps were all promoted, so anything still
+    # wearing a tmp suffix is garbage — on a crash-looping fleet these
+    # are full-size params/opt_state copies
+    for pattern in ("*.tmp", "*.tmp.npz"):
+        for stray in path.glob(pattern):
+            stray.unlink(missing_ok=True)
+
+
+def write_fleet_opt_part(
+    path,
+    *,
+    stamp: int,
+    part: int,
+    parts: int,
+    n_leaves: int,
+    records,
+    skeleton: Any = None,
+) -> str:
+    """One trainer-fleet process writes ITS owner-shard part file —
+    ``opt_state-{stamp}.part{part}of{parts}.pkl``, byte-layout identical
+    to the in-mesh v2 writer's (header + ``("leaf", ordinal, index,
+    gshape, dtype, piece)`` records) so :func:`_assemble_opt_parts`
+    reassembles fleet and in-mesh generations through the same code.
+
+    ``records`` is an iterable of ``(ordinal, index, gshape, dtype,
+    piece)`` (``index=None`` = whole leaf — part 0 only); ``skeleton``
+    rides part 0's header. Returns the part's hash-while-write SHA-256
+    for the meta the committing process (worker 0) writes.
+    """
+    import os
+
+    path = Path(path)
+    stamp = int(stamp)
+
+    def write() -> str:
+        maybe_fail("checkpoint-write")
+        path.mkdir(parents=True, exist_ok=True)
+        name = _opt_part_name(stamp, part, parts)
+        tmp = path / (name + ".tmp")
+        h = hashlib.sha256()
+        with open(tmp, "wb") as f:
+            w = _HashingWriter(f, h)
+            header: Dict[str, Any] = {
+                "part": int(part), "parts": int(parts),
+                "n_leaves": int(n_leaves), "stamp": stamp,
+            }
+            if skeleton is not None:
+                header["skeleton"] = skeleton
+            pickle.dump(header, w)
+            for ordinal, index, gshape, dtype, piece in records:
+                pickle.dump(
+                    (
+                        "leaf", int(ordinal),
+                        tuple(tuple(p) for p in index)
+                        if index is not None else None,
+                        tuple(int(d) for d in gshape), str(dtype),
+                        np.asarray(piece),
+                    ),
+                    w,
+                )
+        os.replace(tmp, path / name)
+        return h.hexdigest()
+
+    return retry_io("checkpoint-write", write)
+
+
+def commit_fleet_generation(
+    path,
+    *,
+    params: Any,
+    step: int,
+    epoch: int,
+    rng: Any,
+    best_score: float,
+    best_step: int,
+    opt_shards: int,
+    opt_digests: Dict[int, str],
+    extra: Optional[Dict[str, Any]] = None,
+    keep: int = 2,
+) -> None:
+    """Worker 0's half of a fleet checkpoint: the opt-state part files
+    are ALREADY on disk (each written by its owning process via
+    :func:`write_fleet_opt_part`; their digests arrive over the fleet's
+    HTTP plane instead of the in-mesh digest allgather) — write the
+    assembled params, the format-v2 meta naming every part's digest, the
+    pointer, then run the shared retention sweep. The committed
+    generation is indistinguishable from an in-mesh v2 save, which is
+    what lets a single-process synchronous run ``--resume`` it."""
+    path = Path(path)
+    keep = max(int(keep), 1)
+    stamp = int(step)
+    meta: Dict[str, Any] = {
+        "step": int(step),
+        "epoch": int(epoch),
+        "rng": np.asarray(rng).tolist(),
+        "best_score": float(best_score),
+        "best_step": int(best_step),
+        "extra": extra or {},
+        "stamp": stamp,
+        "format": CHECKPOINT_FORMAT,
+        "opt_shards": int(opt_shards),
+    }
+
+    def write_files() -> None:
+        maybe_fail("checkpoint-write")
+        path.mkdir(parents=True, exist_ok=True)
+        digests = {
+            f"params-{stamp}.npz": _write_params_npz(path, stamp, params)
+        }
+        for k, digest in opt_digests.items():
+            digests[_opt_part_name(stamp, int(k), int(opt_shards))] = digest
+        meta["digests"] = digests
+        _commit_meta(path, stamp, meta)
+
+    retry_io("checkpoint-write", write_files)
+    _retention_sweep(path, stamp, keep)
+
+
 def _gen_stamp(meta_path: Path) -> Optional[int]:
     """Stamp encoded in a per-generation meta filename, or None."""
     name = meta_path.name
@@ -465,18 +648,8 @@ class TrainCheckpoint:
             # --resume can checkpoint at the same step the live meta already
             # points at, and an in-place rewrite of that file would reopen
             # the torn-write hole for exactly that generation
-            # np.savez ALWAYS appends .npz to a non-.npz name, so the written
-            # file is deterministically params-{stamp}.npz.tmp.npz — never
-            # branch on exists(): a stale literal .tmp left by other tooling
-            # would be promoted over the freshly written file
-            params_tmp = path / f"params-{stamp}.npz.tmp"
-            save_params(params_tmp, params)
-            os.replace(
-                params_tmp.with_suffix(params_tmp.suffix + ".npz"),
-                path / f"params-{stamp}.npz",
-            )
             digests = {
-                f"params-{stamp}.npz": _sha256_file(path / f"params-{stamp}.npz"),
+                f"params-{stamp}.npz": _write_params_npz(path, stamp, params)
             }
             if host_opt is not None:
                 opt_tmp = path / f"opt_state-{stamp}.pkl.tmp"
@@ -493,53 +666,10 @@ class TrainCheckpoint:
             # load() re-hashes exactly what it is about to read, so any
             # torn/truncated byte shows up
             meta["digests"] = digests
-            text = json.dumps(meta, indent=2)
-            # per-generation meta first (enables fallback), pointer last
-            # (atomic commit of "this is the newest generation")
-            gen_tmp = path / f"train_meta-{stamp}.json.tmp"
-            gen_tmp.write_text(text, encoding="utf8")
-            os.replace(gen_tmp, path / f"train_meta-{stamp}.json")
-            tmp = path / "train_meta.json.tmp"
-            tmp.write_text(text, encoding="utf8")
-            os.replace(tmp, path / "train_meta.json")
+            _commit_meta(path, stamp, meta)
 
         retry_io("checkpoint-write", write_files)
-        # retention: the generation just written plus the newest keep-1
-        # committed generations BELOW it. Stamps ABOVE the one just
-        # written are an abandoned lineage (a restart WITHOUT --resume
-        # re-counts steps from 0 into the same directory) — retaining
-        # them would let load()'s newest-stamp-first fallback silently
-        # resume the abandoned run's state, so they are deleted. A crash
-        # before this cleanup only leaves extra files behind.
-        committed = sorted(
-            s
-            for s in (_gen_stamp(p) for p in path.glob("train_meta-*.json"))
-            if s is not None and s < stamp
-        )
-        retained = set(committed[-(keep - 1):]) if keep > 1 else set()
-        retained.add(stamp)
-        for pattern, suffix in (
-            ("params-*.npz", ".npz"),
-            ("opt_state-*.pkl", ".pkl"),
-            ("train_meta-*.json", ".json"),
-        ):
-            prefix = pattern.split("*", 1)[0]
-            for old in path.glob(pattern):
-                core = old.name[len(prefix):-len(suffix)]
-                try:
-                    # "123" (v1) or "123.part0of8" (v2 opt shard)
-                    old_stamp = int(core.split(".", 1)[0])
-                except ValueError:
-                    continue
-                if old_stamp not in retained:
-                    old.unlink(missing_ok=True)
-        # tmp stragglers from crashed earlier saves (params-*.npz.tmp.npz,
-        # *.pkl.tmp, *.json.tmp): this save's own tmps were all promoted
-        # above, so anything still wearing a tmp suffix is garbage — on a
-        # crash-looping fleet these are full-size params/opt_state copies
-        for pattern in ("*.tmp", "*.tmp.npz"):
-            for stray in path.glob(pattern):
-                stray.unlink(missing_ok=True)
+        _retention_sweep(path, stamp, keep)
 
     # -- loading ------------------------------------------------------
 

@@ -1,0 +1,31 @@
+"""Cross-process asynchronous trainer fleet (PAPER.md §L3 ``RayPeerProxy``).
+
+The paper's actual training core — every parameter owned by exactly one
+worker, fire-and-forget gradient push to the owner, optimizer applied at
+quorum, version-check discard of stale gradients — reproduced ACROSS
+processes over the same stdlib-HTTP idiom the serving fleet proved out
+(serving/fleet/), sidestepping this container's missing multi-process CPU
+collectives (``test_multihost`` stays capability-skipped).
+
+Modules:
+
+* :mod:`.ownership` — the host-side owner-shard layout (the same
+  first-divisible-axis rule as :func:`~...parallel.mesh.zero1_spec`, so
+  fleet workers own exactly the shards the v2 checkpoint format writes
+  as per-owner part files) and the local↔canonical optimizer-state
+  mapping elastic cross-process resume stands on;
+* :mod:`.wire` — the pickle-free array codec gradients and parameters
+  ride over HTTP in (json header + raw little-endian bytes — an open
+  port must never ``pickle.load`` client bytes, the PR 8 rule);
+* :mod:`.peer` — :class:`~.peer.OwnerState` (quorum buffer, staleness
+  discard, versioned apply via the single-shard jitted update) and the
+  per-worker HTTP peer server (``/grad``, ``/params``, ``/checkpoint``,
+  plus the standard trainer telemetry surface ``/metrics``/``/healthz``/
+  ``/trace`` that ``telemetry top`` and Prometheus already scrape);
+* :mod:`.worker` — the per-process async training loop (pull → grad →
+  push → apply-wait, with per-phase timing);
+* :mod:`.coordinator` — spawns and supervises the N worker processes
+  (1-core pinning, crash restarts with ``--resume``, SIGTERM drain).
+"""
+
+from .ownership import OwnershipLayout, shard_axis  # noqa: F401

@@ -1,0 +1,147 @@
+"""Trainer-fleet coordinator: spawn and supervise the N worker processes.
+
+The jax-free parent (the ``train --fleet-workers N`` entry): one
+:class:`~..resilience.Supervisor` per worker on its own thread, so a
+crashed worker is relaunched WITH ``--resume`` (it reloads the last
+committed fleet generation, rejoins the peer plane, and its first
+stale-stamped push is discarded and counted — the SIGKILL drill's
+recovery path) while the survivors keep stepping at quorum. Signals to
+the coordinator fan out to every supervisor
+(:meth:`~..resilience.Supervisor.request_shutdown` — SIGTERM → SIGKILL
+escalation per child), and a relayed shutdown is a clean preemption
+(``RC_PREEMPTED``), not a restart.
+
+CPU pinning follows the serving fleet's idiom (PR 6): on a CPU device
+each worker gets a ``taskset -c`` core mask cycled from ``cpu_cores``
+(or this process's affinity set with ``"auto"``) — unmasked co-scheduled
+jax processes thrash each other's XLA thread pools into negative
+scaling.
+"""
+
+from __future__ import annotations
+
+import shutil
+import signal
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..resilience import RC_PREEMPTED, Supervisor, log_event
+
+__all__ = ["FLEET_SHUTDOWN_GRACE_S", "run_fleet"]
+
+# SIGTERM → SIGKILL escalation window for fleet workers. Deliberately
+# much longer than the serving fleet's 10s: worker 0's preemption path
+# finishes the in-flight step and then commits a DISTRIBUTED generation
+# (N-1 HTTP /checkpoint round trips shipping full param slices), and the
+# peers must stay alive to serve those writes — a 10s grace would SIGKILL
+# the commit mid-flight on any non-toy model. terminate_with_grace only
+# waits this long for a child that ignores SIGTERM; a clean preemption
+# exits the moment its checkpoint lands.
+FLEET_SHUTDOWN_GRACE_S = 120.0
+
+
+def _worker_cmd(
+    child_argv: List[str],
+    worker_id: int,
+    attempt: int,
+    taskset_prefix: Optional[List[str]],
+) -> List[str]:
+    cmd = list(taskset_prefix or []) + [
+        sys.executable, "-m", "spacy_ray_tpu", "train",
+    ] + list(child_argv) + ["--fleet-worker-id", str(worker_id)]
+    if attempt > 0 and "--resume" not in cmd:
+        cmd.append("--resume")  # rejoin from the last committed generation
+    return cmd
+
+
+def run_fleet(
+    child_argv: List[str],
+    *,
+    n_workers: int,
+    max_restarts: int = 0,
+    cpu_cores: Optional[List[str]] = None,
+    pin_cores: bool = True,
+    grace_s: float = FLEET_SHUTDOWN_GRACE_S,
+) -> int:
+    """Run the fleet to completion; returns the tree's exit code.
+
+    ``child_argv`` is the worker-side ``train`` argv (config path, fleet
+    knobs, output, …) WITHOUT ``--fleet-worker-id`` — each worker gets
+    its own id appended. Exit code: 0 when every worker exits 0;
+    ``RC_PREEMPTED`` for a relayed shutdown; otherwise the first
+    non-zero worker code (a worker that kept dying past
+    ``max_restarts``).
+    """
+    n_workers = int(n_workers)
+    taskset = shutil.which("taskset") if pin_cores else None
+    if pin_cores and cpu_cores and taskset is None:
+        log_event(
+            "fleet-pinning-unavailable",
+            "cpu_cores set but taskset is unavailable; fleet workers run "
+            "unpinned (expect thrash between co-scheduled XLA pools)",
+        )
+    supervisors: List[Supervisor] = []
+    for w in range(n_workers):
+        prefix: Optional[List[str]] = None
+        if taskset is not None and cpu_cores:
+            prefix = [taskset, "-c", cpu_cores[w % len(cpu_cores)]]
+
+        def build_cmd(attempt: int, w=w, prefix=prefix) -> List[str]:
+            return _worker_cmd(child_argv, w, attempt, prefix)
+
+        supervisors.append(
+            Supervisor(build_cmd, max_restarts, grace_s=grace_s)
+        )
+
+    rcs: Dict[int, int] = {}
+    threads: List[threading.Thread] = []
+    for w, sup in enumerate(supervisors):
+        t = threading.Thread(
+            target=lambda w=w, sup=sup: rcs.__setitem__(w, sup.run()),
+            name=f"fleet-supervisor-{w}",
+            daemon=True,
+        )
+        threads.append(t)
+
+    relayed = threading.Event()
+
+    def _relay(signum: int, frame: Any) -> None:
+        relayed.set()
+        for sup in supervisors:
+            sup.request_shutdown()
+
+    prev_handlers: Dict[int, Any] = {}
+    in_main = threading.current_thread() is threading.main_thread()
+    if in_main:
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers[signum] = signal.signal(signum, _relay)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        if in_main:
+            for signum, prev in prev_handlers.items():
+                try:
+                    signal.signal(signum, prev)
+                except (ValueError, OSError):  # pragma: no cover
+                    pass
+    if relayed.is_set():
+        return RC_PREEMPTED
+    codes = [rcs.get(w, 1) for w in range(n_workers)]
+    if all(rc == 0 for rc in codes):
+        return 0
+    if any(rc == RC_PREEMPTED for rc in codes):
+        return RC_PREEMPTED
+    first_bad = next(rc for rc in codes if rc != 0)
+    log_event(
+        "fleet-failed",
+        f"fleet worker exit codes {codes}; reporting rc={first_bad}",
+        codes=codes,
+    )
+    return first_bad

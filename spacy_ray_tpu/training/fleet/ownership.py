@@ -1,0 +1,329 @@
+"""Host-side parameter-ownership layout for the trainer fleet.
+
+The shard rule is deliberately THE SAME as the in-mesh owner-shard spec
+(:func:`~...parallel.mesh.zero1_spec`: shard the first axis divisible by
+the worker count, replicate otherwise) and the same as the v2 checkpoint
+writer's ``_shard_plan`` derives from those shardings — so a fleet of N
+processes owns exactly the slices an N-replica mesh checkpoints as
+``opt_state-{stamp}.partKofN.pkl`` part files. That identity is what
+makes elastic cross-process resume free: parts written by N separate
+fleet processes reassemble through the UNCHANGED
+``checkpoint._assemble_opt_parts`` into the canonical unsharded layout
+any mesh shape (or a single-process synchronous run) resumes from.
+
+Leaves no axis can shard (scalars, small biases) are owned WHOLE by
+worker 0 — mirroring the v2 format, where replicated leaves are written
+once into part 0 with ``index=None``.
+
+Everything here is numpy-on-host; jax appears only for pytree walking
+(``tree_flatten_with_path``) when mapping a worker's LOCAL optimizer
+state (built by ``tx.init`` over its owned slice tree) onto the
+CANONICAL full-state leaf ordinals. The mapping leans on one structural
+fact: the owned slice tree is the param tree restricted to owned paths,
+so every local optimizer leaf's key path is literally a key path of the
+full state — matching is exact string equality, no heuristics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+PathT = Tuple[str, ...]
+IndexT = Tuple[Tuple[int, int], ...]
+
+
+def shard_axis(shape: Sequence[int], n_workers: int) -> Optional[int]:
+    """First axis divisible by (and at least) ``n_workers`` — the
+    :func:`~...parallel.mesh.zero1_spec` rule verbatim; None means the
+    leaf cannot shard (owned whole by worker 0)."""
+    if n_workers <= 1:
+        return None
+    for axis, dim in enumerate(shape):
+        if dim % n_workers == 0 and dim >= n_workers:
+            return axis
+    return None
+
+
+def path_key(path: PathT) -> str:
+    return "/".join(path)
+
+
+def iter_leaves(tree: Any, prefix: PathT = ()) -> Iterator[Tuple[PathT, Any]]:
+    """Depth-first (sorted-key — jax's dict order) walk of a nested-dict
+    tree, yielding (path, leaf). The SAME path scheme as
+    ``checkpoint._flatten``'s '/'-joined keys (test-pinned: fleet part
+    files and params-npz must round-trip through checkpoint.py), minus
+    that helper's host materialization — slicing and merging need the
+    raw leaves, not copies."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from iter_leaves(tree[k], prefix + (str(k),))
+    else:
+        yield prefix, tree
+
+
+def tree_from_flat(flat: Dict[str, Any]) -> Dict[str, Any]:
+    """'/'-joined path keys back into a nested dict — checkpoint.py's
+    ``_unflatten``, re-exported as the fleet's one unflatten."""
+    from ..checkpoint import _unflatten
+
+    return _unflatten(flat)
+
+
+class OwnershipLayout:
+    """Which worker owns which slice of every param-shaped leaf.
+
+    Built once from the (host) parameter template; the same layout
+    slices gradients (same tree shape as params) and answers the
+    checkpoint writer's ``((start, stop), ...)`` index questions.
+    """
+
+    def __init__(self, template: Any, n_workers: int) -> None:
+        self.n_workers = max(int(n_workers), 1)
+        self.paths: List[PathT] = []
+        self.shapes: List[Tuple[int, ...]] = []
+        self.axes: List[Optional[int]] = []
+        self._by_key: Dict[str, int] = {}
+        for path, leaf in iter_leaves(template):
+            shape = tuple(int(d) for d in np.shape(leaf))
+            self.paths.append(path)
+            self.shapes.append(shape)
+            self.axes.append(shard_axis(shape, self.n_workers))
+            self._by_key[path_key(path)] = len(self.paths) - 1
+
+    # -- ownership queries --------------------------------------------
+    def owns(self, ordinal: int, worker: int) -> bool:
+        """Does ``worker`` own a piece of leaf ``ordinal``? Shardable
+        leaves: every worker owns its slice. Unshardable: worker 0 owns
+        the whole leaf."""
+        if self.axes[ordinal] is None:
+            return worker == 0
+        return 0 <= worker < self.n_workers
+
+    def index(self, ordinal: int, worker: int) -> Optional[IndexT]:
+        """The v2-checkpoint index of ``worker``'s slice of leaf
+        ``ordinal`` — ``((start, stop), ...)`` over ALL axes, or None
+        for a whole (unshardable) leaf."""
+        axis = self.axes[ordinal]
+        shape = self.shapes[ordinal]
+        if axis is None:
+            return None
+        span = shape[axis] // self.n_workers
+        out = []
+        for a, dim in enumerate(shape):
+            if a == axis:
+                out.append((worker * span, (worker + 1) * span))
+            else:
+                out.append((0, dim))
+        return tuple(out)
+
+    def index_for_shape(
+        self, shape: Sequence[int], worker: int
+    ) -> Optional[IndexT]:
+        """Index of ``worker``'s slice for an arbitrary leaf shape (the
+        optimizer-state leaves, whose own shapes decide their sharding —
+        the same by-shape rule ``_shard_plan`` recovers from in-mesh
+        shardings)."""
+        axis = shard_axis(shape, self.n_workers)
+        if axis is None:
+            return None
+        span = int(shape[axis]) // self.n_workers
+        return tuple(
+            (worker * span, (worker + 1) * span) if a == axis else (0, int(d))
+            for a, d in enumerate(shape)
+        )
+
+    @staticmethod
+    def slice_with(arr: np.ndarray, index: Optional[IndexT]) -> np.ndarray:
+        if index is None:
+            return np.asarray(arr)
+        return np.asarray(arr)[tuple(slice(a, b) for a, b in index)]
+
+    # -- tree operations ----------------------------------------------
+    def owned_keys(self, worker: int) -> List[str]:
+        return [
+            path_key(self.paths[i])
+            for i in range(len(self.paths))
+            if self.owns(i, worker)
+        ]
+
+    def flat_slices(self, tree: Any, worker: int) -> Dict[str, np.ndarray]:
+        """``worker``'s owned slices of a params-shaped tree, as a flat
+        '/'-keyed dict of COPIES (safe to mutate / serialize after the
+        source tree moves on)."""
+        out: Dict[str, np.ndarray] = {}
+        for path, leaf in iter_leaves(tree):
+            ordinal = self._by_key[path_key(path)]
+            if not self.owns(ordinal, worker):
+                continue
+            out[path_key(path)] = np.array(
+                self.slice_with(np.asarray(leaf), self.index(ordinal, worker))
+            )
+        return out
+
+    def slice_tree(self, tree: Any, worker: int) -> Dict[str, Any]:
+        """Owned slices as a NESTED dict restricted to owned paths —
+        the tree ``tx.init`` runs on and the jitted shard apply updates."""
+        return tree_from_flat(self.flat_slices(tree, worker))
+
+    def merge_flat(
+        self, full: Any, worker: int, flat: Dict[str, np.ndarray]
+    ) -> None:
+        """Write ``worker``'s slices back into the full host tree IN
+        PLACE (the pull path: refresh non-owned shards from their
+        owner's bytes). Unknown keys and shape mismatches raise — a peer
+        sending a different model is a config error, not data."""
+        for key, piece in flat.items():
+            ordinal = self._by_key.get(key)
+            if ordinal is None:
+                raise ValueError(f"unknown param leaf {key!r} in merge")
+            node = full
+            for p in self.paths[ordinal][:-1]:
+                node = node[p]
+            leaf_key = self.paths[ordinal][-1]
+            index = self.index(ordinal, worker)
+            arr = np.asarray(node[leaf_key])
+            if not isinstance(node[leaf_key], np.ndarray):
+                # first merge into a tree that still holds jax arrays:
+                # materialize a mutable host copy once
+                arr = np.array(arr)
+                node[leaf_key] = arr
+            if index is None:
+                if piece.shape != arr.shape:
+                    raise ValueError(
+                        f"shape mismatch for {key!r}: {piece.shape} vs "
+                        f"{arr.shape}"
+                    )
+                arr[...] = piece
+            else:
+                arr[tuple(slice(a, b) for a, b in index)] = piece
+
+    def signature(self) -> str:
+        """Cheap structural digest (paths + shapes + worker count) every
+        peer must agree on — pushed slices are meaningless across
+        differing layouts, so /healthz carries this and startup verifies
+        it."""
+        import hashlib
+
+        text = f"n={self.n_workers}|" + "|".join(
+            f"{path_key(p)}:{'x'.join(map(str, s))}"
+            for p, s in zip(self.paths, self.shapes)
+        )
+        return hashlib.sha256(text.encode("utf8")).hexdigest()[:16]
+
+
+# ----------------------------------------------------------------------
+# Optimizer-state local <-> canonical mapping
+# ----------------------------------------------------------------------
+
+
+def _flatten_with_keystr(tree: Any) -> List[Tuple[str, Any]]:
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def opt_part_records(
+    tx: Any,
+    param_template: Any,
+    layout: OwnershipLayout,
+    local_opt_state: Any,
+    worker: int,
+) -> Tuple[int, Any, List[Tuple[int, Optional[IndexT], Tuple[int, ...], str, np.ndarray]]]:
+    """Map one worker's LOCAL optimizer state onto the canonical full
+    state's leaf ordinals, producing the v2 part-file records
+    ``(ordinal, index, global_shape, dtype, piece)``.
+
+    Returns ``(n_leaves, skeleton, records)`` — ``skeleton`` is the
+    structure-only (all-zeros) canonical state worker 0's part-0 header
+    carries, exactly like the in-mesh writer's.
+
+    Chain scalars (Adam/schedule counts) exist in EVERY worker's local
+    state but are emitted by worker 0 only, with ``index=None`` — the
+    same placement the in-mesh v2 writer gives replicated leaves.
+    """
+    import jax
+
+    template_struct = jax.eval_shape(tx.init, param_template)
+    global_leaves = _flatten_with_keystr(template_struct)
+    global_by_key = {
+        key: (ordinal, tuple(int(d) for d in leaf.shape), str(leaf.dtype))
+        for ordinal, (key, leaf) in enumerate(global_leaves)
+    }
+    skeleton = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template_struct),
+        [0] * len(global_leaves),
+    )
+    records: List[
+        Tuple[int, Optional[IndexT], Tuple[int, ...], str, np.ndarray]
+    ] = []
+    for key, leaf in _flatten_with_keystr(local_opt_state):
+        if key not in global_by_key:
+            raise ValueError(
+                f"local optimizer leaf {key!r} has no canonical "
+                "counterpart — owned slice tree diverged from the param "
+                "template"
+            )
+        ordinal, gshape, _dtype = global_by_key[key]
+        index = layout.index_for_shape(gshape, worker)
+        piece = np.asarray(jax.device_get(leaf))
+        if index is None:
+            if worker != 0:
+                continue  # worker 0 writes the whole-leaf copies
+            if piece.shape != gshape:
+                raise ValueError(
+                    f"unshardable optimizer leaf {key!r} has local shape "
+                    f"{piece.shape}, canonical {gshape}"
+                )
+        else:
+            want = tuple(b - a for a, b in index)
+            if piece.shape != want:
+                raise ValueError(
+                    f"optimizer leaf {key!r}: local slice shape "
+                    f"{piece.shape} != owner-shard shape {want}"
+                )
+        records.append((ordinal, index, gshape, str(piece.dtype), piece))
+    return len(global_leaves), skeleton, records
+
+
+def local_opt_from_canonical(
+    tx: Any,
+    layout: OwnershipLayout,
+    canonical_opt: Any,
+    worker: int,
+    slice_params: Any,
+) -> Any:
+    """The resume direction: carve one worker's LOCAL optimizer state out
+    of a loaded canonical (unsharded) state. The local structure comes
+    from ``tx.init`` over the owned slice tree; every local leaf's value
+    is the matching slice of the canonical leaf — bit-identical round
+    trip with :func:`opt_part_records`."""
+    import jax
+    import jax.numpy as jnp
+
+    canonical_by_key = {
+        key: leaf for key, leaf in _flatten_with_keystr(canonical_opt)
+    }
+    local_template = jax.eval_shape(tx.init, slice_params)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(local_template)
+    leaves = []
+    for path, struct in flat:
+        key = jax.tree_util.keystr(path)
+        if key not in canonical_by_key:
+            raise ValueError(
+                f"checkpointed optimizer state has no leaf {key!r} — "
+                "optimizer config changed since the checkpoint was written?"
+            )
+        full = np.asarray(jax.device_get(canonical_by_key[key]))
+        index = layout.index_for_shape(full.shape, worker)
+        piece = OwnershipLayout.slice_with(full, index)
+        if tuple(piece.shape) != tuple(struct.shape):
+            raise ValueError(
+                f"optimizer leaf {key!r}: checkpoint slice shape "
+                f"{piece.shape} != local shape {tuple(struct.shape)}"
+            )
+        leaves.append(jnp.asarray(piece))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,533 @@
+"""The fleet worker's peer plane: owner state + HTTP server.
+
+:class:`OwnerState` is the paper's ``RayPeerProxy`` owner side
+(PAPER.md §L3; reference proxies.py:111-133): it holds the one
+authoritative copy of this worker's owned parameter slices and their
+optimizer state, buffers incoming gradients keyed by sender, DISCARDS
+(and counts) gradients whose version stamp is more than ``max_staleness``
+behind the current shard version, and applies the optimizer — the jitted
+single-shard update from :func:`~...parallel.step.make_shard_apply` —
+the moment ``quorum`` distinct workers' gradients are buffered, bumping
+the shard version.
+
+:class:`PeerServer` is the stdlib-HTTP shell around it (the serving
+fleet's proven idiom): ``POST /grad`` (wire.py payloads — never pickle),
+``GET /params`` (version-gated slice pull; 204 = already current),
+``POST /checkpoint`` (write my owner-shard v2 part file, reply digest +
+an atomic same-version copy of my param slices), ``POST /finalize``, and
+the standard trainer telemetry surface — ``GET /metrics`` (JSON or
+Prometheus with a ``worker`` label on every family), ``/healthz`` (clock
+anchor + layout signature), ``/trace``, ``/admin/alerts`` — so
+``telemetry top``, Prometheus scrapers, and ``telemetry collect-trace``
+see each fleet worker exactly as they see a plain trainer.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from .ownership import iter_leaves, path_key, tree_from_flat
+from .wire import WireError, decode_arrays, encode_arrays
+
+logger = logging.getLogger("spacy_ray_tpu.training")
+
+__all__ = ["FleetCounters", "OwnerState", "PeerServer"]
+
+# counter names are chosen so the Prometheus rendering (prefix
+# srt_training, counters get _total) yields the observability plane's
+# documented series: srt_training_grad_{pushed,applied,discarded}_total
+COUNTER_NAMES = (
+    "grad_pushed",      # worker-side: payloads delivered to PEER owners
+                        # (self-delivery excluded: this is the alert
+                        # plane's "is this worker talking to its fleet"
+                        # signal, which a local submit must not feed)
+    "grad_received",    # owner-side: payloads that arrived at this owner
+    "grad_applied",     # owner-side: buffered contributions folded into applies
+    "grad_discarded",   # owner-side: stale-version payloads dropped
+    "push_failed",      # worker-side: pushes that exhausted their retries
+    "pull_failed",      # worker-side: parameter pulls that failed
+    "apply_wait_timeouts",  # worker-side: quorum waits that timed out
+    "pull_wait_timeouts",   # worker-side: staleness-gate waits that timed out
+    "applies",          # owner-side: optimizer applies (version bumps)
+)
+
+
+class FleetCounters:
+    """The fleet ledger: plain thread-safe ints that exist with or
+    without telemetry (the result file / CI discard ledger reads them),
+    optionally mirrored into a ``MetricsRegistry``'s counters so the
+    /metrics surfaces and alert rules see the same numbers."""
+
+    def __init__(self, registry: Any = None) -> None:
+        self._v: Dict[str, int] = {n: 0 for n in COUNTER_NAMES}
+        self._lock = threading.Lock()
+        self._mirror = (
+            {n: registry.counter(n) for n in COUNTER_NAMES}
+            if registry is not None
+            else None
+        )
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._v[name] += int(n)
+        if self._mirror is not None:
+            self._mirror[name].inc(n)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._v)
+
+
+class OwnerState:
+    """Authoritative owner of this worker's parameter slices.
+
+    Apply policy (the knob-controlled async core): an arriving gradient
+    stamped ``s`` against current version ``v`` is
+
+    * buffered when ``s == v`` (current round);
+    * buffered when ``0 < v - s <= max_staleness`` (bounded staleness —
+      a late gradient still contributes to the CURRENT state, classic
+      async-SGD semantics);
+    * discarded and counted otherwise — too stale, or stamped with a
+      FUTURE version (a peer pushing against a pre-crash cache after
+      this owner restarted and rolled back to its checkpoint).
+
+    The buffer is keyed by sender (a worker re-pushing before an apply
+    overwrites its previous contribution); once ``quorum`` distinct
+    senders are buffered the mean gradient goes through the jitted
+    shard apply, the version bumps, and waiters are notified.
+    """
+
+    def __init__(
+        self,
+        *,
+        worker_id: int,
+        n_workers: int,
+        quorum: int,
+        max_staleness: int,
+        apply_fn: Callable,
+        slice_params: Any,
+        opt_state: Any,
+        counters: FleetCounters,
+        version: int = 0,
+        on_version: Optional[Callable[[int], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if not (1 <= quorum <= n_workers):
+            raise ValueError(
+                f"quorum must be in [1, {n_workers}], got {quorum}"
+            )
+        if max_staleness < 0:
+            raise ValueError(f"max_staleness must be >= 0, got {max_staleness}")
+        self.worker_id = int(worker_id)
+        self.n_workers = int(n_workers)
+        self.quorum = int(quorum)
+        self.max_staleness = int(max_staleness)
+        self.apply_fn = apply_fn
+        self.params = slice_params  # device tree (nested dict)
+        self.opt_state = opt_state  # device tree
+        self.counters = counters
+        self.version = int(version)
+        self.on_version = on_version
+        self.clock = clock
+        self.lock = threading.Lock()
+        self._cond = threading.Condition(self.lock)
+        self._buffer: Dict[int, Dict[str, np.ndarray]] = {}
+        self._host_flat: Dict[str, np.ndarray] = {
+            path_key(p): np.array(np.asarray(leaf))
+            for p, leaf in iter_leaves(slice_params)
+        }
+        self._encoded: Optional[bytes] = None
+        self.apply_seconds = 0.0
+        if self.on_version is not None:
+            self.on_version(self.version)
+
+    # -- owner side ----------------------------------------------------
+    def submit(
+        self, worker: int, stamp: int, grads: Dict[str, np.ndarray]
+    ) -> Tuple[bool, int]:
+        """One gradient payload from ``worker`` stamped against shard
+        version ``stamp``. Returns (accepted, current version).
+
+        Structural validation happens HERE, before anything enters the
+        quorum buffer: a wire-valid payload whose keys/shapes don't
+        match the owned slices (a peer resolving a different config — a
+        rejoining worker can get past the tolerant rejoin path without
+        the healthz signature check) must be a counted discard, never a
+        buffered entry that makes the NEXT apply raise mid-quorum and
+        wedge the shard forever."""
+        with self._cond:
+            self.counters.inc("grad_received")
+            if not (0 <= int(worker) < self.n_workers):
+                # a bogus sender id must not count toward quorum
+                self.counters.inc("grad_discarded")
+                return False, self.version
+            lag = self.version - int(stamp)
+            if lag < 0 or lag > self.max_staleness:
+                self.counters.inc("grad_discarded")
+                return False, self.version
+            if set(grads) != set(self._host_flat) or any(
+                grads[k].shape != self._host_flat[k].shape for k in grads
+            ):
+                self.counters.inc("grad_discarded")
+                logger.warning(
+                    "fleet owner %d: structurally mismatched gradient "
+                    "payload from worker %s discarded (peer running a "
+                    "different parameter layout?)",
+                    self.worker_id, worker,
+                )
+                return False, self.version
+            self._buffer[int(worker)] = grads
+            if len(self._buffer) >= self.quorum:
+                try:
+                    self._apply_locked()
+                except Exception:
+                    # belt over the validation above: an apply that still
+                    # raises must not leave a poisoned buffer that
+                    # re-raises at every future quorum — drop the round
+                    # (counted) and keep the shard serving
+                    self.counters.inc(
+                        "grad_discarded", len(self._buffer)
+                    )
+                    self._buffer.clear()
+                    logger.exception(
+                        "fleet owner %d: quorum apply failed; round "
+                        "dropped", self.worker_id,
+                    )
+            return True, self.version
+
+    def _apply_locked(self) -> None:
+        t0 = self.clock()
+        n = len(self._buffer)
+        mean_flat: Dict[str, np.ndarray] = {}
+        for flat in self._buffer.values():
+            for key, arr in flat.items():
+                acc = mean_flat.get(key)
+                mean_flat[key] = arr.astype(np.float32) if acc is None else acc + arr
+        for key in mean_flat:
+            mean_flat[key] = mean_flat[key] / np.float32(n)
+        grads_tree = tree_from_flat(mean_flat)
+        self.params, self.opt_state = self.apply_fn(
+            self.params, self.opt_state, grads_tree
+        )
+        self._host_flat = {
+            path_key(p): np.array(np.asarray(leaf))
+            for p, leaf in iter_leaves(self.params)
+        }
+        self._encoded = None
+        self.version += 1
+        self.counters.inc("grad_applied", n)
+        self.counters.inc("applies")
+        self._buffer.clear()
+        self.apply_seconds += self.clock() - t0
+        if self.on_version is not None:
+            self.on_version(self.version)
+        self._cond.notify_all()
+
+    # -- reader side ---------------------------------------------------
+    def current_flat(self) -> Tuple[int, Dict[str, np.ndarray]]:
+        """(version, owned slices) — the arrays are the post-apply host
+        copies (replaced wholesale on each apply, never mutated), so the
+        returned dict is safe to merge without holding the lock."""
+        with self.lock:
+            return self.version, dict(self._host_flat)
+
+    def encoded(self, known: Optional[int]) -> Tuple[int, Optional[bytes]]:
+        """Wire payload of the current slices, or ``(version, None)``
+        when the caller's ``known`` version is already current. The
+        encoding is cached per version (one encode, many pulls)."""
+        with self.lock:
+            if known is not None and int(known) == self.version:
+                return self.version, None
+            if self._encoded is None:
+                self._encoded = encode_arrays(
+                    {"version": self.version, "worker": self.worker_id},
+                    self._host_flat,
+                )
+            return self.version, self._encoded
+
+    def checkpoint_parts(self, writer: Callable[[int, Any, Dict[str, np.ndarray]], Any]) -> Any:
+        """Run ``writer(version, opt_state, host_flat)`` under the owner
+        lock: no apply can bump the version — or DONATE the optimizer
+        state's device buffers out from under the writer's device_get —
+        while the part file is being written, so the part and the param
+        slices it ships with are one consistent (version-stamped) cut."""
+        with self.lock:
+            return writer(self.version, self.opt_state, dict(self._host_flat))
+
+    def wait_version_above(self, stamp: int, timeout: float) -> bool:
+        """Block until the shard version exceeds ``stamp`` (my round was
+        folded in, or a later one superseded it) — the worker loop's
+        apply-wait phase. False on timeout."""
+        deadline = self.clock() + float(timeout)
+        with self._cond:
+            while self.version <= int(stamp):
+                remaining = deadline - self.clock()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+
+class _PeerHTTPD(ThreadingHTTPServer):
+    daemon_threads = True
+    owner: OwnerState
+    worker_id: int
+    layout_signature: str
+    tel: Any
+    checkpoint_cb: Optional[Callable[[str, int], Dict[str, Any]]]
+    finalize_event: threading.Event
+    counters: FleetCounters
+
+
+class _PeerHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: _PeerHTTPD
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        logger.debug("%s " + fmt, self.address_string(), *args)
+
+    # -- reply helpers -------------------------------------------------
+    def _reply_json(self, status: int, payload: Dict[str, Any]) -> None:
+        from ..telemetry import sanitize_json
+
+        body = json.dumps(sanitize_json(payload)).encode("utf8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_bytes(self, status: int, body: bytes, content_type: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length > 0 else b""
+
+    # -- GET -----------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler naming)
+        parsed = urlparse(self.path)
+        srv = self.server
+        if parsed.path == "/healthz":
+            payload: Dict[str, Any] = {
+                "status": "ok",
+                "role": "fleet-worker",
+                "worker": srv.worker_id,
+                "version": srv.owner.version,
+                "layout": srv.layout_signature,
+            }
+            if srv.tel is not None:
+                payload["anchor"] = srv.tel.trace.anchor()
+            self._reply_json(200, payload)
+        elif parsed.path == "/params":
+            q = parse_qs(parsed.query)
+            known_s = (q.get("known") or [None])[0]
+            try:
+                known = int(known_s) if known_s is not None else None
+            except ValueError:
+                # same discipline as every other input on this port:
+                # malformed client bytes are a clean 400, never a
+                # handler-thread traceback
+                self._reply_json(
+                    400, {"error": "bad_request",
+                          "message": f"known={known_s!r} is not an int"}
+                )
+                return
+            version, body = srv.owner.encoded(known)
+            if body is None:
+                self.send_response(204)
+                self.send_header("X-SRT-Version", str(version))
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+            else:
+                self.send_response(200)
+                self.send_header("X-SRT-Version", str(version))
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+        elif parsed.path == "/metrics":
+            self._metrics(parsed)
+        elif parsed.path == "/admin/alerts":
+            alerts = getattr(srv.tel, "alerts", None)
+            if alerts is None:
+                self._reply_json(200, {"alerts": "disabled"})
+            else:
+                self._reply_json(200, {"alerts": alerts.states()})
+        elif parsed.path == "/trace":
+            if srv.tel is None:
+                self._reply_json(404, {"error": "telemetry_disabled"})
+            else:
+                payload = srv.tel.trace.payload()
+                payload["anchor"] = srv.tel.trace.anchor()
+                payload["role"] = "fleet-worker"
+                self._reply_json(200, payload)
+        else:
+            self._reply_json(404, {"error": "not_found", "message": parsed.path})
+
+    def _metrics(self, parsed: Any) -> None:
+        srv = self.server
+        fmt = (parse_qs(parsed.query).get("format") or [""])[0]
+        if srv.tel is None:
+            # telemetry off: the peer plane still serves its own ledger
+            # (counters + version) so an operator can see the fleet move —
+            # but constructs no registry/trace objects (the zero-calls
+            # contract stays with the worker loop)
+            snap = {
+                "counters": srv.counters.snapshot(),
+                "gauges": {
+                    "fleet_worker": srv.worker_id,
+                    "param_version": srv.owner.version,
+                },
+            }
+            if fmt == "prometheus":
+                from ..prometheus import EXPOSITION_CONTENT_TYPE, render_snapshot
+
+                self._reply_bytes(
+                    200,
+                    render_snapshot(
+                        snap,
+                        prefix="srt_training",
+                        labels={"worker": str(srv.worker_id)},
+                    ).encode("utf8"),
+                    EXPOSITION_CONTENT_TYPE,
+                )
+            else:
+                self._reply_json(200, snap)
+            return
+        alerts = getattr(srv.tel, "alerts", None)
+        if fmt == "prometheus":
+            from ..prometheus import EXPOSITION_CONTENT_TYPE, PromFamilies
+
+            fam = PromFamilies()
+            # the worker label on every trainer family: one Prometheus
+            # server scraping N fleet workers gets N distinct series per
+            # family instead of N colliding unlabeled ones
+            fam.add_snapshot(
+                srv.tel.registry.snapshot(),
+                prefix="srt_training",
+                labels={"worker": str(srv.worker_id)},
+            )
+            if alerts is not None:
+                alerts.add_prometheus(fam)
+            self._reply_bytes(200, fam.render().encode("utf8"), EXPOSITION_CONTENT_TYPE)
+        else:
+            snap = srv.tel.registry.snapshot()
+            snap["worker"] = srv.worker_id
+            if alerts is not None:
+                snap["alerts"] = alerts.summary()
+            self._reply_json(200, snap)
+
+    # -- POST ----------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802
+        parsed = urlparse(self.path)
+        srv = self.server
+        if parsed.path == "/grad":
+            try:
+                meta, arrays = decode_arrays(self._read_body())
+                worker = int(meta["worker"])
+                stamp = int(meta["stamp"])
+            except (WireError, KeyError, TypeError, ValueError) as e:
+                self._reply_json(400, {"error": "bad_payload", "message": str(e)})
+                return
+            accepted, version = srv.owner.submit(worker, stamp, arrays)
+            self._reply_json(
+                200, {"accepted": accepted, "version": version}
+            )
+        elif parsed.path == "/checkpoint":
+            if srv.checkpoint_cb is None:
+                self._reply_json(503, {"error": "not_ready"})
+                return
+            try:
+                req = json.loads(self._read_body().decode("utf8") or "{}")
+                ckpt_dir = str(req["dir"])
+                stamp = int(req["stamp"])
+            except (ValueError, KeyError, UnicodeDecodeError) as e:
+                self._reply_json(400, {"error": "bad_request", "message": str(e)})
+                return
+            try:
+                result = srv.checkpoint_cb(ckpt_dir, stamp)
+            except Exception as e:  # surfaced to the coordinator, not eaten
+                logger.exception("fleet checkpoint part write failed")
+                self._reply_json(
+                    500, {"error": "checkpoint_failed", "message": str(e)}
+                )
+                return
+            body = encode_arrays(result["meta"], result["params"])
+            self._reply_bytes(200, body, "application/octet-stream")
+        elif parsed.path == "/finalize":
+            srv.finalize_event.set()
+            self._reply_json(200, {"status": "finalizing"})
+        else:
+            self._reply_json(404, {"error": "not_found", "message": parsed.path})
+
+
+class PeerServer:
+    """Lifecycle wrapper for one worker's peer endpoint (daemon serve
+    thread, like the trainer telemetry server)."""
+
+    def __init__(
+        self,
+        owner: OwnerState,
+        *,
+        worker_id: int,
+        layout_signature: str,
+        counters: FleetCounters,
+        tel: Any = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        checkpoint_cb: Optional[Callable[[str, int], Dict[str, Any]]] = None,
+    ) -> None:
+        self.httpd = _PeerHTTPD((host, int(port)), _PeerHandler)
+        self.httpd.owner = owner
+        self.httpd.worker_id = int(worker_id)
+        self.httpd.layout_signature = layout_signature
+        self.httpd.tel = tel
+        self.httpd.counters = counters
+        self.httpd.checkpoint_cb = checkpoint_cb
+        self.httpd.finalize_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        host, port = self.httpd.server_address[:2]
+        return str(host), int(port)
+
+    @property
+    def finalize_event(self) -> threading.Event:
+        return self.httpd.finalize_event
+
+    def set_checkpoint_cb(
+        self, cb: Callable[[str, int], Dict[str, Any]]
+    ) -> None:
+        self.httpd.checkpoint_cb = cb
+
+    def start(self) -> Tuple[str, int]:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.2},
+            name=f"fleet-peer-{self.httpd.worker_id}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self.address
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

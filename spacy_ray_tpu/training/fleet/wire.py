@@ -1,0 +1,85 @@
+"""Pickle-free wire codec for the trainer fleet's array payloads.
+
+Gradient pushes and parameter pulls move ``{leaf-path: ndarray}`` dicts
+between processes. The serving subsystem's rule (PR 8) applies here too:
+an open port must never ``pickle.load`` client-supplied bytes. The
+format is a json header (lengths, dtypes, shapes — data, not code)
+followed by the arrays' raw little-endian bytes:
+
+    b"SRTF1" | u64 header length (big-endian) | header json | raw bytes
+
+Arrays are decoded with ``np.frombuffer`` against the declared dtype —
+nothing in the payload is executable. Decode errors raise
+:class:`WireError` (one typed error for every malformed-payload shape).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+MAGIC = b"SRTF1"
+
+__all__ = ["MAGIC", "WireError", "encode_arrays", "decode_arrays"]
+
+
+class WireError(ValueError):
+    """Malformed fleet wire payload (truncated, wrong magic, bad
+    header, byte-count mismatch)."""
+
+
+def encode_arrays(meta: Dict[str, Any], arrays: Dict[str, np.ndarray]) -> bytes:
+    entries = []
+    blobs = []
+    for key in sorted(arrays):
+        arr = np.ascontiguousarray(arrays[key])
+        if arr.dtype.byteorder == ">":  # big-endian host array: normalize
+            arr = arr.astype(arr.dtype.newbyteorder("<"))
+        entries.append([key, arr.dtype.str, list(arr.shape)])
+        blobs.append(arr.tobytes())
+    header = json.dumps({"meta": meta, "arrays": entries}).encode("utf8")
+    return (
+        MAGIC
+        + len(header).to_bytes(8, "big")
+        + header
+        + b"".join(blobs)
+    )
+
+
+def decode_arrays(body: bytes) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    if len(body) < len(MAGIC) + 8 or body[: len(MAGIC)] != MAGIC:
+        raise WireError("bad fleet payload: missing magic")
+    hlen = int.from_bytes(body[len(MAGIC): len(MAGIC) + 8], "big")
+    start = len(MAGIC) + 8
+    if len(body) < start + hlen:
+        raise WireError("bad fleet payload: truncated header")
+    try:
+        header = json.loads(body[start: start + hlen].decode("utf8"))
+        entries = header["arrays"]
+        meta = header.get("meta") or {}
+    except (ValueError, KeyError, UnicodeDecodeError) as e:
+        raise WireError(f"bad fleet payload header: {e}") from e
+    arrays: Dict[str, np.ndarray] = {}
+    offset = start + hlen
+    for entry in entries:
+        try:
+            key, dtype_s, shape = entry
+            dtype = np.dtype(str(dtype_s))
+            shape = tuple(int(d) for d in shape)
+        except (ValueError, TypeError) as e:
+            raise WireError(f"bad fleet payload entry {entry!r}: {e}") from e
+        count = int(np.prod(shape, dtype=np.int64))  # () -> 1, (0, d) -> 0
+        nbytes = dtype.itemsize * count
+        if len(body) < offset + nbytes:
+            raise WireError(f"bad fleet payload: truncated data for {key!r}")
+        arrays[str(key)] = np.frombuffer(
+            body, dtype=dtype, count=count, offset=offset
+        ).reshape(shape).copy()
+        offset += nbytes
+    if offset != len(body):
+        raise WireError(
+            f"bad fleet payload: {len(body) - offset} trailing bytes"
+        )
+    return meta, arrays

@@ -1,0 +1,1066 @@
+"""One trainer-fleet process: the asynchronous pull → grad → push →
+apply-wait loop (PAPER.md §L3/L4, reference worker.py:117-155).
+
+Each of the N processes:
+
+* computes gradients on ITS OWN corpus shard
+  (:func:`~..batcher.shard_stream` by worker id — the per-rank data
+  sharding the reference lacked);
+* pushes the non-owned shard gradients to their owners, fire-and-forget
+  with a bounded :class:`~..resilience.RetryPolicy` (a dead peer costs a
+  counted drop, never a stall);
+* feeds its OWN shard's gradients to its local :class:`~.peer.OwnerState`,
+  which applies the optimizer at quorum and bumps the shard version;
+* blocks (apply-wait) until its own shard's version passes the stamp it
+  pushed against — bounded by ``quorum_wait_s`` so a lost quorum degrades
+  to a counted timeout, not a wedge;
+* pulls newer shard bytes from the other owners at the top of the next
+  step.
+
+Gradient-clip semantics: with a fusable optimizer (Adam.v1 / RAdam.v1)
+the global-norm clip runs WORKER-SIDE over the full gradient tree
+(exact global norm of that worker's gradient) and the owner applies a
+clip-free fused chain on its slice — the one optimizer stage that needs
+the whole tree moves to where the whole tree lives. The owner's state
+STRUCTURE still delegates to the reference chain, so fleet part files
+reassemble into exactly the canonical state a synchronous run resumes
+from (the clip element's state is empty). Non-fusable optimizers run
+their full chain per-shard (per-shard clip — documented caveat,
+TUNING.md §19).
+
+Per-phase wall time (data / pull / grad / push / apply_wait) is
+accounted every step and lands on the bench record and the per-worker
+result file ``fleet-worker-{k}.json`` (which doubles as the CI failure
+artifact's discard-counter ledger).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+import numpy as np
+
+from ...registry import registry
+from ..batcher import bucket_batch_size, bucket_length, shard_stream
+from ..checkpoint import (
+    CheckpointCorrupt,
+    TrainCheckpoint,
+    commit_fleet_generation,
+    write_fleet_opt_part,
+)
+from .. import resilience
+from ..resilience import (
+    RetryPolicy,
+    ShutdownCoordinator,
+    Watchdog,
+    log_event,
+    maybe_fail,
+    retry_io,
+)
+from .ownership import (
+    OwnershipLayout,
+    local_opt_from_canonical,
+    opt_part_records,
+)
+from .peer import FleetCounters, OwnerState, PeerServer
+from .wire import WireError, decode_arrays, encode_arrays
+
+DEFAULT_FLEET_BASE_PORT = 47200
+PHASES = ("data", "pull", "grad", "push", "apply_wait")
+
+__all__ = [
+    "DEFAULT_FLEET_BASE_PORT",
+    "PHASES",
+    "resolve_quorum",
+    "train_fleet_worker",
+]
+
+
+def resolve_quorum(quorum: Optional[int], n_workers: int) -> int:
+    """0/None = auto: all-but-one (min 1) — the fleet keeps stepping
+    through a single crashed peer (the supervisor restarts it) while
+    still averaging nearly every worker's gradient."""
+    if not quorum:
+        return max(1, int(n_workers) - 1)
+    return int(quorum)
+
+
+class _PeerClient:
+    """Minimal persistent HTTP client for one peer (keep-alive, one
+    reconnect on a dead socket, every failure surfaced as OSError so
+    ``retry_io`` treats the whole family as transient)."""
+
+    def __init__(self, url: str, timeout: float = 10.0) -> None:
+        parsed = urlparse(url)
+        if parsed.scheme != "http":
+            raise ValueError(f"fleet peers speak plain http, got {url!r}")
+        self.host = parsed.hostname or "127.0.0.1"
+        self.port = int(parsed.port or 80)
+        self.timeout = float(timeout)
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout
+            )
+        return self._conn
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes] = None,
+        content_type: str = "application/octet-stream",
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        last: Optional[Exception] = None
+        for attempt in (0, 1):  # one transparent reconnect on a dead socket
+            conn = self._connection()
+            try:
+                headers = {"Content-Type": content_type} if body else {}
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                return resp.status, dict(resp.getheaders()), payload
+            except (http.client.HTTPException, OSError, socket.timeout) as e:
+                last = e
+                self.close()
+        raise OSError(f"peer {self.host}:{self.port} unreachable: {last}")
+
+
+def _np_tree(tree: Any) -> Any:
+    """Deep host copy: every leaf a fresh mutable np.ndarray."""
+    if isinstance(tree, dict):
+        return {k: _np_tree(v) for k, v in tree.items()}
+    return np.array(np.asarray(tree))
+
+
+def train_fleet_worker(
+    config: Any,
+    output_path: Optional[Path] = None,
+    *,
+    worker_id: int,
+    n_workers: int,
+    quorum: int = 0,
+    max_staleness: int = 1,
+    base_port: int = DEFAULT_FLEET_BASE_PORT,
+    port: Optional[int] = None,
+    peer_urls: Optional[List[str]] = None,
+    bind_host: str = "127.0.0.1",
+    resume: bool = False,
+    stdout_log: bool = True,
+    metrics_dir: Optional[Path] = None,
+    metrics_port: Optional[int] = None,
+    max_steps_override: Optional[int] = None,
+    install_signal_handlers: bool = True,
+    quorum_wait_s: float = 30.0,
+    push_retries: int = 1,
+    peer_wait_s: float = 120.0,
+    finalize_wait_s: float = 600.0,
+    checkpoint_timeout_s: float = 600.0,
+) -> Tuple[Any, Any]:
+    """Run ONE fleet worker process; returns ``(nlp, TrainResult)`` like
+    :func:`~..loop.train` (whose ``fleet=`` mode delegates here).
+
+    ``metrics_port`` is unused (the peer server IS the telemetry
+    endpoint — one port per worker, ``base_port + worker_id``); accepted
+    so the CLI plumbing stays uniform.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ...parallel import context as pctx
+    from ...parallel.mesh import build_mesh
+    from ...parallel.step import make_shard_apply
+    from ...pipeline.language import Pipeline
+    from .. import optimizers as _optimizers
+    from ..loop import (
+        TrainResult,
+        default_pipeline_score_weights,
+        resolve_dot_name,
+        resolve_training,
+        weighted_score,
+    )
+
+    if jax.process_count() > 1:
+        raise ValueError(
+            "the trainer fleet IS the multi-process mode — run it on "
+            "single-process jax (one fleet worker per process), not under "
+            "jax.distributed"
+        )
+    worker_id = int(worker_id)
+    n_workers = int(n_workers)
+    if not (0 <= worker_id < n_workers):
+        raise ValueError(
+            f"fleet worker id {worker_id} outside [0, {n_workers})"
+        )
+    quorum = resolve_quorum(quorum, n_workers)
+    if not (1 <= quorum <= n_workers):
+        raise ValueError(f"quorum {quorum} outside [1, {n_workers}]")
+    max_staleness = int(max_staleness)
+    if max_staleness < 0:
+        raise ValueError(f"max_staleness must be >= 0, got {max_staleness}")
+
+    config = config.interpolate()
+    T = resolve_training(config)
+    if int(T.get("accumulate_gradient") or 1) != 1:
+        raise ValueError(
+            "fleet mode: accumulate_gradient > 1 is not supported — the "
+            "quorum IS the accumulation (the reference folds them "
+            "together too; SURVEY.md §2.4)"
+        )
+    if T.get("annotating_components"):
+        raise ValueError(
+            "fleet mode does not support annotating_components yet"
+        )
+    if T.get("frozen_components"):
+        raise ValueError(
+            "fleet mode does not support frozen_components yet (the "
+            "optax.masked mask is built over the full tree and cannot "
+            "follow owner-shard slices)"
+        )
+
+    seed = int(T.get("seed") or 0)
+    import random as _random
+
+    _random.seed(seed)
+    np.random.seed(seed)
+
+    resilience.activate_env_fault_plan()
+    resilience.drain_events()
+    resilience.set_default_retry_policy(
+        RetryPolicy(
+            max_retries=int(T.get("io_retries", 3) or 0),
+            base_delay=float(T.get("io_retry_base_s", 0.5) or 0.5),
+        )
+    )
+    push_policy = RetryPolicy(
+        max_retries=max(int(push_retries), 0), base_delay=0.05, max_delay=1.0
+    )
+    shutdown = ShutdownCoordinator()
+
+    # ---- telemetry (per-worker sub-directory; the peer server serves it)
+    tel = None
+    tel_dir = str(metrics_dir) if metrics_dir is not None else str(
+        T.get("metrics_dir") or ""
+    )
+    if tel_dir:
+        from ...alerting import default_training_rules
+        from ..telemetry import Telemetry
+
+        trace_steps = T.get("trace_steps") or [0, 50]
+        tel = Telemetry(
+            Path(tel_dir) / f"fleet-worker-{worker_id}",
+            trace_steps=(int(trace_steps[0]), int(trace_steps[1])),
+            anomaly_detection=bool(T.get("anomaly_detection", True)),
+            process_index=worker_id,
+            alerting=bool(T.get("alerting", True)),
+            alert_rules=default_training_rules(fleet=True),
+            incident_dir=(
+                Path(str(T.get("incident_dir")))
+                if T.get("incident_dir") else None
+            ),
+        )
+        tel.registry.gauge("fleet_worker").set(worker_id)
+
+    # ---- corpora / pipeline -----------------------------------------
+    corpora_cfg = config.get("corpora", {})
+    resolved_corpora = {
+        name: registry.resolve(block) for name, block in corpora_cfg.items()
+    }
+    train_corpus = resolve_dot_name(config, resolved_corpora, T["train_corpus"])
+    dev_corpus = resolve_dot_name(config, resolved_corpora, T["dev_corpus"])
+    nlp = Pipeline.from_config(config)
+    nlp.initialize(train_corpus, seed=seed)
+
+    mesh = build_mesh(n_data=1)
+    tx = registry.resolve(T.get("optimizer") or {"@optimizers": "Adam.v1"})
+    use_averages = bool(getattr(tx, "use_averages", False))
+    if use_averages:
+        raise ValueError(
+            "fleet mode does not support use_averages (the running mean "
+            "needs every post-apply param tree on one host)"
+        )
+    meta = getattr(tx, "fusable", None)
+    if meta:
+        # worker-side exact global-norm clip; owner applies the clip-free
+        # fused chain on its slice (state structure delegates to the
+        # reference chain, so checkpoints stay canonical)
+        worker_clip = float(meta.get("grad_clip") or 0.0)
+        from ...ops.fused_update import make_fused_transformation
+
+        fused = make_fused_transformation(
+            reference_tx=tx.tx, **{**meta, "grad_clip": 0.0}
+        )
+        owner_tx = _optimizers.OptimizerWrapper(fused)
+        owner_tx.applies_updates = True
+    else:
+        worker_clip = 0.0
+        owner_tx = tx
+        log_event(
+            "fleet-per-shard-optimizer",
+            "optimizer is not fusable: the full chain (including any "
+            "global-norm clip) runs PER OWNER SHARD — clip norms are "
+            "shard-local, not global (TUNING.md §19)",
+        )
+
+    batcher = registry.resolve(
+        T.get("batcher")
+        or {"@batchers": "spacy.batch_by_words.v1", "size": 1000,
+            "tolerance": 0.2}
+    )
+    dropout = float(T["dropout"])
+    loss_fn = nlp.make_loss_fn(dropout=dropout)
+
+    params_host = _np_tree(nlp.params)
+    layout = OwnershipLayout(params_host, n_workers)
+
+    # ---- state (fresh or resumed) -----------------------------------
+    step = 0
+    epoch = 0
+    best_score = -1.0
+    best_step = -1
+    version = 0
+    rng = jax.random.fold_in(jax.random.PRNGKey(seed), worker_id)
+    resumed_from: Optional[int] = None
+    ckpt = None
+    if resume and output_path is not None:
+        try:
+            ckpt = TrainCheckpoint.load(Path(output_path) / "last-model")
+        except CheckpointCorrupt as e:
+            log_event(
+                "resume-failed",
+                f"--resume found no intact checkpoint generation ({e}); "
+                "starting from scratch",
+            )
+    if ckpt is not None:
+        params_host = _np_tree(ckpt["params"])
+        step = int(ckpt["step"])
+        epoch = int(ckpt["epoch"])
+        best_score = float(ckpt["best_score"])
+        best_step = int(ckpt["best_step"])
+        resumed_from = step
+        fleet_extra = (ckpt.get("extra") or {}).get("fleet") or {}
+        versions = fleet_extra.get("versions") or []
+        if worker_id < len(versions) and versions[worker_id] is not None:
+            version = int(versions[worker_id])
+        rngs = fleet_extra.get("rngs") or []
+        if worker_id < len(rngs) and rngs[worker_id] is not None:
+            rng = jnp.asarray(np.array(rngs[worker_id], dtype=np.uint32))
+        else:
+            rng = jax.random.fold_in(
+                jnp.asarray(
+                    np.array(
+                        np.asarray(jax.device_get(ckpt["rng"])),
+                        dtype=np.uint32,
+                    )
+                ),
+                worker_id,
+            )
+        log_event(
+            "fleet-resume",
+            f"worker {worker_id} resumed from checkpoint step {step} "
+            f"(shard version {version})",
+            worker=worker_id, step=step, version=version,
+        )
+
+    slice_np = layout.slice_tree(params_host, worker_id)
+    slice_params = jax.tree_util.tree_map(jnp.asarray, slice_np)
+    if ckpt is not None:
+        opt_local = local_opt_from_canonical(
+            owner_tx, layout, ckpt["opt_state"], worker_id, slice_np
+        )
+    else:
+        opt_local = owner_tx.init(slice_params)
+    ckpt = None  # drop the loaded canonical trees
+
+    owns_any = bool(layout.owned_keys(worker_id))
+    if not owns_any:
+        # legal but degenerate (no leaf axis divisible by n_workers
+        # beyond worker 0's whole-leaf ownership): this worker
+        # contributes gradients to the owners but its own shard is empty
+        # — its version never moves, so it must not quorum-wait on it
+        log_event(
+            "fleet-worker-owns-nothing",
+            f"worker {worker_id} owns no parameter slices at "
+            f"n_workers={n_workers} (no axis divisible); it will push "
+            "gradients but apply nothing — consider fewer workers",
+            worker=worker_id, n_workers=n_workers,
+        )
+    counters = FleetCounters(
+        registry=tel.registry if tel is not None else None
+    )
+    version_gauge = (
+        tel.registry.gauge("param_version") if tel is not None else None
+    )
+    owner = OwnerState(
+        worker_id=worker_id,
+        n_workers=n_workers,
+        quorum=quorum,
+        max_staleness=max_staleness,
+        apply_fn=make_shard_apply(owner_tx),
+        slice_params=slice_params,
+        opt_state=opt_local,
+        counters=counters,
+        version=version,
+        on_version=(version_gauge.set if version_gauge is not None else None),
+    )
+
+    # mutable holders the checkpoint callback (handler thread) reads
+    state_holder: Dict[str, Any] = {"step": step, "rng": rng}
+
+    def checkpoint_cb(ckpt_dir: str, stamp: int) -> Dict[str, Any]:
+        def writer(cur_version, opt_state, host_flat):
+            n_leaves, skeleton, records = opt_part_records(
+                owner_tx, params_host, layout, opt_state, worker_id
+            )
+            digest = write_fleet_opt_part(
+                ckpt_dir,
+                stamp=stamp,
+                part=worker_id,
+                parts=n_workers,
+                n_leaves=n_leaves,
+                records=records,
+                skeleton=skeleton if worker_id == 0 else None,
+            )
+            return cur_version, digest, host_flat
+
+        cur_version, digest, host_flat = owner.checkpoint_parts(writer)
+        return {
+            "meta": {
+                "digest": digest,
+                "version": cur_version,
+                "part": worker_id,
+                "step": int(state_holder["step"]),
+                "rng": np.asarray(
+                    jax.device_get(state_holder["rng"])
+                ).tolist(),
+            },
+            "params": host_flat,
+        }
+
+    server = PeerServer(
+        owner,
+        worker_id=worker_id,
+        layout_signature=layout.signature(),
+        counters=counters,
+        tel=tel,
+        host=bind_host,
+        port=int(port) if port is not None else int(base_port) + worker_id,
+        checkpoint_cb=checkpoint_cb,
+    )
+    server.start()
+    urls = list(peer_urls) if peer_urls is not None else [
+        f"http://127.0.0.1:{int(base_port) + i}" for i in range(n_workers)
+    ]
+    if len(urls) != n_workers:
+        raise ValueError(
+            f"peer_urls names {len(urls)} workers, fleet has {n_workers}"
+        )
+    clients: Dict[int, _PeerClient] = {
+        w: _PeerClient(urls[w]) for w in range(n_workers) if w != worker_id
+    }
+    ckpt_clients: Dict[int, _PeerClient] = {}  # long-deadline, lazy
+
+    def wait_for_peers() -> None:
+        """Block until every peer answers /healthz with a matching
+        layout signature. A COLD start that never sees its peers is a
+        misconfiguration (wrong ports/config) and raises loudly; a
+        REJOINING worker (supervisor restart with --resume) proceeds
+        after a short wait instead — its peers may legitimately have
+        finished and exited while it was down (their final state is in
+        the checkpoint it just resumed), and every unreachable-peer
+        push/pull from here on is a counted drop, not a crash."""
+        rejoining = resumed_from is not None
+        wait_s = min(float(peer_wait_s), 15.0) if rejoining else float(
+            peer_wait_s
+        )
+        deadline = time.monotonic() + wait_s
+        pending = set(clients)
+        while pending:
+            for w in sorted(pending):
+                try:
+                    status, _, body = clients[w].request("GET", "/healthz")
+                except OSError:
+                    continue
+                if status != 200:
+                    continue
+                payload = json.loads(body.decode("utf8"))
+                sig = payload.get("layout")
+                if sig != layout.signature():
+                    raise RuntimeError(
+                        f"fleet worker {w} runs a different parameter "
+                        f"layout ({sig} vs {layout.signature()}) — all "
+                        "workers must resolve the same config"
+                    )
+                pending.discard(w)
+            if pending:
+                if time.monotonic() > deadline:
+                    if rejoining:
+                        log_event(
+                            "fleet-peers-unreachable",
+                            f"rejoined worker {worker_id}: peers "
+                            f"{sorted(pending)} unreachable after "
+                            f"{wait_s:.0f}s — proceeding (they may have "
+                            "finished; lost RPCs are counted)",
+                            worker=worker_id, peers=sorted(pending),
+                        )
+                        return
+                    raise RuntimeError(
+                        f"fleet peers never became reachable: "
+                        f"{sorted(pending)} (waited {wait_s:.0f}s)"
+                    )
+                time.sleep(0.1)
+
+    # ---- jitted gradient step ---------------------------------------
+    def gstep(params, tokens, targets, rng_key):
+        import optax
+
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, tokens, targets, rng_key)
+        gnorm = optax.global_norm(grads)
+        if worker_clip > 0:
+            scale = jnp.minimum(
+                1.0, worker_clip / jnp.maximum(gnorm, 1e-16)
+            )
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        return loss, metrics, grads, gnorm
+
+    gstep_jit = jax.jit(gstep)
+
+    def run_gstep(*args):
+        with pctx.use_mesh(mesh):
+            return gstep_jit(*args)
+
+    # ---- logger / eval scaffolding (worker 0 reports) ----------------
+    log_step: Callable[[Optional[Dict[str, Any]]], None]
+    log_finalize: Callable[[], None]
+    if worker_id == 0:
+        import io as _io
+        import sys as _sys
+
+        logger_cfg = T.get("logger") or {
+            "@loggers": "spacy_ray_tpu.ConsoleLogger.v1"
+        }
+        logger_setup = registry.resolve(logger_cfg)
+        out_stream = _sys.stdout if stdout_log else _io.StringIO()
+        log_step, log_finalize = logger_setup(nlp, out_stream, _sys.stderr)
+        dev_examples = list(dev_corpus())
+        score_weights = dict(T.get("score_weights") or {})
+        if not score_weights:
+            score_weights = default_pipeline_score_weights(nlp)
+    else:
+        log_step, log_finalize = (lambda info: None), (lambda: None)
+        dev_examples = []
+        score_weights = {}
+
+    max_steps = int(max_steps_override or T["max_steps"] or 0)
+    max_epochs = int(T["max_epochs"] or 0)
+    eval_frequency = int(T["eval_frequency"] or 200)
+    patience = int(T["patience"] or 0)
+    keep_checkpoints = int(T.get("keep_checkpoints", 2) or 1)
+    n_data = 1
+
+    result = TrainResult()
+    phases: Dict[str, float] = {p: 0.0 for p in PHASES}
+    loss_accum: Dict[str, float] = {}
+    known: Dict[int, int] = {w: -1 for w in clients}
+    last_saved_step = -1 if resumed_from is None else resumed_from
+    stop = False
+    clean_exit = False  # set at normal loop exit; a crash leaves it False
+    steps_run = 0
+    words_since_log = 0
+    start_time = time.perf_counter()
+    last_log_time = start_time
+
+    # ---- data stream (this worker's corpus shard) --------------------
+    def batches():
+        nonlocal epoch
+        while True:
+            stream = train_corpus()
+            if n_workers > 1:
+                stream = shard_stream(stream, worker_id, n_workers)
+            got_any = False
+            for b in batcher(stream):
+                got_any = True
+                yield b
+            if not got_any:
+                raise ValueError(
+                    f"Training corpus is empty on worker {worker_id}'s "
+                    "shard"
+                )
+            epoch += 1
+            if max_epochs and epoch >= max_epochs:
+                return
+
+    last_stamp: Dict[int, int] = {w: -(10 ** 9) for w in clients}
+
+    def pull_peers() -> Dict[int, int]:
+        """Refresh non-owned shards; returns the version stamps the next
+        push will carry (per owner).
+
+        The staleness gate: a worker may run at most ``max_staleness``
+        rounds ahead of any owner — it blocks (bounded by
+        ``quorum_wait_s``) until owner ``w``'s version has passed
+        ``last_stamp[w] - S``, i.e. until the round it last contributed
+        to has closed, S rounds of slack allowed. At S=0 this is what
+        makes quorum=N synchronous-equivalent: without it a fast worker
+        re-pulls an owner mid-round, stamps the OLD version, and its
+        push is discarded — wedging the round it was needed for."""
+        stamps: Dict[int, int] = {}
+        self_version, self_flat = owner.current_flat()
+        layout.merge_flat(params_host, worker_id, self_flat)
+        stamps[worker_id] = self_version
+        deadline = time.monotonic() + float(quorum_wait_s)
+        for w, client in clients.items():
+            timed_out = False
+            while True:
+                try:
+                    status, headers, body = client.request(
+                        "GET", f"/params?known={known[w]}"
+                    )
+                except OSError:
+                    counters.inc("pull_failed")
+                    break
+                if status == 204:
+                    v = int(headers.get("X-SRT-Version", known[w]))
+                elif status == 200:
+                    try:
+                        meta_w, arrays = decode_arrays(body)
+                        v = int(meta_w["version"])
+                    except Exception:
+                        counters.inc("pull_failed")
+                        break
+                    layout.merge_flat(params_host, w, arrays)
+                    if v < known[w]:
+                        # a restarted owner legitimately REGRESSES to its
+                        # checkpointed version: our round bookkeeping
+                        # against the pre-crash lineage is void — reset it
+                        # or the staleness gate below would block a full
+                        # timeout every step waiting for versions that no
+                        # longer exist
+                        last_stamp[w] = -(10 ** 9)
+                        log_event(
+                            "fleet-owner-regressed",
+                            f"owner {w} regressed to version {v} (knew "
+                            f"{known[w]}) — it restarted from its "
+                            "checkpoint; resyncing",
+                            owner=w, version=v, known=known[w],
+                        )
+                    known[w] = v
+                else:
+                    counters.inc("pull_failed")
+                    break
+                if v > last_stamp[w] - max_staleness or timed_out:
+                    stamps[w] = v
+                    break
+                if time.monotonic() > deadline:
+                    timed_out = True  # one final fetch, then proceed
+                    counters.inc("pull_wait_timeouts")
+                    continue
+                time.sleep(0.01)
+            stamps.setdefault(w, known[w])
+        return stamps
+
+    def push_grads(grads: Any, stamps: Dict[int, int]) -> None:
+        for w in range(n_workers):
+            flat = layout.flat_slices(grads, w)
+            if not flat:
+                continue  # nothing shardable lands on this owner
+            if w == worker_id:
+                # self-delivery is NOT counted as a push: grad_pushed is
+                # the fleet-health signal (the push-stalled AbsenceRule
+                # watches it), and an always-succeeding local submit
+                # would keep it moving exactly when every peer is gone
+                owner.submit(worker_id, stamps[worker_id], flat)
+                continue
+            body = encode_arrays(
+                {"worker": worker_id, "stamp": int(stamps.get(w, -1))},
+                flat,
+            )
+
+            def send(w=w, body=body):
+                maybe_fail("grad-push")
+                status, _, reply = clients[w].request(
+                    "POST", "/grad", body=body
+                )
+                if status != 200:
+                    raise OSError(
+                        f"peer {w} rejected grad push: HTTP {status}"
+                    )
+
+            try:
+                retry_io("grad-push", send, policy=push_policy)
+                counters.inc("grad_pushed")
+            except (OSError, resilience.FaultInjected):
+                # fire-and-forget: a dead/unreachable owner costs a
+                # counted drop, never a stalled fleet
+                counters.inc("push_failed")
+            last_stamp[w] = int(stamps.get(w, -1))
+
+    def fleet_checkpoint() -> None:
+        """Worker 0 coordinates one generation: every owner writes its
+        own part (this process directly, peers via POST /checkpoint,
+        which also returns an atomically-consistent copy of their param
+        slices), then worker 0 assembles params and commits meta. Any
+        unreachable peer aborts the generation (a committed meta naming
+        a missing part would poison load()'s fallback walk) — the
+        previous generation stays current."""
+        nonlocal last_saved_step
+        if output_path is None or step == last_saved_step:
+            return
+        stamp = int(step)
+        ckpt_dir = Path(output_path) / "last-model"
+        my = checkpoint_cb(str(ckpt_dir), stamp)
+        digests: Dict[int, str] = {worker_id: my["meta"]["digest"]}
+        versions: List[Optional[int]] = [None] * n_workers
+        rngs: List[Optional[List[int]]] = [None] * n_workers
+        versions[worker_id] = int(my["meta"]["version"])
+        rngs[worker_id] = list(my["meta"]["rng"])
+        assembled = _np_tree(params_host)
+        layout.merge_flat(assembled, worker_id, my["params"])
+        req = json.dumps({"dir": str(ckpt_dir), "stamp": stamp}).encode(
+            "utf8"
+        )
+        for w in sorted(clients):
+            try:
+                # a /checkpoint reply arrives only after the peer's whole
+                # owner-shard part file is hashed and written — the 10s
+                # step-traffic timeout would abort every generation on a
+                # big model, so checkpoint coordination gets its own
+                # long-deadline connections
+                client = ckpt_clients.get(w)
+                if client is None:
+                    client = ckpt_clients[w] = _PeerClient(
+                        urls[w], timeout=float(checkpoint_timeout_s)
+                    )
+                status, _, body = client.request(
+                    "POST", "/checkpoint", body=req,
+                    content_type="application/json",
+                )
+                if status != 200:
+                    raise OSError(f"peer {w} checkpoint: HTTP {status}")
+                meta_w, arrays = decode_arrays(body)
+                digests[w] = str(meta_w["digest"])
+                versions[w] = int(meta_w["version"])
+                rngs[w] = list(meta_w["rng"])
+                layout.merge_flat(assembled, w, arrays)
+            except (OSError, WireError, KeyError, ValueError, TypeError) as e:
+                # unreachable, wire-malformed, meta-incomplete, or
+                # structurally mismatched reply — ALL of them abort the
+                # generation (the docstring's promise); a partial commit
+                # naming a bad part would poison load()'s fallback walk,
+                # and an exception here must not crash the lead's loop
+                log_event(
+                    "fleet-checkpoint-aborted",
+                    f"worker {w} failed the checkpoint exchange at step "
+                    f"{stamp} ({type(e).__name__}: {e}); keeping the "
+                    "previous generation",
+                    worker=w, step=stamp,
+                )
+                return
+        commit_fleet_generation(
+            ckpt_dir,
+            params=assembled,
+            step=stamp,
+            epoch=epoch,
+            rng=np.asarray(jax.device_get(rng)),
+            best_score=best_score,
+            best_step=best_step,
+            opt_shards=n_workers,
+            opt_digests=digests,
+            extra={
+                "fleet": {
+                    "n_workers": n_workers,
+                    "quorum": quorum,
+                    "max_staleness": max_staleness,
+                    "versions": versions,
+                    "rngs": rngs,
+                },
+                "mesh": {"n_data": n_data, "update_sharding": "fleet"},
+            },
+            keep=keep_checkpoints,
+        )
+        last_saved_step = stamp
+
+    # ---- resilience arming ------------------------------------------
+    watchdog: Optional[Watchdog] = None
+    watchdog_timeout = float(T.get("watchdog_timeout_s", 0) or 0)
+    if watchdog_timeout > 0:
+        def watchdog_stats():
+            if tel is not None:
+                tel.emergency_flush()
+            return {
+                "fleet_worker": worker_id,
+                "version": owner.version,
+                **counters.snapshot(),
+            }
+
+        watchdog = Watchdog(watchdog_timeout, stats_fn=watchdog_stats)
+    if install_signal_handlers:
+        shutdown.install()
+    if watchdog is not None:
+        watchdog.start()
+    wait_for_peers()
+    if tel is not None:
+        tel.loop_start()
+
+    try:
+        batch_iter = batches()
+        while not stop:
+            t_data = time.perf_counter()
+            try:
+                b = next(batch_iter)
+            except StopIteration:
+                break
+            max_len = max(len(eg) for eg in b)
+            T_pad = bucket_length(max_len, nlp.length_buckets)
+            B_pad = bucket_batch_size(len(b))
+            collated = nlp.collate(
+                b, pad_batch_to=B_pad, pad_len_to=T_pad, host=True
+            )
+            tokens, targets = collated["tokens"], collated["targets"]
+            n_words = int(collated["n_words"])
+            now = time.perf_counter()
+            phases["data"] += now - t_data
+
+            t_pull = now
+            stamps = pull_peers()
+            now = time.perf_counter()
+            phases["pull"] += now - t_pull
+
+            maybe_fail("step")
+            poisoned = resilience.consume_poison("step")
+            t_grad = now
+            rng, sub = jax.random.split(rng)
+            state_holder["rng"] = rng
+            loss, metrics, grads, gnorm = run_gstep(
+                params_host, tokens, targets, sub
+            )
+            grads = jax.tree_util.tree_map(
+                lambda g: np.asarray(jax.device_get(g)), grads
+            )
+            now = time.perf_counter()
+            phases["grad"] += now - t_grad
+
+            t_push = now
+            push_grads(grads, stamps)
+            now = time.perf_counter()
+            phases["push"] += now - t_push
+
+            t_wait = now
+            if owns_any and not owner.wait_version_above(
+                stamps[worker_id], quorum_wait_s
+            ):
+                counters.inc("apply_wait_timeouts")
+                log_event(
+                    "fleet-quorum-timeout",
+                    f"worker {worker_id}: own shard stuck at version "
+                    f"{owner.version} for {quorum_wait_s:.0f}s (quorum "
+                    f"{quorum} not reached) — proceeding",
+                    worker=worker_id, version=owner.version,
+                )
+            phases["apply_wait"] += time.perf_counter() - t_wait
+
+            step += 1
+            steps_run += 1
+            state_holder["step"] = step
+            result.words_seen += n_words
+            words_since_log += n_words
+            for key, value in jax.device_get(metrics).items():
+                if key.startswith("loss_"):
+                    v = float("nan") if poisoned else float(value)
+                    loss_accum[key[5:]] = loss_accum.get(key[5:], 0.0) + v
+            if tel is not None:
+                tel.step_boundary(
+                    step=step, epoch=epoch, n_words=n_words,
+                    steps_run=steps_run,
+                )
+
+            info: Optional[Dict[str, Any]] = None
+            if worker_id == 0 and step % eval_frequency == 0:
+                eval_t0 = time.perf_counter()
+                scores = nlp.evaluate(dev_examples, params_host, mesh=mesh)
+                eval_seconds = time.perf_counter() - eval_t0
+                score = weighted_score(scores, score_weights)
+                now2 = time.perf_counter()
+                wps = words_since_log / max(now2 - last_log_time, 1e-9)
+                last_log_time = now2
+                words_since_log = 0
+                info = {
+                    "epoch": epoch,
+                    "step": step,
+                    "words": result.words_seen,
+                    "losses": dict(loss_accum),
+                    "other_scores": scores,
+                    "score": score,
+                    "wps": wps,
+                    "eval_seconds": eval_seconds,
+                    "fleet": {
+                        "worker": worker_id,
+                        "version": owner.version,
+                        **counters.snapshot(),
+                    },
+                }
+                result.history.append(info)
+                loss_accum = {}
+                if score > best_score:
+                    best_score = score
+                    best_step = step
+                    if output_path is not None:
+                        nlp.params = params_host
+                        nlp.to_disk(Path(output_path) / "best-model")
+                fleet_checkpoint()
+                if tel is not None:
+                    tel.rearm_step_clock()
+            log_step(info)
+            if watchdog is not None:
+                watchdog.beat()
+
+            if max_steps and step >= max_steps:
+                stop = True
+            if (
+                worker_id == 0
+                and patience
+                and best_step >= 0
+                and (step - best_step) >= patience
+            ):
+                stop = True
+            if not stop and worker_id != 0 and server.finalize_event.is_set():
+                # the lead finished (patience, max_steps, preemption) and
+                # committed its final generation: follow it instead of
+                # training headless to our own max_steps — progress past
+                # this point could never be checkpointed (worker 0 owns
+                # the commit) and every push to it would be a dead letter
+                log_event(
+                    "fleet-finalized",
+                    f"worker {worker_id}: lead worker finalized the "
+                    f"fleet at our step {step} — stopping",
+                    worker=worker_id, step=step,
+                )
+                stop = True
+            if not stop and shutdown.coordinated_stop(1):
+                if worker_id == 0:
+                    fleet_checkpoint()
+                result.interrupted = True
+                log_event(
+                    "preempted",
+                    f"fleet worker {worker_id}: shutdown signal at step "
+                    f"{step}; resume with --resume",
+                    step=step, worker=worker_id,
+                )
+                stop = True
+        clean_exit = True
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+        if install_signal_handlers:
+            shutdown.restore()
+        try:
+            if worker_id == 0:
+                # finalize ONLY on a clean exit (max_steps / patience /
+                # preemption): a CRASHED lead is about to be relaunched
+                # with --resume by its supervisor, and broadcasting
+                # /finalize here would shut down the very peers it needs
+                # to rejoin — the survivors-keep-stepping contract
+                if clean_exit:
+                    if not result.interrupted:
+                        fleet_checkpoint()
+                    for w, client in clients.items():
+                        try:
+                            client.request(
+                                "POST", "/finalize", body=b"{}",
+                                content_type="application/json",
+                            )
+                        except OSError:
+                            pass
+            elif clean_exit:
+                # keep serving /grad, /params and /checkpoint until the
+                # lead finishes its final generation: with quorum < N a
+                # non-evaluating peer finishes max_steps well BEFORE the
+                # lead (eval/checkpoint overhead is lead-only), and
+                # shutting this server early would abort the lead's
+                # final commit. Patience is bounded two ways: the long
+                # finalize_wait_s deadline, and a lead-liveness probe —
+                # a DEAD lead (past its restart cap) will never post
+                # /finalize, and waiting the full deadline for it would
+                # just delay this worker's own ledger
+                lead = clients.get(0)
+                deadline = time.monotonic() + float(finalize_wait_s)
+                lead_misses = 0
+                while not server.finalize_event.wait(timeout=5.0):
+                    if time.monotonic() > deadline:
+                        break
+                    if lead is None:
+                        continue
+                    try:
+                        lead.request("GET", "/healthz")
+                        lead_misses = 0
+                    except OSError:
+                        lead_misses += 1
+                        if lead_misses >= 2:
+                            log_event(
+                                "fleet-lead-gone",
+                                f"worker {worker_id}: lead unreachable "
+                                "while awaiting finalize — exiting",
+                                worker=worker_id,
+                            )
+                            break
+        finally:
+            result.seconds = time.perf_counter() - start_time
+            result.best_score = best_score
+            result.best_step = best_step
+            result.final_step = step
+            result.epoch = epoch
+            result.fleet = {
+                "worker": worker_id,
+                "n_workers": n_workers,
+                "quorum": quorum,
+                "max_staleness": max_staleness,
+                "version": owner.version,
+                "counters": counters.snapshot(),
+                "phases": {p: round(v, 6) for p, v in phases.items()},
+                "owner_apply_seconds": round(owner.apply_seconds, 6),
+            }
+            if output_path is not None:
+                out = Path(output_path)
+                out.mkdir(parents=True, exist_ok=True)
+                ledger = {
+                    "worker": worker_id,
+                    "steps": step,
+                    "words_seen": result.words_seen,
+                    "seconds": round(result.seconds, 6),
+                    "interrupted": result.interrupted,
+                    "resumed_from": resumed_from,
+                    **result.fleet,
+                }
+                (out / f"fleet-worker-{worker_id}.json").write_text(
+                    json.dumps(ledger, indent=2), encoding="utf8"
+                )
+            for client in clients.values():
+                client.close()
+            for client in ckpt_clients.values():
+                client.close()
+            server.stop()
+            if tel is not None:
+                tel.finalize()
+    nlp.params = params_host
+    if worker_id == 0 and output_path is not None:
+        nlp.to_disk(Path(output_path) / "last-model")
+    log_finalize()
+    return nlp, result

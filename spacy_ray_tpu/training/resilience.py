@@ -433,7 +433,7 @@ def retry_io(
 # Fault-injection harness
 # ----------------------------------------------------------------------
 
-FAULT_SITES = ("corpus-read", "collate", "checkpoint-write", "step")
+FAULT_SITES = ("corpus-read", "collate", "checkpoint-write", "step", "grad-push")
 FAULT_PLAN_ENV = "SPACY_RAY_TPU_FAULT_PLAN"
 
 _FAULT_KINDS = ("oserror", "runtime", "sigterm", "nan")
@@ -682,6 +682,14 @@ class Supervisor:
                 daemon=True,
                 name="supervisor-escalate",
             ).start()
+
+    def request_shutdown(self) -> None:
+        """Programmatic equivalent of a relayed signal, for a parent that
+        multiplexes several supervisors on worker threads (the trainer-
+        fleet coordinator): only the parent's MAIN thread can own signal
+        handlers, so it fans the one OS signal out to each supervisor
+        through this."""
+        self._relay(signal.SIGTERM, None)
 
     def run(self) -> int:
         prev_handlers: Dict[int, Any] = {}
