@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry observability serving fleet live train-fleet bench baseline profile step-perf serve-perf update-shard dryrun
+.PHONY: test test-fast test-slow resilience telemetry observability serving fleet live train-fleet train-fleet-obs bench baseline profile step-perf serve-perf update-shard dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -69,6 +69,19 @@ train-fleet:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_training_fleet.py -q -m "not slow"
 	JAX_PLATFORMS=cpu python -m pytest tests/test_training_fleet.py -q -m slow
 	JAX_PLATFORMS=cpu python bench.py --training-fleet
+
+# trainer-fleet observability plane (docs/OBSERVABILITY.md "Training
+# fleet"): srt_training_* dynamics-histogram golden grammar +
+# exactly-summing buckets across fake workers, the fake-clock fleet
+# divergence-detector matrix, fleet-aware `telemetry summarize` /
+# `report`, collect-trace --fleet-base-port expansion, the top columns,
+# the zero-telemetry fleet guard — then the real 2-worker acceptance
+# pair from tests/test_training_fleet.py (subprocess fleet → ONE merged
+# Perfetto timeline + markdown run report; thread-fleet forced-
+# divergence drill → alert + incident bundle naming the worker)
+train-fleet-obs:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_obs.py -q -m "not slow"
+	JAX_PLATFORMS=cpu python -m pytest tests/test_training_fleet.py -q -m "not slow" -k "obs_acceptance or divergence"
 
 bench:
 	python bench.py

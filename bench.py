@@ -3123,6 +3123,10 @@ def run_training_fleet(
             "--fleet-base-port", str(base_port + idx * 16),
             "--cpu-cores", "auto",
             "--output", str(out_dir),
+            # telemetry on: the dynamics histograms (staleness, quorum
+            # wait, per-phase) land in each worker's kind:"fleet" exit
+            # row, which this record and the generated run report digest
+            "--metrics-dir", str(out_dir / "metrics"),
             f"--paths.train={tmpdir / 'train.jsonl'}",
             f"--paths.dev={tmpdir / 'dev.jsonl'}",
             f"--training.max_steps={int(steps)}",
@@ -3174,6 +3178,33 @@ def run_training_fleet(
                 phases[p] = round(phases.get(p, 0.0) + float(v), 3)
             for c, v in (l.get("counters") or {}).items():
                 counters[c] = counters.get(c, 0) + int(v)
+        # the fleet-wide staleness histogram (exact per-le sums on the
+        # shared bucket table — the measured bounded-staleness evidence
+        # TUNING.md §19 reads when setting --max-staleness/--quorum) and
+        # the markdown run report, from ONE load of the run's artifacts
+        # (spacy_ray_tpu/training/report.py owns the layout)
+        staleness = None
+        report_path = None
+        try:
+            from spacy_ray_tpu.training.report import (
+                build_run_report,
+                fleet_exit_rows,
+                load_run,
+                sum_staleness,
+            )
+
+            run = load_run(out_dir)
+            staleness = sum_staleness(fleet_exit_rows(run).values())
+            report_path = out_dir / "run-report.md"
+            report_path.write_text(
+                build_run_report(out_dir, run=run), encoding="utf8"
+            )
+            print(f"# training fleet {n}w run report: {report_path}",
+                  flush=True)
+        except (ValueError, OSError) as e:
+            print(f"# training fleet {n}w run report skipped: {e}",
+                  flush=True)
+            report_path = None
         if n == worker_counts[0]:
             baseline_wps = wps
         contended = len(cores) < n
@@ -3197,6 +3228,10 @@ def run_training_fleet(
             "wall_seconds": round(wall, 2),
             "phase_seconds": phases,
             "counters": counters,
+            "staleness": staleness,
+            # the report itself lives in the (ephemeral) run dir — the
+            # record notes that the path produced one, not a dead path
+            "run_report_generated": report_path is not None,
             "versions": [l.get("version") for l in ledgers],
             "cores_available": len(cores),
             "contended": contended,

@@ -173,8 +173,22 @@ class SnapshotHistory:
                 return None
             base = self._samples[0][1]
         cur = self._samples[-1][1].get(path)
+        if cur is None:
+            return None
         prev = base.get(path)
-        if cur is None or prev is None:
+        if prev is None:
+            # the counter was born INSIDE the window (its instrument is
+            # created lazily, after the base snapshot was taken): its
+            # oldest observed value is the honest base. Without this, a
+            # rule watching a lazily-created counter stays no-signal for
+            # as long as the window reaches back past the birth — the
+            # fleet divergence_flags counter hit exactly this.
+            for _, values in self._samples:
+                v = values.get(path)
+                if v is not None:
+                    prev = v
+                    break
+        if prev is None:
             return None
         # counter resets (process restart feeding one engine) clamp to 0
         return max(cur - prev, 0.0)
@@ -231,6 +245,14 @@ class ThresholdRule(AlertRule):
     answers 503 "warming" for however long the bucket compile sweep
     takes (minutes), and paging on every clean boot would train
     operators to ignore the page that matters. Arming is persistent.
+
+    ``partial=True`` (only meaningful with ``window_s``) judges the
+    delta over however much history exists when the full window isn't
+    retained yet — the same boot-blindness fix the burn rules carry: a
+    partial-span count can only UNDERSTATE the window total, so a
+    ``>=`` rule fires earlier but never spuriously. The
+    fleet-worker-diverging rule uses it (a worker diverging in a run's
+    first ``window_s`` must not be page-blind).
     """
 
     def __init__(
@@ -242,6 +264,7 @@ class ThresholdRule(AlertRule):
         *,
         window_s: Optional[float] = None,
         arm_when: Optional[Tuple[str, float]] = None,
+        partial: bool = False,
         **kw: Any,
     ) -> None:
         super().__init__(name, **kw)
@@ -251,6 +274,7 @@ class ThresholdRule(AlertRule):
         self.op = op
         self.threshold = float(threshold)
         self.window_s = float(window_s) if window_s else None
+        self.partial = bool(partial)
         if arm_when is not None and arm_when[0] not in _OPS:
             raise ValueError(
                 f"arm_when op must be one of {sorted(_OPS)}, "
@@ -268,7 +292,9 @@ class ThresholdRule(AlertRule):
         self, history: SnapshotHistory, now: float
     ) -> Tuple[Optional[bool], Optional[float], str]:
         if self.window_s is not None:
-            v = history.delta(self.path, self.window_s, now)
+            v = history.delta(
+                self.path, self.window_s, now, allow_partial=self.partial
+            )
             what = f"Δ{self.window_s:.0f}s({self.path})"
         else:
             v = history.value(self.path)
@@ -841,6 +867,13 @@ def default_training_rules(
       compute is being thrown away. Expressed as a single-pair
       burn-rate rule (the ratio machinery) with budget ``discard_rate``
       and factor 1.0 — burn ≥ 1 ⟺ discards/received ≥ the threshold.
+    * ``fleet-worker-diverging`` — the lead's cross-worker convergence
+      watch (``FleetDivergenceDetector``: loss z-outlier vs the peer
+      median, NaN training, one-worker discard outlier) flagged a
+      worker inside the trailing window. Only the lead's
+      ``divergence_flags`` counter ever moves, so the rule is silent on
+      every other worker's engine; the flag's anomaly row + incident
+      bundle name the diverging worker.
     """
     rules: List[AlertRule] = [
         AbsenceRule(
@@ -884,6 +917,16 @@ def default_training_rules(
                             1.0,
                         ),
                     ),
+                    severity="page",
+                ),
+                ThresholdRule(
+                    "fleet-worker-diverging",
+                    "counters.divergence_flags",
+                    ">=",
+                    1.0,
+                    window_s=600.0,
+                    for_s=0.0,
+                    partial=True,
                     severity="page",
                 ),
             ]

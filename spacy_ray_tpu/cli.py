@@ -1584,10 +1584,11 @@ def telemetry_command(argv: List[str]) -> int:
     """``telemetry`` — offline and live observability tools, all jax-free
     (safe on any host):
 
-    * ``summarize <metrics.jsonl>`` — digest a telemetry file: training
-      rows (step-time percentiles, device gauges, per-stage breakdown)
-      AND serving rows (SLO window, rejects, by-generation split),
-      anomaly digest;
+    * ``summarize <metrics.jsonl | run-dir>`` — digest a telemetry file
+      (training rows: step-time percentiles, device gauges, per-stage
+      breakdown; serving rows: SLO window, rejects, by-generation split;
+      trainer-fleet rows: counters, phase share, staleness digest;
+      anomaly digest) or a whole fleet run directory;
     * ``top <url>...`` — live terminal dashboard polling ``/metrics`` on
       replica / router / trainer endpoints (req/s, window p50/p99,
       occupancy, queue depth, generation, swap count, anomalies);
@@ -1601,17 +1602,61 @@ def telemetry_command(argv: List[str]) -> int:
       metric digest, and a merged cross-process timeline built with the
       same clock-anchor merge collect-trace uses. Given the incidents
       ROOT, renders the newest bundle.
+    * ``report <run-dir>`` — digest a training run directory (the
+      trainer fleet's per-worker ledgers + metrics.jsonl files, or a
+      single-process run's metrics.jsonl) into ONE markdown report:
+      per-worker loss trajectories, the phase-share table,
+      staleness/discard histograms, quorum-wait/apply timing, and the
+      alert/anomaly timeline (docs/OBSERVABILITY.md "Training fleet").
     """
     usage = ("Usage: spacy_ray_tpu telemetry "
-             "{summarize <metrics.jsonl> | top <url>... | "
-             "collect-trace <url>... --out FILE | "
-             "postmortem <bundle-or-incidents-dir>}")
+             "{summarize <metrics.jsonl-or-run-dir> | top <url>... | "
+             "collect-trace [<url>...] [--fleet-base-port N --workers K] "
+             "--out FILE | "
+             "postmortem <bundle-or-incidents-dir> | "
+             "report <run-dir> [--out FILE]}")
     if not argv or argv[0] not in (
-        "summarize", "top", "collect-trace", "postmortem"
+        "summarize", "top", "collect-trace", "postmortem", "report"
     ):
         print(usage, file=sys.stderr)
         return 1
     sub, rest = argv[0], argv[1:]
+    if sub == "report":
+        parser = argparse.ArgumentParser(
+            prog="spacy_ray_tpu telemetry report"
+        )
+        parser.add_argument("run_dir", type=Path,
+                            help="a training run's output directory "
+                            "(fleet-worker-*.json ledgers + metrics/, "
+                            "or a plain metrics.jsonl run)")
+        parser.add_argument("--metrics-dir", type=Path, default=None,
+                            dest="metrics_dir",
+                            help="where the run's telemetry landed "
+                            "(default: <run-dir>/metrics)")
+        parser.add_argument("--out", type=Path, default=None,
+                            help="also write the markdown report here")
+        args = parser.parse_args(rest)
+
+        from .training.report import build_run_report
+
+        try:
+            report = build_run_report(args.run_dir, args.metrics_dir)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        except OSError as e:
+            print(f"Cannot read {args.run_dir}: {e}", file=sys.stderr)
+            return 1
+        print(report)
+        if args.out is not None:
+            try:
+                args.out.parent.mkdir(parents=True, exist_ok=True)
+                args.out.write_text(report, encoding="utf8")
+            except OSError as e:
+                print(f"Cannot write {args.out}: {e}", file=sys.stderr)
+                return 1
+            print(f"run report written to {args.out}", file=sys.stderr)
+        return 0
     if sub == "postmortem":
         parser = argparse.ArgumentParser(
             prog="spacy_ray_tpu telemetry postmortem"
@@ -1664,7 +1709,9 @@ def telemetry_command(argv: List[str]) -> int:
         parser.add_argument("metrics_path", type=Path,
                             help="metrics.jsonl written by a [training] "
                             "metrics_dir / train --metrics-dir run or a "
-                            "serve --metrics-dir run")
+                            "serve --metrics-dir run — or a trainer-fleet "
+                            "RUN DIRECTORY (fleet-worker-*.json ledgers "
+                            "+ metrics/fleet-worker-*/metrics.jsonl)")
         args = parser.parse_args(rest)
 
         from .training.telemetry import summarize_metrics
@@ -1700,7 +1747,7 @@ def telemetry_command(argv: List[str]) -> int:
     parser = argparse.ArgumentParser(
         prog="spacy_ray_tpu telemetry collect-trace"
     )
-    parser.add_argument("urls", nargs="+", metavar="URL",
+    parser.add_argument("urls", nargs="*", metavar="URL",
                         help="endpoint base URLs; a fleet router URL "
                         "auto-discovers its replicas")
     parser.add_argument("--out", type=Path, required=True,
@@ -1709,11 +1756,43 @@ def telemetry_command(argv: List[str]) -> int:
     parser.add_argument("--no-discover", action="store_true",
                         help="do not expand a router URL into its "
                         "replicas")
+    parser.add_argument("--fleet-base-port", type=int, default=None,
+                        dest="fleet_base_port",
+                        help="TRAINER fleet: scrape worker k's endpoint "
+                        "at <fleet-host>:base+k for k in 0..workers-1 "
+                        "(a trainer fleet has no router to discover "
+                        "through; matches train --fleet-base-port)")
+    parser.add_argument("--workers", type=int, default=None,
+                        help="trainer fleet worker count (with "
+                        "--fleet-base-port)")
+    parser.add_argument("--fleet-host", default="127.0.0.1",
+                        dest="fleet_host",
+                        help="trainer fleet host (default 127.0.0.1)")
     args = parser.parse_args(rest)
 
-    from .serving.tracecollect import collect_fleet_traces, write_merged_trace
+    from .serving.tracecollect import (
+        collect_fleet_traces,
+        fleet_worker_urls,
+        write_merged_trace,
+    )
 
-    merged = collect_fleet_traces(args.urls, discover=not args.no_discover)
+    urls = list(args.urls)
+    if (args.fleet_base_port is None) != (args.workers is None):
+        parser.error("--fleet-base-port and --workers go together")
+    if args.workers is not None and args.workers <= 0:
+        parser.error(f"--workers must be positive, got {args.workers}")
+    if args.fleet_base_port is not None:
+        urls.extend(
+            fleet_worker_urls(
+                args.fleet_base_port, args.workers, host=args.fleet_host
+            )
+        )
+    if not urls:
+        parser.error(
+            "give endpoint URLs, or --fleet-base-port N --workers K "
+            "for a trainer fleet"
+        )
+    merged = collect_fleet_traces(urls, discover=not args.no_discover)
     info = merged.get("otherData") or {}
     if not info.get("merged_from"):
         print(

@@ -605,6 +605,23 @@ def render_bundle(
             )
     else:
         lines.append(f"reason: {inc.get('reason')}")
+        # whatever the tripper stamped beyond the standard envelope —
+        # the fleet divergence trip's worker/mode, an alert trip's
+        # severity/value — is evidence, not metadata to drop
+        extras = {
+            k: v
+            for k, v in inc.items()
+            if k not in (
+                "source", "reason", "process", "unix_time", "generation",
+                "argv", "exit_code", "exit_signal", "replica_id", "slot",
+            )
+            and v is not None
+        }
+        if extras:
+            lines.append(
+                "detail: "
+                + "  ".join(f"{k}={extras[k]}" for k in sorted(extras))
+            )
     lines.append(f"generation: {inc.get('generation')}")
     if inc.get("argv"):
         lines.append("argv:   " + " ".join(str(a) for a in inc["argv"]))

@@ -1,5 +1,8 @@
 """Cross-process trace collection: merge the Perfetto buffers of the
-router, every replica, and the trainer into ONE timeline file.
+router, every replica, the trainer — or every worker of a TRAINER fleet
+(positional endpoints via :func:`fleet_worker_urls`; a grad push leaving
+worker 2 and its apply landing on owner 0 render as one visible hop
+across process tracks) — into ONE timeline file.
 
 Each process's :class:`~..training.telemetry.TraceBuffer` stamps events
 in microseconds relative to its own construction origin on its own
@@ -33,9 +36,26 @@ from urllib.parse import urlparse
 __all__ = [
     "merge_process_traces",
     "fetch_json",
+    "fleet_worker_urls",
     "collect_fleet_traces",
     "write_merged_trace",
 ]
+
+
+def fleet_worker_urls(
+    base_port: int, workers: int, host: str = "127.0.0.1"
+) -> List[str]:
+    """Endpoint URLs for a TRAINER fleet: worker k's peer server (which
+    doubles as its telemetry endpoint) binds ``base_port + k``, so the
+    fleet is addressed positionally — there is no router whose
+    ``/healthz`` replica list could discover it. The CLI's
+    ``collect-trace --fleet-base-port N --workers K`` expands through
+    here."""
+    if int(workers) <= 0:
+        raise ValueError(f"workers must be positive, got {workers}")
+    return [
+        f"http://{host}:{int(base_port) + k}" for k in range(int(workers))
+    ]
 
 
 def _anchor_offset_us(anchor: Optional[Dict[str, Any]]) -> Optional[float]:
@@ -152,6 +172,13 @@ def fetch_json(
         conn.request("GET", path)
         resp = conn.getresponse()
         raw = resp.read()
+    except http.client.HTTPException as e:
+        # a peer exiting mid-response (RemoteDisconnected, torn status
+        # line) raises HTTPException, which is NOT an OSError — without
+        # this mapping, every caller that handles "endpoint went away"
+        # as OSError (telemetry top's poll loop, the trace collector)
+        # would crash on exactly the mid-poll exit it exists to survive
+        raise OSError(f"HTTP exchange with {base_url!r} failed: {e}")
     finally:
         conn.close()
     try:

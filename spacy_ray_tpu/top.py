@@ -70,6 +70,10 @@ class TopModel:
 
     def __init__(self) -> None:
         self._prev: Dict[str, Any] = {}  # url -> (t, counters dict)
+        # consecutive failed scrapes per URL: a fleet worker exiting
+        # mid-poll is COUNTED (and shown), never allowed to break the
+        # refresh loop
+        self._failures: Dict[str, int] = {}
 
     def _rates(
         self, url: str, counters: Dict[str, Any], now: float
@@ -95,7 +99,12 @@ class TopModel:
     ) -> Dict[str, Any]:
         """One endpoint's display row. ``payload`` None = unreachable."""
         if payload is None:
-            return {"url": url, "kind": "down"}
+            self._failures[url] = self._failures.get(url, 0) + 1
+            return {
+                "url": url, "kind": "down",
+                "failures": self._failures[url],
+            }
+        self._failures[url] = 0
         kind = classify_payload(payload)
         if kind == "router":
             fleet = payload.get("fleet") or {}
@@ -158,9 +167,32 @@ class TopModel:
                 "alerts": payload.get("alerts"),
             }
         if kind == "trainer":
-            counters = payload.get("counters") or {}
-            rates = self._rates(url, counters, now)
+            counters = dict(payload.get("counters") or {})
             hists = payload.get("histograms") or {}
+            # per-phase histogram SUMS are monotone like counters, so
+            # feeding them through the same delta arithmetic yields
+            # "seconds of phase X per wall second" — the apply-wait
+            # share column is their ratio over all phases
+            for name, h in hists.items():
+                if (
+                    name.startswith("phase_")
+                    and isinstance(h, dict)
+                    and isinstance(h.get("sum"), (int, float))
+                ):
+                    counters[f"hist.{name}.sum"] = float(h["sum"])
+            rates = self._rates(url, counters, now)
+            phase_rates = {
+                k: v for k, v in rates.items()
+                if k.startswith("hist.phase_") and isinstance(v, float)
+            }
+            apply_wait_pct = None
+            if phase_rates:
+                total = sum(phase_rates.values())
+                wait = phase_rates.get("hist.phase_apply_wait_seconds.sum")
+                if total > 0 and wait is not None:
+                    apply_wait_pct = wait / total
+                elif wait is not None:
+                    apply_wait_pct = 0.0
             # fleet workers (training/fleet/) are trainers with a worker
             # id, a shard version, and the async plane's push/discard
             # counters — each worker is its own scrape URL, so the
@@ -188,6 +220,8 @@ class TopModel:
                 "push_s": push_s,
                 "discard_s": disc_s,
                 "discard_rate": discard_rate,
+                "apply_wait_pct": apply_wait_pct,
+                "staleness_max": _get(hists, "staleness", "max"),
             }
         counters = payload.get("counters") or {}
         rates = self._rates(url, counters, now)
@@ -235,7 +269,13 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
     for row in rows:
         kind = row.get("kind")
         if kind == "down":
-            lines.append(f"  {row['url']}: UNREACHABLE")
+            n_fail = row.get("failures")
+            tail = (
+                f" ({int(n_fail)} failed scrape(s))"
+                if isinstance(n_fail, (int, float)) and n_fail > 1
+                else ""
+            )
+            lines.append(f"  {row['url']}: UNREACHABLE{tail}")
             continue
         if kind == "router":
             gens = ",".join(row.get("generations") or []) or "-"
@@ -277,11 +317,17 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
             if isinstance(worker, (int, float)):
                 dr = row.get("discard_rate")
                 dr_s = f"{dr * 100:.0f}%" if isinstance(dr, float) else "-"
+                aw = row.get("apply_wait_pct")
+                aw_s = f"{aw * 100:.0f}%" if isinstance(aw, float) else "-"
+                sm = row.get("staleness_max")
+                sm_s = f"{int(sm)}" if isinstance(sm, (int, float)) else "-"
                 lines.append(
                     f"    ver {_fmt_int(row.get('version'))}  "
                     f"push {_fmt_rate(row.get('push_s'))}  "
                     f"disc {_fmt_rate(row.get('discard_s'))}  "
-                    f"disc-rate {dr_s}"
+                    f"disc-rate {dr_s}  "
+                    f"wait {aw_s}  "
+                    f"stale-max {sm_s}"
                 )
             lines.append(
                 f"    anomalies {_fmt_int(row.get('anomalies'))}  "
@@ -331,10 +377,20 @@ def run_top(
     """The poll-render loop. ``iterations=None`` runs until Ctrl-C."""
     model = TopModel()
     n = 0
+
+    def poll(url: str) -> Optional[Dict[str, Any]]:
+        # ANY scrape failure (transport OSError, a peer dying between
+        # the status line and the body, torn JSON) is one endpoint's
+        # "down" row this refresh — never the whole loop's crash
+        try:
+            return fetch(url, timeout_s)
+        except Exception:
+            return None
+
     try:
         while iterations is None or n < iterations:
             now = clock()
-            rows = [model.update(u, fetch(u, timeout_s), now) for u in urls]
+            rows = [model.update(u, poll(u), now) for u in urls]
             label = time.strftime("%H:%M:%S")
             out.write(CLEAR + render(rows, now_label=label))
             out.flush()

@@ -120,6 +120,8 @@ class OwnerState:
         version: int = 0,
         on_version: Optional[Callable[[int], None]] = None,
         clock: Callable[[], float] = time.monotonic,
+        registry: Any = None,
+        trace: Any = None,
     ) -> None:
         if not (1 <= quorum <= n_workers):
             raise ValueError(
@@ -147,6 +149,30 @@ class OwnerState:
         }
         self._encoded: Optional[bytes] = None
         self.apply_seconds = 0.0
+        # owner-side dynamics instrumentation (docs/OBSERVABILITY.md
+        # "Training fleet"): the staleness of each ACCEPTED push, the
+        # wall time a round spends waiting for quorum, and the apply
+        # itself — shared bucket tables so cross-worker _bucket series
+        # sum exactly. registry=None (telemetry off) constructs nothing.
+        self.trace = trace
+        self._staleness_hist = self._quorum_wait_hist = None
+        self._apply_hist = None
+        if registry is not None:
+            from ..telemetry import FLEET_DYNAMICS_HISTOGRAMS
+
+            self._staleness_hist = registry.histogram(
+                "staleness",
+                buckets=FLEET_DYNAMICS_HISTOGRAMS["staleness"],
+            )
+            self._quorum_wait_hist = registry.histogram(
+                "quorum_wait_seconds",
+                buckets=FLEET_DYNAMICS_HISTOGRAMS["quorum_wait_seconds"],
+            )
+            self._apply_hist = registry.histogram(
+                "apply_seconds",
+                buckets=FLEET_DYNAMICS_HISTOGRAMS["apply_seconds"],
+            )
+        self._round_start: Optional[float] = None
         if self.on_version is not None:
             self.on_version(self.version)
 
@@ -185,6 +211,12 @@ class OwnerState:
                     self.worker_id, worker,
                 )
                 return False, self.version
+            if self._staleness_hist is not None:
+                self._staleness_hist.observe(float(lag))
+            if not self._buffer:
+                # quorum-wait clock starts when a round OPENS (first
+                # buffered contribution) and stops at the apply
+                self._round_start = self.clock()
             self._buffer[int(worker)] = grads
             if len(self._buffer) >= self.quorum:
                 try:
@@ -194,10 +226,18 @@ class OwnerState:
                     # raises must not leave a poisoned buffer that
                     # re-raises at every future quorum — drop the round
                     # (counted) and keep the shard serving
+                    # accounting caveat: the dropped round's pushes were
+                    # already observed into the staleness histogram at
+                    # their accept gate (observations can't be undone),
+                    # so after this once-ever path the histogram's count
+                    # exceeds applied+still-buffered by the dropped
+                    # round's size — the loud exception below is the
+                    # marker an operator reconciling the two would need
                     self.counters.inc(
                         "grad_discarded", len(self._buffer)
                     )
                     self._buffer.clear()
+                    self._round_start = None
                     logger.exception(
                         "fleet owner %d: quorum apply failed; round "
                         "dropped", self.worker_id,
@@ -206,6 +246,7 @@ class OwnerState:
 
     def _apply_locked(self) -> None:
         t0 = self.clock()
+        trace_t0 = self.trace.now() if self.trace is not None else None
         n = len(self._buffer)
         mean_flat: Dict[str, np.ndarray] = {}
         for flat in self._buffer.values():
@@ -227,7 +268,27 @@ class OwnerState:
         self.counters.inc("grad_applied", n)
         self.counters.inc("applies")
         self._buffer.clear()
-        self.apply_seconds += self.clock() - t0
+        dur = self.clock() - t0
+        self.apply_seconds += dur
+        if self._apply_hist is not None:
+            self._apply_hist.observe(dur)
+        if self._quorum_wait_hist is not None and self._round_start is not None:
+            self._quorum_wait_hist.observe(t0 - self._round_start)
+        self._round_start = None
+        if self.trace is not None:
+            # the owner-side half of the cross-worker hop the merged
+            # fleet timeline shows: a grad_push span on the sender's
+            # track, this grad_apply span on the owner's. Forced — an
+            # apply is the async plane's heartbeat and must outlive the
+            # per-step trace window.
+            self.trace.add_span(
+                "grad_apply",
+                trace_t0,
+                self.trace.now() - trace_t0,
+                cat="fleet",
+                force=True,
+                args={"version": self.version, "contributors": n},
+            )
         if self.on_version is not None:
             self.on_version(self.version)
         self._cond.notify_all()
@@ -362,19 +423,19 @@ class _PeerHandler(BaseHTTPRequestHandler):
         elif parsed.path == "/metrics":
             self._metrics(parsed)
         elif parsed.path == "/admin/alerts":
-            alerts = getattr(srv.tel, "alerts", None)
-            if alerts is None:
+            if srv.tel is None:
                 self._reply_json(200, {"alerts": "disabled"})
             else:
-                self._reply_json(200, {"alerts": alerts.states()})
+                from ..telemetry_http import alerts_reply
+
+                self._reply_json(200, alerts_reply(srv.tel))
         elif parsed.path == "/trace":
             if srv.tel is None:
                 self._reply_json(404, {"error": "telemetry_disabled"})
             else:
-                payload = srv.tel.trace.payload()
-                payload["anchor"] = srv.tel.trace.anchor()
-                payload["role"] = "fleet-worker"
-                self._reply_json(200, payload)
+                from ..telemetry_http import trace_reply
+
+                self._reply_json(200, trace_reply(srv.tel, "fleet-worker"))
         else:
             self._reply_json(404, {"error": "not_found", "message": parsed.path})
 
@@ -408,28 +469,18 @@ class _PeerHandler(BaseHTTPRequestHandler):
             else:
                 self._reply_json(200, snap)
             return
-        alerts = getattr(srv.tel, "alerts", None)
-        if fmt == "prometheus":
-            from ..prometheus import EXPOSITION_CONTENT_TYPE, PromFamilies
+        from ..telemetry_http import metrics_reply
 
-            fam = PromFamilies()
-            # the worker label on every trainer family: one Prometheus
-            # server scraping N fleet workers gets N distinct series per
-            # family instead of N colliding unlabeled ones
-            fam.add_snapshot(
-                srv.tel.registry.snapshot(),
-                prefix="srt_training",
-                labels={"worker": str(srv.worker_id)},
-            )
-            if alerts is not None:
-                alerts.add_prometheus(fam)
-            self._reply_bytes(200, fam.render().encode("utf8"), EXPOSITION_CONTENT_TYPE)
-        else:
-            snap = srv.tel.registry.snapshot()
-            snap["worker"] = srv.worker_id
-            if alerts is not None:
-                snap["alerts"] = alerts.summary()
-            self._reply_json(200, snap)
+        # one shared reply builder with the trainer listener (the worker
+        # label on every family: one Prometheus server scraping N fleet
+        # workers gets N distinct series instead of N colliding ones)
+        body, content_type = metrics_reply(
+            srv.tel,
+            fmt,
+            labels={"worker": str(srv.worker_id)},
+            json_extra={"worker": srv.worker_id},
+        )
+        self._reply_bytes(200, body, content_type)
 
     # -- POST ----------------------------------------------------------
     def do_POST(self) -> None:  # noqa: N802

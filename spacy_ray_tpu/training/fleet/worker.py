@@ -38,7 +38,9 @@ from __future__ import annotations
 
 import http.client
 import json
+import logging
 import socket
+import threading
 import time
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -70,6 +72,8 @@ from .ownership import (
 )
 from .peer import FleetCounters, OwnerState, PeerServer
 from .wire import WireError, decode_arrays, encode_arrays
+
+logger = logging.getLogger("spacy_ray_tpu.training")
 
 DEFAULT_FLEET_BASE_PORT = 47200
 PHASES = ("data", "pull", "grad", "push", "apply_wait")
@@ -172,6 +176,8 @@ def train_fleet_worker(
     peer_wait_s: float = 120.0,
     finalize_wait_s: float = 600.0,
     checkpoint_timeout_s: float = 600.0,
+    watch_interval_s: float = 5.0,
+    alert_interval_s: float = 5.0,
 ) -> Tuple[Any, Any]:
     """Run ONE fleet worker process; returns ``(nlp, TrainResult)`` like
     :func:`~..loop.train` (whose ``fleet=`` mode delegates here).
@@ -270,10 +276,12 @@ def train_fleet_worker(
             process_index=worker_id,
             alerting=bool(T.get("alerting", True)),
             alert_rules=default_training_rules(fleet=True),
+            alert_interval_s=float(alert_interval_s),
             incident_dir=(
                 Path(str(T.get("incident_dir")))
                 if T.get("incident_dir") else None
             ),
+            process_name=f"fleet-worker-{worker_id}",
         )
         tel.registry.gauge("fleet_worker").set(worker_id)
 
@@ -407,6 +415,20 @@ def train_fleet_worker(
     version_gauge = (
         tel.registry.gauge("param_version") if tel is not None else None
     )
+    # worker-side per-phase dynamics histograms (shared bucket tables —
+    # docs/OBSERVABILITY.md "Training fleet"); telemetry off constructs
+    # none of them (the zero-calls contract)
+    phase_hists: Optional[Dict[str, Any]] = None
+    if tel is not None:
+        from ..telemetry import FLEET_DYNAMICS_HISTOGRAMS
+
+        phase_hists = {
+            p: tel.registry.histogram(
+                f"phase_{p}_seconds",
+                buckets=FLEET_DYNAMICS_HISTOGRAMS[f"phase_{p}_seconds"],
+            )
+            for p in PHASES
+        }
     owner = OwnerState(
         worker_id=worker_id,
         n_workers=n_workers,
@@ -418,6 +440,8 @@ def train_fleet_worker(
         counters=counters,
         version=version,
         on_version=(version_gauge.set if version_gauge is not None else None),
+        registry=tel.registry if tel is not None else None,
+        trace=tel.trace if tel is not None else None,
     )
 
     # mutable holders the checkpoint callback (handler thread) reads
@@ -704,13 +728,30 @@ def train_fleet_worker(
                         f"peer {w} rejected grad push: HTTP {status}"
                     )
 
+            t_send = time.perf_counter()
+            delivered = False
             try:
                 retry_io("grad-push", send, policy=push_policy)
                 counters.inc("grad_pushed")
+                delivered = True
             except (OSError, resilience.FaultInjected):
                 # fire-and-forget: a dead/unreachable owner costs a
                 # counted drop, never a stalled fleet
                 counters.inc("push_failed")
+            if tel is not None:
+                # the sender-side half of the cross-worker hop the merged
+                # fleet timeline shows (owner-side twin: grad_apply)
+                tel.trace.add_span(
+                    "grad_push",
+                    t_send,
+                    time.perf_counter() - t_send,
+                    cat="fleet",
+                    args={
+                        "to": w,
+                        "stamp": int(stamps.get(w, -1)),
+                        "delivered": delivered,
+                    },
+                )
             last_stamp[w] = int(stamps.get(w, -1))
 
     def fleet_checkpoint() -> None:
@@ -798,6 +839,74 @@ def train_fleet_worker(
         )
         last_saved_step = stamp
 
+    # ---- convergence watch (lead-side, docs/OBSERVABILITY.md) --------
+    # worker 0 polls every peer's /metrics on a slow daemon thread and
+    # feeds the cross-worker divergence detector: a worker whose recent
+    # loss median is an outlier vs its PEERS (or that is training on
+    # NaNs, or whose arriving gradients keep being discarded) emits
+    # through the anomaly chain — metrics row + trace instant + flight-
+    # recorder bundle naming the worker — and bumps divergence_flags,
+    # which the fleet-worker-diverging alert rule pages on. Telemetry
+    # off constructs neither the detector nor the thread.
+    watch_stop = threading.Event()
+    watch_thread: Optional[threading.Thread] = None
+    if tel is not None and worker_id == 0 and n_workers > 1:
+        from ..telemetry import FleetDivergenceDetector
+
+        div_counter = tel.registry.counter("divergence_flags")
+
+        def _emit_divergence(event: str, message: str, **fields: Any) -> None:
+            div_counter.inc()
+            tel._emit_anomaly(event, message, **fields)
+
+        divergence = FleetDivergenceDetector(_emit_divergence)
+
+        def _watch_stats(payload: Dict[str, Any]) -> Dict[str, Any]:
+            counters_p = payload.get("counters") or {}
+            loss_h = (payload.get("histograms") or {}).get("loss") or {}
+            return {
+                "loss": loss_h.get("p50"),
+                "steps": counters_p.get("steps"),
+                "received": counters_p.get("grad_received"),
+                "discarded": counters_p.get("grad_discarded"),
+                "loss_nonfinite": counters_p.get("loss_nonfinite"),
+            }
+
+        def _watch_loop() -> None:
+            # the step loop's keep-alive peer connections are NOT
+            # thread-safe; the watch owns its own clients
+            watch_clients = {
+                w: _PeerClient(urls[w], timeout=5.0) for w in clients
+            }
+            try:
+                while not watch_stop.wait(float(watch_interval_s)):
+                    stats = {
+                        worker_id: _watch_stats(tel.registry.snapshot())
+                    }
+                    for w, client in watch_clients.items():
+                        try:
+                            status, _, body = client.request(
+                                "GET", "/metrics"
+                            )
+                            if status != 200:
+                                continue
+                            stats[w] = _watch_stats(
+                                json.loads(body.decode("utf8"))
+                            )
+                        except (OSError, ValueError):
+                            continue  # an exiting peer: no-signal, no crash
+                    try:
+                        divergence.observe(stats)
+                    except Exception:
+                        logger.exception("fleet divergence watch failed")
+            finally:
+                for client in watch_clients.values():
+                    client.close()
+
+        watch_thread = threading.Thread(
+            target=_watch_loop, name="fleet-watch", daemon=True
+        )
+
     # ---- resilience arming ------------------------------------------
     watchdog: Optional[Watchdog] = None
     watchdog_timeout = float(T.get("watchdog_timeout_s", 0) or 0)
@@ -819,6 +928,21 @@ def train_fleet_worker(
     wait_for_peers()
     if tel is not None:
         tel.loop_start()
+    if watch_thread is not None:
+        watch_thread.start()
+
+    def note_phase(name: str, t0: float, t1: float) -> None:
+        """One phase's wall time: the ledger accumulator, the shared-
+        bucket histogram, and (inside the trace window) a span on this
+        worker's track — one stamp pair feeds all three surfaces."""
+        d = t1 - t0
+        phases[name] += d
+        if phase_hists is not None:
+            phase_hists[name].observe(d)
+            tel.trace.add_span(
+                f"phase_{name}", t0, d, cat="fleet",
+                args={"step": step + 1},
+            )
 
     try:
         batch_iter = batches()
@@ -837,12 +961,12 @@ def train_fleet_worker(
             tokens, targets = collated["tokens"], collated["targets"]
             n_words = int(collated["n_words"])
             now = time.perf_counter()
-            phases["data"] += now - t_data
+            note_phase("data", t_data, now)
 
             t_pull = now
             stamps = pull_peers()
             now = time.perf_counter()
-            phases["pull"] += now - t_pull
+            note_phase("pull", t_pull, now)
 
             maybe_fail("step")
             poisoned = resilience.consume_poison("step")
@@ -856,12 +980,12 @@ def train_fleet_worker(
                 lambda g: np.asarray(jax.device_get(g)), grads
             )
             now = time.perf_counter()
-            phases["grad"] += now - t_grad
+            note_phase("grad", t_grad, now)
 
             t_push = now
             push_grads(grads, stamps)
             now = time.perf_counter()
-            phases["push"] += now - t_push
+            note_phase("push", t_push, now)
 
             t_wait = now
             if owns_any and not owner.wait_version_above(
@@ -875,21 +999,25 @@ def train_fleet_worker(
                     f"{quorum} not reached) — proceeding",
                     worker=worker_id, version=owner.version,
                 )
-            phases["apply_wait"] += time.perf_counter() - t_wait
+            note_phase("apply_wait", t_wait, time.perf_counter())
 
             step += 1
             steps_run += 1
             state_holder["step"] = step
             result.words_seen += n_words
             words_since_log += n_words
+            loss_val = float("nan") if poisoned else float(loss)
             for key, value in jax.device_get(metrics).items():
                 if key.startswith("loss_"):
                     v = float("nan") if poisoned else float(value)
                     loss_accum[key[5:]] = loss_accum.get(key[5:], 0.0) + v
             if tel is not None:
+                # per-step loss streaming: the row lands in metrics.jsonl
+                # (the run report's loss trajectories) and the recent-
+                # median ring is what the lead's convergence watch polls
                 tel.step_boundary(
                     step=step, epoch=epoch, n_words=n_words,
-                    steps_run=steps_run,
+                    steps_run=steps_run, loss=loss_val,
                 )
 
             info: Optional[Dict[str, Any]] = None
@@ -969,6 +1097,9 @@ def train_fleet_worker(
     finally:
         if watchdog is not None:
             watchdog.stop()
+        watch_stop.set()
+        if watch_thread is not None:
+            watch_thread.join(timeout=5.0)
         if install_signal_handlers:
             shutdown.restore()
         try:
@@ -1052,6 +1183,28 @@ def train_fleet_worker(
                 (out / f"fleet-worker-{worker_id}.json").write_text(
                     json.dumps(ledger, indent=2), encoding="utf8"
                 )
+            if tel is not None:
+                # the kind:"fleet" exit row: the dynamics histograms'
+                # final snapshots ride into metrics.jsonl so the run
+                # report and `telemetry summarize` can digest them
+                # offline (the in-memory registry dies with the process)
+                snap_h = tel.registry.snapshot().get("histograms") or {}
+                tel.append_row({
+                    "kind": "fleet",
+                    "worker": worker_id,
+                    "n_workers": n_workers,
+                    "quorum": quorum,
+                    "max_staleness": max_staleness,
+                    "version": owner.version,
+                    "counters": counters.snapshot(),
+                    "phases": {p: round(v, 6) for p, v in phases.items()},
+                    "histograms": {
+                        k: v for k, v in snap_h.items()
+                        if k in ("staleness", "quorum_wait_seconds",
+                                 "apply_seconds", "loss")
+                        or k.startswith("phase_")
+                    },
+                })
             for client in clients.values():
                 client.close()
             for client in ckpt_clients.values():

@@ -36,11 +36,14 @@ Four pieces, individually inert and composable:
   jsonl training log) AND a ``kind: "anomaly"`` row in ``metrics.jsonl``
   (so ``telemetry summarize`` digests it offline).
 
-``metrics.jsonl`` row kinds: ``step`` (per-step step-time + words),
-``eval`` (gauges: HBM, compile count, live buffers, step-time p50/p95,
-MFU estimate, per-stage seconds), ``anomaly``. Rows buffer in memory and
-flush at eval boundaries / finalize / watchdog fire — never per-step
-file I/O in the hot loop.
+``metrics.jsonl`` row kinds: ``step`` (per-step step-time + words, and
+per-step ``loss`` on the trainer-fleet path), ``eval`` (gauges: HBM,
+compile count, live buffers, step-time p50/p95, MFU estimate, per-stage
+seconds), ``anomaly``, ``serving`` (a serve run's snapshot), ``fleet``
+(a trainer-fleet worker's exit row: counters, phase ledger, dynamics-
+histogram snapshots). Rows buffer in memory and flush at eval
+boundaries / finalize / watchdog fire — never per-step file I/O in the
+hot loop.
 """
 
 from __future__ import annotations
@@ -58,11 +61,14 @@ __all__ = [
     "MetricsRegistry",
     "TraceBuffer",
     "AnomalyDetectors",
+    "FleetDivergenceDetector",
     "Telemetry",
     "TPU_PEAK_BF16",
     "LATENCY_BUCKETS",
     "STEP_SECONDS_BUCKETS",
     "OCCUPANCY_BUCKETS",
+    "STALENESS_BUCKETS",
+    "FLEET_DYNAMICS_HISTOGRAMS",
     "install_compile_hook",
     "compile_count",
     "sample_device_telemetry",
@@ -88,6 +94,29 @@ STEP_SECONDS_BUCKETS = (
     10.0, 30.0, 60.0, 120.0,
 )
 OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+# version lag (in shard versions, not seconds) of each ACCEPTED gradient
+# push — the trainer fleet's bounded-staleness evidence. le=0 is the
+# in-round bucket; anything past max_staleness is discarded before it
+# could be observed, so the +Inf bin staying empty is itself a proof
+# the discard gate holds.
+STALENESS_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 16.0)
+
+# the trainer fleet's dynamics families (docs/OBSERVABILITY.md "Training
+# fleet") and the shared bucket table each uses — ONE definition so the
+# owner side (peer.py), the worker side (worker.py), the run report, and
+# the golden-grammar tests can never disagree on which registry names
+# make up the fleet surface. Keys are registry names (the Prometheus
+# exposition renders them under srt_training_* with a worker label).
+FLEET_DYNAMICS_HISTOGRAMS = {
+    "staleness": STALENESS_BUCKETS,
+    "quorum_wait_seconds": LATENCY_BUCKETS,
+    "apply_seconds": LATENCY_BUCKETS,
+    "phase_data_seconds": STEP_SECONDS_BUCKETS,
+    "phase_pull_seconds": STEP_SECONDS_BUCKETS,
+    "phase_grad_seconds": STEP_SECONDS_BUCKETS,
+    "phase_push_seconds": STEP_SECONDS_BUCKETS,
+    "phase_apply_wait_seconds": STEP_SECONDS_BUCKETS,
+}
 
 
 # ----------------------------------------------------------------------
@@ -1051,6 +1080,231 @@ class AnomalyDetectors:
             )
 
 
+class FleetDivergenceDetector:
+    """Cross-worker convergence watch for the trainer fleet — the
+    fleet-LEVEL twin of :class:`AnomalyDetectors` (which only sees one
+    process's series). The lead worker polls every peer's ``/metrics``
+    and feeds one ``observe(stats)`` call per poll; the detector flags a
+    worker whose behavior diverges from the REST of the fleet:
+
+    * ``nan`` — the worker's ``loss_nonfinite`` counter moved: it is
+      training on NaN/Inf losses right now. Fires immediately (a NaN is
+      unambiguous; no fleet comparison needed).
+    * ``loss-outlier`` — the worker's recent-median loss exceeds
+      ``spike_factor`` × the median of its PEERS' recent medians for
+      ``confirm_polls`` consecutive polls. Comparing against peers (not
+      history) is what keeps a uniformly-slow/uniformly-hot fleet quiet:
+      when every worker's loss rises together the peer median rises with
+      it and no one is an outlier. When the polled stats carry ``steps``
+      the comparison is PACE-GATED: a worker is only judged once it has
+      run ``min_steps`` (its loss ring must mean something), and only
+      against peers within 2× of its step count — early training's
+      steep loss decay makes rings at different step counts
+      incomparable, and a worker merely running BEHIND is the slow-peer
+      signal's business (push-stall, phase histograms), not a
+      divergence.
+    * ``discard-outlier`` — the share of gradients ARRIVING at this
+      worker (it is the owner; discards are owner-side) that were
+      discarded as stale since the last poll exceeds ``discard_rate``
+      while the peer median share stays below half of it: ONE worker's
+      shard version is outrunning its peers' pulls (a speed/placement
+      outlier), not a fleet-wide knob problem (that is the
+      fleet-discard-burn alert's job).
+
+    No-signal discipline: a worker is only judged once it has been seen
+    in ``min_polls`` polls (a just-joined/just-restarted worker's first
+    samples are warmup, not divergence), loss modes need a finite loss
+    median on BOTH sides, and each (worker, mode) pair re-arms only
+    after ``rearm_s`` so a persistently-diverged worker emits a beat,
+    not a storm. Pure host arithmetic with an injected clock — the test
+    matrix drives it deterministically.
+    """
+
+    def __init__(
+        self,
+        emit: Callable[..., Any],
+        *,
+        spike_factor: float = 3.0,
+        discard_rate: float = 0.5,
+        min_polls: int = 3,
+        confirm_polls: int = 2,
+        min_received_delta: int = 4,
+        min_steps: int = 8,
+        pace_factor: float = 2.0,
+        rearm_s: float = 120.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.emit = emit
+        self.spike_factor = float(spike_factor)
+        self.discard_rate = float(discard_rate)
+        self.min_polls = int(min_polls)
+        self.confirm_polls = int(confirm_polls)
+        self.min_received_delta = int(min_received_delta)
+        self.min_steps = int(min_steps)
+        self.pace_factor = float(pace_factor)
+        self.rearm_s = float(rearm_s)
+        self.clock = clock
+        self._polls: Dict[int, int] = {}
+        self._prev: Dict[int, Dict[str, float]] = {}
+        self._loss_strikes: Dict[int, int] = {}
+        self._disc_strikes: Dict[int, int] = {}
+        self._last_fire: Dict[Tuple[int, str], float] = {}
+        self.fired: Dict[str, int] = {}
+
+    def _fire(
+        self, worker: int, mode: str, message: str, **fields: Any
+    ) -> bool:
+        now = self.clock()
+        last = self._last_fire.get((worker, mode))
+        if last is not None and now - last < self.rearm_s:
+            return False
+        self._last_fire[(worker, mode)] = now
+        self.fired[mode] = self.fired.get(mode, 0) + 1
+        self.emit(
+            "fleet-divergence",
+            message,
+            worker=int(worker),
+            mode=mode,
+            **fields,
+        )
+        return True
+
+    @staticmethod
+    def _median(values: List[float]) -> Optional[float]:
+        if not values:
+            return None
+        s = sorted(values)
+        return s[len(s) // 2]
+
+    def observe(self, stats: Dict[int, Dict[str, Any]]) -> List[str]:
+        """One fleet poll: ``stats[worker]`` carries whatever that
+        worker's ``/metrics`` exposed — ``loss`` (recent median, may be
+        None), ``received``/``discarded``/``loss_nonfinite`` counter
+        values. Returns the modes fired this poll."""
+        fired: List[str] = []
+        deltas: Dict[int, Dict[str, float]] = {}
+        for w, row in stats.items():
+            self._polls[w] = self._polls.get(w, 0) + 1
+            prev = self._prev.get(w) or {}
+            cur = {
+                k: float(row.get(k) or 0.0)
+                for k in ("received", "discarded", "loss_nonfinite")
+            }
+            deltas[w] = {
+                k: max(cur[k] - float(prev.get(k) or 0.0), 0.0) for k in cur
+            }
+            self._prev[w] = cur
+            # first poll: the counter's CURRENT value is the delta — a
+            # worker whose NaNs all landed before the watch's first
+            # scrape of it (fast fault inside the first poll interval)
+            # must not have them baselined away forever
+            nan_delta = (
+                deltas[w]["loss_nonfinite"] if prev
+                else cur["loss_nonfinite"]
+            )
+            if nan_delta > 0:
+                if self._fire(
+                    w,
+                    "nan",
+                    f"fleet worker {w} is training on non-finite losses "
+                    f"({int(nan_delta)} NaN/Inf step(s) since the last "
+                    "poll)",
+                    nonfinite=int(nan_delta),
+                ):
+                    fired.append("nan")
+
+        def judgeable(w: int) -> bool:
+            return self._polls.get(w, 0) >= self.min_polls
+
+        finite_loss = {
+            w: float(row["loss"])
+            for w, row in stats.items()
+            if isinstance(row.get("loss"), (int, float))
+            and math.isfinite(float(row["loss"]))
+        }
+        steps_of = {
+            w: float(row["steps"])
+            for w, row in stats.items()
+            if isinstance(row.get("steps"), (int, float))
+        }
+
+        def pace_ok(w: int, pw: int) -> bool:
+            """Loss rings are only comparable between workers at a
+            similar point in training (absent step counts, compare
+            unconditionally — the unit-test/bare-ledger shape)."""
+            sw, sp = steps_of.get(w), steps_of.get(pw)
+            if sw is None or sp is None:
+                return True
+            hi, lo = max(sw, sp), min(sw, sp)
+            return lo > 0 and hi / lo <= self.pace_factor
+
+        for w in sorted(stats):
+            loss = finite_loss.get(w)
+            if loss is not None and steps_of.get(w) is not None and (
+                steps_of[w] < self.min_steps
+            ):
+                loss = None  # ring too young to mean anything
+            peers = [v for pw, v in finite_loss.items()
+                     if pw != w and judgeable(pw) and pace_ok(w, pw)]
+            peer_median = self._median(peers)
+            outlier = (
+                judgeable(w)
+                and loss is not None
+                and peer_median is not None
+                and peer_median > 0
+                and loss > self.spike_factor * peer_median
+            )
+            self._loss_strikes[w] = (
+                self._loss_strikes.get(w, 0) + 1 if outlier else 0
+            )
+            if self._loss_strikes[w] >= self.confirm_polls:
+                if self._fire(
+                    w,
+                    "loss-outlier",
+                    f"fleet worker {w} loss {loss:.4g} is "
+                    f"{loss / peer_median:.1f}x the peer median "
+                    f"{peer_median:.4g} ({self._loss_strikes[w]} "
+                    "consecutive polls)",
+                    loss=loss,
+                    peer_median=peer_median,
+                ):
+                    fired.append("loss-outlier")
+
+        disc_share: Dict[int, float] = {}
+        for w, d in deltas.items():
+            if d["received"] >= self.min_received_delta:
+                disc_share[w] = d["discarded"] / d["received"]
+        for w in sorted(stats):
+            share = disc_share.get(w)
+            peers = [v for pw, v in disc_share.items()
+                     if pw != w and judgeable(pw)]
+            peer_median = self._median(peers)
+            outlier = (
+                judgeable(w)
+                and share is not None
+                and peer_median is not None
+                and share >= self.discard_rate
+                and peer_median < self.discard_rate / 2
+            )
+            self._disc_strikes[w] = (
+                self._disc_strikes.get(w, 0) + 1 if outlier else 0
+            )
+            if self._disc_strikes[w] >= self.confirm_polls:
+                if self._fire(
+                    w,
+                    "discard-outlier",
+                    f"fleet worker {w}: {share * 100:.0f}% of the "
+                    "gradients arriving at it were discarded as stale "
+                    f"since the last poll (peer median "
+                    f"{peer_median * 100:.0f}%) — its shard version is "
+                    "outrunning its peers",
+                    discard_share=share,
+                    peer_median=peer_median,
+                ):
+                    fired.append("discard-outlier")
+        return fired
+
+
 # ----------------------------------------------------------------------
 # Telemetry facade (what the training loop holds)
 # ----------------------------------------------------------------------
@@ -1079,6 +1333,7 @@ class Telemetry:
         alert_rules: Optional[List[Any]] = None,
         alert_interval_s: float = 5.0,
         incident_dir: Optional[Path] = None,
+        process_name: str = "trainer",
     ):
         self.metrics_dir = Path(metrics_dir)
         self.metrics_dir.mkdir(parents=True, exist_ok=True)
@@ -1106,7 +1361,11 @@ class Telemetry:
 
             self.recorder = FlightRecorder(
                 incident_dir=Path(incident_dir),
-                process_name="trainer",
+                # "fleet-worker-K" for trainer-fleet workers: a fleet-wide
+                # incidents dir gets bundles whose flight files and
+                # postmortem timeline tracks name the worker that wrote
+                # them, not N identical "trainer" rows
+                process_name=str(process_name),
                 clock=clock,
             )
         self.alerts = None
@@ -1156,6 +1415,13 @@ class Telemetry:
         self._words = self.registry.counter("words")
         self._steps = self.registry.counter("steps")
         self._anomalies = self.registry.counter("anomalies")
+        # per-step loss streaming (trainer-fleet convergence watch):
+        # created lazily on the first step_boundary(loss=...) so surfaces
+        # that never stream a loss keep their exposition unchanged. The
+        # small ring makes snapshot p50 a RECENT median — the fleet
+        # divergence detector's per-worker signal.
+        self._loss_hist: Optional[_Histogram] = None
+        self._loss_nonfinite: Optional[_Counter] = None
         self._rows: List[Dict[str, Any]] = []
         self._rows_lock = threading.Lock()
         self._last_boundary: Optional[float] = None
@@ -1204,8 +1470,18 @@ class Telemetry:
         if self.recorder is not None:
             # retroactive forensics: a detector firing is exactly the
             # moment the last N seconds are worth keeping (rate-limited
-            # inside the recorder — a NaN storm writes ONE bundle)
-            self.recorder.trip(f"anomaly-{event}", message, step=fields.get("step"))
+            # inside the recorder — a NaN storm writes ONE bundle).
+            # worker/mode ride into incident.json so a fleet-divergence
+            # bundle NAMES the diverging worker, not just the event.
+            self.recorder.trip(
+                f"anomaly-{event}",
+                message,
+                **{
+                    k: fields[k]
+                    for k in ("step", "worker", "mode")
+                    if fields.get(k) is not None
+                },
+            )
 
     def maybe_evaluate_alerts(self, *, force: bool = False) -> None:
         """Rate-limited alert pass: at most one rule evaluation per
@@ -1234,6 +1510,12 @@ class Telemetry:
     def _append_row(self, row: Dict[str, Any]) -> None:
         with self._rows_lock:
             self._rows.append(row)
+
+    def append_row(self, row: Dict[str, Any]) -> None:
+        """Buffer one extra ``metrics.jsonl`` row (flushed with the
+        regular eval/finalize cadence) — the trainer-fleet worker's
+        ``kind: "fleet"`` exit row rides this."""
+        self._append_row(dict(row))
 
     def _flush_rows(self) -> None:
         with self._rows_lock:
@@ -1265,9 +1547,18 @@ class Telemetry:
         steps_run: int,
         inner_steps: int = 1,
         words_each: Optional[List[int]] = None,
+        loss: Optional[float] = None,
     ) -> None:
         """THE one hot-path hook: a single clock stamp, one histogram
         observation, one buffered row, and the trace-window gate.
+
+        ``loss`` (the trainer-fleet path): this step's scalar loss —
+        finite values feed the ``loss`` histogram's recent-median ring
+        (the cross-worker convergence-watch signal) and land on the step
+        row; non-finite values are COUNTED (``loss_nonfinite``) instead
+        of observed, so one NaN cannot poison the median the fleet
+        comparison reads. Applies to the last inner step when
+        ``inner_steps > 1``.
 
         ``inner_steps > 1`` (a ``steps_per_dispatch`` dispatch): the one
         wall-clock window fans out into per-inner-step observations of
@@ -1304,6 +1595,21 @@ class Telemetry:
                 if k > 1:
                     args["dispatch_k"] = k
                     row["dispatch_k"] = k
+                if loss is not None and i == k - 1:
+                    loss_f = float(loss)
+                    row["loss"] = loss_f
+                    if math.isfinite(loss_f):
+                        if self._loss_hist is None:
+                            self._loss_hist = self.registry.histogram(
+                                "loss", max_samples=64
+                            )
+                        self._loss_hist.observe(loss_f)
+                    else:
+                        if self._loss_nonfinite is None:
+                            self._loss_nonfinite = self.registry.counter(
+                                "loss_nonfinite"
+                            )
+                        self._loss_nonfinite.inc()
                 self.trace.add_span(
                     "step", prev + i * dur, dur, cat="step", args=args
                 )
@@ -1540,20 +1846,151 @@ def _summarize_serving_rows(servings: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def _summarize_fleet_rows(fleet_rows: List[Dict[str, Any]]) -> List[str]:
+    """The trainer-fleet section of ``telemetry summarize``: built from
+    the ``kind: "fleet"`` exit row each fleet worker appends at finalize
+    (the newest per worker wins) — per-worker version/counters, the
+    phase-share split, and the dynamics-histogram digest (staleness,
+    quorum wait, apply)."""
+    by_worker: Dict[int, Dict[str, Any]] = {}
+    for row in fleet_rows:
+        w = row.get("worker")
+        if isinstance(w, int):
+            by_worker[w] = row
+    if not by_worker:
+        return []
+    any_row = next(iter(by_worker.values()))
+    lines = [
+        f"trainer fleet: {any_row.get('n_workers')} worker(s)  "
+        f"quorum {any_row.get('quorum')}  "
+        f"max_staleness {any_row.get('max_staleness')}"
+    ]
+    for w in sorted(by_worker):
+        row = by_worker[w]
+        c = row.get("counters") or {}
+        hists = row.get("histograms") or {}
+        phases = row.get("phases") or {}
+        total = sum(float(v) for v in phases.values()) or 1.0
+        share = "  ".join(
+            f"{p} {100 * float(phases.get(p, 0.0)) / total:.0f}%"
+            for p in ("data", "pull", "grad", "push", "apply_wait")
+            if p in phases
+        )
+        lines.append(
+            f"  worker {w}: version {row.get('version')}  "
+            f"pushed {int(c.get('grad_pushed') or 0)}  "
+            f"received {int(c.get('grad_received') or 0)}  "
+            f"applied {int(c.get('grad_applied') or 0)}  "
+            f"discarded {int(c.get('grad_discarded') or 0)}  "
+            f"push-failed {int(c.get('push_failed') or 0)}"
+        )
+        if share:
+            lines.append(f"    phases: {share}")
+        st = hists.get("staleness") or {}
+        if st.get("count"):
+            buckets = st.get("buckets") or []
+            bl = "  ".join(
+                f"<={int(le)}: {int(cum)}" for le, cum in buckets
+                if cum
+            )
+            lines.append(
+                f"    staleness (accepted pushes): n={st['count']}  "
+                f"max {st.get('max')}  {bl}"
+            )
+        qw, ap = hists.get("quorum_wait_seconds") or {}, hists.get(
+            "apply_seconds"
+        ) or {}
+        if qw.get("count") or ap.get("count"):
+            lines.append(
+                f"    quorum-wait p50 {_fmt_ms(qw.get('p50'))} "
+                f"p99 {_fmt_ms(qw.get('p99'))}  "
+                f"apply p50 {_fmt_ms(ap.get('p50'))} "
+                f"p99 {_fmt_ms(ap.get('p99'))}"
+            )
+    return lines
+
+
+def _summarize_run_dir(run_dir: Path) -> str:
+    """``telemetry summarize <run-dir>``: a trainer-fleet run directory
+    (``fleet-worker-*.json`` ledgers + ``metrics/fleet-worker-*/
+    metrics.jsonl``) gets a fleet digest; a plain run directory holding
+    one ``metrics.jsonl`` falls through to the file summary. Discovery
+    is :func:`~.report.load_run` — the ONE definition of the run-dir
+    layout, shared with ``telemetry report`` and the bench harness."""
+    from .report import load_run
+
+    run_dir = Path(run_dir)
+    run = load_run(run_dir)  # ValueError when not a run directory
+    workers = run["workers"]
+    ledgers = {
+        w: e["ledger"] for w, e in workers.items() if "ledger" in e
+    }
+    metrics_paths = [
+        workers[w]["metrics_path"]
+        for w in sorted(workers)
+        if workers[w].get("metrics_path")
+    ]
+    if not ledgers and len(metrics_paths) == 1:
+        # a plain single-process run: the file summary IS the digest
+        return summarize_metrics(metrics_paths[0])
+    lines: List[str] = [f"telemetry summary (fleet run dir): {run_dir}"]
+    if ledgers:
+        rows = [ledgers[w] for w in sorted(ledgers)]
+        total_words = sum(int(r.get("words_seen") or 0) for r in rows)
+        slowest = max(float(r.get("seconds") or 0.0) for r in rows)
+        lines.append(
+            f"workers: {len(rows)}  total words {total_words:,}  "
+            f"slowest worker {slowest:.1f}s"
+            + (
+                f"  ({total_words / slowest:,.0f} words/s fleet-wide)"
+                if slowest > 0
+                else ""
+            )
+        )
+        for r in rows:
+            c = r.get("counters") or {}
+            phases = r.get("phases") or {}
+            total = sum(float(v) for v in phases.values()) or 1.0
+            wait_pct = 100 * float(phases.get("apply_wait") or 0.0) / total
+            lines.append(
+                f"  worker {r.get('worker')}: steps {r.get('steps')}  "
+                f"words {int(r.get('words_seen') or 0):,}  "
+                f"version {r.get('version')}  "
+                f"discarded {int(c.get('grad_discarded') or 0)}  "
+                f"push-failed {int(c.get('push_failed') or 0)}  "
+                f"apply-wait {wait_pct:.0f}%"
+                + ("  [interrupted]" if r.get("interrupted") else "")
+            )
+    for mp in metrics_paths:
+        try:
+            lines.append("")
+            lines.append(summarize_metrics(mp))
+        except (OSError, ValueError) as e:
+            lines.append(f"  ({Path(mp).parent.name}: {e})")
+    return "\n".join(lines)
+
+
 def summarize_metrics(path: Path) -> str:
     """Digest a ``metrics.jsonl``: training rows (per-stage time
-    breakdown, step-time percentiles, device gauges) AND serving rows
+    breakdown, step-time percentiles, device gauges), serving rows
     (``kind: "serving"`` snapshots: SLO window, rejects, by-generation
-    split), plus the anomaly digest. Pure file-in/text-out so the CLI
-    subcommand and the round-trip test share one implementation.
+    split), trainer-fleet rows (``kind: "fleet"`` exit rows: counters,
+    phase share, staleness/quorum-wait/apply digest), plus the anomaly
+    digest. Given a DIRECTORY, digests a fleet run dir (per-worker
+    ledgers + metrics files) or its single ``metrics.jsonl``. Pure
+    file-in/text-out so the CLI subcommand and the round-trip test share
+    one implementation.
 
-    Raises ValueError when the file holds no telemetry rows (a wrong
+    Raises ValueError when the target holds no telemetry rows (a wrong
     path must not print an empty-but-plausible report)."""
     path = Path(path)
+    if path.is_dir():
+        return _summarize_run_dir(path)
     steps: List[Dict[str, Any]] = []
     evals: List[Dict[str, Any]] = []
     anomalies: List[Dict[str, Any]] = []
     servings: List[Dict[str, Any]] = []
+    fleet_rows: List[Dict[str, Any]] = []
     with open(path, encoding="utf8") as f:
         for line in f:
             line = line.strip()
@@ -1572,12 +2009,19 @@ def summarize_metrics(path: Path) -> str:
                 anomalies.append(row)
             elif kind == "serving":
                 servings.append(row)
-    if not steps and not evals and not anomalies and not servings:
+            elif kind == "fleet":
+                fleet_rows.append(row)
+    if (
+        not steps and not evals and not anomalies and not servings
+        and not fleet_rows
+    ):
         raise ValueError(f"{path} contains no telemetry rows")
 
     lines: List[str] = [f"telemetry summary: {path}"]
     if servings:
         lines.extend(_summarize_serving_rows(servings))
+    if fleet_rows:
+        lines.extend(_summarize_fleet_rows(fleet_rows))
     if steps:
         durs = sorted(float(s["step_seconds"]) for s in steps)
         words = sum(int(s.get("words") or 0) for s in steps)

@@ -29,9 +29,74 @@ from urllib.parse import parse_qs, urlparse
 
 from .telemetry import Telemetry, sanitize_json
 
-__all__ = ["TelemetryHTTPServer"]
+__all__ = [
+    "TelemetryHTTPServer",
+    "metrics_reply",
+    "trace_reply",
+    "alerts_reply",
+]
 
 logger = logging.getLogger("spacy_ray_tpu.training")
+
+
+# -- shared reply builders ---------------------------------------------
+# The trainer's listener (below) and the trainer-fleet peer server
+# (training/fleet/peer.py) expose the SAME telemetry surface; these
+# builders are the one definition of what /metrics, /trace and
+# /admin/alerts serve, so the two handlers cannot drift — the fleet
+# variant only adds a worker label (Prometheus) / worker field (JSON).
+
+
+def metrics_reply(
+    tel: Any,
+    fmt: str,
+    *,
+    prefix: str = "srt_training",
+    labels: Optional[Dict[str, Any]] = None,
+    json_extra: Optional[Dict[str, Any]] = None,
+) -> Tuple[bytes, str]:
+    """``(body, content_type)`` for a trainer-role ``/metrics`` reply:
+    the registry snapshot as Prometheus exposition (``labels`` on every
+    family — the fleet's per-worker series) or as JSON (``json_extra``
+    merged in), alert summary/series appended when an engine exists."""
+    alerts = getattr(tel, "alerts", None)
+    if fmt == "prometheus":
+        from .prometheus import EXPOSITION_CONTENT_TYPE, PromFamilies
+
+        fam = PromFamilies()
+        fam.add_snapshot(
+            tel.registry.snapshot(), prefix=prefix, labels=labels
+        )
+        if alerts is not None:
+            alerts.add_prometheus(fam)
+        return fam.render().encode("utf8"), EXPOSITION_CONTENT_TYPE
+    snap = tel.registry.snapshot()
+    if json_extra:
+        snap.update(json_extra)
+    if alerts is not None:
+        # the compact block `telemetry top` renders; full per-rule
+        # states live on /admin/alerts
+        snap["alerts"] = alerts.summary()
+    return (
+        json.dumps(sanitize_json(snap)).encode("utf8"),
+        "application/json",
+    )
+
+
+def trace_reply(tel: Any, role: str) -> Dict[str, Any]:
+    """The live Chrome-trace payload + the clock anchor a cross-process
+    collector needs to place it on a shared timeline."""
+    payload = tel.trace.payload()
+    payload["anchor"] = tel.trace.anchor()
+    payload["role"] = role
+    return payload
+
+
+def alerts_reply(tel: Any) -> Dict[str, Any]:
+    alerts = getattr(tel, "alerts", None)
+    if alerts is None:
+        return {"alerts": "disabled"}
+    return {"alerts": alerts.states()}
 
 
 class _TelemetryHTTPD(ThreadingHTTPServer):
@@ -55,14 +120,6 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _reply_text(self, status: int, text: str, content_type: str) -> None:
-        body = text.encode("utf8")
-        self.send_response(status)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler naming)
         tel = self.server.tel
         parsed = urlparse(self.path)
@@ -77,38 +134,16 @@ class _Handler(BaseHTTPRequestHandler):
             )
         elif parsed.path == "/metrics":
             fmt = (parse_qs(parsed.query).get("format") or [""])[0]
-            alerts = getattr(tel, "alerts", None)
-            if fmt == "prometheus":
-                from .prometheus import (
-                    EXPOSITION_CONTENT_TYPE,
-                    PromFamilies,
-                )
-
-                fam = PromFamilies()
-                fam.add_snapshot(
-                    tel.registry.snapshot(), prefix="srt_training"
-                )
-                if alerts is not None:
-                    alerts.add_prometheus(fam)
-                self._reply_text(200, fam.render(), EXPOSITION_CONTENT_TYPE)
-            else:
-                snap = tel.registry.snapshot()
-                if alerts is not None:
-                    # the compact block `telemetry top` renders; full
-                    # per-rule states live on /admin/alerts
-                    snap["alerts"] = alerts.summary()
-                self._reply_json(200, snap)
+            body, content_type = metrics_reply(tel, fmt)
+            self.send_response(200)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif parsed.path == "/admin/alerts":
-            alerts = getattr(tel, "alerts", None)
-            if alerts is None:
-                self._reply_json(200, {"alerts": "disabled"})
-            else:
-                self._reply_json(200, {"alerts": alerts.states()})
+            self._reply_json(200, alerts_reply(tel))
         elif parsed.path == "/trace":
-            payload = tel.trace.payload()
-            payload["anchor"] = tel.trace.anchor()
-            payload["role"] = self.server.role
-            self._reply_json(200, payload)
+            self._reply_json(200, trace_reply(tel, self.server.role))
         else:
             self._reply_json(
                 404, {"error": "not_found", "message": parsed.path}

@@ -1,0 +1,734 @@
+"""Trainer-fleet observability plane (ISSUE 15 / docs/OBSERVABILITY.md
+"Training fleet"): the srt_training_* dynamics-histogram families'
+Prometheus golden grammar (cumulative _bucket/+Inf==_count, worker
+label, exactly-summing buckets across two fake workers), the fake-clock
+fleet divergence-detector matrix (outlier fires, uniform-slow fleet does
+not, no-signal on a just-joined worker), fleet-aware ``telemetry
+summarize`` + the markdown run report, ``collect-trace``'s positional
+trainer-fleet endpoints, and the ``telemetry top`` fleet columns. The
+real 2-worker acceptance runs live in tests/test_training_fleet.py
+(``make train-fleet-obs`` runs both)."""
+
+import json
+import math
+import re
+import socket
+
+import numpy as np
+import pytest
+
+from spacy_ray_tpu.training.prometheus import render_snapshot
+from spacy_ray_tpu.training.telemetry import (
+    FLEET_DYNAMICS_HISTOGRAMS,
+    FleetDivergenceDetector,
+    MetricsRegistry,
+    STALENESS_BUCKETS,
+    TraceBuffer,
+    summarize_metrics,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ----------------------------------------------------------------------
+# Prometheus golden grammar for the dynamics families
+# ----------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\n]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"\n]*")*\})?'
+    r" (-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$"
+)
+_TYPE_RE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram|summary)$"
+)
+
+
+def _assert_valid_exposition(text):
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("# "):
+            assert not line or _TYPE_RE.match(line), line
+            continue
+        assert _SAMPLE_RE.match(line), f"invalid exposition line: {line!r}"
+
+
+def _fake_worker_registry(worker, staleness_obs, phase_obs):
+    """Drive the SAME instruments the fleet worker/owner construct."""
+    reg = MetricsRegistry()
+    st = reg.histogram(
+        "staleness", buckets=FLEET_DYNAMICS_HISTOGRAMS["staleness"]
+    )
+    for lag in staleness_obs:
+        st.observe(float(lag))
+    qw = reg.histogram(
+        "quorum_wait_seconds",
+        buckets=FLEET_DYNAMICS_HISTOGRAMS["quorum_wait_seconds"],
+    )
+    ap = reg.histogram(
+        "apply_seconds",
+        buckets=FLEET_DYNAMICS_HISTOGRAMS["apply_seconds"],
+    )
+    for _ in staleness_obs:
+        qw.observe(0.01 * (worker + 1))
+        ap.observe(0.002 * (worker + 1))
+    for name, values in phase_obs.items():
+        h = reg.histogram(
+            f"phase_{name}_seconds",
+            buckets=FLEET_DYNAMICS_HISTOGRAMS[f"phase_{name}_seconds"],
+        )
+        for v in values:
+            h.observe(v)
+    reg.counter("grad_received").inc(len(staleness_obs))
+    reg.gauge("fleet_worker").set(worker)
+    return reg
+
+
+def test_dynamics_families_golden_grammar_with_worker_label():
+    reg = _fake_worker_registry(
+        1, [0, 0, 1, 2], {"grad": [0.1, 0.2], "apply_wait": [0.01]}
+    )
+    text = render_snapshot(
+        reg.snapshot(), prefix="srt_training", labels={"worker": "1"}
+    )
+    _assert_valid_exposition(text)
+    # every dynamics family renders as a REAL histogram with the worker
+    # label on every series
+    for family in (
+        "srt_training_staleness",
+        "srt_training_quorum_wait_seconds",
+        "srt_training_apply_seconds",
+        "srt_training_phase_grad_seconds",
+        "srt_training_phase_apply_wait_seconds",
+    ):
+        assert f"# TYPE {family} histogram" in text, family
+        buckets = re.findall(
+            rf'^{family}_bucket{{le="([^"]+)",worker="1"}} (\d+)$',
+            text, re.M,
+        )
+        assert buckets, family
+        assert buckets[-1][0] == "+Inf"
+        counts = [int(c) for _, c in buckets]
+        assert counts == sorted(counts), f"{family} not cumulative"
+        count = re.search(
+            rf'^{family}_count{{worker="1"}} (\d+)$', text, re.M
+        )
+        assert count and int(count.group(1)) == counts[-1], (
+            f"{family}: +Inf bucket must equal _count"
+        )
+    # staleness uses the shared STALENESS table: all bounds + +Inf
+    st_buckets = re.findall(
+        r'^srt_training_staleness_bucket\{le="([^"]+)",worker="1"\} \d+$',
+        text, re.M,
+    )
+    assert len(st_buckets) == len(STALENESS_BUCKETS) + 1
+
+
+def test_dynamics_buckets_sum_exactly_across_workers():
+    """Two fake workers' _bucket series, summed per le, equal one
+    registry that observed the union — the shared-bucket-table
+    guarantee a Prometheus sum() query relies on."""
+    obs0, obs1 = [0, 0, 1], [0, 2, 3, 8]
+    reg0 = _fake_worker_registry(0, obs0, {"grad": [0.1]})
+    reg1 = _fake_worker_registry(1, obs1, {"grad": [0.3, 0.9]})
+    union = _fake_worker_registry(2, obs0 + obs1, {"grad": [0.1, 0.3, 0.9]})
+
+    def buckets(reg, name):
+        snap = reg.snapshot()["histograms"][name]
+        return {float(le): int(c) for le, c in snap["buckets"]}
+
+    for name in ("staleness", "phase_grad_seconds"):
+        b0, b1 = buckets(reg0, name), buckets(reg1, name)
+        bu = buckets(union, name)
+        assert set(b0) == set(b1) == set(bu)  # shared table
+        for le in bu:
+            assert b0[le] + b1[le] == bu[le], (name, le)
+
+
+def test_owner_state_populates_dynamics_histograms():
+    from spacy_ray_tpu.training.fleet.peer import FleetCounters, OwnerState
+
+    reg = MetricsRegistry()
+    trace = TraceBuffer()
+    counters = FleetCounters(registry=reg)
+    owner = OwnerState(
+        worker_id=0, n_workers=3, quorum=2, max_staleness=2,
+        apply_fn=lambda p, s, g: ({"x": p["x"] + g["x"]}, s),
+        slice_params={"x": np.zeros(4, np.float32)}, opt_state={},
+        counters=counters, registry=reg, trace=trace,
+    )
+    g = {"x": np.ones(4, np.float32)}
+    owner.submit(1, 0, g)
+    owner.submit(2, 0, g)          # quorum -> apply, version 1
+    owner.submit(1, 0, g)          # lag 1 (bounded staleness), buffered
+    owner.submit(2, 1, g)          # quorum -> apply, version 2
+    snap = reg.snapshot()["histograms"]
+    st = snap["staleness"]
+    assert st["count"] == 4
+    # lags observed: 0,0,1,0 -> cumulative le=0 is 3, le=1 is 4
+    as_map = {le: c for le, c in st["buckets"]}
+    assert as_map[0.0] == 3 and as_map[1.0] == 4
+    assert snap["apply_seconds"]["count"] == 2
+    assert snap["quorum_wait_seconds"]["count"] == 2
+    names = [e.get("name") for e in trace.payload()["traceEvents"]]
+    assert names.count("grad_apply") == 2
+    # zero-telemetry twin: no registry/trace -> no histograms, no spans
+    owner_off = OwnerState(
+        worker_id=0, n_workers=3, quorum=2, max_staleness=2,
+        apply_fn=lambda p, s, g: ({"x": p["x"] + g["x"]}, s),
+        slice_params={"x": np.zeros(4, np.float32)}, opt_state={},
+        counters=FleetCounters(),
+    )
+    assert owner_off._staleness_hist is None
+    assert owner_off.trace is None
+
+
+# ----------------------------------------------------------------------
+# Fleet divergence detector: the fake-clock matrix
+# ----------------------------------------------------------------------
+
+
+def _driven_detector(**kw):
+    clock = FakeClock()
+    fired = []
+    det = FleetDivergenceDetector(
+        lambda event, message, **fields: fired.append(
+            {"event": event, "message": message, **fields}
+        ),
+        clock=clock,
+        **kw,
+    )
+    return det, fired, clock
+
+
+def _poll(det, clock, rows, dt=10.0):
+    clock.t += dt
+    return det.observe(rows)
+
+
+def _row(loss, received=0, discarded=0, nonfinite=0):
+    return {
+        "loss": loss, "received": received, "discarded": discarded,
+        "loss_nonfinite": nonfinite,
+    }
+
+
+def test_divergence_loss_outlier_fires_and_names_worker():
+    det, fired, clock = _driven_detector()
+    for _ in range(4):
+        _poll(det, clock, {0: _row(1.0), 1: _row(1.1), 2: _row(0.9)})
+    assert not fired
+    for _ in range(2):
+        _poll(det, clock, {0: _row(1.0), 1: _row(9.0), 2: _row(0.9)})
+    assert [f["worker"] for f in fired] == [1]
+    assert fired[0]["mode"] == "loss-outlier"
+    assert "worker 1" in fired[0]["message"]
+
+
+def test_divergence_uniform_slow_fleet_stays_quiet():
+    """Every worker's loss rising TOGETHER is a fleet-wide condition
+    (bad data, bad LR), not one worker diverging — the peer-median
+    comparison must stay silent."""
+    det, fired, clock = _driven_detector()
+    for i in range(12):
+        _poll(det, clock, {
+            w: _row(1.0 * (1 + i), received=8 * (i + 1)) for w in range(3)
+        })
+    assert not fired
+
+
+def test_divergence_no_signal_on_just_joined_worker():
+    det, fired, clock = _driven_detector(min_polls=3, confirm_polls=2)
+    for _ in range(6):
+        _poll(det, clock, {0: _row(1.0), 1: _row(1.1)})
+    # worker 2 joins hot (a restarted worker's warmup loss IS high) —
+    # it must accrue min_polls before being judged
+    _poll(det, clock, {0: _row(1.0), 1: _row(1.1), 2: _row(50.0)})
+    _poll(det, clock, {0: _row(1.0), 1: _row(1.1), 2: _row(50.0)})
+    assert not fired
+    # once seasoned AND still an outlier, it fires
+    _poll(det, clock, {0: _row(1.0), 1: _row(1.1), 2: _row(50.0)})
+    _poll(det, clock, {0: _row(1.0), 1: _row(1.1), 2: _row(50.0)})
+    assert [f["worker"] for f in fired] == [2]
+
+
+def test_divergence_nan_fires_immediately():
+    det, fired, clock = _driven_detector()
+    _poll(det, clock, {0: _row(1.0), 1: _row(1.0)})
+    _poll(det, clock, {0: _row(1.0), 1: _row(None, nonfinite=2)})
+    assert [(f["worker"], f["mode"]) for f in fired] == [(1, "nan")]
+
+
+def test_divergence_nan_before_first_poll_still_fires():
+    """NaN steps that all land BEFORE the watch's first scrape of a
+    worker (a fast fault inside the first poll interval) must not be
+    baselined away as that worker's 'normal'."""
+    det, fired, clock = _driven_detector()
+    _poll(det, clock, {0: _row(1.0), 1: _row(None, nonfinite=3)})
+    assert [(f["worker"], f["mode"]) for f in fired] == [(1, "nan")]
+
+
+def test_divergence_discard_outlier_fires():
+    det, fired, clock = _driven_detector()
+    rows = lambda d1: {
+        0: _row(1.0, received=40, discarded=0),
+        1: _row(1.0, received=40, discarded=d1),
+        2: _row(1.0, received=40, discarded=0),
+    }
+    acc = 0
+    for i in range(4):
+        _poll(det, clock, rows(0))
+    for i in range(3):
+        acc += 30
+        clock.t += 10.0
+        det.observe({
+            0: {"loss": 1.0, "received": 40 * (5 + i), "discarded": 0,
+                "loss_nonfinite": 0},
+            1: {"loss": 1.0, "received": 40 * (5 + i), "discarded": acc,
+                "loss_nonfinite": 0},
+            2: {"loss": 1.0, "received": 40 * (5 + i), "discarded": 0,
+                "loss_nonfinite": 0},
+        })
+    assert any(
+        f["worker"] == 1 and f["mode"] == "discard-outlier" for f in fired
+    ), fired
+
+
+def test_divergence_rearm_suppresses_storm():
+    det, fired, clock = _driven_detector(rearm_s=120.0)
+    for _ in range(10):
+        _poll(det, clock, {0: _row(1.0), 1: _row(9.0), 2: _row(0.9)})
+    assert len([f for f in fired if f["mode"] == "loss-outlier"]) == 1
+    # past the rearm window it beats again
+    clock.t += 200.0
+    for _ in range(3):
+        _poll(det, clock, {0: _row(1.0), 1: _row(9.0), 2: _row(0.9)})
+    assert len([f for f in fired if f["mode"] == "loss-outlier"]) == 2
+
+
+# ----------------------------------------------------------------------
+# The fleet-worker-diverging alert rule
+# ----------------------------------------------------------------------
+
+
+def test_fleet_worker_diverging_rule_fires_early_and_resolves():
+    """partial=True: a divergence flag in a run's FIRST minutes (long
+    before 600s of history exists) must page — and the rule resolves
+    once the flag ages out of the trailing window."""
+    from spacy_ray_tpu.alerting import AlertEngine, default_training_rules
+
+    clock = FakeClock()
+    eng = AlertEngine(
+        default_training_rules(fleet=True), clock=clock, source="trainer"
+    )
+
+    def snap(flags, steps):
+        return {"counters": {
+            "divergence_flags": flags, "steps": steps,
+            "grad_pushed": steps, "grad_received": steps,
+            "grad_discarded": 0,
+        }}
+
+    clock.t = 5.0
+    eng.evaluate(snap(0, 1))
+    clock.t = 10.0
+    eng.evaluate(snap(1, 2))  # 10s into the run: flag raised
+    states = {s["alert"]: s for s in eng.states()}
+    assert states["fleet-worker-diverging"]["state"] == "firing"
+    # 700s later with no new flags the trailing-600s delta is 0
+    for i in range(70):
+        clock.t += 10.0
+        eng.evaluate(snap(1, 3 + i))
+    states = {s["alert"]: s for s in eng.states()}
+    assert states["fleet-worker-diverging"]["state"] in (
+        "resolved", "inactive"
+    )
+
+
+# ----------------------------------------------------------------------
+# Fleet-aware summarize + run report (synthetic run dir)
+# ----------------------------------------------------------------------
+
+
+def _synth_run_dir(tmp_path, n=2, with_nan=False):
+    run = tmp_path / "out"
+    for k in range(n):
+        ledger = {
+            "worker": k, "steps": 20, "words_seen": 4000 + 100 * k,
+            "seconds": 10.0 + k, "interrupted": False,
+            "resumed_from": None, "n_workers": n, "quorum": n - 1,
+            "max_staleness": 1, "version": 20,
+            "counters": {
+                "grad_pushed": 20, "grad_received": 20,
+                "grad_applied": 18, "grad_discarded": 2,
+                "push_failed": 0, "pull_failed": 0,
+                "apply_wait_timeouts": 0, "pull_wait_timeouts": 0,
+                "applies": 18,
+            },
+            "phases": {"data": 1.0, "pull": 0.5, "grad": 6.0,
+                       "push": 0.5, "apply_wait": 2.0},
+        }
+        run.mkdir(parents=True, exist_ok=True)
+        (run / f"fleet-worker-{k}.json").write_text(
+            json.dumps(ledger), encoding="utf8"
+        )
+        mdir = run / "metrics" / f"fleet-worker-{k}"
+        mdir.mkdir(parents=True)
+        rows = []
+        for s in range(1, 21):
+            loss = 5.0 / s + 0.1 * k
+            if with_nan and k == 1 and s == 10:
+                loss = float("nan")
+            rows.append({
+                "kind": "step", "step": s, "epoch": 0, "t": 0.1 * s,
+                "step_seconds": 0.1, "words": 200,
+                # the sanitized on-disk form of a NaN loss is the string
+                "loss": "nan" if math.isnan(loss) else loss,
+            })
+        if with_nan and k == 0:
+            rows.append({
+                "kind": "anomaly", "anomaly": "fleet-divergence",
+                "message": "fleet worker 1 is training on non-finite "
+                           "losses", "worker": 1, "mode": "nan", "t": 1.0,
+            })
+        rows.append({
+            "kind": "fleet", "worker": k, "n_workers": n,
+            "quorum": n - 1, "max_staleness": 1, "version": 20,
+            "counters": ledger["counters"], "phases": ledger["phases"],
+            "histograms": {
+                "staleness": {
+                    "count": 18, "sum": 6.0, "min": 0, "max": 1,
+                    "p50": 0, "p95": 1, "p99": 1,
+                    "buckets": [[b, 12 if b == 0 else 18]
+                                for b in STALENESS_BUCKETS],
+                },
+                "quorum_wait_seconds": {
+                    "count": 18, "sum": 0.9, "min": 0.01, "max": 0.2,
+                    "p50": 0.05, "p95": 0.15, "p99": 0.2,
+                },
+                "apply_seconds": {
+                    "count": 18, "sum": 0.36, "min": 0.01, "max": 0.04,
+                    "p50": 0.02, "p95": 0.03, "p99": 0.04,
+                },
+            },
+        })
+        (mdir / "metrics.jsonl").write_text(
+            "\n".join(json.dumps(r) for r in rows) + "\n", encoding="utf8"
+        )
+        if with_nan and k == 0:
+            (mdir / "alerts.jsonl").write_text(
+                json.dumps({
+                    "kind": "alert", "alert": "fleet-worker-diverging",
+                    "severity": "page", "from": "pending", "to": "firing",
+                    "value": 1.0, "detail": "divergence_flags moved",
+                    "unix_time": 1700000000.0, "source": "trainer",
+                }) + "\n", encoding="utf8",
+            )
+    return run
+
+
+def test_summarize_fleet_run_dir(tmp_path):
+    run = _synth_run_dir(tmp_path)
+    text = summarize_metrics(run)
+    assert "fleet run dir" in text
+    assert "workers: 2" in text
+    assert "worker 0:" in text and "worker 1:" in text
+    assert "apply-wait" in text
+    # the per-worker metrics files are digested too (fleet section)
+    assert "trainer fleet: 2 worker(s)" in text
+    assert "staleness (accepted pushes): n=18" in text
+
+
+def test_summarize_fleet_metrics_file(tmp_path):
+    run = _synth_run_dir(tmp_path)
+    text = summarize_metrics(
+        run / "metrics" / "fleet-worker-0" / "metrics.jsonl"
+    )
+    assert "trainer fleet" in text
+    assert "phases:" in text
+    assert "quorum-wait p50" in text
+
+
+def test_summarize_dir_without_fleet_falls_back_to_metrics_jsonl(tmp_path):
+    d = tmp_path / "plainrun"
+    d.mkdir()
+    (d / "metrics.jsonl").write_text(
+        json.dumps({"kind": "step", "step": 1, "epoch": 0, "t": 0.1,
+                    "step_seconds": 0.1, "words": 10}) + "\n",
+        encoding="utf8",
+    )
+    assert "steps: 1" in summarize_metrics(d)
+    with pytest.raises(OSError):
+        summarize_metrics(tmp_path / "plainrun" / "nope-file")
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError):
+        summarize_metrics(empty)
+
+
+def test_run_report_sections(tmp_path):
+    from spacy_ray_tpu.training.report import build_run_report
+
+    run = _synth_run_dir(tmp_path, with_nan=True)
+    report = build_run_report(run)
+    assert report.startswith("# Training run report")
+    assert "## Per-worker summary" in report
+    assert "## Phase share" in report
+    assert "## Per-worker loss trajectories" in report
+    assert "- worker 0" in report and "- worker 1" in report
+    assert "1 non-finite" in report  # worker 1's NaN step is named
+    assert "## Staleness histogram" in report
+    # the cross-worker total column sums the shared-table buckets
+    assert "| 0 | 12 | 12 | 24 |" in report
+    assert "## Quorum-wait & apply timing" in report
+    assert "## Alert & anomaly timeline" in report
+    assert "fleet-worker-diverging" in report
+    assert "fleet-divergence" in report
+
+
+def test_run_report_raises_on_empty_dir(tmp_path):
+    from spacy_ray_tpu.training.report import build_run_report
+
+    empty = tmp_path / "nothing"
+    empty.mkdir()
+    with pytest.raises(ValueError):
+        build_run_report(empty)
+
+
+# ----------------------------------------------------------------------
+# collect-trace: positional trainer-fleet endpoints
+# ----------------------------------------------------------------------
+
+
+def test_fleet_worker_urls():
+    from spacy_ray_tpu.serving.tracecollect import fleet_worker_urls
+
+    assert fleet_worker_urls(47200, 3) == [
+        "http://127.0.0.1:47200",
+        "http://127.0.0.1:47201",
+        "http://127.0.0.1:47202",
+    ]
+    assert fleet_worker_urls(9000, 1, host="10.0.0.5") == [
+        "http://10.0.0.5:9000"
+    ]
+    with pytest.raises(ValueError):
+        fleet_worker_urls(9000, 0)
+
+
+def test_collect_trace_cli_requires_some_endpoint(capsys):
+    from spacy_ray_tpu.cli import telemetry_command
+
+    with pytest.raises(SystemExit):
+        telemetry_command(["collect-trace", "--out", "/tmp/x.json"])
+    with pytest.raises(SystemExit):
+        telemetry_command([
+            "collect-trace", "--fleet-base-port", "47200",
+            "--out", "/tmp/x.json",
+        ])  # --workers missing
+
+
+def test_collect_trace_merges_two_peer_servers(tmp_path):
+    """Two live PeerServer endpoints (each with its own Telemetry and
+    its own clock anchor) merge into ONE timeline with two process
+    tracks carrying the owner-side grad_apply spans."""
+    from spacy_ray_tpu.serving.tracecollect import collect_fleet_traces
+    from spacy_ray_tpu.training.fleet.peer import FleetCounters, OwnerState, PeerServer
+    from spacy_ray_tpu.training.telemetry import Telemetry
+
+    servers, urls = [], []
+    try:
+        for k in range(2):
+            tel = Telemetry(
+                tmp_path / f"fleet-worker-{k}", process_index=k,
+                alerting=False, anomaly_detection=False,
+            )
+            counters = FleetCounters(registry=tel.registry)
+            owner = OwnerState(
+                worker_id=k, n_workers=2, quorum=1, max_staleness=1,
+                apply_fn=lambda p, s, g: ({"x": p["x"] + g["x"]}, s),
+                slice_params={"x": np.zeros(2, np.float32)},
+                opt_state={}, counters=counters,
+                registry=tel.registry, trace=tel.trace,
+            )
+            owner.submit(1 - k, 0, {"x": np.ones(2, np.float32)})
+            server = PeerServer(
+                owner, worker_id=k, layout_signature="sig",
+                counters=counters, tel=tel,
+            )
+            host, port = server.start()
+            servers.append((server, tel))
+            urls.append(f"http://{host}:{port}")
+        merged = collect_fleet_traces(urls, discover=True)
+        tracks = [
+            e for e in merged["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        ]
+        assert len(tracks) == 2
+        names = {(e.get("pid"), e.get("name")) for e in merged["traceEvents"]
+                 if e.get("ph") != "M"}
+        pids_with_apply = {
+            pid for pid, name in names if name == "grad_apply"
+        }
+        assert len(pids_with_apply) == 2
+        assert not merged["otherData"]["skipped"]
+        # role-tagged track names (the /healthz role plumbs through)
+        assert all(
+            "fleet-worker" in (t.get("args") or {}).get("name", "")
+            for t in tracks
+        )
+    finally:
+        for server, tel in servers:
+            server.stop()
+            tel.finalize()
+
+
+def test_fetch_json_maps_httpexception_to_oserror():
+    """A peer dying mid-response raises http.client.HTTPException (NOT
+    OSError); fetch_json must surface it as the transport failure every
+    caller already handles — the mid-poll-exit satellite."""
+    from spacy_ray_tpu.serving.tracecollect import fetch_json
+
+    # a listener that closes the connection without sending a status
+    # line provokes BadStatusLine/RemoteDisconnected
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    import threading
+
+    def slam():
+        conn, _ = srv.accept()
+        conn.close()
+
+    t = threading.Thread(target=slam, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(OSError):
+            fetch_json(f"http://127.0.0.1:{port}", "/metrics", timeout_s=5)
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------------------------
+# telemetry top: fleet columns + scrape-failure counting
+# ----------------------------------------------------------------------
+
+
+def _fleet_payload(steps, pushed, received, discarded, wait_sum, grad_sum,
+                   stale_max=1):
+    return {
+        "counters": {"steps": steps, "words": steps * 100,
+                     "grad_pushed": pushed, "grad_received": received,
+                     "grad_discarded": discarded},
+        "gauges": {"fleet_worker": 1, "param_version": steps},
+        "histograms": {
+            "step_seconds": {"p50": 0.01, "p95": 0.02},
+            "staleness": {"count": received, "max": stale_max},
+            "phase_grad_seconds": {"count": steps, "sum": grad_sum},
+            "phase_apply_wait_seconds": {"count": steps, "sum": wait_sum},
+        },
+    }
+
+
+def test_top_fleet_worker_apply_wait_and_staleness_columns():
+    from spacy_ray_tpu.top import TopModel, render
+
+    model = TopModel()
+    model.update(
+        "http://t:1", _fleet_payload(100, 200, 200, 0, 10.0, 30.0), now=100.0
+    )
+    row = model.update(
+        "http://t:1", _fleet_payload(110, 220, 220, 5, 12.0, 36.0),
+        now=110.0,
+    )
+    # deltas: wait 2.0s, grad 6.0s over 10s -> wait share 25%
+    assert row["apply_wait_pct"] == pytest.approx(0.25)
+    assert row["staleness_max"] == 1
+    text = render([row])
+    assert "wait 25%" in text
+    assert "stale-max 1" in text
+
+
+def test_top_counts_scrape_failures_and_survives_fetch_exceptions():
+    import io
+
+    from spacy_ray_tpu.top import TopModel, render, run_top
+
+    model = TopModel()
+    row = model.update("http://t:1", None, now=1.0)
+    row = model.update("http://t:1", None, now=2.0)
+    assert row == {"url": "http://t:1", "kind": "down", "failures": 2}
+    assert "UNREACHABLE (2 failed scrape(s))" in render([row])
+    # a recovered endpoint resets the streak
+    model.update("http://t:1", _fleet_payload(1, 1, 1, 0, 0.1, 0.1), now=3.0)
+    assert model.update("http://t:1", None, now=4.0)["failures"] == 1
+
+    # a fetch that RAISES (worker exited mid-poll: RemoteDisconnected
+    # escapes as a non-OSError) must not break the refresh loop
+    def bomb_fetch(url, timeout_s):
+        raise RuntimeError("connection torn mid-poll")
+
+    out = io.StringIO()
+    rc = run_top(
+        ["http://t:1"], iterations=2, interval_s=0.0, out=out,
+        fetch=bomb_fetch, clock=FakeClock(), sleep=lambda s: None,
+    )
+    assert rc == 0
+    assert "UNREACHABLE" in out.getvalue()
+
+
+# ----------------------------------------------------------------------
+# Telemetry loss streaming (the convergence-watch signal)
+# ----------------------------------------------------------------------
+
+
+def test_step_boundary_loss_streams_and_nan_is_counted(tmp_path):
+    from spacy_ray_tpu.training.telemetry import Telemetry
+
+    clock = FakeClock()
+    tel = Telemetry(
+        tmp_path / "m", clock=clock, alerting=False,
+        anomaly_detection=False,
+    )
+    tel.loop_start()
+    for i in range(1, 4):
+        clock.t += 0.1
+        tel.step_boundary(
+            step=i, epoch=0, n_words=10, steps_run=i, loss=float(i)
+        )
+    clock.t += 0.1
+    tel.step_boundary(
+        step=4, epoch=0, n_words=10, steps_run=4, loss=float("nan")
+    )
+    snap = tel.registry.snapshot()
+    assert snap["histograms"]["loss"]["count"] == 3  # NaN not observed
+    assert snap["counters"]["loss_nonfinite"] == 1
+    tel.finalize()
+    rows = [
+        json.loads(l)
+        for l in (tmp_path / "m" / "metrics.jsonl").read_text(
+            "utf8"
+        ).splitlines()
+    ]
+    losses = [r.get("loss") for r in rows if r["kind"] == "step"]
+    assert losses == [1.0, 2.0, 3.0, "nan"]  # sanitized, still valid JSON
+
+
+def test_telemetry_without_loss_creates_no_loss_instruments(tmp_path):
+    from spacy_ray_tpu.training.telemetry import Telemetry
+
+    tel = Telemetry(
+        tmp_path / "m2", alerting=False, anomaly_detection=False
+    )
+    tel.loop_start()
+    tel.step_boundary(step=1, epoch=0, n_words=10, steps_run=1)
+    snap = tel.registry.snapshot()
+    assert "loss" not in snap["histograms"]
+    assert "loss_nonfinite" not in snap["counters"]
+    tel.finalize()

@@ -805,6 +805,226 @@ def _fleet_cli_cmd(cfg_path, data_dir, out, n, *, steps, quorum, staleness,
     ]
 
 
+def test_fleet_obs_acceptance_subprocess_trace_and_report(
+    tagger_config_text, data_dir, tmp_path
+):
+    """The PR 15 acceptance run: a REAL 2-worker fleet (coordinator + 2
+    worker subprocesses over the CLI, telemetry on). Mid-run,
+    ``telemetry collect-trace --fleet-base-port N --workers 2`` merges
+    both workers' live buffers into ONE Perfetto file with spans on two
+    distinct process tracks — including a grad_push span on one track
+    and an owner-side grad_apply span on the other. After the clean
+    exit, ``telemetry summarize <run-dir>`` digests the fleet layout and
+    ``telemetry report`` renders per-worker loss trajectories, the
+    phase-share table, and a non-empty staleness histogram."""
+    import subprocess
+    import urllib.request
+
+    from spacy_ray_tpu.cli import telemetry_command
+    from spacy_ray_tpu.training.report import build_run_report
+    from spacy_ray_tpu.training.telemetry import summarize_metrics
+
+    cfg_path = tmp_path / "cfg.cfg"
+    cfg_path.write_text(tagger_config_text, encoding="utf8")
+    out = tmp_path / "out"
+    base_port = _free_ports(1)[0]
+    cmd = _fleet_cli_cmd(
+        cfg_path, data_dir, out, 2, steps=16, quorum=2, staleness=1,
+        base_port=base_port,
+        extra=("--metrics-dir", str(out / "metrics")),
+    )
+    coord = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True
+    )
+    trace_path = tmp_path / "fleet-trace.json"
+    try:
+        # wait until BOTH workers are up and have stepped at least twice
+        # (>= 1 push and >= 1 apply each at quorum 2), then collect the
+        # live buffers through the real CLI path
+        deadline = time.monotonic() + 420
+        ready = set()
+        while time.monotonic() < deadline and len(ready) < 2:
+            for k in (0, 1):
+                if k in ready:
+                    continue
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{base_port + k}/metrics",
+                        timeout=2,
+                    ) as r:
+                        payload = json.loads(r.read())
+                except (OSError, ValueError):
+                    continue
+                if (payload.get("counters") or {}).get("steps", 0) >= 2:
+                    ready.add(k)
+            if len(ready) < 2:
+                assert coord.poll() is None, (
+                    "fleet exited before both workers were scrapable: "
+                    + coord.stderr.read()[-2000:]
+                )
+                time.sleep(0.2)
+        assert len(ready) == 2, "workers never reached step 2"
+        rc = telemetry_command([
+            "collect-trace",
+            "--fleet-base-port", str(base_port),
+            "--workers", "2",
+            "--out", str(trace_path),
+        ])
+        assert rc == 0
+        coord_rc = coord.wait(timeout=600)
+        assert coord_rc == 0, coord.stderr.read()[-2000:]
+    finally:
+        if coord.poll() is None:
+            coord.kill()
+            coord.wait(timeout=30)
+    # ONE merged Perfetto file, >= 2 distinct worker process tracks
+    merged = json.loads(trace_path.read_text("utf8"))
+    tracks = {
+        e["pid"]: (e.get("args") or {}).get("name")
+        for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert len(tracks) >= 2, tracks
+    assert all("fleet-worker" in (n or "") for n in tracks.values())
+    spans = [
+        (e.get("pid"), e.get("name"))
+        for e in merged["traceEvents"] if e.get("ph") == "X"
+    ]
+    push_pids = {p for p, n in spans if n == "grad_push"}
+    apply_pids = {p for p, n in spans if n == "grad_apply"}
+    assert push_pids and apply_pids
+    # a push leaving one worker and an apply landing on ANOTHER track
+    assert any(
+        pp != ap for pp in push_pids for ap in apply_pids
+    ), (push_pids, apply_pids)
+    # the fleet-aware offline surfaces on the finished run dir
+    summary = summarize_metrics(out)
+    assert "workers: 2" in summary
+    assert "trainer fleet: 2 worker(s)" in summary
+    report = build_run_report(out)
+    assert "## Per-worker loss trajectories" in report
+    assert "- worker 0" in report and "- worker 1" in report
+    assert "## Phase share" in report
+    assert "## Staleness histogram" in report
+    (tmp_path / "run-report.md").write_text(report, encoding="utf8")
+
+
+def test_fleet_divergence_drill_fires_alert_and_bundle(
+    tagger_config_text, data_dir, tmp_path
+):
+    """Forced-divergence drill: a FaultPlan NaN poisons ONE worker's
+    per-step loss mid-run. The lead's convergence watch flags that
+    worker (mode "nan"), the fleet-worker-diverging alert fires, and an
+    incident bundle naming the worker lands in the incidents dir."""
+    from spacy_ray_tpu.training.resilience import FaultPlan
+
+    out = tmp_path / "out"
+    incidents = tmp_path / "incidents"
+    cfg = _config(
+        tagger_config_text, data_dir,
+        **{
+            "training.max_steps": 24,
+            # no mid-run eval: the drill isolates the WATCH chain (the
+            # eval-boundary nan-loss detector is PR 3's, already tested)
+            "training.eval_frequency": 50,
+            "training.incident_dir": str(incidents),
+        },
+    )
+    results = _run_thread_fleet(
+        cfg, out, 2, quorum=1, staleness=1,
+        metrics_dir=out / "metrics",
+        fault_plan=FaultPlan([("step", 6, "nan")]),
+        watch_interval_s=0.2, alert_interval_s=0.2,
+    )
+    assert set(results) == {0, 1}
+    lead_rows = [
+        json.loads(l)
+        for l in (out / "metrics" / "fleet-worker-0" / "metrics.jsonl")
+        .read_text("utf8").splitlines()
+    ]
+    flags = [
+        r for r in lead_rows
+        if r.get("kind") == "anomaly"
+        and r.get("anomaly") == "fleet-divergence"
+    ]
+    assert flags, "the divergence watch never flagged the NaN worker"
+    named = int(flags[0]["worker"])
+    assert flags[0]["mode"] == "nan"
+    assert f"worker {named}" in flags[0]["message"]
+    # the named worker really is the one that trained on the NaN
+    named_rows = [
+        json.loads(l)
+        for l in (
+            out / "metrics" / f"fleet-worker-{named}" / "metrics.jsonl"
+        ).read_text("utf8").splitlines()
+    ]
+    assert any(
+        r.get("kind") == "step" and r.get("loss") == "nan"
+        for r in named_rows
+    )
+    # the alert fired on the lead's engine (alerts.jsonl transition row)
+    alert_rows = [
+        json.loads(l)
+        for l in (out / "metrics" / "fleet-worker-0" / "alerts.jsonl")
+        .read_text("utf8").splitlines()
+    ]
+    assert any(
+        r.get("alert") == "fleet-worker-diverging"
+        and r.get("to") == "firing"
+        for r in alert_rows
+    ), alert_rows
+    # the incident bundle names the worker
+    bundles = [
+        d for d in incidents.iterdir()
+        if d.is_dir() and "fleet-divergence" in d.name
+    ]
+    assert bundles, list(incidents.iterdir())
+    inc = json.loads((bundles[0] / "incident.json").read_text("utf8"))
+    assert inc["worker"] == named
+    assert f"worker {named}" in inc["reason"]
+    from spacy_ray_tpu.incidents import render_postmortem
+
+    rendered = render_postmortem(bundles[0])
+    assert f"worker={named}" in rendered
+
+
+def test_fleet_obs_acceptance_zero_telemetry_guard(
+    tagger_config_text, data_dir, tmp_path, monkeypatch
+):
+    """A fleet worker with telemetry off constructs NO observability
+    objects — no registry, no trace buffer, no detectors, no alert
+    engine, no recorder (booby-trapped constructors prove it) — while
+    the ledger counters and the peer plane keep working."""
+    from spacy_ray_tpu import alerting as alerting_mod
+    from spacy_ray_tpu import incidents as incidents_mod
+    from spacy_ray_tpu.training import telemetry as telemetry_mod
+
+    def _boom(*a, **k):
+        raise AssertionError(
+            "telemetry constructed on the fleet's disabled path"
+        )
+
+    monkeypatch.setattr(telemetry_mod.Telemetry, "__init__", _boom)
+    monkeypatch.setattr(telemetry_mod.MetricsRegistry, "__init__", _boom)
+    monkeypatch.setattr(telemetry_mod.TraceBuffer, "__init__", _boom)
+    monkeypatch.setattr(telemetry_mod.AnomalyDetectors, "__init__", _boom)
+    monkeypatch.setattr(
+        telemetry_mod.FleetDivergenceDetector, "__init__", _boom
+    )
+    monkeypatch.setattr(alerting_mod.AlertEngine, "__init__", _boom)
+    monkeypatch.setattr(incidents_mod.FlightRecorder, "__init__", _boom)
+    cfg = _config(
+        tagger_config_text, data_dir,
+        **{"training.max_steps": 3, "training.eval_frequency": 3},
+    )
+    results = _run_thread_fleet(
+        cfg, tmp_path / "out", 2, quorum=1, staleness=1, metrics_dir=None
+    )
+    for r in results.values():
+        assert r.final_step == 3
+        assert r.fleet["counters"]["grad_received"] >= 1
+
+
 @pytest.mark.slow
 def test_fleet_cli_subprocess_run(tagger_config_text, data_dir, tmp_path):
     """The real thing: coordinator + 2 worker PROCESSES over the CLI;
