@@ -57,18 +57,22 @@ live:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_live.py -q -m "not slow"
 	JAX_PLATFORMS=cpu python bench.py --serving --swap
 
-# asynchronous trainer fleet (docs/TUNING.md §19, RESILIENCE.md "Trainer
-# fleet crash semantics"): ownership/wire/quorum/staleness units + the
+# asynchronous trainer fleet (docs/TUNING.md §19–20, RESILIENCE.md
+# "Trainer fleet crash semantics"): ownership/wire/quorum/staleness
+# units + the wire-compression suite (int8/bf16 codecs, error-feedback
+# telescoping + ablation, delta-pull chain, mixed-codec interop) + the
 # thread-driven 2-worker integration and v2 owner-part round trip, then
 # the subprocess drills — the real CLI fleet, the SIGKILL
 # crash-and-rejoin recovery, and the bounded-staleness convergence
-# acceptance (S∈{0,1,2} vs the synchronous loop) — then the 1/2/4-worker
-# pinned scaling spec (records land in BENCH_SESSION.jsonl with the
-# per-phase breakdown and the discard-counter ledger)
+# acceptance (S∈{0,1,2} vs the synchronous loop, compression ON) — then
+# the 1/2/4-worker pinned scaling spec and the f32-vs-compressed wire
+# A/B (records land in BENCH_SESSION.jsonl with the per-phase
+# breakdown, the discard-counter ledger, and the wire-byte columns)
 train-fleet:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_training_fleet.py -q -m "not slow"
+	JAX_PLATFORMS=cpu python -m pytest tests/test_training_fleet.py tests/test_fleet_wire.py -q -m "not slow"
 	JAX_PLATFORMS=cpu python -m pytest tests/test_training_fleet.py -q -m slow
 	JAX_PLATFORMS=cpu python bench.py --training-fleet
+	JAX_PLATFORMS=cpu python bench.py --fleet-wire-ab
 
 # trainer-fleet observability plane (docs/OBSERVABILITY.md "Training
 # fleet"): srt_training_* dynamics-histogram golden grammar +

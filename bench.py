@@ -3079,7 +3079,9 @@ def run_training_fleet(
     quorum: int = 0,
     max_staleness: int = 1,
     base_port: int = 47340,
-) -> None:
+    grad_compression: str = "auto",
+    param_delta_window: int = 4,
+) -> List[Dict[str, Any]]:
     """``--training-fleet``: the async trainer-fleet scaling spec — the
     REAL ``train --fleet-workers N`` path (coordinator → N pinned worker
     subprocesses exchanging gradients/params over HTTP with quorum apply
@@ -3096,7 +3098,14 @@ def run_training_fleet(
     the same cores — the record stamps ``cores_available`` and
     ``contended: true`` so a flat scaling curve reads as a capability
     limit of the host, not of the fleet (the same honest-refusal
-    discipline as the TPU-gated kernel claims)."""
+    discipline as the TPU-gated kernel claims).
+
+    ``grad_compression`` / ``param_delta_window`` flow through to the
+    workers; each record carries the wire-byte columns (pushed/pulled
+    bytes per step/version, actual vs f32-equivalent) and the RESOLVED
+    codec from the worker ledgers — ``--fleet-wire-ab`` runs this twice
+    (f32 full-frame arm vs compressed arm) and records the ratio.
+    Returns the appended records (skips excluded)."""
     import shutil
     import subprocess
     import sys
@@ -3112,6 +3121,7 @@ def run_training_fleet(
 
     cores = sorted(os.sched_getaffinity(0))
     baseline_wps: Optional[float] = None
+    records: List[Dict[str, Any]] = []
     for idx, n in enumerate(worker_counts):
         out_dir = tmpdir / f"out-w{n}"
         cmd = [
@@ -3121,6 +3131,8 @@ def run_training_fleet(
             "--quorum", str(quorum),
             "--max-staleness", str(max_staleness),
             "--fleet-base-port", str(base_port + idx * 16),
+            "--grad-compression", str(grad_compression),
+            "--param-delta-window", str(param_delta_window),
             "--cpu-cores", "auto",
             "--output", str(out_dir),
             # telemetry on: the dynamics histograms (staleness, quorum
@@ -3178,6 +3190,33 @@ def run_training_fleet(
                 phases[p] = round(phases.get(p, 0.0) + float(v), 3)
             for c, v in (l.get("counters") or {}).items():
                 counters[c] = counters.get(c, 0) + int(v)
+        # the wire-byte columns: fleet-wide bytes actually pushed per
+        # worker step and pulled per version bump, next to their
+        # f32-full-frame equivalents (the _uncompressed twin counters)
+        # so the record carries the measured compression ratio
+        total_steps = sum(int(l.get("steps") or 0) for l in ledgers)
+        total_applies = int(counters.get("applies") or 0)
+        push_b = int(counters.get("wire_push_bytes") or 0)
+        push_raw = int(counters.get("wire_push_bytes_uncompressed") or 0)
+        pull_b = int(counters.get("wire_pull_bytes") or 0)
+        pull_raw = int(counters.get("wire_pull_bytes_uncompressed") or 0)
+        wire = {
+            "bytes_pushed_per_step": (
+                round(push_b / total_steps, 1) if total_steps else None
+            ),
+            "bytes_pushed_per_step_uncompressed": (
+                round(push_raw / total_steps, 1) if total_steps else None
+            ),
+            "bytes_pulled_per_version": (
+                round(pull_b / total_applies, 1) if total_applies else None
+            ),
+            "bytes_pulled_per_version_uncompressed": (
+                round(pull_raw / total_applies, 1) if total_applies else None
+            ),
+            "push_ratio": round(push_raw / push_b, 2) if push_b else None,
+            "pull_ratio": round(pull_raw / pull_b, 2) if pull_b else None,
+        }
+        resolved_codec = ledgers[0].get("grad_compression")
         # the fleet-wide staleness histogram (exact per-le sums on the
         # shared bucket table — the measured bounded-staleness evidence
         # TUNING.md §19 reads when setting --max-staleness/--quorum) and
@@ -3228,6 +3267,9 @@ def run_training_fleet(
             "wall_seconds": round(wall, 2),
             "phase_seconds": phases,
             "counters": counters,
+            "grad_compression": resolved_codec,
+            "param_delta_window": ledgers[0].get("param_delta_window"),
+            "wire": wire,
             "staleness": staleness,
             # the report itself lives in the (ephemeral) run dir — the
             # record notes that the path produced one, not a dead path
@@ -3242,9 +3284,95 @@ def run_training_fleet(
         }
         _append_session(rec, platform)
         print(json.dumps(rec), flush=True)
+        records.append(rec)
     # outside the loop on purpose: a skipped count must not strand the
     # synthetic corpus, and a crash mid-sweep only leaves a tmpdir
     shutil.rmtree(tmpdir, ignore_errors=True)
+    return records
+
+
+def run_fleet_wire_ab(
+    platform: str,
+    *,
+    steps: int = 120,
+    workers: int = 2,
+    quorum: int = 0,
+    max_staleness: int = 1,
+    base_port: int = 47420,
+) -> None:
+    """A/B the fleet wire compression (ROADMAP item 3 acceptance run):
+    the SAME topology (workers/quorum/staleness/steps) once with the
+    uncompressed f32 wire (``--grad-compression f32`` and delta pulls
+    off) and once with compression on (``auto`` + the default delta
+    window), then one record comparing bytes pushed per step and bytes
+    pulled per version — plus both arms' staleness histograms and
+    discard counters, so the record itself shows the compression did
+    not change the staleness/discard dynamics, only the bytes.
+    """
+    arms: Dict[str, Any] = {}
+    for arm, (codec, window, port) in (
+        ("f32", ("f32", 0, base_port)),
+        ("compressed", ("auto", 4, base_port + 40)),
+    ):
+        recs = run_training_fleet(
+            platform,
+            worker_counts=[int(workers)],
+            steps=int(steps),
+            quorum=int(quorum),
+            max_staleness=int(max_staleness),
+            base_port=int(port),
+            grad_compression=codec,
+            param_delta_window=int(window),
+        )
+        if not recs:
+            print(f"# fleet wire A/B: {arm} arm produced no record, "
+                  "aborting comparison", flush=True)
+            return
+        arms[arm] = recs[0]
+
+    def _side(rec: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "grad_compression": rec.get("grad_compression"),
+            "param_delta_window": rec.get("param_delta_window"),
+            "wire": rec.get("wire"),
+            "words_per_sec": rec.get("value"),
+            "staleness": rec.get("staleness"),
+            "discards": (rec.get("counters") or {}).get(
+                "grad_discarded", 0
+            ),
+            "applies": (rec.get("counters") or {}).get("applies", 0),
+        }
+
+    a, b = arms["f32"], arms["compressed"]
+    wa = a.get("wire") or {}
+    wb = b.get("wire") or {}
+
+    def _reduction(key: str) -> Optional[float]:
+        base, comp = wa.get(key), wb.get(key)
+        if not base or not comp:
+            return None
+        return round(float(base) / float(comp), 2)
+
+    rec = {
+        "name": "fleet_wire_ab",
+        "metric": (
+            f"wire bytes f32 vs compressed ({workers} fleet workers, "
+            f"quorum {quorum}, staleness {max_staleness}, "
+            f"{steps} steps/worker, same topology both arms)"
+        ),
+        # headline: how many x fewer bytes each step pushes
+        "value": _reduction("bytes_pushed_per_step"),
+        "unit": "x fewer push bytes/step",
+        "platform": platform,
+        "workers": int(workers),
+        "steps_per_worker": int(steps),
+        "push_bytes_reduction": _reduction("bytes_pushed_per_step"),
+        "pull_bytes_reduction": _reduction("bytes_pulled_per_version"),
+        "f32": _side(a),
+        "compressed": _side(b),
+    }
+    _append_session(rec, platform)
+    print(json.dumps(rec), flush=True)
 
 
 def _print_headline_summary(
@@ -3530,7 +3658,39 @@ def main() -> None:
         "--fleet-staleness", type=int, default=1,
         help="--training-fleet: max accepted gradient staleness S",
     )
+    parser.add_argument(
+        "--fleet-grad-compression", default="auto",
+        choices=("auto", "f32", "bf16", "int8"),
+        help="--training-fleet: wire codec for gradient pushes "
+             "(TUNING.md §20)",
+    )
+    parser.add_argument(
+        "--fleet-delta-window", type=int, default=4,
+        help="--training-fleet: version-delta param pull window "
+             "(0 = full pulls only)",
+    )
+    parser.add_argument(
+        "--fleet-wire-ab", action="store_true",
+        help="A/B the fleet wire compression: one f32/full-pull arm vs "
+             "one compressed arm at --fleet-workers' first count, same "
+             "topology; the comparison record (bytes pushed/step + "
+             "pulled/version reductions, staleness shape both arms) "
+             "lands in BENCH_SESSION.jsonl",
+    )
     args = parser.parse_args()
+
+    if args.fleet_wire_ab:
+        counts = [
+            int(c) for c in str(args.fleet_workers).split(",") if c.strip()
+        ] or [2]
+        run_fleet_wire_ab(
+            "cpu",
+            steps=int(args.fleet_steps),
+            workers=max(2, counts[0]),
+            quorum=int(args.fleet_quorum),
+            max_staleness=int(args.fleet_staleness),
+        )
+        return
 
     if args.training_fleet:
         # subprocess fan-out (the coordinator children own jax); the
@@ -3546,6 +3706,8 @@ def main() -> None:
             steps=int(args.fleet_steps),
             quorum=int(args.fleet_quorum),
             max_staleness=int(args.fleet_staleness),
+            grad_compression=str(args.fleet_grad_compression),
+            param_delta_window=int(args.fleet_delta_window),
         )
         return
 

@@ -225,6 +225,21 @@ def train_command(argv: List[str]) -> int:
                         "-c core masks cycled per worker ('auto' = "
                         "round-robin over this process's affinity set, "
                         "'' = unpinned)")
+    parser.add_argument("--grad-compression", type=str, default="auto",
+                        dest="grad_compression",
+                        choices=("auto", "f32", "bf16", "int8"),
+                        help="fleet: wire codec for gradient pushes "
+                        "(TUNING.md §20). auto = int8 with error "
+                        "feedback where the convergence suite has run, "
+                        "bf16 elsewhere; per-peer negotiated, so mixed "
+                        "fleets degrade to f32 instead of erroring")
+    parser.add_argument("--param-delta-window", type=int, default=4,
+                        dest="param_delta_window",
+                        help="fleet: owners retain K versions of "
+                        "compressed param deltas so a puller at most K "
+                        "versions behind ships a delta frame instead of "
+                        "its full slice; 0 = full pulls only. Window "
+                        "misses degrade to full pulls (RESILIENCE.md)")
     parser.add_argument("--verbose", "-V", action="store_true")
     args, extra = parser.parse_known_args(argv)
 
@@ -276,6 +291,8 @@ def train_command(argv: List[str]) -> int:
                 if args.fleet_base_port is not None
                 else DEFAULT_FLEET_BASE_PORT
             ),
+            "grad_compression": args.grad_compression,
+            "param_delta_window": args.param_delta_window,
         }
 
     nlp, result = train(
@@ -1146,7 +1163,14 @@ def info_command(argv: List[str]) -> int:
              "from spacy_ray_tpu.parallel.mesh import build_mesh; "
              "m = build_mesh(n_data=len(d)); "
              "print(s(r('auto', n_data=len(d), "
-             "backend=d[0].platform), m))"],
+             "backend=d[0].platform), m)); "
+             # the fleet wire codec resolves the same way on the probed
+             # backend (no compile — pure policy over the committed
+             # convergence evidence, training/fleet/wire.py)
+             "from spacy_ray_tpu.training.fleet.wire import "
+             "resolve_grad_compression as rg; "
+             "gc = rg('auto', d[0].platform); "
+             "print(gc[0] + ' (' + gc[1] + ')')"],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         )
         try:
@@ -1157,6 +1181,8 @@ def info_command(argv: List[str]) -> int:
                 print(f"accelerator      reachable: {platform_name} x{n}")
                 if len(lines) > 1:
                     print(f"update_sharding  auto -> {lines[1].strip()}")
+                if len(lines) > 2:
+                    print(f"grad_compression auto -> {lines[2].strip()}")
                 # the int8 precision-overlay resolution is evidence, not
                 # policy (the probe COMPILES + validates the pallas
                 # matmul on the probed backend) — so it gets its OWN

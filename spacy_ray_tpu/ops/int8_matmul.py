@@ -47,6 +47,8 @@ import jax.numpy as jnp
 __all__ = [
     "quantize_int8",
     "dequantize_int8",
+    "quantize_int8_np",
+    "dequantize_int8_np",
     "reference_int8_matmul",
     "int8_matmul",
     "int8_matmul_enabled",
@@ -92,6 +94,44 @@ def dequantize_int8(q8: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     """``q8 [..., N] int8, scale [N] f32 -> f32`` — the reference
     reconstruction the kernel's in-VMEM dequant must match."""
     return q8.astype(jnp.float32) * scale
+
+
+def quantize_int8_np(arr) -> Tuple["np.ndarray", "np.ndarray"]:
+    """Grad-shaped host-side twin of :func:`quantize_int8` for the
+    trainer fleet's wire compression (training/fleet/wire.py): pure
+    numpy (gradients are already host arrays on the push path — no
+    device round trip), same symmetric per-channel semantics and the
+    same test-pinned bound (per-element error <= scale / 2).
+
+    Shape policy: rank >= 2 quantizes per-channel over the LAST axis
+    (``scale`` shape ``(N,)``, exactly :func:`quantize_int8`); rank <= 1
+    uses ONE per-tensor scale (``scale`` shape ``()``) — a per-element
+    scale on a vector would cost 5 bytes/element against the 4 it
+    replaces. Gradient leaves are any rank, weight matrices rank 2+."""
+    import numpy as np
+
+    a = np.ascontiguousarray(np.asarray(arr, dtype=np.float32))
+    if a.ndim >= 2:
+        reduce_axes = tuple(range(a.ndim - 1))
+        absmax = np.max(np.abs(a), axis=reduce_axes) if a.size else np.zeros(
+            a.shape[-1], np.float32
+        )
+    else:
+        absmax = np.max(np.abs(a)) if a.size else np.float32(0.0)
+    scale = np.maximum(
+        np.asarray(absmax, np.float32) / np.float32(127.0), np.float32(1e-12)
+    ).astype(np.float32)
+    q = np.clip(np.rint(a / scale), -127.0, 127.0).astype(np.int8)
+    return q, scale
+
+
+def dequantize_int8_np(q8, scale) -> "np.ndarray":
+    """Host-side reconstruction twin of :func:`dequantize_int8` —
+    broadcasting covers both the per-channel (rank >= 2) and per-tensor
+    (rank <= 1) scale shapes :func:`quantize_int8_np` emits."""
+    import numpy as np
+
+    return q8.astype(np.float32) * np.asarray(scale, np.float32)
 
 
 def reference_int8_matmul(

@@ -533,6 +533,11 @@ def make_shard_apply(tx: Any, *, donate: bool = True) -> Callable:
     (``applies_updates`` — ops/fused_update.py on the owned slice, as in
     the in-mesh "full" mode) or a plain optax chain. State and params
     are donated: the owner holds exactly one live copy of its shard.
+
+    Wire compression is invisible here: compressed gradient pushes are
+    dequantized to f32 at the wire boundary (fleet/wire.decode_grads)
+    BEFORE the quorum buffer, so this apply always consumes plain f32
+    grad trees — same numerics whatever codec carried them.
     """
     applies_updates = bool(getattr(tx, "applies_updates", False))
 

@@ -204,6 +204,18 @@ class TopModel:
             disc_s = rates.get("grad_discarded")
             if isinstance(recv_s, float) and isinstance(disc_s, float):
                 discard_rate = disc_s / recv_s if recv_s > 0 else 0.0
+            # the wire column: push MB/s actually sent plus the
+            # compression ratio (uncompressed/actual) — same counter-
+            # delta arithmetic, two more monotone series
+            wire_push_bps = rates.get("wire_push_bytes")
+            wire_push_raw_bps = rates.get("wire_push_bytes_uncompressed")
+            wire_ratio = None
+            if (
+                isinstance(wire_push_bps, float)
+                and isinstance(wire_push_raw_bps, float)
+                and wire_push_bps > 0
+            ):
+                wire_ratio = wire_push_raw_bps / wire_push_bps
             return {
                 "url": url,
                 "kind": kind,
@@ -222,6 +234,8 @@ class TopModel:
                 "discard_rate": discard_rate,
                 "apply_wait_pct": apply_wait_pct,
                 "staleness_max": _get(hists, "staleness", "max"),
+                "wire_push_bps": wire_push_bps,
+                "wire_ratio": wire_ratio,
             }
         counters = payload.get("counters") or {}
         rates = self._rates(url, counters, now)
@@ -321,13 +335,20 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 aw_s = f"{aw * 100:.0f}%" if isinstance(aw, float) else "-"
                 sm = row.get("staleness_max")
                 sm_s = f"{int(sm)}" if isinstance(sm, (int, float)) else "-"
+                wb = row.get("wire_push_bps")
+                wb_s = (
+                    f"{wb / 1e6:.2f}MB/s" if isinstance(wb, float) else "-"
+                )
+                wr = row.get("wire_ratio")
+                wr_s = f"{wr:.1f}x" if isinstance(wr, float) else "-"
                 lines.append(
                     f"    ver {_fmt_int(row.get('version'))}  "
                     f"push {_fmt_rate(row.get('push_s'))}  "
                     f"disc {_fmt_rate(row.get('discard_s'))}  "
                     f"disc-rate {dr_s}  "
                     f"wait {aw_s}  "
-                    f"stale-max {sm_s}"
+                    f"stale-max {sm_s}  "
+                    f"wire {wb_s} ({wr_s})"
                 )
             lines.append(
                 f"    anomalies {_fmt_int(row.get('anomalies'))}  "

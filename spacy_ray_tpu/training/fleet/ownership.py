@@ -169,12 +169,20 @@ class OwnershipLayout:
         return tree_from_flat(self.flat_slices(tree, worker))
 
     def merge_flat(
-        self, full: Any, worker: int, flat: Dict[str, np.ndarray]
+        self,
+        full: Any,
+        worker: int,
+        flat: Dict[str, np.ndarray],
+        *,
+        add: bool = False,
     ) -> None:
         """Write ``worker``'s slices back into the full host tree IN
         PLACE (the pull path: refresh non-owned shards from their
-        owner's bytes). Unknown keys and shape mismatches raise — a peer
-        sending a different model is a config error, not data."""
+        owner's bytes). ``add=True`` ACCUMULATES instead of assigning —
+        a delta pull ships ``wire_v - wire_known`` and the puller adds
+        it onto the slice it already holds. Unknown keys and shape
+        mismatches raise — a peer sending a different model is a config
+        error, not data."""
         for key, piece in flat.items():
             ordinal = self._by_key.get(key)
             if ordinal is None:
@@ -196,9 +204,16 @@ class OwnershipLayout:
                         f"shape mismatch for {key!r}: {piece.shape} vs "
                         f"{arr.shape}"
                     )
-                arr[...] = piece
+                if add:
+                    arr[...] += piece
+                else:
+                    arr[...] = piece
             else:
-                arr[tuple(slice(a, b) for a, b in index)] = piece
+                where = tuple(slice(a, b) for a, b in index)
+                if add:
+                    arr[where] += piece
+                else:
+                    arr[where] = piece
 
     def signature(self) -> str:
         """Cheap structural digest (paths + shapes + worker count) every

@@ -35,7 +35,14 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from .ownership import iter_leaves, path_key, tree_from_flat
-from .wire import WireError, decode_arrays, encode_arrays
+from .wire import (
+    WIRE_CODECS,
+    WireError,
+    _compress_leaf,
+    decode_grads,
+    encode_arrays,
+    encode_delta_frame,
+)
 
 logger = logging.getLogger("spacy_ray_tpu.training")
 
@@ -57,6 +64,15 @@ COUNTER_NAMES = (
     "apply_wait_timeouts",  # worker-side: quorum waits that timed out
     "pull_wait_timeouts",   # worker-side: staleness-gate waits that timed out
     "applies",          # owner-side: optimizer applies (version bumps)
+    # wire-byte accounting (the compression ledger): bytes actually on
+    # the wire vs what the same payloads would have cost as PR 14 f32
+    # frames — the _uncompressed twins make the ratio computable from
+    # any scrape. Counted on the SENDING/REQUESTING worker: pushes when
+    # delivered, pulls on a 200 body.
+    "wire_push_bytes",
+    "wire_push_bytes_uncompressed",
+    "wire_pull_bytes",
+    "wire_pull_bytes_uncompressed",
 )
 
 
@@ -122,6 +138,9 @@ class OwnerState:
         clock: Callable[[], float] = time.monotonic,
         registry: Any = None,
         trace: Any = None,
+        delta_window: int = 0,
+        delta_codec: str = "int8",
+        delta_budget_bytes: int = 8 << 20,
     ) -> None:
         if not (1 <= quorum <= n_workers):
             raise ValueError(
@@ -148,6 +167,31 @@ class OwnerState:
             for p, leaf in iter_leaves(slice_params)
         }
         self._encoded: Optional[bytes] = None
+        # version-delta pull state (delta_window=0 disables — the PR 14
+        # full-pull wire). The owner maintains a DETERMINISTIC f32 "wire
+        # chain": wire_v = wire_{v-1} + deq(Q(p_v - wire_{v-1})) — error
+        # feedback on the chain itself, so |wire_v - p_v| stays bounded
+        # by one quantization step and never accumulates. Each apply
+        # stores that version's COMPRESSED piece; a pull from known=k
+        # within the window ships the stacked pieces k+1..v, and every
+        # delta-following puller lands exactly on wire_v regardless of
+        # how many pulls it skipped. Window misses and budget evictions
+        # fall back to a full pull — degrade, never stall.
+        self.delta_window = max(0, int(delta_window))
+        self.delta_codec = str(delta_codec)
+        self.delta_budget_bytes = int(delta_budget_bytes)
+        self._wire_flat: Optional[Dict[str, np.ndarray]] = (
+            {
+                k: np.asarray(v, dtype=np.float32).copy()
+                for k, v in self._host_flat.items()
+            }
+            if self.delta_window > 0
+            else None
+        )
+        # version -> (piece codec, compressed piece arrays, data bytes)
+        self._delta_pieces: Dict[int, Tuple[str, Dict[str, np.ndarray], int]] = {}
+        self._delta_bytes = 0
+        self._delta_cache: Dict[int, bytes] = {}  # known -> assembled frame
         self.apply_seconds = 0.0
         # owner-side dynamics instrumentation (docs/OBSERVABILITY.md
         # "Training fleet"): the staleness of each ACCEPTED push, the
@@ -265,6 +309,8 @@ class OwnerState:
         }
         self._encoded = None
         self.version += 1
+        if self._wire_flat is not None:
+            self._record_delta_locked()
         self.counters.inc("grad_applied", n)
         self.counters.inc("applies")
         self._buffer.clear()
@@ -293,6 +339,32 @@ class OwnerState:
             self.on_version(self.version)
         self._cond.notify_all()
 
+    def _record_delta_locked(self) -> None:
+        """Advance the wire chain past the apply that just bumped
+        ``self.version`` and store that version's compressed piece
+        (changed leaves only — a leaf the apply didn't move costs zero
+        wire bytes; decode treats a missing key as a zero delta)."""
+        assert self._wire_flat is not None
+        piece: Dict[str, np.ndarray] = {}
+        nbytes = 0
+        for key, new in self._host_flat.items():
+            delta = np.asarray(new, dtype=np.float32) - self._wire_flat[key]
+            if not np.any(delta):
+                continue
+            entries, deq = _compress_leaf(self.delta_codec, key, delta)
+            piece.update(entries)
+            self._wire_flat[key] = self._wire_flat[key] + deq
+            nbytes += sum(int(a.nbytes) for a in entries.values())
+        self._delta_pieces[self.version] = (self.delta_codec, piece, nbytes)
+        self._delta_bytes += nbytes
+        self._delta_cache.clear()
+        floor = self.version - self.delta_window
+        for v in sorted(self._delta_pieces):
+            over_budget = self._delta_bytes > self.delta_budget_bytes
+            if v > floor and not (over_budget and v < self.version):
+                break
+            self._delta_bytes -= self._delta_pieces.pop(v)[2]
+
     # -- reader side ---------------------------------------------------
     def current_flat(self) -> Tuple[int, Dict[str, np.ndarray]]:
         """(version, owned slices) — the arrays are the post-apply host
@@ -305,15 +377,58 @@ class OwnerState:
         """Wire payload of the current slices, or ``(version, None)``
         when the caller's ``known`` version is already current. The
         encoding is cached per version (one encode, many pulls)."""
+        version, body, _ = self.encoded_for(known, accept_delta=False)
+        return version, body
+
+    def _full_encoded_locked(self) -> bytes:
+        if self._encoded is None:
+            self._encoded = encode_arrays(
+                {"version": self.version, "worker": self.worker_id},
+                self._host_flat,
+            )
+        return self._encoded
+
+    def encoded_for(
+        self, known: Optional[int], accept_delta: bool = False
+    ) -> Tuple[int, Optional[bytes], str]:
+        """``(version, body, codec)`` for one pull. ``body is None`` =
+        caller is current (204). A delta frame is served only when the
+        caller asked for one (``X-SRT-Accept: delta``), every piece
+        ``known+1..version`` is still retained (window + byte budget),
+        AND the delta is actually smaller than the cached full frame —
+        otherwise the full f32 frame, so a window miss degrades, never
+        stalls. Assembled frames are cached per ``known`` (cleared on
+        every apply; at most ``delta_window`` entries)."""
         with self.lock:
             if known is not None and int(known) == self.version:
-                return self.version, None
-            if self._encoded is None:
-                self._encoded = encode_arrays(
-                    {"version": self.version, "worker": self.worker_id},
-                    self._host_flat,
-                )
-            return self.version, self._encoded
+                return self.version, None, "current"
+            if (
+                accept_delta
+                and self._wire_flat is not None
+                and known is not None
+                and 0 <= self.version - int(known) <= self.delta_window
+            ):
+                k = int(known)
+                needed = range(k + 1, self.version + 1)
+                if all(v in self._delta_pieces for v in needed):
+                    body = self._delta_cache.get(k)
+                    if body is None:
+                        body = encode_delta_frame(
+                            {
+                                "version": self.version,
+                                "worker": self.worker_id,
+                                "base": k,
+                            },
+                            [
+                                (v,) + self._delta_pieces[v][:2]
+                                for v in needed
+                            ],
+                        )
+                        self._delta_cache[k] = body
+                    full = self._full_encoded_locked()
+                    if len(body) < len(full):
+                        return self.version, body, "delta"
+            return self.version, self._full_encoded_locked(), "f32"
 
     def checkpoint_parts(self, writer: Callable[[int, Any, Dict[str, np.ndarray]], Any]) -> Any:
         """Run ``writer(version, opt_state, host_flat)`` under the owner
@@ -389,6 +504,10 @@ class _PeerHandler(BaseHTTPRequestHandler):
                 "worker": srv.worker_id,
                 "version": srv.owner.version,
                 "layout": srv.layout_signature,
+                # wire codecs this build DECODES — pushers negotiate
+                # against this (absent on old peers -> they get f32)
+                "codecs": list(WIRE_CODECS),
+                "delta_window": srv.owner.delta_window,
             }
             if srv.tel is not None:
                 payload["anchor"] = srv.tel.trace.anchor()
@@ -407,7 +526,14 @@ class _PeerHandler(BaseHTTPRequestHandler):
                           "message": f"known={known_s!r} is not an int"}
                 )
                 return
-            version, body = srv.owner.encoded(known)
+            # delta negotiation rides a REQUEST header (an old worker
+            # sends no header and gets the PR 14 full frame); the reply
+            # names what was actually served so the puller never has to
+            # sniff the frame
+            accept = str(self.headers.get("X-SRT-Accept") or "")
+            version, body, codec = srv.owner.encoded_for(
+                known, accept_delta="delta" in accept
+            )
             if body is None:
                 self.send_response(204)
                 self.send_header("X-SRT-Version", str(version))
@@ -416,6 +542,7 @@ class _PeerHandler(BaseHTTPRequestHandler):
             else:
                 self.send_response(200)
                 self.send_header("X-SRT-Version", str(version))
+                self.send_header("X-SRT-Codec", codec)
                 self.send_header("Content-Type", "application/octet-stream")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -488,7 +615,11 @@ class _PeerHandler(BaseHTTPRequestHandler):
         srv = self.server
         if parsed.path == "/grad":
             try:
-                meta, arrays = decode_arrays(self._read_body())
+                # decode_grads dequantizes bf16/int8 frames to f32 and
+                # passes unknown codecs through untouched — the
+                # structural check in OwnerState.submit turns a genuine
+                # mismatch into a counted discard, not a 400
+                meta, arrays = decode_grads(self._read_body())
                 worker = int(meta["worker"])
                 stamp = int(meta["stamp"])
             except (WireError, KeyError, TypeError, ValueError) as e:

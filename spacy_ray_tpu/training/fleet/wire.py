@@ -11,18 +11,74 @@ followed by the arrays' raw little-endian bytes:
 Arrays are decoded with ``np.frombuffer`` against the declared dtype —
 nothing in the payload is executable. Decode errors raise
 :class:`WireError` (one typed error for every malformed-payload shape).
+
+Wire compression (ROADMAP item 3, the PAPERS.md arXiv 2004.13336
+communication-first framing) layers ON TOP of this frame without
+changing it: a ``codec`` field in the json meta names how the arrays
+were shrunk before encoding —
+
+``f32``
+    arrays as-is (the PR 14 wire, and the interop fallback).
+``bf16``
+    f32 leaves carried as their top 16 bits (round-to-nearest-even),
+    2x smaller, ~3 decimal digits — the conservative tier.
+``int8``
+    per-output-channel symmetric int8 (the trusted ``ops/int8_matmul``
+    recipe, 4x smaller): each leaf ``k`` becomes an int8 array plus an
+    f32 ``k#scale`` companion. Tiny leaves (rank 0, or fewer than 8
+    elements) ride through as f32 — the scale would outweigh the
+    savings.
+``delta``
+    a parameter pull as stacked per-version COMPRESSED deltas
+    (``v{n}/{key}`` keys) against the puller's known version; summing
+    the dequantized pieces reproduces the owner's deterministic wire
+    chain exactly (see ``peer.OwnerState``).
+
+Negotiation is the sender's job: :func:`negotiate_push_codec` drops to
+``f32`` unless the receiver advertised the codec on ``/healthz``, and a
+receiver that sees an UNKNOWN codec passes the arrays through untouched
+rather than erroring — a mixed fleet (old worker, new owner or vice
+versa) degrades to the PR 14 wire, never to a crash.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from ...ops.int8_matmul import dequantize_int8_np, quantize_int8_np
+
 MAGIC = b"SRTF1"
 
-__all__ = ["MAGIC", "WireError", "encode_arrays", "decode_arrays"]
+#: codecs this build can DECODE — what /healthz advertises to pushers.
+WIRE_CODECS = ("f32", "bf16", "int8", "delta")
+
+#: companion-key suffix carrying a quantized leaf's per-channel scales.
+SCALE_SUFFIX = "#scale"
+
+#: int8 leaves below this many elements ship as f32 — the f32 scale
+#: companion would cost more bytes than quantization saves.
+INT8_MIN_LEAF = 8
+
+__all__ = [
+    "MAGIC",
+    "WIRE_CODECS",
+    "SCALE_SUFFIX",
+    "WireError",
+    "encode_arrays",
+    "decode_arrays",
+    "compress_arrays",
+    "decompress_arrays",
+    "encode_grads",
+    "decode_grads",
+    "encode_delta_frame",
+    "decode_delta_frame",
+    "GradCompressor",
+    "resolve_grad_compression",
+    "negotiate_push_codec",
+]
 
 
 class WireError(ValueError):
@@ -83,3 +139,258 @@ def decode_arrays(body: bytes) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
             f"bad fleet payload: {len(body) - offset} trailing bytes"
         )
     return meta, arrays
+
+
+# -- leaf codecs -------------------------------------------------------
+
+
+def _to_bf16_bits(arr: np.ndarray) -> np.ndarray:
+    """f32 -> bf16 carried as uint16: keep the top 16 bits with
+    round-to-nearest-even (the widening-add trick, in uint64 so the
+    carry can't wrap). No ml_dtypes dependency — the wire dtype is
+    plain ``<u2`` and only THIS module gives the bits meaning."""
+    a = np.ascontiguousarray(np.asarray(arr, dtype=np.float32))
+    bits = a.view(np.uint32).astype(np.uint64)
+    one = np.uint64(1)
+    rounded = (bits + np.uint64(0x7FFF) + ((bits >> np.uint64(16)) & one))
+    return (rounded >> np.uint64(16)).astype(np.uint16).reshape(a.shape)
+
+
+def _from_bf16_bits(bits: np.ndarray) -> np.ndarray:
+    b = np.ascontiguousarray(np.asarray(bits, dtype=np.uint16))
+    return (b.astype(np.uint32) << np.uint32(16)).view(np.float32)
+
+
+def _compress_leaf(
+    codec: str, key: str, arr: np.ndarray
+) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """``(wire-entries, dequantized)`` for one leaf. The second return
+    is what the RECEIVER will reconstruct — the error-feedback residual
+    and the owner's deterministic wire chain are both defined by it."""
+    a32 = np.ascontiguousarray(np.asarray(arr, dtype=np.float32))
+    if codec == "bf16":
+        bits = _to_bf16_bits(a32)
+        return {key: bits}, _from_bf16_bits(bits)
+    if codec == "int8":
+        if a32.ndim == 0 or a32.size < INT8_MIN_LEAF or key.endswith(SCALE_SUFFIX):
+            return {key: a32}, a32
+        q, scale = quantize_int8_np(a32)
+        return {key: q, key + SCALE_SUFFIX: scale}, dequantize_int8_np(q, scale)
+    return {key: a32}, a32  # f32 (and the never-error fallback)
+
+
+def compress_arrays(
+    arrays: Dict[str, np.ndarray], codec: str
+) -> Dict[str, np.ndarray]:
+    """Stateless (no error feedback) compression of a whole dict —
+    parameter pieces and plain grad frames. ``f32`` passes through."""
+    if codec == "f32":
+        return {k: np.ascontiguousarray(np.asarray(v)) for k, v in arrays.items()}
+    out: Dict[str, np.ndarray] = {}
+    for key in sorted(arrays):
+        entries, _ = _compress_leaf(codec, key, arrays[key])
+        out.update(entries)
+    return out
+
+
+def decompress_arrays(
+    arrays: Dict[str, np.ndarray], codec: str
+) -> Dict[str, np.ndarray]:
+    """Invert :func:`compress_arrays`. int8 leaves missing their
+    ``#scale`` companion raise :class:`WireError`; an UNKNOWN codec
+    passes the arrays through as declared (the interop fallback — the
+    receiver's structural checks turn a genuine mismatch into a counted
+    discard, never a crash)."""
+    if codec == "bf16":
+        return {
+            k: _from_bf16_bits(v) if v.dtype == np.uint16 else v
+            for k, v in arrays.items()
+        }
+    if codec == "int8":
+        out: Dict[str, np.ndarray] = {}
+        for k, v in arrays.items():
+            if k.endswith(SCALE_SUFFIX):
+                continue
+            sk = k + SCALE_SUFFIX
+            if sk in arrays:
+                out[k] = dequantize_int8_np(v, arrays[sk])
+            elif v.dtype == np.int8:
+                raise WireError(
+                    f"bad fleet payload: int8 leaf {k!r} missing {sk!r}"
+                )
+            else:
+                out[k] = v  # tiny-leaf f32 passthrough
+        return out
+    return dict(arrays)  # f32 and unknown codecs
+
+
+# -- gradient frames ---------------------------------------------------
+
+
+def encode_grads(
+    meta: Dict[str, Any], grads: Dict[str, np.ndarray], codec: str = "f32"
+) -> bytes:
+    """A gradient push frame: ``meta["codec"]`` names the compression,
+    arrays carry the compressed leaves. Stateless — the push path uses
+    :class:`GradCompressor` so the quantization error feeds back."""
+    m = dict(meta)
+    m["codec"] = str(codec)
+    return encode_arrays(m, compress_arrays(grads, str(codec)))
+
+
+def decode_grads(body: bytes) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    """Decode a gradient push frame to f32 leaves. A frame without a
+    ``codec`` field is a PR 14 f32 frame; a frame with an unknown codec
+    decodes to its arrays as declared (fallback, never an error)."""
+    meta, arrays = decode_arrays(body)
+    codec = str(meta.get("codec") or "f32")
+    if codec in ("f32", "bf16", "int8"):
+        return meta, decompress_arrays(arrays, codec)
+    return meta, arrays
+
+
+# -- delta frames (version-delta param pulls) --------------------------
+
+
+def encode_delta_frame(
+    meta: Dict[str, Any],
+    pieces: Iterable[Tuple[int, str, Dict[str, np.ndarray]]],
+) -> bytes:
+    """A param pull as stacked per-version deltas. ``pieces`` is
+    ``(version, piece_codec, compressed-arrays)`` oldest-first; each
+    piece's arrays are ALREADY compressed (they're the owner's stored
+    wire-chain pieces — re-encoding them would fork the chain). Keys go
+    on the wire as ``v{version}/{key}`` and the piece table rides in
+    ``meta["pieces"]``."""
+    table: List[List[Any]] = []
+    arrays: Dict[str, np.ndarray] = {}
+    for version, piece_codec, piece in pieces:
+        table.append([int(version), str(piece_codec)])
+        for key, arr in piece.items():
+            arrays[f"v{int(version)}/{key}"] = arr
+    m = dict(meta)
+    m["codec"] = "delta"
+    m["pieces"] = table
+    return encode_arrays(m, arrays)
+
+
+def decode_delta_frame(
+    meta: Dict[str, Any], arrays: Dict[str, np.ndarray]
+) -> Dict[str, np.ndarray]:
+    """Sum a delta frame's dequantized pieces: ``{key: f32 delta}`` to
+    ADD onto the puller's known-version params. Malformed piece tables
+    raise :class:`WireError` (truncated array data already raised in
+    :func:`decode_arrays`)."""
+    try:
+        table = [(int(v), str(c)) for v, c in meta["pieces"]]
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireError(f"bad delta frame piece table: {e}") from e
+    total: Dict[str, np.ndarray] = {}
+    for version, piece_codec in table:
+        prefix = f"v{version}/"
+        piece = {
+            k[len(prefix):]: a for k, a in arrays.items()
+            if k.startswith(prefix)
+        }
+        for key, delta in decompress_arrays(piece, piece_codec).items():
+            d32 = np.asarray(delta, dtype=np.float32)
+            total[key] = d32 if key not in total else total[key] + d32
+    return total
+
+
+# -- error-feedback push compression -----------------------------------
+
+
+class GradCompressor:
+    """Per-(peer, leaf) error-feedback quantization for gradient pushes.
+
+    Quantization error is ADDED BACK into the next round's gradient for
+    the same peer (``g_t' = g_t + r_{t-1}; r_t = g_t' - deq(Q(g_t'))``),
+    so over T rounds the dequantized sum telescopes to the raw-grad sum
+    minus one bounded final residual — the property that keeps the
+    S∈{0,1,2} convergence envelope intact (tests pin it exactly).
+    ``error_feedback=False`` is the ablation control: sub-step signal
+    then quantizes to zero forever and never reaches the owner.
+
+    Not thread-safe; the worker's round loop is single-threaded.
+    """
+
+    def __init__(self, codec: str, *, error_feedback: bool = True) -> None:
+        self.codec = str(codec)
+        self.error_feedback = bool(error_feedback)
+        self._residual: Dict[Tuple[Any, str], np.ndarray] = {}
+
+    def compress(
+        self,
+        peer: Any,
+        grads: Dict[str, np.ndarray],
+        codec: Optional[str] = None,
+    ) -> Tuple[Dict[str, np.ndarray], str]:
+        """``(wire-arrays, codec-used)`` for one peer's push. ``codec``
+        overrides the default (per-peer negotiation)."""
+        c = str(codec) if codec is not None else self.codec
+        out: Dict[str, np.ndarray] = {}
+        for key in sorted(grads):
+            g32 = np.asarray(grads[key], dtype=np.float32)
+            rkey = (peer, key)
+            if self.error_feedback and c != "f32":
+                residual = self._residual.get(rkey)
+                if residual is not None:
+                    g32 = g32 + residual
+            entries, deq = _compress_leaf(c, key, g32)
+            out.update(entries)
+            if self.error_feedback and c != "f32":
+                self._residual[rkey] = (g32 - deq).astype(np.float32)
+        return out, c
+
+    def encode(
+        self,
+        peer: Any,
+        meta: Dict[str, Any],
+        grads: Dict[str, np.ndarray],
+        codec: Optional[str] = None,
+    ) -> bytes:
+        """One call for the push path: compress (with error feedback)
+        and frame."""
+        arrays, used = self.compress(peer, grads, codec)
+        m = dict(meta)
+        m["codec"] = used
+        return encode_arrays(m, arrays)
+
+
+# -- negotiation -------------------------------------------------------
+
+
+def resolve_grad_compression(requested: str, backend: str) -> Tuple[str, str]:
+    """``(codec, reason)`` for ``--grad-compression``. ``auto`` resolves
+    int8 only where the error-feedback convergence suite has run (the
+    cpu fixture suite, tests/test_training_fleet.py); the conservative
+    bf16 tier elsewhere — the serving overlay's honest-evidence rule."""
+    req = str(requested or "auto").lower()
+    if req in ("f32", "bf16", "int8"):
+        return req, "explicit"
+    if req != "auto":
+        raise ValueError(
+            f"unknown --grad-compression {requested!r} "
+            "(choose auto|f32|bf16|int8)"
+        )
+    if str(backend).lower() == "cpu":
+        return "int8", "error-feedback convergence suite committed on cpu"
+    return (
+        "bf16",
+        f"no committed int8+error-feedback convergence record on "
+        f"{backend} — conservative tier",
+    )
+
+
+def negotiate_push_codec(resolved: str, peer_codecs: Any) -> str:
+    """The codec to PUSH with, given what the peer's ``/healthz``
+    advertised. An old peer (no ``codecs`` field) or one that doesn't
+    decode ``resolved`` gets plain f32 — degrade, never error."""
+    if not peer_codecs:
+        return "f32"
+    try:
+        advertised = {str(c) for c in peer_codecs}
+    except TypeError:
+        return "f32"
+    return str(resolved) if str(resolved) in advertised else "f32"

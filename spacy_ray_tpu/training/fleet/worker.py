@@ -71,7 +71,15 @@ from .ownership import (
     opt_part_records,
 )
 from .peer import FleetCounters, OwnerState, PeerServer
-from .wire import WireError, decode_arrays, encode_arrays
+from .wire import (
+    GradCompressor,
+    WireError,
+    decode_arrays,
+    decode_delta_frame,
+    encode_arrays,
+    negotiate_push_codec,
+    resolve_grad_compression,
+)
 
 logger = logging.getLogger("spacy_ray_tpu.training")
 
@@ -130,13 +138,16 @@ class _PeerClient:
         path: str,
         body: Optional[bytes] = None,
         content_type: str = "application/octet-stream",
+        headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, Dict[str, str], bytes]:
         last: Optional[Exception] = None
         for attempt in (0, 1):  # one transparent reconnect on a dead socket
             conn = self._connection()
             try:
-                headers = {"Content-Type": content_type} if body else {}
-                conn.request(method, path, body=body, headers=headers)
+                hdrs = {"Content-Type": content_type} if body else {}
+                if headers:
+                    hdrs.update(headers)
+                conn.request(method, path, body=body, headers=hdrs)
                 resp = conn.getresponse()
                 payload = resp.read()
                 return resp.status, dict(resp.getheaders()), payload
@@ -178,6 +189,9 @@ def train_fleet_worker(
     checkpoint_timeout_s: float = 600.0,
     watch_interval_s: float = 5.0,
     alert_interval_s: float = 5.0,
+    grad_compression: str = "auto",
+    param_delta_window: int = 4,
+    grad_error_feedback: bool = True,
 ) -> Tuple[Any, Any]:
     """Run ONE fleet worker process; returns ``(nlp, TrainResult)`` like
     :func:`~..loop.train` (whose ``fleet=`` mode delegates here).
@@ -185,6 +199,14 @@ def train_fleet_worker(
     ``metrics_port`` is unused (the peer server IS the telemetry
     endpoint — one port per worker, ``base_port + worker_id``); accepted
     so the CLI plumbing stays uniform.
+
+    ``grad_compression`` picks the push codec (``auto`` resolves per
+    backend, TUNING.md §20); ``param_delta_window`` is the owner-side K
+    for version-delta pulls (0 = PR 14 full pulls). Both degrade to f32
+    against peers that don't advertise the codec.
+    ``grad_error_feedback=False`` is the ablation control the
+    convergence suite uses — never turn it off for real runs (sub-step
+    gradient signal then quantizes to zero forever).
     """
     import jax
     import jax.numpy as jnp
@@ -409,6 +431,26 @@ def train_fleet_worker(
             "gradients but apply nothing — consider fewer workers",
             worker=worker_id, n_workers=n_workers,
         )
+    # ---- wire compression (ROADMAP item 3: the bandwidth plane) ------
+    # one resolved codec per process; the ACTUAL codec per peer is
+    # negotiated at push time against what its /healthz advertises, so
+    # a mixed fleet (an old f32-only worker among compressed ones)
+    # interoperates — it just gets f32 frames
+    wire_codec, wire_reason = resolve_grad_compression(
+        grad_compression, jax.default_backend()
+    )
+    param_delta_window = max(0, int(param_delta_window))
+    compressor = GradCompressor(
+        wire_codec, error_feedback=bool(grad_error_feedback)
+    )
+    peer_codecs: Dict[int, Any] = {}
+    log_event(
+        "fleet-wire-codec",
+        f"worker {worker_id}: grad compression {grad_compression} -> "
+        f"{wire_codec} ({wire_reason}); param delta window "
+        f"{param_delta_window}",
+        worker=worker_id, codec=wire_codec, delta_window=param_delta_window,
+    )
     counters = FleetCounters(
         registry=tel.registry if tel is not None else None
     )
@@ -442,6 +484,8 @@ def train_fleet_worker(
         on_version=(version_gauge.set if version_gauge is not None else None),
         registry=tel.registry if tel is not None else None,
         trace=tel.trace if tel is not None else None,
+        delta_window=param_delta_window,
+        delta_codec=wire_codec,
     )
 
     # mutable holders the checkpoint callback (handler thread) reads
@@ -500,6 +544,18 @@ def train_fleet_worker(
     }
     ckpt_clients: Dict[int, _PeerClient] = {}  # long-deadline, lazy
 
+    # what each peer exchange WOULD cost as a PR 14 f32 frame — the
+    # _uncompressed twin counters' source (slice shapes are static, so
+    # one encode of the template per peer at startup is exact)
+    wire_full_bytes: Dict[int, int] = {}
+    for w in clients:
+        flat_w = layout.flat_slices(params_host, w)
+        if flat_w:
+            wire_full_bytes[w] = len(encode_arrays(
+                {"worker": worker_id, "stamp": 0},
+                {k: np.asarray(v, np.float32) for k, v in flat_w.items()},
+            ))
+
     def wait_for_peers() -> None:
         """Block until every peer answers /healthz with a matching
         layout signature. A COLD start that never sees its peers is a
@@ -531,6 +587,9 @@ def train_fleet_worker(
                         f"layout ({sig} vs {layout.signature()}) — all "
                         "workers must resolve the same config"
                     )
+                # what this peer can DECODE (absent on pre-compression
+                # peers: they get f32 pushes)
+                peer_codecs[w] = payload.get("codecs")
                 pending.discard(w)
             if pending:
                 if time.monotonic() > deadline:
@@ -651,12 +710,20 @@ def train_fleet_worker(
         layout.merge_flat(params_host, worker_id, self_flat)
         stamps[worker_id] = self_version
         deadline = time.monotonic() + float(quorum_wait_s)
+        # ask for delta frames only when we track a window ourselves; an
+        # owner that can't serve one (old peer ignores the header, new
+        # peer outside the window) replies with a full frame — degrade,
+        # never stall (RESILIENCE.md)
+        accept_hdrs = (
+            {"X-SRT-Accept": "delta"} if param_delta_window > 0 else None
+        )
         for w, client in clients.items():
             timed_out = False
             while True:
                 try:
                     status, headers, body = client.request(
-                        "GET", f"/params?known={known[w]}"
+                        "GET", f"/params?known={known[w]}",
+                        headers=accept_hdrs,
                     )
                 except OSError:
                     counters.inc("pull_failed")
@@ -667,10 +734,31 @@ def train_fleet_worker(
                     try:
                         meta_w, arrays = decode_arrays(body)
                         v = int(meta_w["version"])
+                        is_delta = str(meta_w.get("codec") or "") == "delta"
+                        deltas = None
+                        if is_delta:
+                            base = int(meta_w.get("base", -1))
+                            if base != known[w]:
+                                raise WireError(
+                                    f"delta frame base {base} does not "
+                                    f"match known version {known[w]}"
+                                )
+                            deltas = decode_delta_frame(meta_w, arrays)
                     except Exception:
                         counters.inc("pull_failed")
                         break
-                    layout.merge_flat(params_host, w, arrays)
+                    if is_delta:
+                        layout.merge_flat(
+                            params_host, w, deltas, add=True
+                        )
+                    else:
+                        layout.merge_flat(params_host, w, arrays)
+                    counters.inc("wire_pull_bytes", len(body))
+                    counters.inc(
+                        "wire_pull_bytes_uncompressed",
+                        wire_full_bytes.get(w, len(body))
+                        if is_delta else len(body),
+                    )
                     if v < known[w]:
                         # a restarted owner legitimately REGRESSES to its
                         # checkpointed version: our round bookkeeping
@@ -713,9 +801,15 @@ def train_fleet_worker(
                 # would keep it moving exactly when every peer is gone
                 owner.submit(worker_id, stamps[worker_id], flat)
                 continue
-            body = encode_arrays(
+            # per-peer negotiated codec: the error-feedback residual for
+            # peer w absorbs THIS frame's quantization error and rides
+            # into the next round's gradient for w (f32 keeps none)
+            codec_w = negotiate_push_codec(wire_codec, peer_codecs.get(w))
+            body = compressor.encode(
+                w,
                 {"worker": worker_id, "stamp": int(stamps.get(w, -1))},
                 flat,
+                codec_w,
             )
 
             def send(w=w, body=body):
@@ -733,6 +827,11 @@ def train_fleet_worker(
             try:
                 retry_io("grad-push", send, policy=push_policy)
                 counters.inc("grad_pushed")
+                counters.inc("wire_push_bytes", len(body))
+                counters.inc(
+                    "wire_push_bytes_uncompressed",
+                    wire_full_bytes.get(w, len(body)),
+                )
                 delivered = True
             except (OSError, resilience.FaultInjected):
                 # fire-and-forget: a dead/unreachable owner costs a
@@ -750,6 +849,8 @@ def train_fleet_worker(
                         "to": w,
                         "stamp": int(stamps.get(w, -1)),
                         "delivered": delivered,
+                        "codec": codec_w,
+                        "bytes": len(body),
                     },
                 )
             last_stamp[w] = int(stamps.get(w, -1))
@@ -1164,6 +1265,8 @@ def train_fleet_worker(
                 "quorum": quorum,
                 "max_staleness": max_staleness,
                 "version": owner.version,
+                "grad_compression": wire_codec,
+                "param_delta_window": param_delta_window,
                 "counters": counters.snapshot(),
                 "phases": {p: round(v, 6) for p, v in phases.items()},
                 "owner_apply_seconds": round(owner.apply_seconds, 6),
@@ -1196,6 +1299,8 @@ def train_fleet_worker(
                     "quorum": quorum,
                     "max_staleness": max_staleness,
                     "version": owner.version,
+                    "grad_compression": wire_codec,
+                    "param_delta_window": param_delta_window,
                     "counters": counters.snapshot(),
                     "phases": {p: round(v, 6) for p, v in phases.items()},
                     "histograms": {

@@ -362,6 +362,41 @@ def build_run_report(
             for w in sorted(stale)
         )
         lines += ["", f"accepted-push totals: {counts}", ""]
+
+    # -- wire table (compression ledger, TUNING.md §20) -----------------
+    wire_rows = []
+    for w in ids:
+        src = ledgers.get(w) or fleet_rows.get(w) or {}
+        c = src.get("counters") or {}
+        if any(c.get(k) for k in ("wire_push_bytes", "wire_pull_bytes")):
+            wire_rows.append((w, src, c))
+    if wire_rows:
+        lines += [
+            "## Wire bytes (actual vs f32-equivalent)",
+            "",
+            "| worker | codec | delta window | pushed | pushed f32-eq "
+            "| push ratio | pulled | pulled f32-eq | pull ratio |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+        for w, src, c in wire_rows:
+            def _mb(name: str) -> float:
+                return float(c.get(name) or 0) / 1e6
+
+            def _ratio(actual: str, raw: str) -> str:
+                a, r = float(c.get(actual) or 0), float(c.get(raw) or 0)
+                return f"{r / a:.1f}x" if a > 0 else "-"
+
+            lines.append(
+                f"| {w} | {src.get('grad_compression') or '-'} "
+                f"| {src.get('param_delta_window', '-')} "
+                f"| {_mb('wire_push_bytes'):.2f}MB "
+                f"| {_mb('wire_push_bytes_uncompressed'):.2f}MB "
+                f"| {_ratio('wire_push_bytes', 'wire_push_bytes_uncompressed')} "
+                f"| {_mb('wire_pull_bytes'):.2f}MB "
+                f"| {_mb('wire_pull_bytes_uncompressed'):.2f}MB "
+                f"| {_ratio('wire_pull_bytes', 'wire_pull_bytes_uncompressed')} |"
+            )
+        lines.append("")
     timing = []
     for w in sorted(fleet_rows):
         h = fleet_rows[w].get("histograms") or {}

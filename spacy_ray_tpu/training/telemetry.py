@@ -69,6 +69,7 @@ __all__ = [
     "OCCUPANCY_BUCKETS",
     "STALENESS_BUCKETS",
     "FLEET_DYNAMICS_HISTOGRAMS",
+    "FLEET_WIRE_COUNTERS",
     "install_compile_hook",
     "compile_count",
     "sample_device_telemetry",
@@ -117,6 +118,20 @@ FLEET_DYNAMICS_HISTOGRAMS = {
     "phase_push_seconds": STEP_SECONDS_BUCKETS,
     "phase_apply_wait_seconds": STEP_SECONDS_BUCKETS,
 }
+
+# the fleet's wire-byte counter families (the compression ledger —
+# fleet/peer.py COUNTER_NAMES mirrors these into every worker's
+# registry, Prometheus renders them as srt_training_<name>_total with a
+# worker label). The _uncompressed twins count what the SAME payloads
+# would have cost as f32 full frames, so compression ratio is
+# (uncompressed / actual) computable from any two scrapes — `telemetry
+# top`'s wire column and the run report's wire table both divide these.
+FLEET_WIRE_COUNTERS = (
+    "wire_push_bytes",
+    "wire_push_bytes_uncompressed",
+    "wire_pull_bytes",
+    "wire_pull_bytes_uncompressed",
+)
 
 
 # ----------------------------------------------------------------------

@@ -45,6 +45,9 @@ logger = logging.getLogger("spacy_ray_tpu.training")
 # builders are the one definition of what /metrics, /trace and
 # /admin/alerts serve, so the two handlers cannot drift — the fleet
 # variant only adds a worker label (Prometheus) / worker field (JSON).
+# Fleet workers' registries also carry the wire-byte compression ledger
+# (telemetry.FLEET_WIRE_COUNTERS -> srt_training_wire_*_bytes_total
+# series); it arrives here through the same snapshot, nothing special.
 
 
 def metrics_reply(

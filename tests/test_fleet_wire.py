@@ -1,0 +1,494 @@
+"""Fleet wire compression (training/fleet/wire.py): the int8/bf16 leaf
+codecs and their quantization-error bounds, the codec malformed-frame
+matrix (unknown codec -> passthrough, missing scale / truncated delta ->
+WireError), error-feedback accumulation (exact telescoping + the
+sub-threshold-signal control proving the residual is load-bearing), the
+owner's version-delta pull chain (window/budget eviction, full-pull
+fallback, skip-puller exactness), codec negotiation, and a mixed-codec
+2-worker fleet run whose byte counters prove per-peer negotiation.
+"""
+
+import json
+import socket
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.ops.int8_matmul import (
+    dequantize_int8_np,
+    quantize_int8_np,
+)
+from spacy_ray_tpu.training.fleet.peer import (
+    FleetCounters,
+    OwnerState,
+    PeerServer,
+)
+from spacy_ray_tpu.training.fleet.wire import (
+    INT8_MIN_LEAF,
+    SCALE_SUFFIX,
+    WIRE_CODECS,
+    GradCompressor,
+    WireError,
+    _from_bf16_bits,
+    _to_bf16_bits,
+    compress_arrays,
+    decode_arrays,
+    decode_delta_frame,
+    decode_grads,
+    decompress_arrays,
+    encode_arrays,
+    encode_delta_frame,
+    encode_grads,
+    negotiate_push_codec,
+    resolve_grad_compression,
+)
+from spacy_ray_tpu.util import write_synth_jsonl
+
+
+# ----------------------------------------------------------------------
+# Leaf quantizers: bounds + device/host parity
+# ----------------------------------------------------------------------
+
+
+def test_int8_roundtrip_error_bounded_by_half_scale():
+    """The wire's load-bearing bound: per-element reconstruction error
+    <= scale/2 for the element's channel (round-to-nearest), across
+    ranks, scales and degenerate all-zero channels."""
+    rng = np.random.default_rng(0)
+    cases = [
+        rng.normal(0, 0.02, (16, 24)).astype(np.float32),
+        (rng.normal(0, 3.0, (4, 8, 12)) * 100).astype(np.float32),
+        rng.normal(0, 1.0, 64).astype(np.float32),  # rank 1: per-tensor
+        np.zeros((8, 8), np.float32),
+        np.concatenate(  # one dead channel next to a live one
+            [np.zeros((16, 1), np.float32),
+             rng.normal(0, 1, (16, 1)).astype(np.float32)], axis=1
+        ),
+    ]
+    for arr in cases:
+        q, scale = quantize_int8_np(arr)
+        assert q.dtype == np.int8 and scale.dtype == np.float32
+        err = np.abs(dequantize_int8_np(q, scale) - arr)
+        # scale broadcasts over the last axis exactly as dequant does
+        assert np.all(err <= scale / 2 + 1e-7), arr.shape
+
+
+def test_int8_np_matches_device_quantizer(mesh8):
+    """quantize_int8_np is the host-side twin of ops.quantize_int8 —
+    same q8 and scales bit-for-bit on the same input (the serving int8
+    path and the wire must agree on what 'int8' means)."""
+    import jax.numpy as jnp
+
+    from spacy_ray_tpu.ops.int8_matmul import quantize_int8
+
+    rng = np.random.default_rng(1)
+    w = rng.normal(0, 0.5, (32, 16)).astype(np.float32)
+    q_np, s_np = quantize_int8_np(w)
+    q_dev, s_dev = quantize_int8(jnp.asarray(w))
+    np.testing.assert_array_equal(q_np, np.asarray(q_dev))
+    np.testing.assert_allclose(s_np, np.asarray(s_dev), rtol=1e-6)
+
+
+def test_bf16_bits_roundtrip():
+    rng = np.random.default_rng(2)
+    a = rng.normal(0, 10, (7, 9)).astype(np.float32)
+    out = _from_bf16_bits(_to_bf16_bits(a))
+    assert out.shape == a.shape and out.dtype == np.float32
+    # bf16 keeps 8 mantissa bits: relative error < 2^-8
+    np.testing.assert_allclose(out, a, rtol=2 ** -8)
+    # bf16-representable values survive exactly (incl. signed zeros)
+    exact = np.array([0.0, -0.0, 1.0, -2.5, 0.15625], np.float32)
+    np.testing.assert_array_equal(_from_bf16_bits(_to_bf16_bits(exact)), exact)
+
+
+# ----------------------------------------------------------------------
+# Codec matrix: frames, fallbacks, malformed payloads
+# ----------------------------------------------------------------------
+
+
+def _grads():
+    rng = np.random.default_rng(3)
+    return {
+        "a/W": rng.normal(0, 0.1, (12, 8)).astype(np.float32),
+        "a/b": rng.normal(0, 0.1, 12).astype(np.float32),
+        "tiny": np.ones(3, np.float32),  # < INT8_MIN_LEAF: f32 ride-along
+    }
+
+
+@pytest.mark.parametrize("codec", ["f32", "bf16", "int8"])
+def test_grad_frame_roundtrip(codec):
+    grads = _grads()
+    body = encode_grads({"worker": 1, "stamp": 4}, grads, codec)
+    meta, out = decode_grads(body)
+    assert meta["codec"] == codec
+    assert set(out) == set(grads)
+    tol = {"f32": 0, "bf16": 2 ** -8, "int8": 2e-2}[codec]
+    for k in grads:
+        assert out[k].dtype == np.float32
+        np.testing.assert_allclose(out[k], grads[k], rtol=tol, atol=tol)
+    # tiny leaves never quantize (the scale companion would cost more)
+    assert grads["tiny"].size < INT8_MIN_LEAF
+    np.testing.assert_array_equal(out["tiny"], grads["tiny"])
+
+
+def test_unknown_codec_decodes_as_declared_never_errors():
+    """A frame from a NEWER build with a codec this one doesn't know
+    must decode to its arrays untouched — the structural check in
+    OwnerState.submit then makes it a counted discard, not a crash."""
+    grads = {"x": np.ones(8, np.float32)}
+    body = encode_arrays({"worker": 0, "codec": "zstd-v9"}, grads)
+    meta, out = decode_grads(body)
+    assert meta["codec"] == "zstd-v9"
+    np.testing.assert_array_equal(out["x"], grads["x"])
+    # and a PR 14 frame with no codec field at all is plain f32
+    meta2, out2 = decode_grads(encode_arrays({"worker": 0}, grads))
+    np.testing.assert_array_equal(out2["x"], grads["x"])
+
+
+def test_int8_leaf_missing_scale_is_wire_error():
+    q, _scale = quantize_int8_np(np.ones((8, 8), np.float32))
+    with pytest.raises(WireError, match="missing"):
+        decompress_arrays({"w": q}, "int8")
+    # but a genuine f32 leaf inside an int8 frame passes through
+    out = decompress_arrays({"w": np.ones(3, np.float32)}, "int8")
+    np.testing.assert_array_equal(out["w"], np.ones(3, np.float32))
+
+
+def test_delta_frame_roundtrip_and_malformed():
+    rng = np.random.default_rng(4)
+    d1 = {"x": rng.normal(0, 1, (8, 8)).astype(np.float32)}
+    d2 = {"x": rng.normal(0, 1, (8, 8)).astype(np.float32)}
+    pieces = [
+        (1, "int8", compress_arrays(d1, "int8")),
+        (2, "int8", compress_arrays(d2, "int8")),
+    ]
+    body = encode_delta_frame({"worker": 0, "base": 0}, pieces)
+    meta, arrays = decode_arrays(body)
+    assert meta["codec"] == "delta" and meta["pieces"] == [[1, "int8"], [2, "int8"]]
+    total = decode_delta_frame(meta, arrays)
+    np.testing.assert_allclose(total["x"], d1["x"] + d2["x"], atol=4e-2)
+    # truncated raw bytes die in decode_arrays with the typed error
+    with pytest.raises(WireError):
+        decode_arrays(body[:-5])
+    # a mangled piece table dies in decode_delta_frame, same type
+    with pytest.raises(WireError):
+        decode_delta_frame({"pieces": "nope"}, arrays)
+    with pytest.raises(WireError):
+        decode_delta_frame({}, arrays)
+
+
+# ----------------------------------------------------------------------
+# Error feedback: exact telescoping + the ablation control
+# ----------------------------------------------------------------------
+
+
+def test_error_feedback_telescopes_exactly():
+    """Over T rounds, sum(dequantized pushes) + final residual ==
+    sum(raw grads) — per peer, per leaf. This is the identity that keeps
+    the convergence envelope: no gradient mass is ever lost, only
+    delayed by at most one round."""
+    rng = np.random.default_rng(5)
+    comp = GradCompressor("int8")
+    raw_sum = np.zeros((16, 8), np.float32)
+    deq_sum = np.zeros((16, 8), np.float32)
+    for _ in range(3):
+        g = rng.normal(0, 0.05, (16, 8)).astype(np.float32)
+        raw_sum += g
+        arrays, used = comp.compress(7, {"w": g})
+        assert used == "int8"
+        deq_sum += decompress_arrays(arrays, "int8")["w"]
+    residual = comp._residual[(7, "w")]
+    np.testing.assert_allclose(deq_sum + residual, raw_sum, atol=1e-4)
+
+
+def test_error_feedback_is_load_bearing():
+    """Deterministic ablation: a per-channel outlier pins the channel's
+    quantization step ABOVE a persistent small signal elsewhere in the
+    same channel. With error feedback the signal accumulates across
+    rounds and eventually ships; without it, every round quantizes to
+    zero and the owner never sees the signal at all."""
+    step = 1.0 / 127  # channel scale once the outlier lands
+    g = np.zeros((4, 4), np.float32)
+    g[0, 3] = 1.0       # outlier, channel 3: scale = 1/127
+    g[3, 3] = 2.5e-3    # signal in the SAME channel, < step/2
+
+    def shipped(error_feedback):
+        comp = GradCompressor("int8", error_feedback=error_feedback)
+        total = 0.0
+        for _ in range(6):
+            arrays, _ = comp.compress(0, {"w": g})
+            total += float(decompress_arrays(arrays, "int8")["w"][3, 3])
+        return total
+
+    assert g[3, 3] < step / 2  # the signal alone rounds to zero
+    on, off = shipped(True), shipped(False)
+    assert off == 0.0, "without EF the sub-step signal must vanish"
+    assert on > 0.0, "with EF the residual must accumulate and ship"
+    # and what shipped is within one quantization step of the truth
+    assert abs(on - 6 * g[3, 3]) <= step
+
+
+def test_f32_codec_keeps_no_residual():
+    comp = GradCompressor("f32")
+    comp.compress(0, {"w": np.ones((8, 8), np.float32)})
+    assert not comp._residual
+
+
+# ----------------------------------------------------------------------
+# Negotiation
+# ----------------------------------------------------------------------
+
+
+def test_resolve_grad_compression():
+    assert resolve_grad_compression("int8", "tpu") == ("int8", "explicit")
+    assert resolve_grad_compression("auto", "cpu")[0] == "int8"
+    codec, reason = resolve_grad_compression("auto", "tpu")
+    assert codec == "bf16" and "tpu" in reason
+    with pytest.raises(ValueError):
+        resolve_grad_compression("zstd", "cpu")
+
+
+def test_negotiate_push_codec_degrades_to_f32():
+    assert negotiate_push_codec("int8", list(WIRE_CODECS)) == "int8"
+    assert negotiate_push_codec("int8", ["f32"]) == "f32"
+    assert negotiate_push_codec("int8", None) == "f32"  # old peer
+    assert negotiate_push_codec("int8", 17) == "f32"  # garbage healthz
+    assert negotiate_push_codec("f32", list(WIRE_CODECS)) == "f32"
+
+
+# ----------------------------------------------------------------------
+# Owner delta chain: serving, eviction, fallback, exactness
+# ----------------------------------------------------------------------
+
+
+def _delta_owner(window, budget=8 << 20, shape=(64, 64)):
+    def apply_fn(params, opt_state, grads):
+        return {"x": params["x"] + grads["x"]}, opt_state
+
+    return OwnerState(
+        worker_id=0, n_workers=2, quorum=1, max_staleness=10,
+        apply_fn=apply_fn,
+        slice_params={"x": np.zeros(shape, np.float32)},
+        opt_state={}, counters=FleetCounters(),
+        delta_window=window, delta_codec="int8",
+        delta_budget_bytes=budget,
+    )
+
+
+def _push_rounds(owner, n, seed=6):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        g = rng.normal(0, 0.1, owner._host_flat["x"].shape)
+        owner.submit(1, owner.version, {"x": g.astype(np.float32)})
+
+
+def test_owner_serves_delta_within_window():
+    owner = _delta_owner(window=4)
+    _push_rounds(owner, 3)
+    # current puller: 204
+    assert owner.encoded_for(3, accept_delta=True) == (3, None, "current")
+    # one-behind delta puller
+    v, body, codec = owner.encoded_for(2, accept_delta=True)
+    assert v == 3 and codec == "delta"
+    # the delta IS smaller — the whole point
+    _, full, full_codec = owner.encoded_for(None, accept_delta=True)
+    assert full_codec == "f32" and len(body) < len(full) / 2
+    # without the accept header the same pull is a full frame
+    assert owner.encoded_for(2, accept_delta=False)[2] == "f32"
+
+
+def test_owner_delta_skip_puller_matches_stepwise_exactly():
+    """A puller that skipped versions gets the STACKED pieces and lands
+    bit-identically where stepwise pulls land — the wire chain is one
+    deterministic sequence, not per-puller arithmetic."""
+    owner = _delta_owner(window=4)
+    _push_rounds(owner, 3)
+    meta0, arrays0 = decode_arrays(owner.encoded_for(0, accept_delta=True)[1])
+    skip = decode_delta_frame(meta0, arrays0)["x"]
+    stepwise = np.zeros_like(skip)
+    for known in (0, 1, 2):
+        # per-known frames serve the suffix known+1..3 of the same chain
+        m, a = decode_arrays(owner.encoded_for(known, accept_delta=True)[1])
+        assert m["base"] == known
+    for v in (1, 2, 3):  # replay the chain one piece at a time
+        piece_codec, piece, _ = owner._delta_pieces[v]
+        stepwise = stepwise + decompress_arrays(piece, piece_codec)["x"]
+    np.testing.assert_array_equal(skip, stepwise)
+    # and the chain tracks the true params within quantization error
+    truth = owner._host_flat["x"]
+    assert np.max(np.abs(skip - truth)) < 2e-2
+
+
+def test_owner_delta_window_miss_degrades_to_full():
+    owner = _delta_owner(window=2)
+    _push_rounds(owner, 4)
+    v, body, codec = owner.encoded_for(0, accept_delta=True)  # lag 4 > 2
+    assert v == 4 and codec == "f32"
+    meta, arrays = decode_arrays(body)
+    np.testing.assert_array_equal(arrays["x"], owner._host_flat["x"])
+    # inside the window the delta path still serves
+    assert owner.encoded_for(3, accept_delta=True)[2] == "delta"
+
+
+def test_owner_delta_budget_eviction_degrades_to_full():
+    """A tiny byte budget keeps only the newest piece: the 1-behind pull
+    stays a delta, anything older is a full pull — degrade, never
+    stall."""
+    owner = _delta_owner(window=4, budget=1)
+    _push_rounds(owner, 3)
+    assert list(owner._delta_pieces) == [3]
+    assert owner.encoded_for(2, accept_delta=True)[2] == "delta"
+    assert owner.encoded_for(1, accept_delta=True)[2] == "f32"
+
+
+def test_owner_tiny_slice_delta_falls_back_when_not_smaller():
+    """On a leaf so small the delta frame's header outweighs the saved
+    bytes, the owner serves the full frame even though every piece is
+    retained — the `len(delta) < len(full)` gate."""
+    owner = _delta_owner(window=4, shape=(4,))
+    _push_rounds(owner, 1)
+    assert owner.encoded_for(0, accept_delta=True)[2] == "f32"
+
+
+def test_peer_server_delta_negotiation_over_http():
+    """End to end over the real port: /healthz advertises codecs + the
+    delta window, X-SRT-Accept: delta gets a delta frame with the codec
+    named in X-SRT-Codec, no header gets the PR 14 full frame."""
+    owner = _delta_owner(window=4)
+    _push_rounds(owner, 2)
+    srv = PeerServer(
+        owner, worker_id=0, layout_signature="sig",
+        counters=owner.counters,
+    )
+    host, port = srv.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/healthz", timeout=5
+        ) as r:
+            health = json.loads(r.read())
+        assert health["codecs"] == list(WIRE_CODECS)
+        assert health["delta_window"] == 4
+
+        req = urllib.request.Request(
+            f"http://{host}:{port}/params?known=1",
+            headers={"X-SRT-Accept": "delta"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.headers["X-SRT-Codec"] == "delta"
+            assert int(r.headers["X-SRT-Version"]) == 2
+            meta, arrays = decode_arrays(r.read())
+        delta = decode_delta_frame(meta, arrays)["x"]
+        # the served delta IS the owner's stored v2 chain piece
+        piece_codec, piece, _ = owner._delta_pieces[2]
+        np.testing.assert_array_equal(
+            delta, decompress_arrays(piece, piece_codec)["x"]
+        )
+        # old-style pull: full frame, codec f32, true params
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/params?known=1", timeout=5
+        ) as r:
+            assert r.headers["X-SRT-Codec"] == "f32"
+            _, full_arrays = decode_arrays(r.read())
+        np.testing.assert_array_equal(full_arrays["x"], owner._host_flat["x"])
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------------------
+# Mixed-codec fleet: per-peer negotiation proven by byte counters
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def wire_data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("fleet_wire_data")
+    write_synth_jsonl(d / "train.jsonl", 120, kind="tagger", seed=0)
+    write_synth_jsonl(d / "dev.jsonl", 30, kind="tagger", seed=1)
+    return d
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_mixed_codec_fleet_interop(tagger_config_text, wire_data_dir, tmp_path):
+    """One worker pinned to the PR 14 wire (f32 pushes, no delta pulls),
+    one on int8+delta — the fleet must train to completion with zero
+    discards/push failures, and the byte counters must show the two
+    workers NEGOTIATED different push codecs: the compressed worker's
+    f32-equivalent/actual push ratio is >=1.5x, the f32 worker's is ~1x.
+    """
+    from spacy_ray_tpu.training.fleet.worker import train_fleet_worker
+
+    cfg = Config.from_str(tagger_config_text).apply_overrides({
+        "paths.train": str(wire_data_dir / "train.jsonl"),
+        "paths.dev": str(wire_data_dir / "dev.jsonl"),
+        "training.max_steps": 8,
+        "training.eval_frequency": 8,
+    })
+    per_worker = {
+        0: {"grad_compression": "f32", "param_delta_window": 0},
+        1: {"grad_compression": "int8", "param_delta_window": 4},
+    }
+    ports = _free_ports(2)
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    results, errors = {}, {}
+
+    def run(k):
+        try:
+            _, res = train_fleet_worker(
+                cfg, tmp_path / "out", worker_id=k, n_workers=2,
+                quorum=2, max_staleness=0, port=ports[k], peer_urls=urls,
+                stdout_log=False, install_signal_handlers=False,
+                quorum_wait_s=60.0, **per_worker[k],
+            )
+            results[k] = res
+        except Exception as e:  # surfaced below
+            errors[k] = e
+
+    threads = [
+        threading.Thread(target=run, args=(k,), name=f"mixed-fleet-{k}")
+        for k in (0, 1)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not [t.name for t in threads if t.is_alive()]
+    assert not errors, f"mixed fleet raised: {errors}"
+    assert set(results) == {0, 1}
+
+    for k, res in results.items():
+        fl = res.fleet
+        assert res.final_step == 8
+        assert fl["version"] == 8  # lockstep at S=0 quorum=2
+        assert fl["counters"]["grad_discarded"] == 0
+        assert fl["counters"]["push_failed"] == 0
+        assert fl["counters"]["pull_failed"] == 0
+        assert fl["grad_compression"] == per_worker[k]["grad_compression"]
+
+    def push_ratio(k):
+        c = results[k].fleet["counters"]
+        return c["wire_push_bytes_uncompressed"] / c["wire_push_bytes"]
+
+    # worker 1 negotiated int8 against worker 0 (which ADVERTISES all
+    # codecs even while pushing f32 itself) -> real compression; worker
+    # 0's pushes are byte-for-byte the f32 wire (ratio ~1, the small
+    # slack is the codec field in the json header)
+    assert push_ratio(1) >= 1.5, results[1].fleet["counters"]
+    assert 0.9 <= push_ratio(0) <= 1.1, results[0].fleet["counters"]
+    # pulls: worker 1 ASKS for deltas but worker 0's owner has window 0
+    # -> full frames for everyone (degrade, never stall), ratio ~1
+    for k in (0, 1):
+        c = results[k].fleet["counters"]
+        assert c["wire_pull_bytes"] > 0
+        assert c["wire_pull_bytes"] >= 0.9 * c["wire_pull_bytes_uncompressed"]
