@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry observability serving fleet live train-fleet train-fleet-obs bench baseline profile step-perf serve-perf update-shard dryrun
+.PHONY: test test-fast test-slow resilience telemetry observability serving fleet live train-fleet train-fleet-obs train-fleet-chaos bench baseline profile step-perf serve-perf update-shard dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -86,6 +86,19 @@ train-fleet:
 train-fleet-obs:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_obs.py -q -m "not slow"
 	JAX_PLATFORMS=cpu python -m pytest tests/test_training_fleet.py -q -m "not slow" -k "obs_acceptance or divergence"
+
+# elastic-membership chaos drills (docs/RESILIENCE.md "Ownership
+# failover", docs/TUNING.md §21): the fake-clock lease matrix (a
+# merely-slow worker is provably never evicted), re-shard / epoch-fence
+# / rejoin units, PeerServer malformed-input fuzz (typed 400s, never a
+# traceback), then the slow subprocess drills — owner SIGKILL past its
+# restart budget → lease eviction → epoch-fenced re-shard → the
+# survivors keep training (zero NaN, zero lost lineage, degraded-success
+# rc=0) and the wire-chaos matrix (corrupt/delay/dup/partition at every
+# wire site; a healed zombie's stale-epoch pushes all fenced)
+train-fleet-chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_membership.py -q -m "not slow"
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_membership.py -q -m slow
 
 bench:
 	python bench.py

@@ -3275,6 +3275,14 @@ def run_training_fleet(
             # record notes that the path produced one, not a dead path
             "run_report_generated": report_path is not None,
             "versions": [l.get("version") for l in ledgers],
+            # elastic membership: final epoch per worker (all equal on a
+            # quiet run; a failover run shows the bumps) and the
+            # fleet-wide eviction count, promoted out of `counters` so
+            # sweep queries don't have to dig
+            "membership_epochs": [
+                l.get("membership_epoch") for l in ledgers
+            ],
+            "evictions": int(counters.get("evictions") or 0),
             "cores_available": len(cores),
             "contended": contended,
             "scaling_vs_first": (

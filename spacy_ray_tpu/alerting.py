@@ -874,6 +874,15 @@ def default_training_rules(
       ``divergence_flags`` counter ever moves, so the rule is silent on
       every other worker's engine; the flag's anomaly row + incident
       bundle name the diverging worker.
+    * ``fleet-owner-evicted`` — the lease verdict fired: the acting
+      lead declared a peer dead and bumped the membership epoch
+      (RESILIENCE.md "Ownership failover"). Training continues on the
+      survivors by design, but an eviction is capacity loss plus an
+      optimizer-moment restore on every re-sharded slice — a human
+      should know within the window. Only the acting lead's
+      ``evictions`` counter moves (``partial=True`` keeps the other
+      engines silent); the eviction's structured event and the
+      ``fleet-membership.jsonl`` ledger row name the evicted worker.
     """
     rules: List[AlertRule] = [
         AbsenceRule(
@@ -922,6 +931,16 @@ def default_training_rules(
                 ThresholdRule(
                     "fleet-worker-diverging",
                     "counters.divergence_flags",
+                    ">=",
+                    1.0,
+                    window_s=600.0,
+                    for_s=0.0,
+                    partial=True,
+                    severity="page",
+                ),
+                ThresholdRule(
+                    "fleet-owner-evicted",
+                    "counters.evictions",
                     ">=",
                     1.0,
                     window_s=600.0,

@@ -240,6 +240,15 @@ def train_command(argv: List[str]) -> int:
                         "versions behind ships a delta frame instead of "
                         "its full slice; 0 = full pulls only. Window "
                         "misses degrade to full pulls (RESILIENCE.md)")
+    parser.add_argument("--peer-lease-s", type=float, default=60.0,
+                        dest="peer_lease_s",
+                        help="fleet: elastic-membership lease — a peer "
+                        "silent on /healthz for this long AND missing 3 "
+                        "consecutive probes is evicted by the acting "
+                        "lead; survivors re-shard its parameters at the "
+                        "next membership epoch (RESILIENCE.md "
+                        "'Ownership failover'). 0 disables eviction "
+                        "(frozen membership)")
     parser.add_argument("--verbose", "-V", action="store_true")
     args, extra = parser.parse_known_args(argv)
 
@@ -293,6 +302,7 @@ def train_command(argv: List[str]) -> int:
             ),
             "grad_compression": args.grad_compression,
             "param_delta_window": args.param_delta_window,
+            "peer_lease_s": args.peer_lease_s,
         }
 
     nlp, result = train(

@@ -229,6 +229,8 @@ class TopModel:
                 "alerts": payload.get("alerts"),
                 "worker": worker,
                 "version": _get(payload, "gauges", "param_version"),
+                "epoch": _get(payload, "gauges", "membership_epoch"),
+                "evictions": counters.get("evictions"),
                 "push_s": push_s,
                 "discard_s": disc_s,
                 "discard_rate": discard_rate,
@@ -343,6 +345,8 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 wr_s = f"{wr:.1f}x" if isinstance(wr, float) else "-"
                 lines.append(
                     f"    ver {_fmt_int(row.get('version'))}  "
+                    f"epoch {_fmt_int(row.get('epoch'))}  "
+                    f"evict {_fmt_int(row.get('evictions'))}  "
                     f"push {_fmt_rate(row.get('push_s'))}  "
                     f"disc {_fmt_rate(row.get('discard_s'))}  "
                     f"disc-rate {dr_s}  "

@@ -466,13 +466,37 @@ def commit_fleet_generation(
     path = Path(path)
     keep = max(int(keep), 1)
     stamp = int(step)
+    extra = dict(extra or {})
+    fleet_extra = extra.get("fleet")
+    if isinstance(fleet_extra, dict) and (
+        "epoch" in fleet_extra or "active" in fleet_extra
+    ):
+        # normalize the elastic-membership block BEFORE it hits disk: a
+        # malformed epoch/active here would poison every later resume's
+        # membership restore (worker.py falls back to the full original
+        # fleet on a bad block, silently undoing a failover)
+        fleet_extra = dict(fleet_extra)
+        m_epoch = int(fleet_extra.get("epoch", 0))
+        active = sorted(int(w) for w in fleet_extra.get("active") or [])
+        if m_epoch < 0:
+            raise ValueError(
+                f"fleet membership epoch {m_epoch} is negative"
+            )
+        if not active or len(set(active)) != len(active) or active[0] < 0:
+            raise ValueError(
+                f"fleet membership active set {active!r} must be "
+                "non-empty, unique, non-negative worker ids"
+            )
+        fleet_extra["epoch"] = m_epoch
+        fleet_extra["active"] = active
+        extra["fleet"] = fleet_extra
     meta: Dict[str, Any] = {
         "step": int(step),
         "epoch": int(epoch),
         "rng": np.asarray(rng).tolist(),
         "best_score": float(best_score),
         "best_step": int(best_step),
-        "extra": extra or {},
+        "extra": extra,
         "stamp": stamp,
         "format": CHECKPOINT_FORMAT,
         "opt_shards": int(opt_shards),

@@ -1,0 +1,418 @@
+"""Elastic fleet membership: leases, epochs, survivor re-sharding.
+
+PR 14's fleet assumed the worker set was immutable: a worker that died
+PAST its restart cap froze its owned shards forever while peers burned
+``pull_wait_timeouts`` every step. This module makes membership a
+first-class, *fenced* quantity:
+
+* :class:`LeaseTracker` — lease-based liveness with consecutive-miss
+  hysteresis. A peer is declared dead only when BOTH its lease expired
+  (no successful ``/healthz`` for ``lease_s`` seconds) AND it missed
+  ``miss_threshold`` consecutive probes. ``/healthz`` is served by each
+  worker's daemon HTTP thread, so a merely-SLOW worker (long step, long
+  eval) keeps answering and provably never gets evicted — the same
+  fake-clock-tested discipline as the autoscaler/canary guards.
+
+* :class:`Membership` — the fleet-wide truth: a monotonically increasing
+  **epoch** plus the sorted tuple of active worker ids. Every eviction
+  or join bumps the epoch; every push/pull/checkpoint frame is stamped
+  with it, and owners discard (counted, ``epoch_fenced``) any frame
+  carrying a different epoch — a zombie owner resurfacing after its
+  eviction cannot corrupt the new layout (RESILIENCE.md "Ownership
+  failover").
+
+* :class:`RankedLayout` — the re-shard: the SAME first-divisible-axis
+  rule as :class:`~.ownership.OwnershipLayout`, computed over the
+  **survivor count** and addressed by original worker id (ids are
+  mapped to dense survivor ranks internally). Checkpoint part files are
+  written per RANK, so a post-failover generation is a normal
+  ``len(active)``-shard v2 generation that a synchronous run — or a
+  fresh fleet of any size — resumes exactly.
+
+* :class:`PeerBackoff` — the dead-owner pull-spin fix: a pull target
+  that keeps missing its deadline costs ONE structured
+  ``fleet-peer-unreachable`` event and a capped exponential backoff,
+  not a full ``quorum_wait_s`` burn plus a counter tick every step.
+
+* :class:`MembershipLedger` — append-only ``fleet-membership.jsonl``
+  event log (evictions, joins, adoptions) in the run directory; the
+  run report's membership timeline and CI's failure artifacts read it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .ownership import IndexT, OwnershipLayout
+
+__all__ = [
+    "LeaseTracker",
+    "Membership",
+    "MembershipLedger",
+    "PeerBackoff",
+    "RankedLayout",
+]
+
+
+class LeaseTracker:
+    """Lease + consecutive-miss hysteresis per peer.
+
+    The verdict is two-factor by design: ``lease_s`` bounds how long a
+    peer may go unheard (wall clock), ``miss_threshold`` demands the
+    silence be corroborated by that many consecutive failed probes.
+    Either alone is evictable-by-accident — a long GC pause plus one
+    unlucky probe, or a fast probe loop burning through misses inside a
+    second — together they are not. Thread-safe; ``clock`` is injectable
+    for fake-clock tests.
+    """
+
+    def __init__(
+        self,
+        peers: Iterable[int],
+        *,
+        lease_s: float,
+        miss_threshold: int = 3,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if float(lease_s) <= 0:
+            raise ValueError(f"lease_s must be > 0, got {lease_s}")
+        if int(miss_threshold) < 1:
+            raise ValueError(
+                f"miss_threshold must be >= 1, got {miss_threshold}"
+            )
+        self.lease_s = float(lease_s)
+        self.miss_threshold = int(miss_threshold)
+        self.clock = clock
+        self._lock = threading.Lock()
+        now = self.clock()
+        # a fresh peer starts with a full lease (grace for startup)
+        self._last_ok: Dict[int, float] = {int(p): now for p in peers}
+        self._misses: Dict[int, int] = {int(p): 0 for p in self._last_ok}
+
+    def peers(self) -> List[int]:
+        with self._lock:
+            return sorted(self._last_ok)
+
+    def add(self, peer: int) -> None:
+        with self._lock:
+            if int(peer) not in self._last_ok:
+                self._last_ok[int(peer)] = self.clock()
+                self._misses[int(peer)] = 0
+
+    def remove(self, peer: int) -> None:
+        with self._lock:
+            self._last_ok.pop(int(peer), None)
+            self._misses.pop(int(peer), None)
+
+    def observe(self, peer: int, ok: bool) -> None:
+        """Record one probe result for ``peer``."""
+        p = int(peer)
+        with self._lock:
+            if p not in self._last_ok:
+                return
+            if ok:
+                self._last_ok[p] = self.clock()
+                self._misses[p] = 0
+            else:
+                self._misses[p] += 1
+
+    def dead(self, peer: int) -> bool:
+        p = int(peer)
+        with self._lock:
+            last = self._last_ok.get(p)
+            if last is None:
+                return False
+            return (
+                self.clock() - last > self.lease_s
+                and self._misses[p] >= self.miss_threshold
+            )
+
+    def expired(self) -> List[int]:
+        """Every tracked peer currently past BOTH gates."""
+        with self._lock:
+            now = self.clock()
+            return sorted(
+                p
+                for p, last in self._last_ok.items()
+                if now - last > self.lease_s
+                and self._misses[p] >= self.miss_threshold
+            )
+
+
+class RankedLayout:
+    """An :class:`~.ownership.OwnershipLayout` over the ACTIVE worker
+    set, addressed by original worker id.
+
+    The base layout is computed for ``len(active)`` workers (the same
+    first-divisible-axis rule, so part files remain v2-canonical); ids
+    are translated to dense survivor ranks at every call. An id outside
+    the active set owns nothing — its slices were re-owned at the epoch
+    bump, which is exactly what the epoch fence enforces on the wire.
+    """
+
+    def __init__(self, template: Any, active: Sequence[int]) -> None:
+        self.active = tuple(sorted(int(w) for w in set(active)))
+        if not self.active:
+            raise ValueError("RankedLayout needs at least one active worker")
+        self._rank: Dict[int, int] = {
+            w: r for r, w in enumerate(self.active)
+        }
+        self.base = OwnershipLayout(template, len(self.active))
+        self.n_workers = self.base.n_workers
+        self.paths = self.base.paths
+        self.shapes = self.base.shapes
+        self.axes = self.base.axes
+
+    def rank_of(self, worker: int) -> Optional[int]:
+        return self._rank.get(int(worker))
+
+    # -- id-addressed delegation --------------------------------------
+    def owns(self, ordinal: int, worker: int) -> bool:
+        r = self.rank_of(worker)
+        return False if r is None else self.base.owns(ordinal, r)
+
+    def index(self, ordinal: int, worker: int) -> Optional[IndexT]:
+        r = self.rank_of(worker)
+        if r is None:
+            raise ValueError(f"worker {worker} is not in the active set")
+        return self.base.index(ordinal, r)
+
+    def key_index(self, key: str, worker: int) -> Optional[IndexT]:
+        r = self.rank_of(worker)
+        if r is None:
+            raise ValueError(f"worker {worker} is not in the active set")
+        return self.base.key_index(key, r)
+
+    def index_for_shape(
+        self, shape: Sequence[int], worker: int
+    ) -> Optional[IndexT]:
+        r = self.rank_of(worker)
+        if r is None:
+            raise ValueError(f"worker {worker} is not in the active set")
+        return self.base.index_for_shape(shape, r)
+
+    slice_with = staticmethod(OwnershipLayout.slice_with)
+
+    def owned_keys(self, worker: int) -> List[str]:
+        r = self.rank_of(worker)
+        return [] if r is None else self.base.owned_keys(r)
+
+    def flat_slices(self, tree: Any, worker: int) -> Dict[str, np.ndarray]:
+        r = self.rank_of(worker)
+        return {} if r is None else self.base.flat_slices(tree, r)
+
+    def slice_tree(self, tree: Any, worker: int) -> Dict[str, Any]:
+        r = self.rank_of(worker)
+        if r is None:
+            return {}
+        return self.base.slice_tree(tree, r)
+
+    def merge_flat(
+        self,
+        full: Any,
+        worker: int,
+        flat: Dict[str, np.ndarray],
+        *,
+        add: bool = False,
+    ) -> None:
+        r = self.rank_of(worker)
+        if r is None:
+            raise ValueError(f"worker {worker} is not in the active set")
+        self.base.merge_flat(full, r, flat, add=add)
+
+    def signature(self) -> str:
+        """Structural digest peers must agree on. Includes the ACTIVE
+        id set: two fleets at different memberships slice differently,
+        so their frames must not interoperate silently."""
+        import hashlib
+
+        text = (
+            "active=" + ",".join(map(str, self.active)) + "|"
+            + self.base.signature()
+        )
+        return hashlib.sha256(text.encode("utf8")).hexdigest()[:16]
+
+
+class Membership:
+    """The fleet-wide membership truth: ``(epoch, active ids)``.
+
+    Immutable; :meth:`evict` / :meth:`admit` return the NEXT membership
+    at ``epoch + 1``. The lead is the lowest active id — a deterministic
+    survivor-rank fallback, so when the lead itself dies the next-lowest
+    survivor's lease thread takes over the verdict with no election.
+    """
+
+    def __init__(self, active: Sequence[int], epoch: int = 0) -> None:
+        self.active: Tuple[int, ...] = tuple(
+            sorted(int(w) for w in set(active))
+        )
+        if not self.active:
+            raise ValueError("membership needs at least one active worker")
+        self.epoch = int(epoch)
+        if self.epoch < 0:
+            raise ValueError(f"membership epoch must be >= 0, got {self.epoch}")
+
+    @property
+    def lead(self) -> int:
+        return self.active[0]
+
+    def __contains__(self, worker: int) -> bool:
+        return int(worker) in self.active
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, Membership)
+            and self.epoch == other.epoch
+            and self.active == other.active
+        )
+
+    def __repr__(self) -> str:
+        return f"Membership(epoch={self.epoch}, active={list(self.active)})"
+
+    def evict(self, worker: int) -> "Membership":
+        if int(worker) not in self.active:
+            raise ValueError(f"worker {worker} is not active")
+        survivors = tuple(w for w in self.active if w != int(worker))
+        if not survivors:
+            raise ValueError("cannot evict the last active worker")
+        return Membership(survivors, self.epoch + 1)
+
+    def admit(self, worker: int) -> "Membership":
+        if int(worker) in self.active:
+            raise ValueError(f"worker {worker} is already active")
+        return Membership(self.active + (int(worker),), self.epoch + 1)
+
+    def layout(self, template: Any) -> RankedLayout:
+        return RankedLayout(template, self.active)
+
+    # -- wire form ----------------------------------------------------
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "epoch": self.epoch,
+            "active": list(self.active),
+            "lead": self.lead,
+        }
+
+    @classmethod
+    def from_wire(cls, payload: Any) -> "Membership":
+        """Validated parse of a ``/membership`` body — malformed input
+        raises ValueError (the server turns it into a 400, never a
+        handler traceback)."""
+        if not isinstance(payload, dict):
+            raise ValueError("membership payload must be a JSON object")
+        epoch = payload.get("epoch")
+        active = payload.get("active")
+        if not isinstance(epoch, int) or isinstance(epoch, bool) or epoch < 0:
+            raise ValueError(f"membership epoch must be an int >= 0, got {epoch!r}")
+        if (
+            not isinstance(active, (list, tuple))
+            or not active
+            or not all(
+                isinstance(w, int) and not isinstance(w, bool) and w >= 0
+                for w in active
+            )
+        ):
+            raise ValueError(
+                f"membership active set must be a non-empty list of "
+                f"worker ids, got {active!r}"
+            )
+        return cls(active, epoch)
+
+
+class PeerBackoff:
+    """Capped exponential backoff per unreachable peer (the dead-owner
+    pull-spin fix). ``record_failure`` returns True exactly once per
+    outage — the caller's cue to emit the single structured
+    ``fleet-peer-unreachable`` event; while a peer is backing off,
+    ``skip`` is True and the pull loop spends ZERO wait time on it."""
+
+    def __init__(
+        self,
+        *,
+        base_s: float = 1.0,
+        cap_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.clock = clock
+        self._delay: Dict[int, float] = {}
+        self._until: Dict[int, float] = {}
+
+    def record_failure(self, peer: int) -> bool:
+        p = int(peer)
+        first = p not in self._delay
+        delay = self.base_s if first else min(
+            self._delay[p] * 2.0, self.cap_s
+        )
+        self._delay[p] = delay
+        self._until[p] = self.clock() + delay
+        return first
+
+    def record_success(self, peer: int) -> bool:
+        """Clear ``peer``'s outage; True when one was in progress (the
+        caller's cue to log the recovery)."""
+        p = int(peer)
+        was_down = p in self._delay
+        self._delay.pop(p, None)
+        self._until.pop(p, None)
+        return was_down
+
+    def skip(self, peer: int) -> bool:
+        until = self._until.get(int(peer))
+        return until is not None and self.clock() < until
+
+    def current_delay(self, peer: int) -> float:
+        return self._delay.get(int(peer), 0.0)
+
+
+class MembershipLedger:
+    """Append-only jsonl event log for membership transitions
+    (``fleet-membership.jsonl`` in the run dir). Written by whichever
+    worker is the ACTING lead at the time — one writer per event, append
+    mode, one line per event, so a lead failover keeps extending the
+    same file."""
+
+    def __init__(self, path: Optional[Path]) -> None:
+        self.path = Path(path) if path is not None else None
+        self._lock = threading.Lock()
+
+    def append(self, event: str, **fields: Any) -> None:
+        if self.path is None:
+            return
+        row = {"ts": time.time(), "event": str(event), **fields}
+        line = json.dumps(row, sort_keys=True) + "\n"
+        try:
+            with self._lock:
+                self.path.parent.mkdir(parents=True, exist_ok=True)
+                with open(self.path, "a", encoding="utf8") as f:
+                    f.write(line)
+        except OSError:
+            pass  # the ledger is evidence, never a crash source
+
+
+def read_membership_ledger(path: Path) -> List[Dict[str, Any]]:
+    """All well-formed rows of a ``fleet-membership.jsonl`` (bad lines
+    skipped — the file may be mid-append when read)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        text = Path(path).read_text(encoding="utf8")
+    except OSError:
+        return out
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict):
+            out.append(row)
+    return out

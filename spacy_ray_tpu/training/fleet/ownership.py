@@ -119,6 +119,15 @@ class OwnershipLayout:
                 out.append((0, dim))
         return tuple(out)
 
+    def key_index(self, key: str, worker: int) -> Optional[IndexT]:
+        """``index`` addressed by '/'-joined path key instead of
+        ordinal — what re-shard geometry comparisons work in, since
+        ordinals are only stable within one layout."""
+        ordinal = self._by_key.get(key)
+        if ordinal is None:
+            raise ValueError(f"unknown param leaf {key!r}")
+        return self.index(ordinal, worker)
+
     def index_for_shape(
         self, shape: Sequence[int], worker: int
     ) -> Optional[IndexT]:
@@ -257,11 +266,22 @@ def opt_part_records(
     carries, exactly like the in-mesh writer's.
 
     Chain scalars (Adam/schedule counts) exist in EVERY worker's local
-    state but are emitted by worker 0 only, with ``index=None`` — the
-    same placement the in-mesh v2 writer gives replicated leaves.
+    state but are emitted by the rank-0 owner only, with ``index=None``
+    — the same placement the in-mesh v2 writer gives replicated leaves.
+    (With a plain :class:`OwnershipLayout` rank == worker id; an elastic
+    :class:`~.membership.RankedLayout` maps surviving ids to dense
+    ranks, so after a failover the new lowest-id survivor writes them.)
     """
     import jax
 
+    rank = worker
+    rank_of = getattr(layout, "rank_of", None)
+    if rank_of is not None:
+        rank = rank_of(worker)
+        if rank is None:
+            raise ValueError(
+                f"worker {worker} is not in the layout's active set"
+            )
     template_struct = jax.eval_shape(tx.init, param_template)
     global_leaves = _flatten_with_keystr(template_struct)
     global_by_key = {
@@ -286,8 +306,8 @@ def opt_part_records(
         index = layout.index_for_shape(gshape, worker)
         piece = np.asarray(jax.device_get(leaf))
         if index is None:
-            if worker != 0:
-                continue  # worker 0 writes the whole-leaf copies
+            if rank != 0:
+                continue  # the rank-0 owner writes the whole-leaf copies
             if piece.shape != gshape:
                 raise ValueError(
                     f"unshardable optimizer leaf {key!r} has local shape "

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import logging
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -34,6 +35,7 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from .membership import Membership
 from .ownership import iter_leaves, path_key, tree_from_flat
 from .wire import (
     WIRE_CODECS,
@@ -42,7 +44,14 @@ from .wire import (
     decode_grads,
     encode_arrays,
     encode_delta_frame,
+    frame_epoch,
 )
+
+#: hard request-body ceiling (bytes) — a frame bigger than any sane
+#: gradient/checkpoint payload for this repo's models is hostile or
+#: corrupt input, and reading it into memory before discovering that is
+#: the damage. 413 + counted discard, never an allocation stampede.
+MAX_BODY_BYTES = 1 << 30
 
 logger = logging.getLogger("spacy_ray_tpu.training")
 
@@ -73,6 +82,14 @@ COUNTER_NAMES = (
     "wire_push_bytes_uncompressed",
     "wire_pull_bytes",
     "wire_pull_bytes_uncompressed",
+    # elastic-membership ledger (PR 17): frames carrying a stale/foreign
+    # membership epoch that were counted-discarded at the fence, peers
+    # this worker (as acting lead) declared dead, and orphaned param
+    # leaves this worker adopted at a re-shard. Prometheus names:
+    # srt_training_{epoch_fenced,evictions,shards_adopted}_total.
+    "epoch_fenced",
+    "evictions",
+    "shards_adopted",
 )
 
 
@@ -455,6 +472,43 @@ class OwnerState:
 
 class _PeerHTTPD(ThreadingHTTPServer):
     daemon_threads = True
+
+    # ``server_close`` only closes the LISTENING socket; keep-alive
+    # connections stay serviced by their daemon handler threads, so a
+    # "stopped" server would keep answering /healthz probes over
+    # established connections forever — a thread-fleet worker could
+    # never be declared dead by the lease tracker. Track every accepted
+    # connection so stop() can sever them the way a killed PROCESS
+    # would.
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+
+    def process_request(self, request: Any, client_address: Any) -> None:
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request: Any) -> None:
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self) -> None:
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
     owner: OwnerState
     worker_id: int
     layout_signature: str
@@ -462,6 +516,16 @@ class _PeerHTTPD(ThreadingHTTPServer):
     checkpoint_cb: Optional[Callable[[str, int], Dict[str, Any]]]
     finalize_event: threading.Event
     counters: FleetCounters
+    # elastic membership (PR 17): the epoch every frame is fenced
+    # against, the advertised membership, broadcast adoptions pending
+    # the worker loop's next step boundary, and queued join requests
+    # (drained by the acting lead's membership thread)
+    epoch: int
+    membership: Optional[Dict[str, Any]]
+    membership_lock: threading.Lock
+    pending_membership: Optional[Membership]
+    join_requests: list
+    max_body_bytes: int
 
 
 class _PeerHandler(BaseHTTPRequestHandler):
@@ -508,9 +572,15 @@ class _PeerHandler(BaseHTTPRequestHandler):
                 # against this (absent on old peers -> they get f32)
                 "codecs": list(WIRE_CODECS),
                 "delta_window": srv.owner.delta_window,
+                "epoch": srv.epoch,
             }
             if srv.tel is not None:
                 payload["anchor"] = srv.tel.trace.anchor()
+            self._reply_json(200, payload)
+        elif parsed.path == "/membership":
+            with srv.membership_lock:
+                payload = dict(srv.membership or {})
+            payload.setdefault("epoch", srv.epoch)
             self._reply_json(200, payload)
         elif parsed.path == "/params":
             q = parse_qs(parsed.query)
@@ -525,6 +595,35 @@ class _PeerHandler(BaseHTTPRequestHandler):
                     400, {"error": "bad_request",
                           "message": f"known={known_s!r} is not an int"}
                 )
+                return
+            # epoch fence on the pull side: a zombie owner (or a peer
+            # still on a pre-eviction membership) must not receive the
+            # NEW layout's slices — its merge offsets would be wrong.
+            # Absent header = epoch 0 (pre-elastic puller); garbage is a
+            # 400 like every other malformed input on this port.
+            epoch_s = self.headers.get("X-SRT-Epoch")
+            if epoch_s is not None:
+                try:
+                    req_epoch = int(epoch_s)
+                except ValueError:
+                    self._reply_json(
+                        400, {"error": "bad_request",
+                              "message": f"X-SRT-Epoch {epoch_s!r} is not an int"}
+                    )
+                    return
+            else:
+                req_epoch = 0
+            if req_epoch != srv.epoch:
+                srv.counters.inc("epoch_fenced")
+                self.send_response(409)
+                self.send_header("X-SRT-Epoch", str(srv.epoch))
+                body = json.dumps(
+                    {"error": "epoch_fenced", "epoch": srv.epoch}
+                ).encode("utf8")
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
                 return
             # delta negotiation rides a REQUEST header (an old worker
             # sends no header and gets the PR 14 full frame); the reply
@@ -579,6 +678,7 @@ class _PeerHandler(BaseHTTPRequestHandler):
                 "gauges": {
                     "fleet_worker": srv.worker_id,
                     "param_version": srv.owner.version,
+                    "membership_epoch": srv.epoch,
                 },
             }
             if fmt == "prometheus":
@@ -609,21 +709,61 @@ class _PeerHandler(BaseHTTPRequestHandler):
         )
         self._reply_bytes(200, body, content_type)
 
+    def _body_or_413(self) -> Optional[bytes]:
+        """Read the request body, or reply 413 + counted discard and
+        return None when the declared length exceeds the cap — an
+        oversized frame must cost a typed rejection, not a
+        multi-gigabyte allocation inside a handler thread."""
+        srv = self.server
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            self._reply_json(
+                400, {"error": "bad_request",
+                      "message": "Content-Length is not an int"}
+            )
+            return None
+        if length > srv.max_body_bytes:
+            srv.counters.inc("grad_discarded")
+            self._reply_json(
+                413, {"error": "body_too_large",
+                      "message": f"{length} bytes exceeds the "
+                      f"{srv.max_body_bytes}-byte frame cap"}
+            )
+            return None
+        return self.rfile.read(length) if length > 0 else b""
+
     # -- POST ----------------------------------------------------------
     def do_POST(self) -> None:  # noqa: N802
         parsed = urlparse(self.path)
         srv = self.server
         if parsed.path == "/grad":
+            body = self._body_or_413()
+            if body is None:
+                return
             try:
                 # decode_grads dequantizes bf16/int8 frames to f32 and
                 # passes unknown codecs through untouched — the
                 # structural check in OwnerState.submit turns a genuine
                 # mismatch into a counted discard, not a 400
-                meta, arrays = decode_grads(self._read_body())
+                meta, arrays = decode_grads(body)
+                epoch = frame_epoch(meta)
                 worker = int(meta["worker"])
                 stamp = int(meta["stamp"])
             except (WireError, KeyError, TypeError, ValueError) as e:
                 self._reply_json(400, {"error": "bad_payload", "message": str(e)})
+                return
+            if epoch != srv.epoch:
+                # the zombie fence: a push stamped with a dead
+                # membership's epoch is counted-discarded BEFORE the
+                # quorum buffer — its slice offsets describe a layout
+                # that no longer exists, and applying them would corrupt
+                # the re-sharded state silently
+                srv.counters.inc("epoch_fenced")
+                self._reply_json(
+                    200,
+                    {"accepted": False, "fenced": True, "epoch": srv.epoch},
+                )
                 return
             accepted, version = srv.owner.submit(worker, stamp, arrays)
             self._reply_json(
@@ -633,12 +773,26 @@ class _PeerHandler(BaseHTTPRequestHandler):
             if srv.checkpoint_cb is None:
                 self._reply_json(503, {"error": "not_ready"})
                 return
+            body = self._body_or_413()
+            if body is None:
+                return
             try:
-                req = json.loads(self._read_body().decode("utf8") or "{}")
+                req = json.loads(body.decode("utf8") or "{}")
                 ckpt_dir = str(req["dir"])
                 stamp = int(req["stamp"])
-            except (ValueError, KeyError, UnicodeDecodeError) as e:
+                epoch = frame_epoch(req if isinstance(req, dict) else {})
+            except (WireError, ValueError, KeyError, UnicodeDecodeError) as e:
                 self._reply_json(400, {"error": "bad_request", "message": str(e)})
+                return
+            if epoch != srv.epoch:
+                # a checkpoint generation must be one membership's
+                # consistent cut: parts written under different epochs
+                # have different shard geometry and would assemble into
+                # garbage — fence the request, keep the old generation
+                srv.counters.inc("epoch_fenced")
+                self._reply_json(
+                    409, {"error": "epoch_fenced", "epoch": srv.epoch}
+                )
                 return
             try:
                 result = srv.checkpoint_cb(ckpt_dir, stamp)
@@ -650,6 +804,66 @@ class _PeerHandler(BaseHTTPRequestHandler):
                 return
             body = encode_arrays(result["meta"], result["params"])
             self._reply_bytes(200, body, "application/octet-stream")
+        elif parsed.path == "/membership":
+            # lead-broadcast adoption: a NEW membership (strictly higher
+            # epoch) is queued for the worker loop's next step boundary
+            # — the swap must happen between steps, not mid-push, and
+            # not on a handler thread that races the trainer
+            body = self._body_or_413()
+            if body is None:
+                return
+            try:
+                m = Membership.from_wire(
+                    json.loads(body.decode("utf8") or "{}")
+                )
+            except (ValueError, UnicodeDecodeError) as e:
+                self._reply_json(
+                    400, {"error": "bad_request", "message": str(e)}
+                )
+                return
+            with srv.membership_lock:
+                if m.epoch <= srv.epoch and not (
+                    srv.pending_membership is not None
+                    and m.epoch > srv.pending_membership.epoch
+                ):
+                    # a zombie lead re-broadcasting its dead membership
+                    # is fenced exactly like its pushes
+                    srv.counters.inc("epoch_fenced")
+                    self._reply_json(
+                        409, {"error": "epoch_fenced", "epoch": srv.epoch}
+                    )
+                    return
+                # racing broadcasts: the HIGHEST epoch wins the pending
+                # slot (same rule as PeerServer.queue_membership) — an
+                # older-but-unfenced frame must not regress it
+                if (
+                    srv.pending_membership is None
+                    or m.epoch > srv.pending_membership.epoch
+                ):
+                    srv.pending_membership = m
+            self._reply_json(200, {"adopted": True, "epoch": m.epoch})
+        elif parsed.path == "/membership/join":
+            body = self._body_or_413()
+            if body is None:
+                return
+            try:
+                req = json.loads(body.decode("utf8") or "{}")
+                joiner = req["worker"]
+                if (
+                    isinstance(joiner, bool)
+                    or not isinstance(joiner, int)
+                    or joiner < 0
+                ):
+                    raise ValueError(f"worker {joiner!r} is not an id")
+            except (ValueError, KeyError, UnicodeDecodeError) as e:
+                self._reply_json(
+                    400, {"error": "bad_request", "message": str(e)}
+                )
+                return
+            with srv.membership_lock:
+                if joiner not in srv.join_requests:
+                    srv.join_requests.append(joiner)
+            self._reply_json(200, {"queued": True, "epoch": srv.epoch})
         elif parsed.path == "/finalize":
             srv.finalize_event.set()
             self._reply_json(200, {"status": "finalizing"})
@@ -681,6 +895,12 @@ class PeerServer:
         self.httpd.counters = counters
         self.httpd.checkpoint_cb = checkpoint_cb
         self.httpd.finalize_event = threading.Event()
+        self.httpd.epoch = 0
+        self.httpd.membership = None
+        self.httpd.membership_lock = threading.Lock()
+        self.httpd.pending_membership = None
+        self.httpd.join_requests = []
+        self.httpd.max_body_bytes = int(MAX_BODY_BYTES)
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -697,6 +917,59 @@ class PeerServer:
     ) -> None:
         self.httpd.checkpoint_cb = cb
 
+    # -- elastic membership (PR 17) ------------------------------------
+    @property
+    def epoch(self) -> int:
+        return self.httpd.epoch
+
+    def set_membership(
+        self, membership: Membership, layout_signature: str
+    ) -> None:
+        """Adopt ``membership`` as this server's fencing truth — called
+        by the worker loop at the step boundary where it applies the
+        re-shard (never from a handler thread)."""
+        with self.httpd.membership_lock:
+            self.httpd.epoch = int(membership.epoch)
+            self.httpd.membership = membership.to_wire()
+            self.httpd.layout_signature = str(layout_signature)
+
+    def set_owner(self, owner: OwnerState) -> None:
+        """Swap in the re-sharded owner state (same step boundary as
+        :meth:`set_membership`). Handler threads read ``srv.owner`` per
+        request, so the swap is one attribute assignment."""
+        self.httpd.owner = owner
+
+    def queue_membership(self, membership: Membership) -> None:
+        """Queue a membership the LOCAL worker decided on (the acting
+        lead's own eviction verdict) for its next step boundary — the
+        same pending slot a broadcast lands in."""
+        with self.httpd.membership_lock:
+            pending = self.httpd.pending_membership
+            if pending is None or membership.epoch > pending.epoch:
+                self.httpd.pending_membership = membership
+
+    def take_pending_membership(self) -> Optional[Membership]:
+        with self.httpd.membership_lock:
+            m = self.httpd.pending_membership
+            self.httpd.pending_membership = None
+            return m
+
+    def pending_membership_epoch(self) -> Optional[int]:
+        """Non-consuming peek for the step loop's quorum wait: a queued
+        epoch newer than the current one means survivors already stamp
+        their frames with the NEW epoch, so the old epoch's quorum can
+        never complete — the wait should yield to the apply instead of
+        burning ``quorum_wait_s``."""
+        with self.httpd.membership_lock:
+            m = self.httpd.pending_membership
+            return None if m is None else m.epoch
+
+    def drain_join_requests(self) -> list:
+        with self.httpd.membership_lock:
+            reqs = list(self.httpd.join_requests)
+            self.httpd.join_requests.clear()
+            return reqs
+
     def start(self) -> Tuple[str, int]:
         self._thread = threading.Thread(
             target=self.httpd.serve_forever,
@@ -710,6 +983,10 @@ class PeerServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        # sever established keep-alive connections too — peers' lease
+        # probes must see this worker DIE (connection dropped), exactly
+        # as they would if the whole process were SIGKILLed
+        self.httpd.close_all_connections()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None

@@ -67,6 +67,7 @@ __all__ = [
     "WIRE_CODECS",
     "SCALE_SUFFIX",
     "WireError",
+    "frame_epoch",
     "encode_arrays",
     "decode_arrays",
     "compress_arrays",
@@ -84,6 +85,21 @@ __all__ = [
 class WireError(ValueError):
     """Malformed fleet wire payload (truncated, wrong magic, bad
     header, byte-count mismatch)."""
+
+
+def frame_epoch(meta: Dict[str, Any]) -> int:
+    """The membership epoch stamped on a frame's meta (PR 17 elastic
+    membership: every push/pull/checkpoint frame carries the sender's
+    epoch, and owners fence mismatches). A frame WITHOUT the field is a
+    pre-elastic peer's — epoch 0 by definition, so an unchanged fleet
+    interoperates. A garbage stamp raises :class:`WireError` (the
+    malformed-payload family, not a handler traceback)."""
+    e = meta.get("epoch", 0)
+    if isinstance(e, bool) or not isinstance(e, int) or e < 0:
+        raise WireError(
+            f"bad fleet payload: epoch {e!r} is not an int >= 0"
+        )
+    return int(e)
 
 
 def encode_arrays(meta: Dict[str, Any], arrays: Dict[str, np.ndarray]) -> bytes:
@@ -320,6 +336,12 @@ class GradCompressor:
         self.error_feedback = bool(error_feedback)
         self._residual: Dict[Tuple[Any, str], np.ndarray] = {}
 
+    def reset(self) -> None:
+        """Drop all accumulated residuals. Required at an ownership
+        re-shard: residuals are per-(peer, leaf-slice) and the slice
+        geometry they telescope against no longer exists."""
+        self._residual.clear()
+
     def compress(
         self,
         peer: Any,
@@ -335,6 +357,11 @@ class GradCompressor:
             rkey = (peer, key)
             if self.error_feedback and c != "f32":
                 residual = self._residual.get(rkey)
+                if residual is not None and residual.shape != g32.shape:
+                    # slice geometry changed under us (ownership
+                    # re-shard raced a push): the residual's region no
+                    # longer exists, carrying it would corrupt
+                    residual = None
                 if residual is not None:
                     g32 = g32 + residual
             entries, deq = _compress_leaf(c, key, g32)

@@ -65,8 +65,13 @@ from ..resilience import (
     maybe_fail,
     retry_io,
 )
+from .membership import (
+    LeaseTracker,
+    Membership,
+    MembershipLedger,
+    PeerBackoff,
+)
 from .ownership import (
-    OwnershipLayout,
     local_opt_from_canonical,
     opt_part_records,
 )
@@ -187,6 +192,11 @@ def train_fleet_worker(
     peer_wait_s: float = 120.0,
     finalize_wait_s: float = 600.0,
     checkpoint_timeout_s: float = 600.0,
+    peer_lease_s: float = 60.0,
+    lease_miss_threshold: int = 3,
+    lease_poll_s: float = 2.0,
+    peer_timeout_s: Optional[float] = None,
+    probe_timeout_s: Optional[float] = None,
     watch_interval_s: float = 5.0,
     alert_interval_s: float = 5.0,
     grad_compression: str = "auto",
@@ -207,6 +217,18 @@ def train_fleet_worker(
     ``grad_error_feedback=False`` is the ablation control the
     convergence suite uses — never turn it off for real runs (sub-step
     gradient signal then quantizes to zero forever).
+
+    ``peer_lease_s`` arms elastic membership (RESILIENCE.md "Ownership
+    failover"): every worker leases its peers off ``/healthz``; the
+    acting lead (lowest live active id) evicts a peer whose lease
+    expired AND that missed ``lease_miss_threshold`` consecutive
+    probes, bumps the fleet-wide membership epoch, and survivors
+    re-shard ownership over the remaining ids at their next step
+    boundary. Set ``peer_lease_s=0`` to disable eviction entirely
+    (PR 14 frozen-membership behavior). ``peer_timeout_s`` /
+    ``probe_timeout_s`` override the ``[training]``
+    ``fleet_peer_timeout_s`` / ``fleet_probe_timeout_s`` knobs for
+    step-traffic and liveness-probe connections respectively.
     """
     import jax
     import jax.numpy as jnp
@@ -236,9 +258,19 @@ def train_fleet_worker(
         raise ValueError(
             f"fleet worker id {worker_id} outside [0, {n_workers})"
         )
+    quorum_requested = int(quorum or 0)
     quorum = resolve_quorum(quorum, n_workers)
     if not (1 <= quorum <= n_workers):
         raise ValueError(f"quorum {quorum} outside [1, {n_workers}]")
+
+    def _quorum_for(n_active: int) -> int:
+        """The effective quorum after a membership change: auto re-auto-
+        resolves over the survivor count; an explicit quorum is clamped
+        so a shrunken fleet can still reach it."""
+        if quorum_requested <= 0:
+            return resolve_quorum(0, n_active)
+        return max(1, min(quorum_requested, n_active))
+
     max_staleness = int(max_staleness)
     if max_staleness < 0:
         raise ValueError(f"max_staleness must be >= 0, got {max_staleness}")
@@ -280,6 +312,24 @@ def train_fleet_worker(
         max_retries=max(int(push_retries), 0), base_delay=0.05, max_delay=1.0
     )
     shutdown = ShutdownCoordinator()
+    # per-peer connection deadlines: explicit kwargs win, then the
+    # [training] knobs, then the historical constants (10s step traffic,
+    # 5s liveness probes — same precedence as checkpoint_timeout_s)
+    peer_timeout = float(
+        peer_timeout_s if peer_timeout_s is not None
+        else T.get("fleet_peer_timeout_s") or 10.0
+    )
+    probe_timeout = float(
+        probe_timeout_s if probe_timeout_s is not None
+        else T.get("fleet_probe_timeout_s") or 5.0
+    )
+    if peer_timeout <= 0 or probe_timeout <= 0:
+        raise ValueError(
+            "fleet_peer_timeout_s / fleet_probe_timeout_s must be > 0"
+        )
+    peer_lease_s = float(peer_lease_s)
+    lease_miss_threshold = max(1, int(lease_miss_threshold))
+    lease_poll_s = max(0.2, float(lease_poll_s))
 
     # ---- telemetry (per-worker sub-directory; the peer server serves it)
     tel = None
@@ -357,7 +407,8 @@ def train_fleet_worker(
     loss_fn = nlp.make_loss_fn(dropout=dropout)
 
     params_host = _np_tree(nlp.params)
-    layout = OwnershipLayout(params_host, n_workers)
+    membership = Membership(range(n_workers))
+    layout = membership.layout(params_host)
 
     # ---- state (fresh or resumed) -----------------------------------
     step = 0
@@ -385,6 +436,25 @@ def train_fleet_worker(
         best_step = int(ckpt["best_step"])
         resumed_from = step
         fleet_extra = (ckpt.get("extra") or {}).get("fleet") or {}
+        ck_active = fleet_extra.get("active")
+        if ck_active:
+            # the checkpoint carries the membership it was committed
+            # under — resume into THAT fleet, not the config's nominal
+            # one (a pre-elastic checkpoint has no such field: epoch 0,
+            # everyone active)
+            try:
+                membership = Membership(
+                    [int(a) for a in ck_active],
+                    int(fleet_extra.get("epoch") or 0),
+                )
+                layout = membership.layout(params_host)
+            except (TypeError, ValueError) as e:
+                log_event(
+                    "fleet-resume-membership-invalid",
+                    f"checkpoint extra.fleet.active is malformed ({e}); "
+                    "assuming the full nominal fleet at epoch 0",
+                    worker=worker_id,
+                )
         versions = fleet_extra.get("versions") or []
         if worker_id < len(versions) and versions[worker_id] is not None:
             version = int(versions[worker_id])
@@ -408,9 +478,10 @@ def train_fleet_worker(
             worker=worker_id, step=step, version=version,
         )
 
+    quorum = _quorum_for(len(membership.active))
     slice_np = layout.slice_tree(params_host, worker_id)
     slice_params = jax.tree_util.tree_map(jnp.asarray, slice_np)
-    if ckpt is not None:
+    if ckpt is not None and worker_id in membership:
         opt_local = local_opt_from_canonical(
             owner_tx, layout, ckpt["opt_state"], worker_id, slice_np
         )
@@ -419,7 +490,21 @@ def train_fleet_worker(
     ckpt = None  # drop the loaded canonical trees
 
     owns_any = bool(layout.owned_keys(worker_id))
-    if not owns_any:
+    if worker_id not in membership:
+        # resumed from a checkpoint committed AFTER our eviction: we are
+        # a returning member, not a config error — the join flow below
+        # asks the acting lead to admit us at the next epoch boundary;
+        # until the admit broadcast lands, every push is epoch-fenced
+        # (counted) at the owners
+        log_event(
+            "fleet-resume-evicted",
+            f"worker {worker_id} resumed into membership epoch "
+            f"{membership.epoch} which no longer names it (active "
+            f"{list(membership.active)}) — requesting rejoin",
+            worker=worker_id, epoch=membership.epoch,
+            active=list(membership.active),
+        )
+    elif not owns_any:
         # legal but degenerate (no leaf axis divisible by n_workers
         # beyond worker 0's whole-leaf ownership): this worker
         # contributes gradients to the owners but its own shard is empty
@@ -457,6 +542,18 @@ def train_fleet_worker(
     version_gauge = (
         tel.registry.gauge("param_version") if tel is not None else None
     )
+    epoch_gauge = (
+        tel.registry.gauge("membership_epoch") if tel is not None else None
+    )
+    if epoch_gauge is not None:
+        epoch_gauge.set(membership.epoch)
+    member_ledger = MembershipLedger(
+        Path(output_path) / "fleet-membership.jsonl"
+        if output_path is not None else None
+    )
+    backoff = PeerBackoff(
+        base_s=1.0, cap_s=max(1.0, min(30.0, float(quorum_wait_s)))
+    )
     # worker-side per-phase dynamics histograms (shared bucket tables —
     # docs/OBSERVABILITY.md "Training fleet"); telemetry off constructs
     # none of them (the zero-calls contract)
@@ -492,18 +589,29 @@ def train_fleet_worker(
     state_holder: Dict[str, Any] = {"step": step, "rng": rng}
 
     def checkpoint_cb(ckpt_dir: str, stamp: int) -> Dict[str, Any]:
+        # snapshot the membership-dependent pieces once: the step loop
+        # may swap layout/membership at its next boundary while this
+        # handler-thread call is in flight
+        lay, member = layout, membership
+        rank = lay.rank_of(worker_id)
+        if rank is None:
+            raise ValueError(
+                f"worker {worker_id} is not in membership epoch "
+                f"{member.epoch} — cannot contribute a checkpoint part"
+            )
+
         def writer(cur_version, opt_state, host_flat):
             n_leaves, skeleton, records = opt_part_records(
-                owner_tx, params_host, layout, opt_state, worker_id
+                owner_tx, params_host, lay, opt_state, worker_id
             )
             digest = write_fleet_opt_part(
                 ckpt_dir,
                 stamp=stamp,
-                part=worker_id,
-                parts=n_workers,
+                part=rank,
+                parts=len(member.active),
                 n_leaves=n_leaves,
                 records=records,
-                skeleton=skeleton if worker_id == 0 else None,
+                skeleton=skeleton if rank == 0 else None,
             )
             return cur_version, digest, host_flat
 
@@ -512,7 +620,7 @@ def train_fleet_worker(
             "meta": {
                 "digest": digest,
                 "version": cur_version,
-                "part": worker_id,
+                "part": rank,
                 "step": int(state_holder["step"]),
                 "rng": np.asarray(
                     jax.device_get(state_holder["rng"])
@@ -531,6 +639,7 @@ def train_fleet_worker(
         port=int(port) if port is not None else int(base_port) + worker_id,
         checkpoint_cb=checkpoint_cb,
     )
+    server.set_membership(membership, layout.signature())
     server.start()
     urls = list(peer_urls) if peer_urls is not None else [
         f"http://127.0.0.1:{int(base_port) + i}" for i in range(n_workers)
@@ -540,7 +649,8 @@ def train_fleet_worker(
             f"peer_urls names {len(urls)} workers, fleet has {n_workers}"
         )
     clients: Dict[int, _PeerClient] = {
-        w: _PeerClient(urls[w]) for w in range(n_workers) if w != worker_id
+        w: _PeerClient(urls[w], timeout=peer_timeout)
+        for w in membership.active if w != worker_id
     }
     ckpt_clients: Dict[int, _PeerClient] = {}  # long-deadline, lazy
 
@@ -555,6 +665,8 @@ def train_fleet_worker(
                 {"worker": worker_id, "stamp": 0},
                 {k: np.asarray(v, np.float32) for k, v in flat_w.items()},
             ))
+
+    drifted: set = set()  # peers seen at a different membership epoch
 
     def wait_for_peers() -> None:
         """Block until every peer answers /healthz with a matching
@@ -582,6 +694,28 @@ def train_fleet_worker(
                 payload = json.loads(body.decode("utf8"))
                 sig = payload.get("layout")
                 if sig != layout.signature():
+                    peer_epoch = payload.get("epoch")
+                    if (
+                        isinstance(peer_epoch, int)
+                        and not isinstance(peer_epoch, bool)
+                        and peer_epoch != membership.epoch
+                    ):
+                        # not a config error — the peer is at a different
+                        # MEMBERSHIP epoch (the fleet re-sharded while we
+                        # were down); the join/refresh flow reconciles
+                        log_event(
+                            "fleet-membership-drift",
+                            f"worker {w} is at membership epoch "
+                            f"{peer_epoch}, we are at {membership.epoch} "
+                            "— syncing membership instead of failing "
+                            "the layout check",
+                            worker=worker_id, peer=w,
+                            peer_epoch=peer_epoch,
+                            epoch=membership.epoch,
+                        )
+                        drifted.add(w)
+                        pending.discard(w)
+                        continue
                     raise RuntimeError(
                         f"fleet worker {w} runs a different parameter "
                         f"layout ({sig} vs {layout.signature()}) — all "
@@ -608,6 +742,221 @@ def train_fleet_worker(
                         f"{sorted(pending)} (waited {wait_s:.0f}s)"
                     )
                 time.sleep(0.1)
+
+    # ---- elastic membership: refresh / join / epoch-fenced re-shard --
+    _join_throttle = {"t": -(10.0 ** 9)}
+
+    def request_join(m: Membership) -> None:
+        """First-class rejoin: ask ``m``'s lead to admit us at the next
+        epoch boundary. We keep training meanwhile — our pushes stay
+        epoch-fenced (counted) at the owners until the admit broadcast
+        lands. Throttled: the pull loop hits a fence every step while
+        we are out, and one join request per few seconds is plenty."""
+        now = time.monotonic()
+        if now - _join_throttle["t"] < 5.0:
+            return
+        _join_throttle["t"] = now
+        lead = m.lead
+        if lead == worker_id:
+            return
+        client = clients.get(lead)
+        if client is None:
+            client = clients[lead] = _PeerClient(
+                urls[lead], timeout=peer_timeout
+            )
+        try:
+            client.request(
+                "POST", "/membership/join",
+                body=json.dumps({"worker": worker_id}).encode("utf8"),
+                content_type="application/json",
+            )
+        except OSError:
+            return
+        member_ledger.append(
+            "join-requested", worker=worker_id, epoch=m.epoch
+        )
+        log_event(
+            "fleet-join-requested",
+            f"worker {worker_id} asked lead {lead} to rejoin the fleet "
+            f"(their membership epoch {m.epoch})",
+            worker=worker_id, lead=lead, epoch=m.epoch,
+        )
+
+    def refresh_membership(w: int) -> None:
+        """Sync membership off peer ``w`` after a fence/drift signal:
+        adopt its view when newer (queued — the step boundary applies
+        it), or request a join when it no longer names us. Step-loop
+        thread only (it shares the keep-alive clients)."""
+        client = clients.get(w)
+        if client is None:
+            return
+        try:
+            status, _, body = client.request("GET", "/membership")
+            if status != 200:
+                return
+            m = Membership.from_wire(json.loads(body.decode("utf8")))
+        except (OSError, ValueError, KeyError, UnicodeDecodeError):
+            return
+        if m.epoch <= membership.epoch:
+            return
+        if worker_id in m:
+            server.queue_membership(m)
+        else:
+            request_join(m)
+
+    def apply_membership(new_m: Membership) -> None:
+        """The epoch-fenced re-shard, at a step boundary only: recompute
+        ownership over the new active set (same first-divisible-axis
+        rule, survivor-rank addressed), adopt re-owned slices (params
+        from this worker's ``params_host`` — the owners' last broadcast
+        versions — and optimizer state carved from the last intact fleet
+        checkpoint, fresh-init fallback), swap the OwnerState, and stamp
+        the new epoch on everything downstream. Handler threads only
+        QUEUE memberships; this runs exclusively on the step loop."""
+        nonlocal membership, layout, owner, owns_any, quorum
+        old_m, old_layout = membership, layout
+        was_active = worker_id in old_m
+        version_base = owner.version
+        if was_active:
+            # fold the live owner shard into params_host first: its
+            # quorum applies since the last pull must survive the swap
+            _, self_flat = owner.current_flat()
+            old_layout.merge_flat(params_host, worker_id, self_flat)
+        old_index = {
+            k: old_layout.key_index(k, worker_id)
+            for k in (old_layout.owned_keys(worker_id) if was_active else ())
+        }
+        membership = new_m
+        layout = membership.layout(params_host)
+        quorum = _quorum_for(len(membership.active))
+        now_active = worker_id in membership
+        changed = [
+            k for k in layout.owned_keys(worker_id)
+            if k not in old_index
+            or old_index[k] != layout.key_index(k, worker_id)
+        ] if now_active else []
+        slice_np = layout.slice_tree(params_host, worker_id)
+        new_slice = jax.tree_util.tree_map(jnp.asarray, slice_np)
+        new_opt = None
+        opt_src = "fresh-init"
+        if now_active and not changed:
+            # geometry unchanged (pure join/evict of a worker we took
+            # nothing from): keep the live optimizer moments
+            def _grab(cur_version, opt_state, host_flat):
+                return cur_version, opt_state, host_flat
+
+            _, new_opt, _ = owner.checkpoint_parts(_grab)
+            opt_src = "live"
+        elif now_active and output_path is not None:
+            try:
+                ck2 = TrainCheckpoint.load(Path(output_path) / "last-model")
+                new_opt = local_opt_from_canonical(
+                    owner_tx, layout, ck2["opt_state"], worker_id, slice_np
+                )
+                opt_src = f"checkpoint@{int(ck2['step'])}"
+            except (CheckpointCorrupt, OSError, KeyError, ValueError,
+                    TypeError):
+                new_opt = None
+        if new_opt is None:
+            new_opt = owner_tx.init(new_slice)
+            if changed:
+                log_event(
+                    "fleet-opt-reinit",
+                    f"worker {worker_id}: no intact fleet checkpoint to "
+                    f"carve adopted optimizer state from — fresh moments "
+                    f"for {len(changed)} re-sharded slices",
+                    worker=worker_id, epoch=membership.epoch,
+                    resharded=len(changed),
+                )
+        new_owner = OwnerState(
+            worker_id=worker_id,
+            n_workers=n_workers,
+            quorum=quorum,
+            max_staleness=max_staleness,
+            apply_fn=make_shard_apply(owner_tx),
+            slice_params=new_slice,
+            opt_state=new_opt,
+            counters=counters,
+            version=version_base,
+            on_version=(
+                version_gauge.set if version_gauge is not None else None
+            ),
+            registry=tel.registry if tel is not None else None,
+            trace=tel.trace if tel is not None else None,
+            delta_window=param_delta_window,
+            delta_codec=wire_codec,
+        )
+        owner = new_owner
+        server.set_owner(new_owner)
+        server.set_membership(membership, layout.signature())
+        owns_any = bool(layout.owned_keys(worker_id))
+        # clients follow the active set
+        for w in [w for w in list(clients) if w not in membership]:
+            clients.pop(w).close()
+            gone = ckpt_clients.pop(w, None)
+            if gone is not None:
+                gone.close()
+            known.pop(w, None)
+            last_stamp.pop(w, None)
+            wire_full_bytes.pop(w, None)
+            peer_codecs.pop(w, None)
+        for w in membership.active:
+            if w == worker_id or w in clients:
+                continue
+            clients[w] = _PeerClient(urls[w], timeout=peer_timeout)
+            try:
+                status, _, body = clients[w].request("GET", "/healthz")
+                if status == 200:
+                    peer_codecs[w] = json.loads(
+                        body.decode("utf8")
+                    ).get("codecs")
+            except (OSError, ValueError):
+                pass
+        # the old epoch's version bookkeeping and delta chains are void
+        # under the new slice geometry: force full re-pulls
+        for w in clients:
+            known[w] = -1
+            last_stamp[w] = -(10 ** 9)
+            flat_w = layout.flat_slices(params_host, w)
+            if flat_w:
+                wire_full_bytes[w] = len(encode_arrays(
+                    {"worker": worker_id, "stamp": 0},
+                    {k: np.asarray(v, np.float32)
+                     for k, v in flat_w.items()},
+                ))
+            else:
+                wire_full_bytes.pop(w, None)
+        # grad-push error-feedback residuals telescope against slices
+        # of the dead geometry — carrying them would corrupt
+        compressor.reset()
+        if changed:
+            counters.inc("shards_adopted", len(changed))
+        if epoch_gauge is not None:
+            epoch_gauge.set(membership.epoch)
+        member_ledger.append(
+            "apply", worker=worker_id, epoch=membership.epoch,
+            active=list(membership.active), resharded=len(changed),
+            opt_source=opt_src,
+        )
+        log_event(
+            "fleet-membership-applied",
+            f"worker {worker_id}: membership epoch {membership.epoch} "
+            f"applied (active {list(membership.active)}, "
+            f"{len(changed)} slices re-sharded, optimizer {opt_src})",
+            worker=worker_id, epoch=membership.epoch,
+            active=list(membership.active), resharded=len(changed),
+        )
+        if was_active and not now_active:
+            # the fleet moved on without us (a heal after a partition,
+            # say): request readmission — our pushes are fenced until it
+            log_event(
+                "fleet-self-evicted",
+                f"worker {worker_id}: membership epoch "
+                f"{membership.epoch} no longer names this worker — "
+                "requesting rejoin",
+                worker=worker_id, epoch=membership.epoch,
+            )
+            request_join(membership)
 
     # ---- jitted gradient step ---------------------------------------
     def gstep(params, tokens, targets, rng_key):
@@ -707,29 +1056,62 @@ def train_fleet_worker(
         push is discarded — wedging the round it was needed for."""
         stamps: Dict[int, int] = {}
         self_version, self_flat = owner.current_flat()
-        layout.merge_flat(params_host, worker_id, self_flat)
+        if worker_id in membership:
+            layout.merge_flat(params_host, worker_id, self_flat)
         stamps[worker_id] = self_version
         deadline = time.monotonic() + float(quorum_wait_s)
         # ask for delta frames only when we track a window ourselves; an
         # owner that can't serve one (old peer ignores the header, new
         # peer outside the window) replies with a full frame — degrade,
-        # never stall (RESILIENCE.md)
-        accept_hdrs = (
-            {"X-SRT-Accept": "delta"} if param_delta_window > 0 else None
-        )
-        for w, client in clients.items():
+        # never stall (RESILIENCE.md). Every pull carries our membership
+        # epoch: a re-sharded owner 409s a stale one (the fence), which
+        # is our cue to sync membership instead of merging wrong-geometry
+        # bytes.
+        accept_hdrs: Dict[str, str] = {
+            "X-SRT-Epoch": str(membership.epoch)
+        }
+        if param_delta_window > 0:
+            accept_hdrs["X-SRT-Accept"] = "delta"
+        fenced_by: Optional[int] = None
+        for w, client in list(clients.items()):
+            if backoff.skip(w):
+                # mid-outage: zero wait spent on this owner (the
+                # dead-owner pull-spin fix) — push against what we know
+                stamps[w] = known.get(w, -1)
+                continue
             timed_out = False
+            unreachable = False
             while True:
                 try:
+                    if resilience.partitioned(w):
+                        raise OSError(f"peer {w} partitioned (fault plan)")
+                    maybe_fail("param-pull")
+                    act = resilience.consume_wire_fault("param-pull")
+                    if act is not None and act[0] == "delay":
+                        time.sleep(float(act[1] or 1.0))
                     status, headers, body = client.request(
                         "GET", f"/params?known={known[w]}",
                         headers=accept_hdrs,
                     )
-                except OSError:
+                    if act is not None and act[0] == "dup":
+                        # duplicated request: idempotent GET, the second
+                        # reply wins — proves re-reads are harmless
+                        status, headers, body = client.request(
+                            "GET", f"/params?known={known[w]}",
+                            headers=accept_hdrs,
+                        )
+                    if act is not None and act[0] == "corrupt":
+                        body = resilience.corrupt_bytes(body)
+                except (OSError, resilience.FaultInjected):
                     counters.inc("pull_failed")
+                    unreachable = True
                     break
                 if status == 204:
                     v = int(headers.get("X-SRT-Version", known[w]))
+                elif status == 409:
+                    # epoch fence: the fleet re-sharded past us
+                    fenced_by = w
+                    break
                 elif status == 200:
                     try:
                         meta_w, arrays = decode_arrays(body)
@@ -786,11 +1168,36 @@ def train_fleet_worker(
                     counters.inc("pull_wait_timeouts")
                     continue
                 time.sleep(0.01)
-            stamps.setdefault(w, known[w])
+            if unreachable or timed_out:
+                # ONE structured event per outage, then capped backoff —
+                # not a quorum_wait_s burn plus a counter tick every step
+                if backoff.record_failure(w):
+                    log_event(
+                        "fleet-peer-unreachable",
+                        f"worker {worker_id}: owner {w} "
+                        f"{'unreachable' if unreachable else 'missing its staleness deadline'}"
+                        f" — pulls back off (cap {backoff.cap_s:.0f}s) "
+                        "until it answers again",
+                        worker=worker_id, owner=w,
+                        reason=(
+                            "unreachable" if unreachable else "deadline"
+                        ),
+                    )
+            elif fenced_by != w and backoff.record_success(w):
+                log_event(
+                    "fleet-peer-recovered",
+                    f"worker {worker_id}: owner {w} answering again — "
+                    "backoff cleared",
+                    worker=worker_id, owner=w,
+                )
+            stamps.setdefault(w, known.get(w, -1))
+        if fenced_by is not None:
+            refresh_membership(fenced_by)
         return stamps
 
     def push_grads(grads: Any, stamps: Dict[int, int]) -> None:
-        for w in range(n_workers):
+        fenced_peer: Dict[str, Optional[int]] = {"w": None}
+        for w in list(membership.active):
             flat = layout.flat_slices(grads, w)
             if not flat:
                 continue  # nothing shardable lands on this owner
@@ -801,19 +1208,39 @@ def train_fleet_worker(
                 # would keep it moving exactly when every peer is gone
                 owner.submit(worker_id, stamps[worker_id], flat)
                 continue
+            if w not in clients:
+                continue
             # per-peer negotiated codec: the error-feedback residual for
             # peer w absorbs THIS frame's quantization error and rides
             # into the next round's gradient for w (f32 keeps none)
             codec_w = negotiate_push_codec(wire_codec, peer_codecs.get(w))
             body = compressor.encode(
                 w,
-                {"worker": worker_id, "stamp": int(stamps.get(w, -1))},
+                {
+                    "worker": worker_id,
+                    "stamp": int(stamps.get(w, -1)),
+                    "epoch": int(membership.epoch),
+                },
                 flat,
                 codec_w,
             )
+            # wire chaos (the drill matrix): one queued fault covers one
+            # frame — a corrupted body stays corrupted across retries
+            # (the owner 400s it every time: a counted, typed discard)
+            act = resilience.consume_wire_fault("grad-push")
+            dup = False
+            if act is not None:
+                if act[0] == "corrupt":
+                    body = resilience.corrupt_bytes(body)
+                elif act[0] == "delay":
+                    time.sleep(float(act[1] or 1.0))
+                elif act[0] == "dup":
+                    dup = True
 
-            def send(w=w, body=body):
+            def send(w=w, body=body, dup=dup):
                 maybe_fail("grad-push")
+                if resilience.partitioned(w):
+                    raise OSError(f"peer {w} partitioned (fault plan)")
                 status, _, reply = clients[w].request(
                     "POST", "/grad", body=body
                 )
@@ -821,6 +1248,16 @@ def train_fleet_worker(
                     raise OSError(
                         f"peer {w} rejected grad push: HTTP {status}"
                     )
+                if dup:
+                    # duplicated frame: the owner's round bookkeeping
+                    # takes one contribution per (worker, stamp) — the
+                    # twin is a counted discard, never a double-apply
+                    clients[w].request("POST", "/grad", body=body)
+                try:
+                    if json.loads(reply.decode("utf8")).get("fenced"):
+                        fenced_peer["w"] = w
+                except (ValueError, UnicodeDecodeError, AttributeError):
+                    pass
 
             t_send = time.perf_counter()
             delivered = False
@@ -854,6 +1291,9 @@ def train_fleet_worker(
                     },
                 )
             last_stamp[w] = int(stamps.get(w, -1))
+        if fenced_peer["w"] is not None:
+            # an owner fenced our frame: we are at a stale epoch — sync
+            refresh_membership(fenced_peer["w"])
 
     def fleet_checkpoint() -> None:
         """Worker 0 coordinates one generation: every owner writes its
@@ -866,21 +1306,34 @@ def train_fleet_worker(
         nonlocal last_saved_step
         if output_path is None or step == last_saved_step:
             return
+        if worker_id not in membership:
+            return  # a fenced-out worker must not commit generations
         stamp = int(step)
         ckpt_dir = Path(output_path) / "last-model"
         my = checkpoint_cb(str(ckpt_dir), stamp)
-        digests: Dict[int, str] = {worker_id: my["meta"]["digest"]}
+        # part digests are keyed by survivor RANK: a post-failover
+        # generation is a normal len(active)-shard v2 generation
+        digests: Dict[int, str] = {
+            int(my["meta"]["part"]): my["meta"]["digest"]
+        }
         versions: List[Optional[int]] = [None] * n_workers
         rngs: List[Optional[List[int]]] = [None] * n_workers
         versions[worker_id] = int(my["meta"]["version"])
         rngs[worker_id] = list(my["meta"]["rng"])
         assembled = _np_tree(params_host)
         layout.merge_flat(assembled, worker_id, my["params"])
-        req = json.dumps({"dir": str(ckpt_dir), "stamp": stamp}).encode(
-            "utf8"
-        )
+        req = json.dumps({
+            "dir": str(ckpt_dir), "stamp": stamp,
+            "epoch": int(membership.epoch),
+        }).encode("utf8")
         for w in sorted(clients):
             try:
+                maybe_fail("checkpoint-wire")
+                if resilience.partitioned(w):
+                    raise OSError(f"peer {w} partitioned (fault plan)")
+                act = resilience.consume_wire_fault("checkpoint-wire")
+                if act is not None and act[0] == "delay":
+                    time.sleep(float(act[1] or 1.0))
                 # a /checkpoint reply arrives only after the peer's whole
                 # owner-shard part file is hashed and written — the 10s
                 # step-traffic timeout would abort every generation on a
@@ -897,12 +1350,26 @@ def train_fleet_worker(
                 )
                 if status != 200:
                     raise OSError(f"peer {w} checkpoint: HTTP {status}")
+                if act is not None and act[0] == "dup":
+                    # re-sent coordination request: same stamp, same
+                    # part file — idempotent by construction
+                    status, _, body = client.request(
+                        "POST", "/checkpoint", body=req,
+                        content_type="application/json",
+                    )
+                    if status != 200:
+                        raise OSError(
+                            f"peer {w} checkpoint: HTTP {status}"
+                        )
+                if act is not None and act[0] == "corrupt":
+                    body = resilience.corrupt_bytes(body)
                 meta_w, arrays = decode_arrays(body)
-                digests[w] = str(meta_w["digest"])
+                digests[int(meta_w["part"])] = str(meta_w["digest"])
                 versions[w] = int(meta_w["version"])
                 rngs[w] = list(meta_w["rng"])
                 layout.merge_flat(assembled, w, arrays)
-            except (OSError, WireError, KeyError, ValueError, TypeError) as e:
+            except (OSError, WireError, KeyError, ValueError, TypeError,
+                    resilience.FaultInjected) as e:
                 # unreachable, wire-malformed, meta-incomplete, or
                 # structurally mismatched reply — ALL of them abort the
                 # generation (the docstring's promise); a partial commit
@@ -924,13 +1391,15 @@ def train_fleet_worker(
             rng=np.asarray(jax.device_get(rng)),
             best_score=best_score,
             best_step=best_step,
-            opt_shards=n_workers,
+            opt_shards=len(membership.active),
             opt_digests=digests,
             extra={
                 "fleet": {
                     "n_workers": n_workers,
                     "quorum": quorum,
                     "max_staleness": max_staleness,
+                    "epoch": int(membership.epoch),
+                    "active": list(membership.active),
                     "versions": versions,
                     "rngs": rngs,
                 },
@@ -977,7 +1446,8 @@ def train_fleet_worker(
             # the step loop's keep-alive peer connections are NOT
             # thread-safe; the watch owns its own clients
             watch_clients = {
-                w: _PeerClient(urls[w], timeout=5.0) for w in clients
+                w: _PeerClient(urls[w], timeout=probe_timeout)
+                for w in clients
             }
             try:
                 while not watch_stop.wait(float(watch_interval_s)):
@@ -1008,6 +1478,160 @@ def train_fleet_worker(
             target=_watch_loop, name="fleet-watch", daemon=True
         )
 
+    # ---- lease-based liveness + the eviction verdict -----------------
+    # EVERY worker runs the tracker; only the ACTING LEAD — the lowest
+    # active id it still believes live — issues verdicts. Lead death
+    # therefore falls through to the next survivor deterministically,
+    # no election. Verdicts and admits are queued/broadcast here but
+    # APPLIED only at step boundaries (apply_membership), so handler
+    # threads and this thread never touch the layout.
+    member_stop = threading.Event()
+    member_thread: Optional[threading.Thread] = None
+    if n_workers > 1 and peer_lease_s > 0:
+        def _membership_loop() -> None:
+            # own clients: the step loop's keep-alive connections are
+            # not thread-safe (same rule as the watch loop)
+            probes = {
+                w: _PeerClient(urls[w], timeout=probe_timeout)
+                for w in range(n_workers) if w != worker_id
+            }
+            tracker = LeaseTracker(
+                [w for w in membership.active if w != worker_id],
+                lease_s=peer_lease_s,
+                miss_threshold=lease_miss_threshold,
+            )
+            # epoch of our own last QUEUED verdict: a verdict applies
+            # only at the step loop's next boundary, so without this the
+            # lead would re-evict (and re-count, and re-log) the same
+            # peer every poll round until the apply lands
+            verdict_epoch = 0
+            try:
+                while not member_stop.wait(lease_poll_s):
+                    m = membership  # one snapshot per round
+                    if worker_id not in m:
+                        continue  # fenced-out: no verdicts while stale
+                    if m.epoch < verdict_epoch:
+                        continue  # our verdict is still pending apply
+                    for w in list(tracker.peers()):
+                        if w not in m:
+                            tracker.remove(w)
+                    for w in m.active:
+                        if w != worker_id:
+                            tracker.add(w)
+                    drift_from: Optional[int] = None
+                    for w in m.active:
+                        if w == worker_id:
+                            continue
+                        ok = False
+                        try:
+                            status, _, body = probes[w].request(
+                                "GET", "/healthz"
+                            )
+                            if status == 200:
+                                ok = True
+                                pe = json.loads(
+                                    body.decode("utf8")
+                                ).get("epoch")
+                                if (
+                                    isinstance(pe, int)
+                                    and not isinstance(pe, bool)
+                                    and pe > m.epoch
+                                ):
+                                    drift_from = w
+                        except (OSError, ValueError):
+                            ok = False
+                        tracker.observe(w, ok)
+                    if drift_from is not None:
+                        # a peer is ahead of us — we missed a broadcast;
+                        # pull its membership and queue it
+                        try:
+                            status, _, body = probes[drift_from].request(
+                                "GET", "/membership"
+                            )
+                            if status == 200:
+                                mm = Membership.from_wire(
+                                    json.loads(body.decode("utf8"))
+                                )
+                                if mm.epoch > m.epoch and worker_id in mm:
+                                    server.queue_membership(mm)
+                        except (OSError, ValueError, KeyError,
+                                UnicodeDecodeError):
+                            pass
+                        continue  # re-probe under the new membership
+                    live = [
+                        w for w in m.active
+                        if w == worker_id or not tracker.dead(w)
+                    ]
+                    if not live or min(live) != worker_id:
+                        continue  # not the acting lead this round
+                    new_m = m
+                    dead = [w for w in m.active if w not in live]
+                    for w in dead:
+                        new_m = new_m.evict(w)
+                    joiners = sorted(
+                        int(j) for j in server.drain_join_requests()
+                        if isinstance(j, int)
+                        and 0 <= int(j) < n_workers
+                        and int(j) not in new_m
+                    )
+                    for j in joiners:
+                        new_m = new_m.admit(j)
+                    if new_m.epoch == m.epoch:
+                        continue
+                    if dead:
+                        counters.inc("evictions", len(dead))
+                        member_ledger.append(
+                            "evict", lead=worker_id, evicted=dead,
+                            epoch=new_m.epoch,
+                            active=list(new_m.active),
+                        )
+                        log_event(
+                            "fleet-owner-evicted",
+                            f"acting lead {worker_id}: evicting {dead} "
+                            f"(lease {peer_lease_s:.0f}s and "
+                            f"{lease_miss_threshold} consecutive misses "
+                            f"both expired) — membership epoch "
+                            f"{new_m.epoch}, survivors "
+                            f"{list(new_m.active)}",
+                            lead=worker_id, evicted=dead,
+                            epoch=new_m.epoch,
+                            active=list(new_m.active),
+                        )
+                    if joiners:
+                        member_ledger.append(
+                            "admit", lead=worker_id, admitted=joiners,
+                            epoch=new_m.epoch,
+                            active=list(new_m.active),
+                        )
+                        log_event(
+                            "fleet-worker-admitted",
+                            f"acting lead {worker_id}: admitting "
+                            f"{joiners} at membership epoch "
+                            f"{new_m.epoch}",
+                            lead=worker_id, admitted=joiners,
+                            epoch=new_m.epoch,
+                        )
+                    verdict_epoch = new_m.epoch
+                    wire_m = json.dumps(new_m.to_wire()).encode("utf8")
+                    for w in new_m.active:
+                        if w == worker_id:
+                            continue
+                        try:
+                            probes[w].request(
+                                "POST", "/membership", body=wire_m,
+                                content_type="application/json",
+                            )
+                        except OSError:
+                            pass  # it will drift-sync off /healthz
+                    server.queue_membership(new_m)
+            finally:
+                for c in probes.values():
+                    c.close()
+
+        member_thread = threading.Thread(
+            target=_membership_loop, name="fleet-membership", daemon=True
+        )
+
     # ---- resilience arming ------------------------------------------
     watchdog: Optional[Watchdog] = None
     watchdog_timeout = float(T.get("watchdog_timeout_s", 0) or 0)
@@ -1027,10 +1651,16 @@ def train_fleet_worker(
     if watchdog is not None:
         watchdog.start()
     wait_for_peers()
+    for w in sorted(drifted):
+        refresh_membership(w)
+    if n_workers > 1 and worker_id not in membership:
+        request_join(membership)
     if tel is not None:
         tel.loop_start()
     if watch_thread is not None:
         watch_thread.start()
+    if member_thread is not None:
+        member_thread.start()
 
     def note_phase(name: str, t0: float, t1: float) -> None:
         """One phase's wall time: the ledger accumulator, the shared-
@@ -1048,6 +1678,12 @@ def train_fleet_worker(
     try:
         batch_iter = batches()
         while not stop:
+            # step boundary: adopt any queued membership (a lead
+            # broadcast, our own verdict, or a drift-sync) before any
+            # frame of this step is stamped
+            pending_m = server.take_pending_membership()
+            if pending_m is not None and pending_m.epoch > membership.epoch:
+                apply_membership(pending_m)
             t_data = time.perf_counter()
             try:
                 b = next(batch_iter)
@@ -1089,17 +1725,39 @@ def train_fleet_worker(
             note_phase("push", t_push, now)
 
             t_wait = now
-            if owns_any and not owner.wait_version_above(
-                stamps[worker_id], quorum_wait_s
-            ):
-                counters.inc("apply_wait_timeouts")
-                log_event(
-                    "fleet-quorum-timeout",
-                    f"worker {worker_id}: own shard stuck at version "
-                    f"{owner.version} for {quorum_wait_s:.0f}s (quorum "
-                    f"{quorum} not reached) — proceeding",
-                    worker=worker_id, version=owner.version,
-                )
+            if owns_any:
+                wait_deadline = time.monotonic() + float(quorum_wait_s)
+                reached = False
+                wait_fenced = False
+                while True:
+                    remaining = wait_deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    if owner.wait_version_above(
+                        stamps[worker_id], min(0.25, remaining)
+                    ):
+                        reached = True
+                        break
+                    pending_epoch = server.pending_membership_epoch()
+                    if (
+                        pending_epoch is not None
+                        and pending_epoch > membership.epoch
+                    ):
+                        # an eviction verdict is queued: survivors
+                        # already stamp the NEW epoch, so this epoch's
+                        # quorum can never complete — yield to the
+                        # apply at the top of the next iteration
+                        wait_fenced = True
+                        break
+                if not reached and not wait_fenced:
+                    counters.inc("apply_wait_timeouts")
+                    log_event(
+                        "fleet-quorum-timeout",
+                        f"worker {worker_id}: own shard stuck at version "
+                        f"{owner.version} for {quorum_wait_s:.0f}s (quorum "
+                        f"{quorum} not reached) — proceeding",
+                        worker=worker_id, version=owner.version,
+                    )
             note_phase("apply_wait", t_wait, time.perf_counter())
 
             step += 1
@@ -1157,6 +1815,18 @@ def train_fleet_worker(
                 fleet_checkpoint()
                 if tel is not None:
                     tel.rearm_step_clock()
+            elif (
+                worker_id != 0
+                and worker_id == membership.lead
+                and step % eval_frequency == 0
+            ):
+                # lead failover: the acting lead inherits CHECKPOINT
+                # duty (scores pause — the dev corpus and logger live on
+                # worker 0 — but the lineage keeps committing;
+                # RESILIENCE.md "Ownership failover")
+                fleet_checkpoint()
+                if tel is not None:
+                    tel.rearm_step_clock()
             log_step(info)
             if watchdog is not None:
                 watchdog.beat()
@@ -1170,7 +1840,11 @@ def train_fleet_worker(
                 and (step - best_step) >= patience
             ):
                 stop = True
-            if not stop and worker_id != 0 and server.finalize_event.is_set():
+            if (
+                not stop
+                and worker_id != membership.lead
+                and server.finalize_event.is_set()
+            ):
                 # the lead finished (patience, max_steps, preemption) and
                 # committed its final generation: follow it instead of
                 # training headless to our own max_steps — progress past
@@ -1184,7 +1858,7 @@ def train_fleet_worker(
                 )
                 stop = True
             if not stop and shutdown.coordinated_stop(1):
-                if worker_id == 0:
+                if worker_id == membership.lead:
                     fleet_checkpoint()
                 result.interrupted = True
                 log_event(
@@ -1199,17 +1873,22 @@ def train_fleet_worker(
         if watchdog is not None:
             watchdog.stop()
         watch_stop.set()
+        member_stop.set()
         if watch_thread is not None:
             watch_thread.join(timeout=5.0)
+        if member_thread is not None and member_thread.is_alive():
+            member_thread.join(timeout=5.0)
         if install_signal_handlers:
             shutdown.restore()
         try:
-            if worker_id == 0:
+            if worker_id == membership.lead:
                 # finalize ONLY on a clean exit (max_steps / patience /
                 # preemption): a CRASHED lead is about to be relaunched
                 # with --resume by its supervisor, and broadcasting
                 # /finalize here would shut down the very peers it needs
-                # to rejoin — the survivors-keep-stepping contract
+                # to rejoin — the survivors-keep-stepping contract.
+                # membership.lead, not literal 0: after a lead failover
+                # the acting lead owns the final commit and broadcast
                 if clean_exit:
                     if not result.interrupted:
                         fleet_checkpoint()
@@ -1232,7 +1911,7 @@ def train_fleet_worker(
                 # a DEAD lead (past its restart cap) will never post
                 # /finalize, and waiting the full deadline for it would
                 # just delay this worker's own ledger
-                lead = clients.get(0)
+                lead = clients.get(membership.lead)
                 deadline = time.monotonic() + float(finalize_wait_s)
                 lead_misses = 0
                 while not server.finalize_event.wait(timeout=5.0):
@@ -1265,6 +1944,8 @@ def train_fleet_worker(
                 "quorum": quorum,
                 "max_staleness": max_staleness,
                 "version": owner.version,
+                "membership_epoch": int(membership.epoch),
+                "active": list(membership.active),
                 "grad_compression": wire_codec,
                 "param_delta_window": param_delta_window,
                 "counters": counters.snapshot(),
@@ -1299,6 +1980,8 @@ def train_fleet_worker(
                     "quorum": quorum,
                     "max_staleness": max_staleness,
                     "version": owner.version,
+                    "membership_epoch": int(membership.epoch),
+                    "active": list(membership.active),
                     "grad_compression": wire_codec,
                     "param_delta_window": param_delta_window,
                     "counters": counters.snapshot(),
@@ -1318,7 +2001,7 @@ def train_fleet_worker(
             if tel is not None:
                 tel.finalize()
     nlp.params = params_host
-    if worker_id == 0 and output_path is not None:
+    if worker_id == membership.lead and output_path is not None:
         nlp.to_disk(Path(output_path) / "last-model")
     log_finalize()
     return nlp, result

@@ -174,6 +174,15 @@ DEFAULT_TRAINING = {
     # each needs the host between consecutive steps. See TUNING.md §11
     # for when NOT to raise it (watchdog granularity, preemption latency).
     "steps_per_dispatch": 1,
+    # trainer-fleet peer connection deadlines (fleet mode only; plain
+    # runs ignore them). fleet_peer_timeout_s bounds every step-traffic
+    # exchange (grad push, param pull); fleet_probe_timeout_s bounds the
+    # liveness/membership/watch probes — probes must fail FAST so the
+    # lease verdict reflects reality, while step traffic gets room for a
+    # big frame on a loaded box. The /checkpoint exchange has its own
+    # (much longer) checkpoint_timeout_s on the worker entry point.
+    "fleet_peer_timeout_s": 10.0,
+    "fleet_probe_timeout_s": 5.0,
 }
 
 # Sub-blocks resolved through the registry rather than read as plain values.
@@ -301,6 +310,16 @@ _TRAINING_TYPES: Dict[str, Tuple[Callable[[Any], bool], str]] = {
     "steps_per_dispatch": (
         lambda v: isinstance(v, int) and not isinstance(v, bool) and v >= 1,
         "an int >= 1",
+    ),
+    "fleet_peer_timeout_s": (
+        lambda v: isinstance(v, (int, float)) and not isinstance(v, bool)
+        and v > 0,
+        "a number of seconds > 0",
+    ),
+    "fleet_probe_timeout_s": (
+        lambda v: isinstance(v, (int, float)) and not isinstance(v, bool)
+        and v > 0,
+        "a number of seconds > 0",
     ),
 }
 

@@ -129,7 +129,13 @@ def load_run(
             "alerts": _read_jsonl(run_dir / "alerts.jsonl")
             or _read_jsonl(mdir / "alerts.jsonl"),
         }
-    return {"run_dir": run_dir, "workers": workers}
+    return {
+        "run_dir": run_dir,
+        "workers": workers,
+        # elastic-membership transition ledger (evict/admit/apply rows,
+        # written by the acting lead) — absent file reads as []
+        "membership": _read_jsonl(run_dir / "fleet-membership.jsonl"),
+    }
 
 
 def fleet_exit_rows(run: Dict[str, Any]) -> Dict[int, Dict[str, Any]]:
@@ -264,6 +270,51 @@ def build_run_report(
                 f"| {int(c.get('grad_discarded') or 0)} "
                 f"| {int(c.get('push_failed') or 0)} "
                 f"| {'yes' if l.get('interrupted') else 'no'} |"
+            )
+        lines.append("")
+
+    # -- membership timeline (elastic fleet, RESILIENCE.md) -------------
+    member_rows = run.get("membership") or []
+    if member_rows:
+        final_epoch = max(
+            (int(r.get("epoch") or 0) for r in member_rows), default=0
+        )
+        lines += [
+            "## Membership timeline",
+            "",
+            f"Final membership epoch **{final_epoch}** across "
+            f"{len(member_rows)} recorded transition(s).",
+            "",
+            "| unix time | event | epoch | detail | active |",
+            "|---|---|---|---|---|",
+        ]
+        for row in sorted(
+            member_rows, key=lambda r: float(r.get("ts") or 0.0)
+        ):
+            ev = row.get("event")
+            if ev == "evict":
+                detail = (
+                    f"lead {row.get('lead')} evicted {row.get('evicted')}"
+                )
+            elif ev == "admit":
+                detail = (
+                    f"lead {row.get('lead')} admitted {row.get('admitted')}"
+                )
+            elif ev == "apply":
+                detail = (
+                    f"worker {row.get('worker')} re-owned "
+                    f"{row.get('resharded')} shard group(s), "
+                    f"opt from {row.get('opt_source')}"
+                )
+            elif ev == "join-requested":
+                detail = f"worker {row.get('worker')} asked to rejoin"
+            else:
+                detail = "-"
+            active = row.get("active")
+            lines.append(
+                f"| {float(row.get('ts') or 0.0):.1f} | {ev} "
+                f"| {row.get('epoch')} | {detail} "
+                f"| {active if active is not None else '-'} |"
             )
         lines.append("")
 

@@ -68,6 +68,9 @@ __all__ = [
     "activate_env_fault_plan",
     "maybe_fail",
     "consume_poison",
+    "consume_wire_fault",
+    "partitioned",
+    "corrupt_bytes",
     "terminate_with_grace",
     "Supervisor",
     "log_event",
@@ -433,10 +436,23 @@ def retry_io(
 # Fault-injection harness
 # ----------------------------------------------------------------------
 
-FAULT_SITES = ("corpus-read", "collate", "checkpoint-write", "step", "grad-push")
+FAULT_SITES = (
+    "corpus-read", "collate", "checkpoint-write", "step", "grad-push",
+    "param-pull", "checkpoint-wire",
+)
 FAULT_PLAN_ENV = "SPACY_RAY_TPU_FAULT_PLAN"
 
 _FAULT_KINDS = ("oserror", "runtime", "sigterm", "nan")
+
+#: wire-chaos kinds (the PR 17 harness): they never raise — they queue
+#: an ACTION the fleet's wire call sites consume via
+#: :func:`consume_wire_fault`, or (partition/heal) flip a peer's
+#: membership in the partitioned set read by :func:`partitioned`.
+_WIRE_FAULT_KINDS = ("corrupt", "delay", "dup", "partition", "heal")
+
+#: sites whose calls move bytes between fleet peers — the only sites a
+#: wire-chaos kind may target (elsewhere it would be a silent no-op).
+_WIRE_FAULT_SITES = ("grad-push", "param-pull", "checkpoint-wire")
 
 
 class FaultInjected(RuntimeError):
@@ -450,10 +466,11 @@ class FaultPlan:
     Spec grammar (env var :data:`FAULT_PLAN_ENV` or programmatic):
 
         spec     := rule ("," rule)*
-        rule     := site ":" call ":" kind
+        rule     := site ":" call ":" kind [":" arg]
         site     := one of FAULT_SITES
         call     := 1-based call number at that site
-        kind     := "oserror" | "runtime" | "sigterm"
+        kind     := "oserror" | "runtime" | "sigterm" | "nan"
+                  | "corrupt" | "delay" | "dup" | "partition" | "heal"
 
     ``oserror`` raises OSError (the retryable family — exercises backoff),
     ``runtime`` raises :class:`FaultInjected` (non-retryable — exercises
@@ -463,18 +480,41 @@ class FaultPlan:
     :func:`consume_poison` after ``maybe_fail("step")`` and turns that
     step's reported loss into NaN, driving the telemetry NaN-loss
     anomaly detector end-to-end without corrupting real training math.
+
+    The WIRE-CHAOS kinds (PR 17 harness; fleet wire sites only —
+    ``grad-push``, ``param-pull``, ``checkpoint-wire``) never raise.
+    They queue an action the wire call site consumes via
+    :func:`consume_wire_fault` right where the bytes move:
+
+    * ``corrupt`` — the next frame at the site has a byte flipped
+      (:func:`corrupt_bytes`) → the receiver's :class:`WireError` path;
+    * ``delay[:seconds]`` — the next call sleeps ``seconds`` (default
+      1.0) first — injected latency past a step deadline;
+    * ``dup`` — the next frame is delivered twice (exercises the
+      buffer-overwrite / idempotent-pull semantics);
+    * ``partition[:peer]`` — ALL traffic to/from ``peer`` (every peer
+      when omitted) fails with OSError until a ``heal`` rule fires —
+      call sites poll :func:`partitioned`;
+    * ``heal[:peer]`` — lift a partition (all partitions when omitted).
+
     Counters are per-site and per-plan; activating a plan resets them.
     """
 
-    def __init__(self, rules: Sequence[Tuple[str, int, str]]) -> None:
-        for site, call, kind in rules:
+    def __init__(
+        self, rules: Sequence[Tuple[str, int, str, Optional[str]]]
+    ) -> None:
+        normalized: List[Tuple[str, int, str, Optional[str]]] = []
+        for rule in rules:
+            site, call, kind = rule[0], rule[1], rule[2]
+            arg = rule[3] if len(rule) > 3 else None
             if site not in FAULT_SITES:
                 raise ValueError(
                     f"unknown fault site {site!r} (known: {', '.join(FAULT_SITES)})"
                 )
-            if kind not in _FAULT_KINDS:
+            if kind not in _FAULT_KINDS and kind not in _WIRE_FAULT_KINDS:
+                known = ", ".join(_FAULT_KINDS + _WIRE_FAULT_KINDS)
                 raise ValueError(
-                    f"unknown fault kind {kind!r} (known: {', '.join(_FAULT_KINDS)})"
+                    f"unknown fault kind {kind!r} (known: {known})"
                 )
             if call < 1:
                 raise ValueError(f"fault call number must be >= 1, got {call}")
@@ -487,31 +527,63 @@ class FaultPlan:
                     f"fault kind 'nan' is only wired at the 'step' site "
                     f"(got {site!r}): the loop polls consume_poison there"
                 )
-        self.rules = list(rules)
+            if kind in _WIRE_FAULT_KINDS and site not in _WIRE_FAULT_SITES:
+                # same silent-no-op discipline for the chaos kinds
+                raise ValueError(
+                    f"fault kind {kind!r} is only wired at the fleet wire "
+                    f"sites {', '.join(_WIRE_FAULT_SITES)} (got {site!r})"
+                )
+            if arg is not None:
+                if kind == "delay":
+                    try:
+                        float(arg)
+                    except ValueError:
+                        raise ValueError(
+                            f"delay arg {arg!r} is not a number of seconds"
+                        )
+                elif kind in ("partition", "heal"):
+                    try:
+                        int(arg)
+                    except ValueError:
+                        raise ValueError(
+                            f"{kind} arg {arg!r} is not a peer id"
+                        )
+                else:
+                    raise ValueError(
+                        f"fault kind {kind!r} takes no arg (got {arg!r})"
+                    )
+            normalized.append((site, call, kind, arg))
+        self.rules = normalized
         self._counts: Dict[str, int] = {}
         self._poisoned: set = set()
+        # site -> queued (kind, arg) wire actions, consumed FIFO by the
+        # wire call sites; partitions live in a separate peer set
+        self._wire_actions: Dict[str, List[Tuple[str, Optional[str]]]] = {}
+        self._partitioned: set = set()
+        self._partition_all = False
         self._lock = threading.Lock()
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
-        rules: List[Tuple[str, int, str]] = []
+        rules: List[Tuple[str, int, str, Optional[str]]] = []
         for chunk in spec.split(","):
             chunk = chunk.strip()
             if not chunk:
                 continue
             parts = chunk.split(":")
-            if len(parts) != 3:
+            if len(parts) not in (3, 4):
                 raise ValueError(
-                    f"bad fault rule {chunk!r} (want site:call:kind)"
+                    f"bad fault rule {chunk!r} (want site:call:kind[:arg])"
                 )
-            site, call_s, kind = parts
+            site, call_s, kind = parts[0], parts[1], parts[2]
+            arg = parts[3].strip() if len(parts) == 4 else None
             try:
                 call = int(call_s)
             except ValueError:
                 raise ValueError(
                     f"bad fault rule {chunk!r}: call {call_s!r} is not an int"
                 )
-            rules.append((site.strip(), call, kind.strip().lower()))
+            rules.append((site.strip(), call, kind.strip().lower(), arg))
         return cls(rules)
 
     def check(self, site: str) -> None:
@@ -519,14 +591,18 @@ class FaultPlan:
         with self._lock:
             n = self._counts.get(site, 0) + 1
             self._counts[site] = n
-        for r_site, r_call, r_kind in self.rules:
+        for rule in self.rules:
+            r_site, r_call, r_kind, r_arg = rule
             if r_site == site and r_call == n:
-                self._trigger(site, n, r_kind)
+                self._trigger(site, n, r_kind, r_arg)
 
-    def _trigger(self, site: str, call: int, kind: str) -> None:
+    def _trigger(
+        self, site: str, call: int, kind: str, arg: Optional[str] = None
+    ) -> None:
         log_event(
             "fault-injected", f"{site} call {call}: {kind}",
             site=site, call=call, kind=kind,
+            **({"arg": arg} if arg is not None else {}),
         )
         if kind == "oserror":
             raise OSError(f"injected fault: {site} call {call}")
@@ -537,6 +613,22 @@ class FaultPlan:
         if kind == "nan":
             with self._lock:
                 self._poisoned.add(site)
+        if kind in ("corrupt", "delay", "dup"):
+            with self._lock:
+                self._wire_actions.setdefault(site, []).append((kind, arg))
+        if kind == "partition":
+            with self._lock:
+                if arg is None:
+                    self._partition_all = True
+                else:
+                    self._partitioned.add(int(arg))
+        if kind == "heal":
+            with self._lock:
+                if arg is None:
+                    self._partition_all = False
+                    self._partitioned.clear()
+                else:
+                    self._partitioned.discard(int(arg))
 
     def consume_poison(self, site: str) -> bool:
         """True exactly once per triggered ``nan`` rule at ``site``."""
@@ -545,6 +637,28 @@ class FaultPlan:
                 self._poisoned.discard(site)
                 return True
         return False
+
+    def consume_wire_fault(
+        self, site: str
+    ) -> Optional[Tuple[str, Optional[str]]]:
+        """Pop the next queued ``(kind, arg)`` wire action at ``site``
+        (corrupt/delay/dup), or None. FIFO; each triggered rule is
+        consumed exactly once."""
+        with self._lock:
+            queue = self._wire_actions.get(site)
+            if queue:
+                return queue.pop(0)
+        return None
+
+    def partitioned(self, peer: Any) -> bool:
+        """Is traffic to/from ``peer`` currently severed?"""
+        with self._lock:
+            if self._partition_all:
+                return True
+            try:
+                return int(peer) in self._partitioned
+            except (TypeError, ValueError):
+                return False
 
 
 _ACTIVE_PLAN: Optional[FaultPlan] = None
@@ -590,6 +704,41 @@ def consume_poison(site: str) -> bool:
     if plan is not None:
         return plan.consume_poison(site)
     return False
+
+
+def consume_wire_fault(site: str) -> Optional[Tuple[str, Optional[str]]]:
+    """Next queued wire-chaos action (corrupt/delay/dup) at ``site``, or
+    None. Free when no plan is active (one global read) — the fleet's
+    wire call sites poll this right after ``maybe_fail(site)``."""
+    plan = _ACTIVE_PLAN
+    if plan is not None:
+        return plan.consume_wire_fault(site)
+    return None
+
+
+def partitioned(peer: Any) -> bool:
+    """Is ``peer`` behind an injected partition? Free when no plan is
+    active — the fleet's wire call sites check this before every
+    exchange and surface True as the same OSError a real severed link
+    produces."""
+    plan = _ACTIVE_PLAN
+    if plan is not None:
+        return plan.partitioned(peer)
+    return False
+
+
+def corrupt_bytes(body: bytes) -> bytes:
+    """Deterministically flip one byte in the middle of a frame — the
+    ``corrupt`` chaos kind's payload mutation. Applied to an SRTF1 frame
+    it lands inside the header/data region (past the magic), so the
+    receiver sees a :class:`~.fleet.wire.WireError`-shaped failure, not
+    an unrecognized protocol."""
+    if not body:
+        return body
+    b = bytearray(body)
+    i = len(b) // 2
+    b[i] ^= 0xFF
+    return bytes(b)
 
 
 # ----------------------------------------------------------------------

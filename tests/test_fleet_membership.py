@@ -1,0 +1,999 @@
+"""Elastic fleet membership (PR 17): lease-based owner failover with
+epoch-fenced ownership re-sharding, plus the wire chaos harness.
+
+Fast tier: the fake-clock lease matrix (a merely-slow worker is provably
+never evicted), Membership/RankedLayout re-shard units, PeerBackoff,
+MembershipLedger, epoch fencing over real HTTP, the PeerServer
+malformed-input fuzz suite (typed 400/413, never a handler traceback),
+FaultPlan wire-chaos units, and a 3-worker thread-fleet eviction
+integration (crash one worker, watch the lead evict it and the epoch-1
+fleet of two finish).
+
+Slow tier (``make train-fleet-chaos``): the subprocess owner-loss drill
+(SIGKILL a worker past its restart budget → lease eviction →
+epoch-fenced re-shard → the survivors finish cleanly, degraded-success
+rc=0, zero NaN) and the wire-chaos matrix (corrupt/delay/dup/partition
+at the grad-push and param-pull sites on a live fleet).
+"""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spacy_ray_tpu.config import Config
+from spacy_ray_tpu.training import resilience
+from spacy_ray_tpu.training.fleet.membership import (
+    LeaseTracker,
+    Membership,
+    MembershipLedger,
+    PeerBackoff,
+    RankedLayout,
+    read_membership_ledger,
+)
+from spacy_ray_tpu.training.fleet.ownership import OwnershipLayout
+from spacy_ray_tpu.training.fleet.peer import (
+    FleetCounters,
+    OwnerState,
+    PeerServer,
+)
+from spacy_ray_tpu.training.fleet.wire import (
+    WireError,
+    decode_arrays,
+    encode_arrays,
+    frame_epoch,
+)
+from spacy_ray_tpu.util import write_synth_jsonl
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("membership_data")
+    write_synth_jsonl(d / "train.jsonl", 120, kind="tagger", seed=0)
+    write_synth_jsonl(d / "dev.jsonl", 30, kind="tagger", seed=1)
+    return d
+
+
+def _config(tagger_config_text, data_dir, **over):
+    cfg = Config.from_str(tagger_config_text)
+    return cfg.apply_overrides(
+        {
+            "paths.train": str(data_dir / "train.jsonl"),
+            "paths.dev": str(data_dir / "dev.jsonl"),
+            **over,
+        }
+    )
+
+
+def _assert_finite_model(out):
+    """Every weight in the run's final model is finite (zero NaN, zero
+    lost lineage)."""
+    model_dir = (
+        out / "best-model"
+        if (out / "best-model" / "params.npz").exists()
+        else out / "last-model"
+    )
+    with np.load(model_dir / "params.npz") as data:
+        assert data.files
+        for name in data.files:
+            assert np.all(np.isfinite(data[name])), name
+
+
+# ----------------------------------------------------------------------
+# LeaseTracker: the fake-clock matrix
+# ----------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def test_lease_verdict_needs_both_factors():
+    """Death is two-factor: lease expiry alone is not evictable, a miss
+    burst alone is not evictable — only both together are."""
+    clock = _FakeClock()
+    tr = LeaseTracker([1, 2], lease_s=10.0, miss_threshold=3, clock=clock)
+    # lease expired, zero misses (a peer we simply haven't probed):
+    # not dead
+    clock.advance(11.0)
+    assert not tr.dead(1)
+    # misses >= threshold but lease NOT expired (fast probe loop burning
+    # through misses inside a second): not dead
+    tr.observe(2, True)
+    for _ in range(5):
+        tr.observe(2, False)
+    assert not tr.dead(2)
+    # both: dead
+    for _ in range(3):
+        tr.observe(1, False)
+    assert tr.dead(1)
+    assert tr.expired() == [1]
+
+
+def test_slow_but_answering_worker_never_evicted():
+    """The headline guarantee: a worker that keeps ANSWERING — however
+    slowly — is provably never evicted, because every success resets
+    both the lease clock and the miss counter."""
+    clock = _FakeClock()
+    tr = LeaseTracker([1], lease_s=10.0, miss_threshold=3, clock=clock)
+    # a long-GC-pause pattern: 9.9s of silence (2 missed probes), then
+    # one answer, forever
+    for _ in range(50):
+        clock.advance(9.9)
+        tr.observe(1, False)
+        tr.observe(1, False)
+        assert not tr.dead(1)
+        tr.observe(1, True)
+        assert not tr.dead(1)
+    # and even with misses piling past the threshold, a success inside
+    # the lease wipes them
+    for _ in range(10):
+        tr.observe(1, False)
+    tr.observe(1, True)
+    clock.advance(9.0)
+    assert not tr.dead(1)
+
+
+def test_lease_startup_grace_and_add_remove():
+    clock = _FakeClock()
+    tr = LeaseTracker([1], lease_s=5.0, miss_threshold=2, clock=clock)
+    # a freshly tracked peer starts with a full lease of grace
+    clock.advance(3.0)
+    tr.add(3)
+    tr.observe(3, False)
+    tr.observe(3, False)
+    clock.advance(3.0)  # 3's lease (started at add time) not yet expired
+    assert not tr.dead(3)
+    clock.advance(3.0)
+    assert tr.dead(3)
+    tr.remove(3)
+    assert not tr.dead(3)  # untracked peers have no verdict
+    assert tr.peers() == [1]
+    tr.observe(3, False)  # observing an untracked peer is a no-op
+    assert tr.peers() == [1]
+
+
+def test_lease_tracker_validates_inputs():
+    with pytest.raises(ValueError):
+        LeaseTracker([1], lease_s=0.0)
+    with pytest.raises(ValueError):
+        LeaseTracker([1], lease_s=5.0, miss_threshold=0)
+
+
+# ----------------------------------------------------------------------
+# Membership: epochs, lead fallback, wire form
+# ----------------------------------------------------------------------
+
+
+def test_membership_evict_admit_bump_epoch():
+    m = Membership(range(3))
+    assert (m.epoch, m.active, m.lead) == (0, (0, 1, 2), 0)
+    m1 = m.evict(0)
+    assert (m1.epoch, m1.active) == (1, (1, 2))
+    assert m1.lead == 1  # deterministic survivor-rank fallback
+    m2 = m1.admit(0)
+    assert (m2.epoch, m2.active, m2.lead) == (2, (0, 1, 2), 0)
+    assert 0 not in m1 and 0 in m2
+    with pytest.raises(ValueError):
+        m1.evict(0)  # not active
+    with pytest.raises(ValueError):
+        m2.admit(1)  # already active
+    with pytest.raises(ValueError):
+        Membership([5]).evict(5)  # never evict the last worker
+    with pytest.raises(ValueError):
+        Membership([])
+    with pytest.raises(ValueError):
+        Membership([0], epoch=-1)
+
+
+def test_membership_wire_roundtrip_and_validation():
+    m = Membership([0, 2], epoch=3)
+    assert Membership.from_wire(m.to_wire()) == m
+    for bad in (
+        None,
+        [],
+        "x",
+        {"epoch": 1},                        # no active
+        {"epoch": -1, "active": [0]},        # negative epoch
+        {"epoch": True, "active": [0]},      # bool is not an int here
+        {"epoch": 1, "active": []},          # empty active
+        {"epoch": 1, "active": [0, "1"]},    # non-int id
+        {"epoch": 1, "active": [0, -2]},     # negative id
+        {"epoch": 1, "active": [True]},      # bool id
+        {"epoch": 1.5, "active": [0]},       # float epoch
+    ):
+        with pytest.raises(ValueError):
+            Membership.from_wire(bad)
+
+
+# ----------------------------------------------------------------------
+# RankedLayout: the re-shard
+# ----------------------------------------------------------------------
+
+
+def _template():
+    rng = np.random.default_rng(0)
+    return {
+        "a": {"W": rng.random((12, 6), dtype=np.float32),
+              "b": rng.random(5, dtype=np.float32)},
+        "c": {"E": rng.random((9, 4), dtype=np.float32)},
+    }
+
+
+def test_ranked_layout_is_survivor_count_layout_by_original_id():
+    """The post-eviction layout over survivors {0, 2} IS the 2-worker
+    OwnershipLayout, addressed by the ORIGINAL ids — so part files stay
+    v2-canonical while the wire keeps speaking worker ids."""
+    template = _template()
+    ranked = RankedLayout(template, [0, 2])
+    base = OwnershipLayout(template, 2)
+    assert ranked.rank_of(0) == 0 and ranked.rank_of(2) == 1
+    assert ranked.rank_of(1) is None
+    for worker, rank in ((0, 0), (2, 1)):
+        assert ranked.owned_keys(worker) == base.owned_keys(rank)
+        flat = ranked.flat_slices(template, worker)
+        for key, arr in base.flat_slices(template, rank).items():
+            np.testing.assert_array_equal(flat[key], arr)
+    # an id outside the active set owns nothing (its shards were
+    # re-owned at the epoch bump)
+    assert ranked.owned_keys(1) == []
+    assert ranked.slice_tree(template, 1) == {}
+    with pytest.raises(ValueError):
+        ranked.merge_flat(template, 1, {})
+    with pytest.raises(ValueError):
+        ranked.index(0, 1)
+
+
+def test_ranked_layout_merge_reconstructs_after_reshard():
+    import jax
+
+    template = _template()
+    ranked = RankedLayout(template, [0, 2])
+    zeros = jax.tree_util.tree_map(np.zeros_like, template)
+    for w in (0, 2):
+        ranked.merge_flat(zeros, w, ranked.flat_slices(template, w))
+    for path in ("a", "c"):
+        for leaf in template[path]:
+            np.testing.assert_array_equal(
+                zeros[path][leaf], template[path][leaf]
+            )
+
+
+def test_ranked_layout_signature_depends_on_active_set():
+    """Two fleets at different memberships slice differently, so their
+    signatures must differ even at the same survivor COUNT."""
+    template = _template()
+    assert (
+        RankedLayout(template, [0, 1]).signature()
+        != RankedLayout(template, [0, 2]).signature()
+    )
+    assert (
+        RankedLayout(template, [0, 1, 2]).signature()
+        != RankedLayout(template, [0, 1]).signature()
+    )
+    with pytest.raises(ValueError):
+        RankedLayout(template, [])
+
+
+# ----------------------------------------------------------------------
+# PeerBackoff: the dead-owner pull-spin fix
+# ----------------------------------------------------------------------
+
+
+def test_peer_backoff_one_event_per_outage_capped_delay():
+    clock = _FakeClock()
+    b = PeerBackoff(base_s=1.0, cap_s=4.0, clock=clock)
+    assert not b.skip(7)
+    assert b.record_failure(7) is True       # the ONE event per outage
+    assert b.record_failure(7) is False      # same outage: silent
+    assert b.current_delay(7) == 2.0         # doubled
+    for _ in range(5):
+        b.record_failure(7)
+    assert b.current_delay(7) == 4.0         # capped
+    assert b.skip(7)                         # zero wait mid-outage
+    clock.advance(5.0)
+    assert not b.skip(7)                     # window elapsed: retry
+    assert b.record_success(7) is True       # recovery is loggable once
+    assert b.record_success(7) is False
+    assert b.current_delay(7) == 0.0
+    assert b.record_failure(7) is True       # a NEW outage starts over
+    assert b.current_delay(7) == 1.0
+
+
+# ----------------------------------------------------------------------
+# MembershipLedger
+# ----------------------------------------------------------------------
+
+
+def test_membership_ledger_roundtrip_and_null_path(tmp_path):
+    path = tmp_path / "run" / "fleet-membership.jsonl"
+    ledger = MembershipLedger(path)
+    ledger.append("evict", lead=0, evicted=[2], epoch=1, active=[0, 1])
+    ledger.append("apply", worker=1, epoch=1, active=[0, 1], resharded=3)
+    path.open("a", encoding="utf8").write("{torn json\n")  # mid-append
+    rows = read_membership_ledger(path)
+    assert [r["event"] for r in rows] == ["evict", "apply"]
+    assert rows[0]["evicted"] == [2] and rows[0]["epoch"] == 1
+    assert all("ts" in r for r in rows)
+    # a ledger with no path is an explicit no-op, not a crash
+    MembershipLedger(None).append("evict", epoch=1)
+    assert read_membership_ledger(tmp_path / "missing.jsonl") == []
+
+
+# ----------------------------------------------------------------------
+# Epoch fencing over real HTTP
+# ----------------------------------------------------------------------
+
+
+def _server(epoch=0, active=(0, 1), checkpoint_cb=None, quorum=1):
+    counters = FleetCounters()
+    owner = OwnerState(
+        worker_id=1, n_workers=2, quorum=quorum, max_staleness=0,
+        apply_fn=lambda p, o, g: ({"x": p["x"] + g["x"]}, o),
+        slice_params={"x": np.zeros(4, np.float32)},
+        opt_state={}, counters=counters,
+    )
+    server = PeerServer(
+        owner, worker_id=1, layout_signature="sig", counters=counters,
+        checkpoint_cb=checkpoint_cb,
+    )
+    if epoch:
+        server.set_membership(Membership(active, epoch), "sig-e")
+    host, port = server.start()
+    return server, counters, f"http://{host}:{port}"
+
+
+def _post(url, path, body, headers=None):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url + path, data=body, method="POST", headers=headers or {}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _get(url, path, headers=None):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(url + path, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_grad_push_epoch_fence_counted():
+    server, counters, url = _server(epoch=2)
+    try:
+        grads = {"x": np.ones(4, np.float32)}
+        # stale epoch: fenced, counted, NOT accepted — and the reply
+        # names the current epoch so the zombie can resync
+        body = encode_arrays({"worker": 0, "stamp": 0, "epoch": 1}, grads)
+        status, reply = _post(url, "/grad", body)
+        assert status == 200
+        assert json.loads(reply) == {
+            "accepted": False, "fenced": True, "epoch": 2,
+        }
+        # missing epoch field = pre-elastic peer = epoch 0: also fenced
+        # against a server at epoch 2
+        body = encode_arrays({"worker": 0, "stamp": 0}, grads)
+        _, reply = _post(url, "/grad", body)
+        assert json.loads(reply)["fenced"] is True
+        assert counters.snapshot()["epoch_fenced"] == 2
+        # the CURRENT epoch passes the fence and applies at quorum 1
+        body = encode_arrays({"worker": 0, "stamp": 0, "epoch": 2}, grads)
+        _, reply = _post(url, "/grad", body)
+        assert json.loads(reply) == {"accepted": True, "version": 1}
+        assert counters.snapshot()["grad_applied"] == 1
+    finally:
+        server.stop()
+
+
+def test_param_pull_epoch_fence_409():
+    server, counters, url = _server(epoch=3)
+    try:
+        status, reply = _get(
+            url, "/params?known=-1", headers={"X-SRT-Epoch": "2"}
+        )
+        assert status == 409
+        assert json.loads(reply)["error"] == "epoch_fenced"
+        # absent header = epoch 0 (pre-elastic puller): fenced too
+        status, _ = _get(url, "/params?known=-1")
+        assert status == 409
+        assert counters.snapshot()["epoch_fenced"] == 2
+        status, body = _get(
+            url, "/params?known=-1", headers={"X-SRT-Epoch": "3"}
+        )
+        assert status == 200
+        meta, arrays = decode_arrays(body)
+        assert meta["version"] == 0
+        np.testing.assert_array_equal(arrays["x"], np.zeros(4))
+    finally:
+        server.stop()
+
+
+def test_checkpoint_wire_epoch_fence_409(tmp_path):
+    def cb(ckpt_dir, stamp):
+        return {
+            "meta": {"part": 1, "digest": "d", "version": 0},
+            "params": {"x": np.zeros(4, np.float32)},
+        }
+
+    server, counters, url = _server(epoch=1, checkpoint_cb=cb)
+    try:
+        req = {"dir": str(tmp_path), "stamp": 5, "epoch": 0}
+        status, reply = _post(
+            url, "/checkpoint", json.dumps(req).encode("utf8")
+        )
+        assert status == 409
+        assert json.loads(reply)["epoch"] == 1
+        assert counters.snapshot()["epoch_fenced"] == 1
+        req["epoch"] = 1
+        status, body = _post(
+            url, "/checkpoint", json.dumps(req).encode("utf8")
+        )
+        assert status == 200
+        meta, _ = decode_arrays(body)
+        assert meta["part"] == 1
+    finally:
+        server.stop()
+
+
+def test_membership_broadcast_queue_and_fence():
+    server, counters, url = _server(epoch=2)
+    try:
+        # a zombie lead re-broadcasting its dead membership is fenced
+        stale = Membership([0, 1, 2], 1).to_wire()
+        status, _ = _post(
+            url, "/membership", json.dumps(stale).encode("utf8")
+        )
+        assert status == 409
+        assert server.take_pending_membership() is None
+        # a strictly newer membership is queued for the step boundary
+        newer = Membership([0, 1], 3).to_wire()
+        status, reply = _post(
+            url, "/membership", json.dumps(newer).encode("utf8")
+        )
+        assert status == 200 and json.loads(reply)["adopted"] is True
+        pending = server.take_pending_membership()
+        assert pending is not None and pending.epoch == 3
+        assert server.take_pending_membership() is None  # drained
+        # the HIGHEST pending epoch wins when broadcasts race
+        _post(url, "/membership",
+              json.dumps(Membership([0, 1], 5).to_wire()).encode("utf8"))
+        _post(url, "/membership",
+              json.dumps(Membership([0, 1], 4).to_wire()).encode("utf8"))
+        assert server.take_pending_membership().epoch == 5
+        # /membership GET advertises the adopted truth
+        status, body = _get(url, "/membership")
+        assert status == 200
+        assert json.loads(body)["active"] == [0, 1]
+        # join requests queue and drain once
+        status, reply = _post(
+            url, "/membership/join",
+            json.dumps({"worker": 2}).encode("utf8"),
+        )
+        assert status == 200 and json.loads(reply)["queued"] is True
+        assert server.drain_join_requests() == [2]
+        assert server.drain_join_requests() == []
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------------------------
+# PeerServer malformed-input fuzz: typed 400/413, never a traceback
+# ----------------------------------------------------------------------
+
+
+def test_peer_server_fuzz_malformed_inputs_typed_never_traceback():
+    server, counters, url = _server(
+        epoch=0, checkpoint_cb=lambda d, s: {"meta": {}, "params": {}}
+    )
+    server.httpd.max_body_bytes = 4096  # make the 413 path cheap to hit
+    try:
+        valid = encode_arrays(
+            {"worker": 0, "stamp": 0}, {"x": np.ones(4, np.float32)}
+        )
+        grad_bodies = [
+            b"",                                  # empty
+            b"not-an-srtf1-frame",                # garbage
+            valid[: len(valid) // 2],             # truncated mid-frame
+            b"\x00" * 64,                         # wrong magic
+            valid[:8] + b"\xff" * (len(valid) - 8),  # corrupted payload
+            # wire-valid but meta missing worker/stamp
+            encode_arrays({}, {"x": np.ones(4, np.float32)}),
+            # garbage epoch stamp (frame_epoch must raise WireError,
+            # surfaced as a 400)
+            encode_arrays(
+                {"worker": 0, "stamp": 0, "epoch": "zero"},
+                {"x": np.ones(4, np.float32)},
+            ),
+            encode_arrays(
+                {"worker": 0, "stamp": 0, "epoch": -1},
+                {"x": np.ones(4, np.float32)},
+            ),
+        ]
+        for body in grad_bodies:
+            status, reply = _post(url, "/grad", body)
+            assert status == 400, (status, body[:40])
+            assert json.loads(reply)["error"] in ("bad_payload", "bad_request")
+        # oversized frame: 413 + counted discard, no allocation stampede
+        status, reply = _post(url, "/grad", b"x" * 8192)
+        assert status == 413
+        assert json.loads(reply)["error"] == "body_too_large"
+        assert counters.snapshot()["grad_discarded"] >= 1
+
+        for path, body in [
+            ("/checkpoint", b"{not json"),
+            ("/checkpoint", json.dumps({"stamp": 1}).encode("utf8")),
+            ("/checkpoint", json.dumps(
+                {"dir": "/tmp/x", "stamp": "abc"}).encode("utf8")),
+            ("/checkpoint", json.dumps(
+                {"dir": "/tmp/x", "stamp": 1, "epoch": []}).encode("utf8")),
+            ("/checkpoint", b"\xff\xfe garbage bytes"),
+            ("/membership", b"{broken"),
+            ("/membership", json.dumps({"epoch": 1}).encode("utf8")),
+            ("/membership", json.dumps(
+                {"epoch": -2, "active": [0]}).encode("utf8")),
+            ("/membership", json.dumps(
+                {"epoch": 1, "active": ["a"]}).encode("utf8")),
+            ("/membership/join", b"{broken"),
+            ("/membership/join", json.dumps({}).encode("utf8")),
+            ("/membership/join", json.dumps(
+                {"worker": -1}).encode("utf8")),
+            ("/membership/join", json.dumps(
+                {"worker": True}).encode("utf8")),
+            ("/membership/join", json.dumps(
+                {"worker": "2"}).encode("utf8")),
+        ]:
+            status, reply = _post(url, path, body)
+            assert status == 400, (path, status, body[:40])
+            assert json.loads(reply)["error"] == "bad_request"
+
+        # malformed GET inputs stay typed too
+        assert _get(url, "/params?known=abc")[0] == 400
+        assert _get(url, "/params?known=-1",
+                    headers={"X-SRT-Epoch": "xx"})[0] == 400
+        assert _get(url, "/nope")[0] == 404
+
+        # after the whole barrage the server is still healthy and the
+        # owner state untouched — no handler thread died mid-request
+        status, body = _get(url, "/healthz")
+        assert status == 200
+        h = json.loads(body)
+        assert h["status"] == "ok" and h["version"] == 0
+        snap = counters.snapshot()
+        assert snap["grad_applied"] == 0 and snap["applies"] == 0
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------------------------
+# FaultPlan wire-chaos grammar
+# ----------------------------------------------------------------------
+
+
+def test_fault_plan_wire_kinds_parse_queue_and_consume():
+    plan = resilience.FaultPlan.parse(
+        "grad-push:1:corrupt,grad-push:2:dup,param-pull:1:delay:0.25"
+    )
+    prev = resilience.set_fault_plan(plan)
+    try:
+        assert resilience.consume_wire_fault("grad-push") is None
+        plan.check("grad-push")
+        plan.check("grad-push")
+        # FIFO: the call-1 corrupt comes out before the call-2 dup
+        assert resilience.consume_wire_fault("grad-push") == ("corrupt", None)
+        assert resilience.consume_wire_fault("grad-push") == ("dup", None)
+        assert resilience.consume_wire_fault("grad-push") is None
+        plan.check("param-pull")
+        assert resilience.consume_wire_fault("param-pull") == ("delay", "0.25")
+    finally:
+        resilience.set_fault_plan(prev)
+
+
+def test_fault_plan_partition_and_heal():
+    plan = resilience.FaultPlan.parse(
+        "param-pull:1:partition:1,param-pull:2:heal:1,"
+        "param-pull:3:partition,param-pull:4:heal"
+    )
+    prev = resilience.set_fault_plan(plan)
+    try:
+        assert not resilience.partitioned(1)
+        plan.check("param-pull")
+        assert resilience.partitioned(1) and not resilience.partitioned(0)
+        plan.check("param-pull")
+        assert not resilience.partitioned(1)
+        plan.check("param-pull")  # argless: sever everything
+        assert resilience.partitioned(0) and resilience.partitioned(99)
+        plan.check("param-pull")  # argless heal: restore everything
+        assert not resilience.partitioned(0)
+    finally:
+        resilience.set_fault_plan(prev)
+    # no active plan: both predicates are free and False/None
+    assert not resilience.partitioned(1)
+    assert resilience.consume_wire_fault("grad-push") is None
+
+
+def test_fault_plan_rejects_malformed_chaos_rules():
+    for bad in (
+        "grad-push:1:delay:soon",       # delay arg not a number
+        "grad-push:1:partition:peer2",  # partition arg not an id
+        "grad-push:1:corrupt:x",        # corrupt takes no arg
+        "grad-push:one:corrupt",        # call not an int
+        "grad-push:corrupt",            # missing call field
+    ):
+        with pytest.raises(ValueError):
+            resilience.FaultPlan.parse(bad)
+
+
+def test_corrupt_bytes_flips_one_mid_frame_byte():
+    body = bytes(range(16)) * 4
+    out = resilience.corrupt_bytes(body)
+    assert len(out) == len(body)
+    diffs = [i for i in range(len(body)) if out[i] != body[i]]
+    assert diffs == [len(body) // 2]
+    assert resilience.corrupt_bytes(b"") == b""
+    # a corrupted SRTF1 frame decodes as a typed WireError, never a
+    # crash in the receiver
+    frame = encode_arrays(
+        {"worker": 0, "stamp": 0}, {"x": np.ones(8, np.float32)}
+    )
+    with pytest.raises(WireError):
+        decode_arrays(resilience.corrupt_bytes(frame))
+
+
+def test_frame_epoch_reads_and_rejects():
+    assert frame_epoch({}) == 0  # pre-elastic frame: epoch 0 by definition
+    assert frame_epoch({"epoch": 4}) == 4
+    for bad in ({"epoch": -1}, {"epoch": True}, {"epoch": "2"},
+                {"epoch": 1.5}):
+        with pytest.raises(WireError):
+            frame_epoch(bad)
+
+
+# ----------------------------------------------------------------------
+# [training] knobs (satellite: surfaced _PeerClient timeouts)
+# ----------------------------------------------------------------------
+
+
+def test_fleet_timeout_knobs_defaults_and_validation():
+    from spacy_ray_tpu.training.loop import DEFAULT_TRAINING, validate_training
+
+    assert DEFAULT_TRAINING["fleet_peer_timeout_s"] == 10.0
+    assert DEFAULT_TRAINING["fleet_probe_timeout_s"] == 5.0
+    validate_training(
+        {"fleet_peer_timeout_s": 2.5, "fleet_probe_timeout_s": 1}
+    )
+    for key in ("fleet_peer_timeout_s", "fleet_probe_timeout_s"):
+        for bad in (0, -1, "fast", None):
+            with pytest.raises(ValueError):
+                validate_training({key: bad})
+
+
+def test_cli_exposes_peer_lease_flag():
+    """``--peer-lease-s`` reaches the worker kwargs (0 disables
+    eviction — the documented pre-elastic fallback)."""
+    import inspect
+
+    from spacy_ray_tpu.training.fleet.worker import train_fleet_worker
+
+    sig = inspect.signature(train_fleet_worker)
+    assert sig.parameters["peer_lease_s"].default == 60.0
+    assert "lease_miss_threshold" in sig.parameters
+    assert "peer_timeout_s" in sig.parameters
+    assert "probe_timeout_s" in sig.parameters
+
+
+# ----------------------------------------------------------------------
+# Thread-fleet eviction integration: crash one worker, lead evicts,
+# the epoch-1 fleet of two finishes
+# ----------------------------------------------------------------------
+
+
+class _ThreadKillPlan(resilience.FaultPlan):
+    """Raise FaultInjected at ``site`` on the victim THREAD's Nth call —
+    the deterministic in-process analog of SIGKILLing one worker (the
+    global plan's call counter is shared across worker threads, so a
+    plain site:call rule cannot name a victim)."""
+
+    def __init__(self, victim_thread, site, call):
+        super().__init__([])
+        self.victim = victim_thread
+        self.site = site
+        self.call = call
+        self._n = 0
+        self._l = threading.Lock()
+
+    def check(self, site):
+        if site != self.site:
+            return
+        if threading.current_thread().name != self.victim:
+            return
+        with self._l:
+            self._n += 1
+            n = self._n
+        if n == self.call:
+            raise resilience.FaultInjected(
+                f"killed {self.victim} at {site} call {n}"
+            )
+
+
+def test_thread_fleet_evicts_dead_worker_and_resharding_continues(
+    tagger_config_text, data_dir, tmp_path
+):
+    """3 workers; worker 2 dies at its 2nd step (FaultInjected — its
+    server goes down with it). With a small lease the acting lead (0)
+    evicts it, the survivors re-shard at epoch 1 with quorum
+    re-resolved, the membership ledger records the transition, and the
+    run finishes finite."""
+    from spacy_ray_tpu.training.fleet.worker import train_fleet_worker
+
+    cfg = _config(
+        tagger_config_text, data_dir,
+        **{"training.max_steps": 24, "training.eval_frequency": 8},
+    )
+    out = tmp_path / "out"
+    n = 3
+    ports = _free_ports(n)
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    results, errors = {}, {}
+    plan = _ThreadKillPlan("fleet-mem-2", "step", 2)
+    prev = resilience.set_fault_plan(plan)
+
+    def run(k):
+        try:
+            _, res = train_fleet_worker(
+                cfg, out, worker_id=k, n_workers=n, quorum=0,
+                max_staleness=1, port=ports[k], peer_urls=urls,
+                stdout_log=False, install_signal_handlers=False,
+                quorum_wait_s=60.0,
+                peer_lease_s=1.0, lease_miss_threshold=2,
+                lease_poll_s=0.2,
+            )
+            results[k] = res
+        except Exception as e:
+            errors[k] = e
+
+    threads = [
+        threading.Thread(target=run, args=(k,), name=f"fleet-mem-{k}")
+        for k in range(n)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=420)
+        alive = [t.name for t in threads if t.is_alive()]
+        assert not alive, f"fleet workers wedged: {alive}"
+    finally:
+        resilience.set_fault_plan(prev)
+
+    # the victim died on the injected fault; the survivors finished
+    assert set(errors) == {2}
+    assert isinstance(errors[2], resilience.FaultInjected)
+    assert set(results) == {0, 1}
+    for k in (0, 1):
+        fleet = results[k].fleet
+        assert fleet["membership_epoch"] >= 1, fleet
+        assert list(fleet["active"]) == [0, 1], fleet
+    # quorum re-resolved over the survivors (auto at 2 active = 1)
+    assert results[0].fleet["quorum"] == 1
+    # the acting lead counted the eviction and wrote the ledger
+    assert results[0].fleet["counters"]["evictions"] >= 1
+    rows = read_membership_ledger(out / "fleet-membership.jsonl")
+    evicts = [r for r in rows if r["event"] == "evict"]
+    assert evicts and 2 in evicts[0]["evicted"]
+    assert evicts[0]["active"] == [0, 1]
+    applies = [r for r in rows if r["event"] == "apply"]
+    assert applies, "survivors never recorded the re-shard apply"
+    # survivors trained past the failover: finite weights on disk
+    for k in (0, 1):
+        assert results[k].final_step > 0
+    _assert_finite_model(out)
+
+
+# ----------------------------------------------------------------------
+# Slow tier: subprocess owner-loss drill + the wire chaos matrix
+# ----------------------------------------------------------------------
+
+
+def _fleet_cli_cmd(cfg_path, data_dir, out, n, *, steps, quorum, staleness,
+                   base_port, extra=()):
+    import sys
+
+    return [
+        sys.executable, "-m", "spacy_ray_tpu", "train", str(cfg_path),
+        "--device", "cpu",
+        "--fleet-workers", str(n),
+        "--quorum", str(quorum),
+        "--max-staleness", str(staleness),
+        "--fleet-base-port", str(base_port),
+        "--output", str(out),
+        f"--paths.train={data_dir / 'train.jsonl'}",
+        f"--paths.dev={data_dir / 'dev.jsonl'}",
+        f"--training.max_steps={steps}",
+        "--training.eval_frequency=8",
+        *extra,
+    ]
+
+
+@pytest.mark.slow
+def test_fleet_owner_loss_drill_subprocess(
+    tagger_config_text, data_dir, tmp_path
+):
+    """The acceptance drill: SIGKILL a worker whose restart budget is
+    ZERO. Its lease expires, the acting lead evicts it, the survivors
+    re-shard and finish cleanly — the coordinator reports the designed
+    degraded success (rc=0) with the eviction on the ledger, and every
+    surviving weight is finite."""
+    import os as _os
+    import signal
+    import subprocess
+    import urllib.request
+
+    cfg_path = tmp_path / "cfg.cfg"
+    cfg_path.write_text(tagger_config_text, encoding="utf8")
+    out = tmp_path / "out"
+    base_port = _free_ports(1)[0]
+    cmd = _fleet_cli_cmd(
+        cfg_path, data_dir, out, 3, steps=48, quorum=1, staleness=1,
+        base_port=base_port,
+        extra=("--max-restarts", "0", "--peer-lease-s", "4"),
+    )
+    coord = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True
+    )
+    victim_url = f"http://127.0.0.1:{base_port + 2}/healthz"
+
+    def victim_version():
+        try:
+            with urllib.request.urlopen(victim_url, timeout=2) as r:
+                return json.loads(r.read()).get("version")
+        except OSError:
+            return None
+
+    try:
+        # kill once the victim has stepped a few versions so the
+        # survivors have its last broadcast slices to adopt from
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            v = victim_version()
+            if v is not None and v >= 2:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("victim never reached version 2")
+        pid = int(
+            subprocess.run(
+                ["pgrep", "-f", "--", "--fleet-worker-id 2"],
+                capture_output=True, text=True,
+            ).stdout.split()[0]
+        )
+        _os.kill(pid, signal.SIGKILL)
+        rc = coord.wait(timeout=600)
+        out_text = coord.stdout.read()
+        err_text = coord.stderr.read()
+    finally:
+        if coord.poll() is None:
+            coord.kill()
+            coord.wait(timeout=30)
+    # degraded success: survivors finished cleanly past the dead
+    # worker's exhausted (zero) restart budget
+    assert rc == 0, (out_text[-2000:], err_text[-2000:])
+    assert "fleet-degraded-success" in out_text + err_text
+    # the eviction is on the membership ledger with the survivor set
+    rows = read_membership_ledger(out / "fleet-membership.jsonl")
+    evicts = [r for r in rows if r["event"] == "evict"]
+    assert evicts and 2 in evicts[-1]["evicted"], rows
+    assert 2 not in evicts[-1]["active"]
+    # survivor ledgers carry the bumped epoch and the survivor set
+    for k in (0, 1):
+        ledger = json.loads(
+            (out / f"fleet-worker-{k}.json").read_text("utf8")
+        )
+        assert ledger["membership_epoch"] >= 1
+        assert ledger["active"] == [0, 1]
+    # zero NaN, zero lost lineage: the final weights are finite
+    _assert_finite_model(out)
+
+
+@pytest.mark.slow
+def test_fleet_wire_chaos_matrix_thread_fleet(
+    tagger_config_text, data_dir, tmp_path
+):
+    """The chaos matrix on a live 2-worker fleet: corrupt, dup, and
+    delayed frames at the grad-push and param-pull sites plus a
+    partition/heal cycle — every fault is a COUNTED degradation
+    (typed discard, one unreachable event, recovery on heal), training
+    finishes, and no weight goes non-finite."""
+    from spacy_ray_tpu.training.fleet.worker import train_fleet_worker
+
+    cfg = _config(
+        tagger_config_text, data_dir,
+        **{"training.max_steps": 24, "training.eval_frequency": 8},
+    )
+    out = tmp_path / "out"
+    plan = resilience.FaultPlan.parse(
+        "grad-push:3:corrupt,grad-push:5:dup,grad-push:9:delay:0.1,"
+        "param-pull:4:dup,param-pull:6:delay:0.1,"
+        "param-pull:10:partition:1,param-pull:16:heal:1"
+    )
+    n = 2
+    ports = _free_ports(n)
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    results, errors = {}, {}
+    prev = resilience.set_fault_plan(plan)
+
+    def run(k):
+        try:
+            _, res = train_fleet_worker(
+                cfg, out, worker_id=k, n_workers=n, quorum=1,
+                max_staleness=1, port=ports[k], peer_urls=urls,
+                stdout_log=False, install_signal_handlers=False,
+                quorum_wait_s=60.0,
+            )
+            results[k] = res
+        except Exception as e:
+            errors[k] = e
+
+    threads = [
+        threading.Thread(target=run, args=(k,), name=f"fleet-chaos-{k}")
+        for k in range(n)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=420)
+        alive = [t.name for t in threads if t.is_alive()]
+        assert not alive, f"fleet workers wedged: {alive}"
+        assert not errors, f"fleet workers raised: {errors}"
+    finally:
+        resilience.set_fault_plan(prev)
+    assert set(results) == {0, 1}
+    # the chaos left counted fingerprints, not crashes: the corrupted
+    # frame is a discard at its receiver, the partition costs push/pull
+    # failures on the severed link
+    totals = {}
+    for k in (0, 1):
+        for name, v in results[k].fleet["counters"].items():
+            totals[name] = totals.get(name, 0) + int(v)
+    assert (
+        totals.get("grad_discarded", 0)
+        + totals.get("push_failed", 0)
+        + totals.get("pull_failed", 0)
+    ) >= 1, totals
+    # default lease (60s) means the brief partition never evicted anyone
+    for k in (0, 1):
+        assert results[k].fleet["membership_epoch"] == 0
+        assert list(results[k].fleet["active"]) == [0, 1]
+    # zero NaN through the whole matrix
+    _assert_finite_model(out)

@@ -80,7 +80,7 @@ def test_fault_plan_rejects_bad_specs():
 def test_env_fault_plan_activation(monkeypatch):
     monkeypatch.setenv(resilience.FAULT_PLAN_ENV, "step:3:runtime")
     plan = resilience.activate_env_fault_plan()
-    assert plan is not None and plan.rules == [("step", 3, "runtime")]
+    assert plan is not None and plan.rules == [("step", 3, "runtime", None)]
     # empty env leaves the active plan alone
     monkeypatch.setenv(resilience.FAULT_PLAN_ENV, "")
     assert resilience.activate_env_fault_plan() is plan
