@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry observability serving fleet live train-fleet train-fleet-obs train-fleet-chaos bench baseline profile step-perf serve-perf update-shard dryrun
+.PHONY: test test-fast test-slow resilience telemetry observability serving fleet live train-fleet train-fleet-obs train-fleet-chaos bench bench-gate baseline profile step-perf serve-perf update-shard dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -102,6 +102,21 @@ train-fleet-chaos:
 
 bench:
 	python bench.py
+
+# regression sentry (docs/OBSERVABILITY.md "Host resources & the run
+# ledger"): one fast bench smoke appends its fresh record to a scratch
+# session (SRT_BENCH_SESSION keeps throwaway runs OUT of committed
+# history), then `telemetry ledger regress` judges it against the latest
+# clean committed record for the same (spec, shape, platform, labels)
+# key. Exits 1 only on a confirmed clean-vs-clean regression beyond the
+# measurement's own noise band; a contended host makes the verdict
+# "untrusted", never red. The JSON verdict is the CI artifact.
+bench-gate:
+	rm -f .bench-gate-fresh.jsonl
+	SRT_BENCH_SESSION=.bench-gate-fresh.jsonl JAX_PLATFORMS=cpu python bench.py --configs cnn_tagger
+	JAX_PLATFORMS=cpu python -m spacy_ray_tpu telemetry ledger regress \
+		--record .bench-gate-fresh.jsonl --session BENCH_SESSION.jsonl \
+		--json-out bench-gate-verdict.json
 
 baseline:
 	python bench.py --measure-baseline

@@ -49,7 +49,14 @@ BASELINE_FILE = Path(__file__).parent / "MEASURED_BASELINE.json"
 # config completes, so a relay crash mid-suite loses nothing (VERDICT r3
 # next #1b). TPU records are additionally merged into TPU_BENCH_SESSION.json
 # (the round-2 pattern) so the CPU-fallback path keeps surfacing them.
-SESSION_FILE = Path(__file__).parent / "BENCH_SESSION.jsonl"
+# SRT_BENCH_SESSION redirects the append target — the bench-gate CI
+# smoke writes its fresh record to a scratch file and judges it against
+# the committed session with `telemetry ledger regress` instead of
+# polluting history with throwaway runs.
+SESSION_FILE = Path(
+    os.environ.get("SRT_BENCH_SESSION")
+    or Path(__file__).parent / "BENCH_SESSION.jsonl"
+)
 TPU_SESSION_FILE = Path(__file__).parent / "TPU_BENCH_SESSION.json"
 
 # Host-specific cache for the measured peak (matmul microbench); not
@@ -197,6 +204,10 @@ def _append_session(rec: Dict[str, Any], platform: str) -> None:
     import datetime
 
     stamped = dict(rec)
+    # every committed record carries machine-derived host truth; arms
+    # that ran a contention probe stamp their own richer block upstream
+    if "host" not in stamped:
+        stamped["host"] = _host_block()
     stamped["recorded_at"] = datetime.datetime.now(
         datetime.timezone.utc
     ).isoformat(timespec="seconds").replace("+00:00", "Z")
@@ -224,6 +235,20 @@ def _append_session(rec: Dict[str, Any], platform: str) -> None:
                                     encoding="utf8")
     except Exception as e:
         print(f"# tpu session merge failed: {e}", flush=True)
+
+
+def _host_block(cores_needed: Optional[int] = None) -> Dict[str, Any]:
+    """The machine-derived ``host`` stamp on every record: effective
+    cores (cgroup/affinity/cpu-count min with provenance), the
+    contention probe's verdict when the arm declares how many cores it
+    wants, and the process RSS peak. Never fatal — a hostile host gets
+    an error stamp, not a crashed bench."""
+    try:
+        from spacy_ray_tpu.training.hoststats import host_block
+
+        return host_block(cores_needed=cores_needed)
+    except Exception as e:  # /proc-less or exotic host: stamp, don't die
+        return {"error": str(e)}
 
 
 def _flash_status(spec_env: Optional[Dict[str, str]] = None) -> str:
@@ -950,6 +975,14 @@ def run_one(spec: Dict[str, Any], platform: str) -> Optional[Dict[str, Any]]:
             round(reprobe_ratio, 3) if reprobe_ratio is not None else None
         ),
         "contended": contended,
+        # machine-derived host truth (hoststats): cores with provenance
+        # (cgroup quota vs affinity vs cpu count), spin-probe verdict,
+        # and rss peak — what the run ledger ingests to decide whether
+        # this record is baseline-worthy. The reprobe-based `contended`
+        # above stays authoritative for single-spec arms (it measures
+        # the actual timed window); the host block's probe is the
+        # forward-looking stamp.
+        "host": _host_block(cores_needed=1),
     }
     if spec.get("attention"):
         # self-describing kernel provenance: a CPU fallback can't pose as a
@@ -1327,6 +1360,7 @@ def run_update_only(platform: str, configs=None) -> None:
                     reprobe_ratio is not None
                     and reprobe_ratio < CONTENTION_RATIO
                 ),
+                "host": _host_block(cores_needed=1),
             }
             print(json.dumps(rec), flush=True)
             _append_session(rec, platform)
@@ -1557,6 +1591,7 @@ def run_update_sharded(platform: str, n_devices: int, configs=None) -> None:
                     reprobe_ratio is not None
                     and reprobe_ratio < CONTENTION_RATIO
                 ),
+                "host": _host_block(cores_needed=1),
             }
             print(json.dumps(rec), flush=True)
             _append_session(rec, platform)
@@ -3098,7 +3133,10 @@ def run_training_fleet(
     the same cores — the record stamps ``cores_available`` and
     ``contended: true`` so a flat scaling curve reads as a capability
     limit of the host, not of the fleet (the same honest-refusal
-    discipline as the TPU-gated kernel claims).
+    discipline as the TPU-gated kernel claims). Both stamps are
+    machine-derived (training/hoststats): effective cores are the min
+    of affinity, cpu count and the cgroup quota, and the contention
+    verdict adds a busy-spin efficiency probe.
 
     ``grad_compression`` / ``param_delta_window`` flow through to the
     workers; each record carries the wire-byte columns (pushed/pulled
@@ -3246,7 +3284,12 @@ def run_training_fleet(
             report_path = None
         if n == worker_counts[0]:
             baseline_wps = wps
-        contended = len(cores) < n
+        # machine-derived stamp (hoststats replaces the old hand
+        # arithmetic): effective cores fold the cgroup quota in — raw
+        # sched affinity overstates a quota-capped CI box — and the
+        # busy-spin probe catches neighbors core counts can't see
+        host = _host_block(cores_needed=n)
+        contended = bool(host.get("contended"))
         rec = {
             "name": "training_fleet",
             "metric": (
@@ -3283,8 +3326,9 @@ def run_training_fleet(
                 l.get("membership_epoch") for l in ledgers
             ],
             "evictions": int(counters.get("evictions") or 0),
-            "cores_available": len(cores),
+            "cores_available": int(host.get("cores") or len(cores)),
             "contended": contended,
+            "host": host,
             "scaling_vs_first": (
                 round(wps / baseline_wps, 2)
                 if baseline_wps and n != worker_counts[0] else None

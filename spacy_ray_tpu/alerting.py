@@ -71,6 +71,7 @@ __all__ = [
     "default_serving_rules",
     "default_router_rules",
     "default_training_rules",
+    "process_rules",
 ]
 
 # numeric encoding of the alert state for the Prometheus gauge — the
@@ -735,6 +736,56 @@ class AlertEngine:
 # ----------------------------------------------------------------------
 
 
+def process_rules(
+    *,
+    rss_growth_bytes: float = 256 * 1024 * 1024,
+    rss_window_s: float = 600.0,
+    fd_limit: float = 512.0,
+    fd_for_s: float = 60.0,
+) -> List[AlertRule]:
+    """The host-resource leak detectors every role set carries, reading
+    the ``process`` block hoststats injects into each role's alert
+    snapshot (docs/OBSERVABILITY.md "Host resources & the run ledger"):
+
+    * ``process-rss-growth`` — NET RSS growth beyond
+      ``rss_growth_bytes`` inside the trailing ``rss_window_s``. The
+      windowed delta clamps decreases to zero, so a sawtooth allocator
+      that keeps returning memory stays quiet while a monotone leak
+      accumulates; no ``partial``, so a process younger than the window
+      is no-signal — a short-lived CLI run can't page.
+    * ``process-fd-leak`` — open fds above ``fd_limit`` held for
+      ``fd_for_s``, ARMED only after the process has been seen healthy
+      (fd count at or below half the limit): a deliberately fd-hungry
+      deployment that BOOTS above the gate never arms (that's its
+      normal, not a leak), short-lived processes rarely live long
+      enough to arm-then-breach, and a missing ``/proc`` surface is
+      plain no-signal.
+
+    Both are tickets, not pages: a leak is a trend to fix this week,
+    not an outage to wake someone for — the watchdog and the burn rules
+    own the acute failure modes.
+    """
+    return [
+        ThresholdRule(
+            "process-rss-growth",
+            "process.rss_bytes",
+            ">=",
+            float(rss_growth_bytes),
+            window_s=float(rss_window_s),
+            severity="ticket",
+        ),
+        ThresholdRule(
+            "process-fd-leak",
+            "process.open_fds",
+            ">",
+            float(fd_limit),
+            arm_when=("<=", float(fd_limit) / 2.0),
+            for_s=float(fd_for_s),
+            severity="ticket",
+        ),
+    ]
+
+
 def default_serving_rules(
     *,
     p99_target_s: float = 0.5,
@@ -743,8 +794,9 @@ def default_serving_rules(
 ) -> List[AlertRule]:
     """A serving replica's defaults, evaluated over its own
     ``ServingTelemetry.snapshot()``: the request-success error budget
-    (typed rejects + errors over requests), and the sliding-window p99
-    against the SLO target."""
+    (typed rejects + errors over requests), the sliding-window p99
+    against the SLO target, and the host-resource leak detectors
+    (:func:`process_rules`)."""
     return [
         BurnRateRule(
             "serving-error-budget-burn",
@@ -774,7 +826,7 @@ def default_serving_rules(
             for_s=30.0,
             severity="page",
         ),
-    ]
+    ] + process_rules()
 
 
 def default_router_rules(
@@ -834,7 +886,10 @@ def default_router_rules(
             for_s=30.0,
             severity="page",
         ),
-    ]
+        # the router's own host truth rides the composite snapshot at
+        # top level (fleet.observe_tick), same dotted paths as the
+        # other roles
+    ] + process_rules()
 
 
 def default_training_rules(
@@ -950,4 +1005,5 @@ def default_training_rules(
                 ),
             ]
         )
+    rules.extend(process_rules())
     return rules

@@ -160,6 +160,14 @@ class ServingTelemetry:
         self.trace = TraceBuffer(
             clock=clock, pid=int(process_index), max_events=trace_max_events
         )
+        # host-resource truth (docs/OBSERVABILITY.md "Host resources &
+        # the run ledger"): inside the facade so telemetry-off serving
+        # constructs no sampler; rate-limited internally, so the
+        # observer loop, /metrics scrapes and router polls share one
+        # cached /proc read
+        from ..training.hoststats import ProcessSampler
+
+        self.hoststats = ProcessSampler(clock=clock)
         # the SLO histograms carry cumulative Prometheus bucket tables
         # (telemetry.py LATENCY_BUCKETS — shared repo-wide so replica
         # series sum exactly at the router/scraper) on top of the
@@ -402,6 +410,10 @@ class ServingTelemetry:
                 "request_latency_p95": win["p95"],
                 "request_latency_p99": win["p99"],
             }
+        # host truth rides every snapshot: the server's JSON /metrics,
+        # the observer tick (recorder ring + process.* alert rules) and
+        # the router's replica polls all read this one key
+        snap["process"] = self.hoststats.sample()
         return snap
 
 

@@ -345,6 +345,9 @@ class Fleet:
             "router": self.tel.snapshot(),
             "replicas": [h.describe() for h in self.supervisor.handles()],
             "scrape_failures": self.router.scrape_failure_stats(),
+            # router-process host truth: what the process.* alert rules
+            # (rss-growth, fd-leak) and the flight ring read
+            "process": self.tel.hoststats.sample(),
         }
         if self.recorder is not None:
             self.recorder.record(snap)

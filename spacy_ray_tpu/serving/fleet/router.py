@@ -214,6 +214,12 @@ class RouterTelemetry:
 
         self.registry = MetricsRegistry(clock=clock)
         self.trace = TraceBuffer(clock=clock, max_events=trace_max_events)
+        # host-resource truth for the router PROCESS itself (replicas
+        # report their own via their snapshots); facade-owned so the
+        # telemetry-off fleet constructs no sampler
+        from ...training.hoststats import ProcessSampler
+
+        self.hoststats = ProcessSampler(clock=clock)
         self._latency = self.registry.histogram(
             "router_latency_seconds", 2048, buckets=LATENCY_BUCKETS
         )
@@ -835,6 +841,9 @@ class Router:
         out["scrape_failures"] = self.scrape_failure_stats()
         if self.tel is not None:
             out["router"] = self.tel.snapshot()
+            # the router process's own host truth (each replica's rides
+            # inside its snapshot under fleet/replica entries)
+            out["process"] = self.tel.hoststats.sample()
         if self.alerts is not None:
             out["alerts"] = self.alerts.summary()
         cache_stats = self.cache_stats()
@@ -906,6 +915,13 @@ class Router:
                 # avoids a duplicate unlabeled series in the same family
                 (tel_snap.get("counters") or {}).pop("cache_hits", None)
             fam.add_snapshot(tel_snap, prefix="srt_router")
+            from ...training.hoststats import add_process_family
+
+            # the ROUTER's own srt_process_* family, unlabeled; the
+            # replicas' families live on their own scrape endpoints
+            # (labeling them into this body would double-count RSS in
+            # any sum() a scraper writes)
+            add_process_family(fam, self.tel.hoststats.sample())
         for rid, n in self.scrape_failure_stats().items():
             fam.add(
                 "srt_router_replica_scrape_failures_total", "counter", n,

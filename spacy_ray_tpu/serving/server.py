@@ -249,6 +249,12 @@ class _Handler(BaseHTTPRequestHandler):
 
             fam = PromFamilies()
             fam.add_snapshot(snap, prefix="srt_serving")
+            # add_snapshot only walks counters/gauges/histograms — the
+            # snapshot's "process" block becomes the shared (unprefixed)
+            # srt_process_* family here, same names on every surface
+            from ..training.hoststats import add_process_family
+
+            add_process_family(fam, snap.get("process"))
             # live-serving identity as explicit gauges (counters span
             # generations, so the generation is NOT a label on them —
             # it is its own series)

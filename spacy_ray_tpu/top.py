@@ -6,8 +6,9 @@ payload the autoscaler and canary guard consume) and renders one screen
 per refresh: request rate (derived from counter deltas between polls,
 the scraper's rate() in miniature), sliding-window p50/p99, batch
 occupancy, queue depth, serving generation + swap count, typed rejects,
-scrape failures, and — for a trainer endpoint — step rate, words/s and
-the anomaly count.
+scrape failures, the host-resource columns every endpoint now carries
+(cpu% / rss / open fds, from the ``process`` block), and — for a
+trainer endpoint — step rate, words/s and the anomaly count.
 
 Design for testability (the dashboard must not need a fleet to be
 verified): the clock, the fetch function, and the output stream are all
@@ -62,6 +63,40 @@ def _fmt_rate(v: Optional[float]) -> str:
 
 def _fmt_int(v: Any) -> str:
     return f"{int(v):,}" if isinstance(v, (int, float)) else "-"
+
+
+def _fmt_pct(v: Any) -> str:
+    return f"{float(v):.0f}%" if isinstance(v, (int, float)) else "-"
+
+
+def _fmt_bytes(v: Any) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    if v >= 1 << 30:
+        return f"{v / (1 << 30):.2f}GB"
+    return f"{v / (1 << 20):.0f}MB"
+
+
+def _process_cols(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """The host-resource columns every row kind shares, from the
+    payload's top-level ``process`` block (hoststats.ProcessSampler on
+    each surface). Absent block = absent columns, honest dashes."""
+    proc = payload.get("process")
+    if not isinstance(proc, dict):
+        return {"cpu_pct": None, "rss": None, "fds": None}
+    return {
+        "cpu_pct": proc.get("cpu_percent"),
+        "rss": proc.get("rss_bytes"),
+        "fds": proc.get("open_fds"),
+    }
+
+
+def _fmt_host(row: Dict[str, Any]) -> str:
+    return (
+        f"cpu {_fmt_pct(row.get('cpu_pct'))}  "
+        f"rss {_fmt_bytes(row.get('rss'))}  "
+        f"fd {_fmt_int(row.get('fds'))}"
+    )
 
 
 class TopModel:
@@ -165,6 +200,7 @@ class TopModel:
                     if isinstance(cache, dict) else None
                 ),
                 "alerts": payload.get("alerts"),
+                **_process_cols(payload),
             }
         if kind == "trainer":
             counters = dict(payload.get("counters") or {})
@@ -238,6 +274,7 @@ class TopModel:
                 "staleness_max": _get(hists, "staleness", "max"),
                 "wire_push_bps": wire_push_bps,
                 "wire_ratio": wire_ratio,
+                **_process_cols(payload),
             }
         counters = payload.get("counters") or {}
         rates = self._rates(url, counters, now)
@@ -258,6 +295,7 @@ class TopModel:
             ) if rates else None,
             "exemplars": counters.get("slow_exemplars"),
             "alerts": payload.get("alerts"),
+            **_process_cols(payload),
         }
 
 
@@ -314,6 +352,7 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 f"rej {_fmt_rate(row.get('reject_s'))}  "
                 f"cache {cache_s}  "
                 f"scrape-fail {_fmt_int(row.get('scrape_failures'))}  "
+                f"{_fmt_host(row)}  "
                 f"alerts {_fmt_alerts(row.get('alerts'))}"
             )
         elif kind == "trainer":
@@ -357,6 +396,7 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
             lines.append(
                 f"    anomalies {_fmt_int(row.get('anomalies'))}  "
                 f"compiles {_fmt_int(row.get('compiles'))}  "
+                f"{_fmt_host(row)}  "
                 f"alerts {_fmt_alerts(row.get('alerts'))}"
             )
         else:
@@ -373,6 +413,7 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 f"occ {_fmt_int(row.get('occupancy'))}  "
                 f"rej {_fmt_rate(row.get('reject_s'))}  "
                 f"slow-exemplars {_fmt_int(row.get('exemplars'))}  "
+                f"{_fmt_host(row)}  "
                 f"alerts {_fmt_alerts(row.get('alerts'))}"
             )
     return "\n".join(lines) + "\n"

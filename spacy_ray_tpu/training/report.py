@@ -468,6 +468,60 @@ def build_run_report(
             "",
         ]
 
+    # -- host resources (hoststats eval-row blocks) ---------------------
+    host_rows = []
+    for w in ids:
+        procs = [
+            r.get("process")
+            for r in workers[w].get("rows") or []
+            if isinstance(r.get("process"), dict)
+        ]
+        if procs:
+            host_rows.append((w, procs))
+    if host_rows:
+        def _hb(v: Any) -> str:
+            if not isinstance(v, (int, float)):
+                return "-"
+            return (
+                f"{v / (1 << 30):.2f}GB" if v >= 1 << 30
+                else f"{v / (1 << 20):.0f}MB"
+            )
+
+        lines += [
+            "## Host resources",
+            "",
+            "Per-worker `/proc` truth sampled at eval boundaries "
+            "(training/hoststats; docs/OBSERVABILITY.md \"Host resources "
+            "& the run ledger\"). High involuntary ctx switches with low "
+            "cpu% = the host is contended, not the model slow.",
+            "",
+            "| worker | cpu% last | cpu% max | rss | rss peak | threads "
+            "| fds | ctx vol | ctx invol |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+        for w, procs in host_rows:
+            last = procs[-1]
+            cpus = [
+                float(p["cpu_percent"]) for p in procs
+                if isinstance(p.get("cpu_percent"), (int, float))
+            ]
+            peaks = [
+                float(p["rss_peak_bytes"]) for p in procs
+                if isinstance(p.get("rss_peak_bytes"), (int, float))
+            ]
+            lines.append(
+                f"| {w} "
+                f"| {f'{cpus[-1]:.0f}' if cpus else '-'} "
+                f"| {f'{max(cpus):.0f}' if cpus else '-'} "
+                f"| {_hb(last.get('rss_bytes'))} "
+                f"| {_hb(max(peaks) if peaks else None)} "
+                f"| {last.get('threads') if last.get('threads') is not None else '-'} "
+                f"| {last.get('open_fds') if last.get('open_fds') is not None else '-'} "
+                f"| {last.get('ctx_switches_voluntary') if last.get('ctx_switches_voluntary') is not None else '-'} "
+                f"| {last.get('ctx_switches_involuntary') if last.get('ctx_switches_involuntary') is not None else '-'} |"
+            )
+        lines.append("")
+
     # -- alert & anomaly timeline --------------------------------------
     alert_events: List[Tuple[float, str]] = []
     for w in ids:

@@ -1358,6 +1358,14 @@ class Telemetry:
         self.trace_steps = (int(trace_steps[0]), int(trace_steps[1]))
         self.registry = MetricsRegistry(clock=clock)
         self.trace = TraceBuffer(clock=clock, pid=int(process_index))
+        # host-resource truth (docs/OBSERVABILITY.md "Host resources &
+        # the run ledger"): lives INSIDE the facade so the disabled
+        # path constructs no sampler and reads no /proc (zero-telemetry
+        # contract). Internally rate-limited — the alert ticker and
+        # every /metrics scrape share one cached sample, no new thread.
+        from .hoststats import ProcessSampler
+
+        self.hoststats = ProcessSampler(clock=clock)
         self.detectors: Optional[AnomalyDetectors] = None
         if anomaly_detection:
             self.detectors = AnomalyDetectors(
@@ -1517,6 +1525,10 @@ class Telemetry:
             return
         self._last_alert_eval = now
         snap = self.registry.snapshot()
+        # host truth rides the same cadence: the leak/fd rules read
+        # dotted paths under "process", and the flight-recorder ring
+        # keeps RSS history for postmortems
+        snap["process"] = self.hoststats.sample()
         if self.recorder is not None:
             self.recorder.record(snap)
         if self.alerts is not None:
@@ -1725,6 +1737,9 @@ class Telemetry:
         }
         if input_pipeline is not None:
             row["input_pipeline"] = input_pipeline
+        # host truth in the run record: the report's host-resource
+        # section and the run ledger's run-dir ingest both read this
+        row["process"] = self.hoststats.sample()
         self._append_row(row)
         self._flush_rows()
         self.maybe_evaluate_alerts(force=True)

@@ -63,17 +63,27 @@ def metrics_reply(
     family — the fleet's per-worker series) or as JSON (``json_extra``
     merged in), alert summary/series appended when an engine exists."""
     alerts = getattr(tel, "alerts", None)
+    # host-resource truth: the facade owns the sampler (disabled
+    # telemetry = no facade = no /proc reads); srt_process_* is a
+    # shared gauge family, NOT a prefixed snapshot key, so the same
+    # names line up across trainer/peer/replica/router scrapes
+    sampler = getattr(tel, "hoststats", None)
     if fmt == "prometheus":
+        from .hoststats import add_process_family
         from .prometheus import EXPOSITION_CONTENT_TYPE, PromFamilies
 
         fam = PromFamilies()
         fam.add_snapshot(
             tel.registry.snapshot(), prefix=prefix, labels=labels
         )
+        if sampler is not None:
+            add_process_family(fam, sampler.sample(), labels=labels)
         if alerts is not None:
             alerts.add_prometheus(fam)
         return fam.render().encode("utf8"), EXPOSITION_CONTENT_TYPE
     snap = tel.registry.snapshot()
+    if sampler is not None:
+        snap["process"] = sampler.sample()
     if json_extra:
         snap.update(json_extra)
     if alerts is not None:

@@ -22,6 +22,7 @@ from spacy_ray_tpu.alerting import (
     default_router_rules,
     default_serving_rules,
     default_training_rules,
+    process_rules,
 )
 
 
@@ -589,6 +590,115 @@ def test_default_rule_sets_construct():
         default_training_rules(),
     ):
         AlertEngine(rules)
+
+
+def test_default_rule_sets_carry_process_rules():
+    # PR 18: every role set watches its own process for rss/fd leaks
+    for rules in (
+        default_serving_rules(),
+        default_router_rules(),
+        default_training_rules(),
+    ):
+        names = {r.name for r in rules}
+        assert {"process-rss-growth", "process-fd-leak"} <= names
+
+
+# ----------------------------------------------------------------------
+# Process leak rules (PR 18): rss growth + fd leak lifecycles
+# ----------------------------------------------------------------------
+
+MB = 1024 * 1024
+
+
+def _proc_snap(rss_mb, fds=10):
+    return {"process": {"rss_bytes": rss_mb * MB, "open_fds": fds}}
+
+
+def _proc_state(eng, name):
+    return next(r for r in eng.states() if r["alert"] == name)
+
+
+def test_process_rss_growth_fires_on_monotone_leak():
+    clock = FakeClock()
+    eng = AlertEngine(process_rules(), clock=clock)
+    # a steady process spanning the 600s window: net growth 0, quiet
+    rss = 500
+    for _ in range(11):
+        clock.advance(60.0)
+        eng.evaluate(_proc_snap(rss))
+    assert _proc_state(eng, "process-rss-growth")["state"] == "inactive"
+    # a monotone leak: +50MB/min accumulates past 256MB inside 600s
+    for _ in range(6):
+        clock.advance(60.0)
+        rss += 50
+        eng.evaluate(_proc_snap(rss))
+    st = _proc_state(eng, "process-rss-growth")
+    assert st["state"] == "firing" and st["severity"] == "ticket"
+    # the leak stops (plateau): the window slides past it and resolves
+    for _ in range(11):
+        clock.advance(60.0)
+        eng.evaluate(_proc_snap(rss))
+    assert _proc_state(eng, "process-rss-growth")["state"] == "inactive"
+
+
+def test_process_rss_sawtooth_allocator_stays_quiet():
+    # an allocator that borrows and RETURNS memory (batch buffers):
+    # net-delta clamping keeps the windowed growth under the bound
+    clock = FakeClock()
+    eng = AlertEngine(process_rules(), clock=clock)
+    for i in range(30):
+        clock.advance(60.0)
+        eng.evaluate(_proc_snap(500 + (100 if i % 2 else 0)))
+        assert _proc_state(eng, "process-rss-growth")["state"] == "inactive"
+
+
+def test_process_rss_short_lived_process_is_no_signal():
+    # younger than the window: no partial fallback, no false ticket on
+    # a CLI run that legitimately allocates its working set at boot
+    clock = FakeClock()
+    eng = AlertEngine(process_rules(), clock=clock)
+    eng.evaluate(_proc_snap(100))
+    clock.advance(30.0)
+    eng.evaluate(_proc_snap(500))  # +400MB, but only 30s of history
+    assert _proc_state(eng, "process-rss-growth")["state"] == "inactive"
+
+
+def test_process_fd_leak_arms_only_after_healthy_baseline():
+    clock = FakeClock()
+    eng = AlertEngine(process_rules(), clock=clock)
+    # boots already above the limit: that's its normal, never arms
+    for _ in range(10):
+        clock.advance(30.0)
+        eng.evaluate(_proc_snap(100, fds=600))
+    st = _proc_state(eng, "process-fd-leak")
+    assert st["state"] == "inactive" and "not armed" in st["detail"]
+    # seen healthy once (<= limit/2): the rule arms
+    clock.advance(30.0)
+    eng.evaluate(_proc_snap(100, fds=40))
+    # a real leak: above the limit, held past for_s -> ticket
+    clock.advance(30.0)
+    eng.evaluate(_proc_snap(100, fds=700))
+    assert _proc_state(eng, "process-fd-leak")["state"] == "pending"
+    clock.advance(90.0)
+    eng.evaluate(_proc_snap(100, fds=700))
+    st = _proc_state(eng, "process-fd-leak")
+    assert st["state"] == "firing" and st["severity"] == "ticket"
+    # fds come back down: resolved
+    clock.advance(10.0)
+    eng.evaluate(_proc_snap(100, fds=50))
+    assert _proc_state(eng, "process-fd-leak")["state"] == "inactive"
+
+
+def test_process_rules_missing_proc_surface_is_no_signal():
+    # a hostile /proc (or a platform without one): both rules no-signal
+    clock = FakeClock()
+    eng = AlertEngine(process_rules(), clock=clock)
+    for _ in range(25):
+        clock.advance(60.0)
+        eng.evaluate({"process": {"rss_bytes": None, "open_fds": None}})
+    for name in ("process-rss-growth", "process-fd-leak"):
+        st = _proc_state(eng, name)
+        assert st["state"] == "inactive"
 
 
 # ----------------------------------------------------------------------

@@ -781,6 +781,11 @@ def test_fleet_disabled_telemetry_makes_zero_calls(monkeypatch):
 
     monkeypatch.setattr(telemetry_mod.MetricsRegistry, "__init__", _boom)
     monkeypatch.setattr(telemetry_mod.TraceBuffer, "__init__", _boom)
+    # PR 18: the router's host sampler lives inside RouterTelemetry —
+    # telemetry off means zero /proc reads on the fleet edge too
+    from spacy_ray_tpu.training import hoststats as hoststats_mod
+
+    monkeypatch.setattr(hoststats_mod.ProcessSampler, "__init__", _boom)
     stub = StubReplica(snapshot=_snap(10, 0.3, 4))
     handle = make_handle(0, stub)
     router = Router(lambda: [handle], telemetry=None)

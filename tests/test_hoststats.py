@@ -1,0 +1,293 @@
+"""Host-resource truth (training/hoststats.py): sampler math over a
+fake ``/proc`` fixture, cgroup v1/v2 quota parsing, effective-core
+accounting, the contention probe's two verdict paths, and the
+missing-file degrade-to-no-signal rule every field carries."""
+
+import pytest
+
+from spacy_ray_tpu.training.hoststats import (
+    PROCESS_GAUGE_FIELDS,
+    ProcessSampler,
+    add_process_family,
+    contention_probe,
+    effective_cores,
+    host_block,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _write_proc(
+    root,
+    *,
+    utime=200,
+    stime=100,
+    threads=7,
+    rss_kb=2048,
+    hwm_kb=4096,
+    vol=11,
+    invol=3,
+    read_bytes=1000,
+    write_bytes=2000,
+    n_fds=5,
+):
+    """A fake /proc/self with every file the sampler reads. The comm
+    field deliberately contains spaces AND a paren — the classic stat
+    parsing trap."""
+    rest = ["S"] + ["0"] * 10 + [str(utime), str(stime)]
+    rest += ["0"] * 4 + [str(threads)] + ["0"] * 3
+    (root / "stat").write_text(
+        f"1234 (test (weird) proc) {' '.join(rest)}\n", encoding="ascii"
+    )
+    (root / "status").write_text(
+        f"Name:\ttest\nVmRSS:\t{rss_kb} kB\nVmHWM:\t{hwm_kb} kB\n"
+        f"Threads:\t{threads}\n"
+        f"voluntary_ctxt_switches:\t{vol}\n"
+        f"nonvoluntary_ctxt_switches:\t{invol}\n",
+        encoding="ascii",
+    )
+    (root / "io").write_text(
+        f"rchar: 99\nwchar: 99\nread_bytes: {read_bytes}\n"
+        f"write_bytes: {write_bytes}\n",
+        encoding="ascii",
+    )
+    fd_dir = root / "fd"
+    fd_dir.mkdir(exist_ok=True)
+    for old in fd_dir.iterdir():
+        old.unlink()
+    for i in range(n_fds):
+        (fd_dir / str(i)).write_text("", encoding="ascii")
+
+
+# ----------------------------------------------------------------------
+# ProcessSampler
+# ----------------------------------------------------------------------
+
+
+def test_sampler_reads_fake_proc(tmp_path):
+    _write_proc(tmp_path)
+    clock = FakeClock()
+    s = ProcessSampler(proc_root=str(tmp_path), clock=clock, clk_tck=100.0)
+    out = s.sample(force=True)
+    assert out["cpu_seconds_total"] == pytest.approx(3.0)  # (200+100)/100
+    assert out["threads"] == 7
+    assert out["rss_bytes"] == 2048 * 1024
+    assert out["rss_peak_bytes"] == 4096 * 1024
+    assert out["ctx_switches_voluntary"] == 11
+    assert out["ctx_switches_involuntary"] == 3
+    assert out["io_read_bytes"] == 1000
+    assert out["io_write_bytes"] == 2000
+    assert out["open_fds"] == 5
+    # unadvanced fake clock: zero wall time since the construction
+    # prime — cpu_percent is honestly absent, never a division blowup
+    assert out["cpu_percent"] is None
+    assert set(PROCESS_GAUGE_FIELDS) <= set(out)
+
+
+def test_sampler_cpu_percent_delta(tmp_path):
+    _write_proc(tmp_path, utime=200, stime=100)
+    clock = FakeClock()
+    s = ProcessSampler(proc_root=str(tmp_path), clock=clock, clk_tck=100.0)
+    # +500 ticks = +5 cpu-seconds over 10 wall-seconds = 50%
+    _write_proc(tmp_path, utime=600, stime=200)
+    clock.advance(10.0)
+    out = s.sample(force=True)
+    assert out["cpu_percent"] == pytest.approx(50.0)
+    # a clock that never goes backwards in cpu keeps the delta >= 0
+    _write_proc(tmp_path, utime=100, stime=100)  # counter "reset"
+    clock.advance(10.0)
+    out = s.sample(force=True)
+    assert out["cpu_percent"] == 0.0
+
+
+def test_sampler_rate_limit_caches(tmp_path):
+    _write_proc(tmp_path, rss_kb=1000)
+    clock = FakeClock()
+    s = ProcessSampler(
+        proc_root=str(tmp_path), clock=clock, min_interval_s=1.0
+    )
+    first = s.sample(force=True)
+    _write_proc(tmp_path, rss_kb=9999)
+    # inside the interval: the cached sample comes back, no /proc read
+    assert s.sample() is first
+    clock.advance(1.5)
+    assert s.sample()["rss_bytes"] == 9999 * 1024
+
+
+def test_sampler_missing_files_degrade_to_none(tmp_path):
+    # an EMPTY fake root: every field independently no-signal
+    s = ProcessSampler(proc_root=str(tmp_path), clock=FakeClock())
+    out = s.sample(force=True)
+    for key in PROCESS_GAUGE_FIELDS:
+        assert out[key] is None, key
+
+
+def test_sampler_partial_proc(tmp_path):
+    # status present, stat/io absent: status fields real, rest None
+    _write_proc(tmp_path)
+    (tmp_path / "stat").unlink()
+    (tmp_path / "io").unlink()
+    s = ProcessSampler(proc_root=str(tmp_path), clock=FakeClock())
+    out = s.sample(force=True)
+    assert out["rss_bytes"] == 2048 * 1024
+    assert out["cpu_seconds_total"] is None
+    assert out["threads"] is None
+    assert out["io_read_bytes"] is None
+
+
+def test_add_process_family_skips_none(tmp_path):
+    from spacy_ray_tpu.training.prometheus import PromFamilies
+
+    _write_proc(tmp_path)
+    s = ProcessSampler(proc_root=str(tmp_path), clock=FakeClock())
+    fam = PromFamilies()
+    add_process_family(fam, s.sample(force=True), labels={"worker": 0})
+    text = fam.render()
+    assert 'srt_process_rss_bytes{worker="0"} 2097152' in text
+    assert "# TYPE srt_process_rss_bytes gauge" in text
+    # cpu_percent was None (unadvanced clock) -> family entirely absent
+    assert "srt_process_cpu_percent" not in text
+    # a None/empty sample renders nothing at all
+    fam2 = PromFamilies()
+    add_process_family(fam2, None)
+    assert "srt_process" not in fam2.render()
+
+
+# ----------------------------------------------------------------------
+# cgroup quota + effective cores
+# ----------------------------------------------------------------------
+
+
+def test_effective_cores_cgroup_v2(tmp_path):
+    (tmp_path / "cpu.max").write_text("50000 100000\n", encoding="ascii")
+    out = effective_cores(
+        cgroup_root=str(tmp_path), cpu_count=64, affinity=64
+    )
+    assert out["cgroup_quota"] == pytest.approx(0.5)
+    assert out["cgroup_version"] == "v2"
+    # floor(0.5) clamps to the 1-core minimum, provenance names the quota
+    assert out["cores"] == 1
+    assert out["source"] == "cgroup_quota"
+
+
+def test_effective_cores_cgroup_v2_unlimited(tmp_path):
+    (tmp_path / "cpu.max").write_text("max 100000\n", encoding="ascii")
+    out = effective_cores(
+        cgroup_root=str(tmp_path), cpu_count=8, affinity=4
+    )
+    assert out["cgroup_quota"] is None
+    assert out["cores"] == 4
+    assert out["source"] == "affinity"
+
+
+def test_effective_cores_cgroup_v1(tmp_path):
+    (tmp_path / "cpu.cfs_quota_us").write_text("200000\n", encoding="ascii")
+    (tmp_path / "cpu.cfs_period_us").write_text("100000\n", encoding="ascii")
+    out = effective_cores(
+        cgroup_root=str(tmp_path), cpu_count=64, affinity=64
+    )
+    assert out["cgroup_quota"] == pytest.approx(2.0)
+    assert out["cgroup_version"] == "v1"
+    assert out["cores"] == 2
+
+
+def test_effective_cores_v1_unlimited_quota(tmp_path):
+    (tmp_path / "cpu.cfs_quota_us").write_text("-1\n", encoding="ascii")
+    (tmp_path / "cpu.cfs_period_us").write_text("100000\n", encoding="ascii")
+    out = effective_cores(
+        cgroup_root=str(tmp_path), cpu_count=6, affinity=6
+    )
+    assert out["cgroup_quota"] is None
+    assert out["cores"] == 6
+
+
+def test_effective_cores_no_cgroup(tmp_path):
+    out = effective_cores(
+        cgroup_root=str(tmp_path / "nope"), cpu_count=12, affinity=3
+    )
+    assert out["cores"] == 3
+    assert out["cgroup_version"] is None
+
+
+# ----------------------------------------------------------------------
+# contention probe
+# ----------------------------------------------------------------------
+
+
+def _scripted(values):
+    """A callable replaying ``values`` then repeating the last one."""
+    it = iter(values)
+    last = [values[-1]]
+
+    def fn():
+        try:
+            v = next(it)
+            last[0] = v
+            return v
+        except StopIteration:
+            return last[0]
+
+    return fn
+
+
+def test_contention_probe_core_arithmetic():
+    cores = {"cores": 1, "source": "cgroup_quota"}
+    out = contention_probe(2, cores=cores)
+    assert out["contended"] is True
+    assert "cores 1 < needed 2" in out["reason"]
+    assert "cgroup_quota" in out["reason"]
+    assert out["spin_efficiency"] is None  # short-circuited, no spin
+
+
+def test_contention_probe_spin_verdicts():
+    cores = {"cores": 4, "source": "affinity"}
+    # clock: t0=0, loop sees 1 (>= spin_s) and exits, wall=1;
+    # cpu_time advances only 0.2 -> efficiency 0.2 -> contended
+    out = contention_probe(
+        1, cores=cores, spin_s=1.0,
+        clock=_scripted([0.0, 1.0, 1.0]),
+        cpu_time=_scripted([0.0, 0.2]),
+    )
+    assert out["contended"] is True
+    assert out["spin_efficiency"] == pytest.approx(0.2)
+    assert "spin efficiency" in out["reason"]
+    # a clean host: cpu keeps pace with wall -> not contended
+    out = contention_probe(
+        1, cores=cores, spin_s=1.0,
+        clock=_scripted([0.0, 1.0, 1.0]),
+        cpu_time=_scripted([0.0, 0.97]),
+    )
+    assert out["contended"] is False
+    assert out["reason"] is None
+    assert out["spin_efficiency"] == pytest.approx(0.97)
+
+
+def test_host_block_shape(tmp_path):
+    proc = tmp_path / "proc"
+    proc.mkdir()
+    _write_proc(proc)
+    cg = tmp_path / "cg"
+    cg.mkdir()
+    (cg / "cpu.max").write_text("400000 100000\n", encoding="ascii")
+    sampler = ProcessSampler(proc_root=str(proc), clock=FakeClock())
+    block = host_block(
+        cores_needed=8, sampler=sampler, cgroup_root=str(cg)
+    )
+    # cores folded with the quota, verdict + provenance + rss all there
+    assert block["cgroup_quota"] == pytest.approx(4.0)
+    assert block["contended"] is True
+    assert "needed 8" in block["contention_reason"]
+    assert block["rss_peak_bytes"] == 4096 * 1024
+    assert block["rss_bytes"] == 2048 * 1024
+    # without cores_needed: accounting only, no verdict claimed
+    block = host_block(sampler=sampler, cgroup_root=str(cg))
+    assert "contended" not in block

@@ -743,6 +743,51 @@ def test_top_model_serving_and_trainer_rows():
     assert "anomalies 2" in screen
 
 
+def test_top_process_columns_all_row_kinds():
+    """PR 18: every row kind carries cpu%/rss/fd columns read from the
+    payload's top-level ``process`` block, rendered with honest dashes
+    when a surface does not export one."""
+    from spacy_ray_tpu.top import TopModel, render
+
+    proc = {"cpu_percent": 37.2, "rss_bytes": 512 * 1024 * 1024,
+            "open_fds": 23}
+    serving = {
+        "counters": {"requests": 10},
+        "gauges": {"queue_depth": 0},
+        "histograms": {},
+        "slo_window": {"request_latency_p99": 0.020},
+        "generation": 1,
+        "swap_count": 0,
+        "process": proc,
+    }
+    trainer = {
+        "counters": {"steps": 40, "words": 80_000},
+        "gauges": {},
+        "histograms": {},
+        "process": proc,
+    }
+    router = dict(_router_payload(100))
+    router["process"] = {"cpu_percent": 3.0,
+                         "rss_bytes": 3 * (1 << 30), "open_fds": 99}
+    model = TopModel()
+    srow = model.update("s", serving, now=0.0)
+    trow = model.update("t", trainer, now=0.0)
+    rrow = model.update("r", router, now=0.0)
+    for row in (srow, trow):
+        assert row["cpu_pct"] == pytest.approx(37.2)
+        assert row["rss"] == 512 * 1024 * 1024
+        assert row["fds"] == 23
+    assert rrow["rss"] == 3 * (1 << 30)
+    screen = render([srow, trow, rrow])
+    assert screen.count("cpu 37%  rss 512MB  fd 23") == 2
+    assert "cpu 3%  rss 3.00GB  fd 99" in screen
+    # a surface without the block: dashes, not zeros
+    bare = model.update("s2", {k: v for k, v in serving.items()
+                               if k != "process"}, now=0.0)
+    assert bare["cpu_pct"] is None and bare["rss"] is None
+    assert "cpu -  rss -  fd -" in render([bare])
+
+
 def test_run_top_injected_loop():
     from spacy_ray_tpu.top import run_top
     import io

@@ -1,0 +1,348 @@
+"""Run ledger & regression sentry (training/runledger.py): ingest from
+the committed session file, torn-line tolerance, the comparability key,
+the diff refusal matrix, and the regress verdicts — nonzero only on a
+confirmed clean-vs-clean regression beyond the noise band."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from spacy_ray_tpu.training import runledger as rl
+
+COMMITTED_SESSION = Path(__file__).resolve().parent.parent / "BENCH_SESSION.jsonl"
+
+
+def _rec(**over):
+    """A clean cnn_tagger-style session record; override per test."""
+    rec = {
+        "name": "cnn_tagger",
+        "metric": "train_words_per_sec_per_chip (CNN tok2vec tagger)",
+        "value": 2600.0,
+        "unit": "words/s/chip",
+        "platform": "cpu",
+        "devices": 1,
+        "B": 256,
+        "T": 64,
+        "n_reps": 3,
+        "wps_reps": [2574.0, 2600.0, 2626.0],
+        "wps_min": 2574.0,
+        "wps_max": 2626.0,
+        "peak_reprobe_ratio": 0.97,
+        "contended": False,
+        "recorded_at": "2026-08-01T00:00:00Z",
+    }
+    rec.update(over)
+    return rec
+
+
+def _write_session(path, records):
+    path.write_text(
+        "".join(json.dumps(r) + "\n" for r in records), encoding="utf8"
+    )
+    return path
+
+
+# ----------------------------------------------------------------------
+# normalization + ingestion
+# ----------------------------------------------------------------------
+
+
+def test_normalize_skips_stubs_and_valueless():
+    assert rl.normalize_record({"skipped": True, "name": "x"}) is None
+    assert rl.normalize_record({"name": "x", "value": "fast"}) is None
+    assert rl.normalize_record({"value": 1.0}) is None
+    row = rl.normalize_record(_rec(), source="s:1")
+    assert row["name"] == "cnn_tagger"
+    assert row["value"] == 2600.0
+    assert row["shape"] == {"B": 256, "T": 64, "devices": 1}
+    assert row["source"] == "s:1"
+
+
+def test_normalize_drops_default_off_labels():
+    # a knob at its OFF default is the same arm as pre-knob history:
+    # records older than the knob omit the field entirely, and the
+    # bench-gate smoke must still find its baseline among them
+    old = rl.normalize_record(_rec())
+    new = rl.normalize_record(
+        _rec(fused_update="off (optax chain)", param_shadow="off",
+             flash="off", grad_compression="f32", param_delta_window=0)
+    )
+    assert new["labels"] == {}
+    assert rl.row_key(new) == rl.row_key(old)
+    # the ON settings still make a distinct arm
+    on = rl.normalize_record(_rec(fused_update="active (xla)"))
+    assert rl.row_key(on) != rl.row_key(old)
+
+
+def test_normalize_strips_label_parentheticals():
+    # "active (pallas)" and "active (reference)" are the same arm — the
+    # parenthetical is host-probe detail, not config
+    a = rl.normalize_record(_rec(flash="active (pallas)"))
+    b = rl.normalize_record(_rec(flash="active (reference)"))
+    assert a["labels"]["flash"] == "active"
+    assert rl.row_key(a) == rl.row_key(b)
+
+
+def test_ingest_committed_session():
+    rows, skipped = rl.ingest_session(COMMITTED_SESSION)
+    assert len(rows) > 100
+    by_key = {}
+    for r in rows:
+        by_key.setdefault(rl.row_key(r), []).append(r)
+    assert len(by_key) > 10
+    # every row carries the fields the sentry needs
+    for r in rows:
+        assert r["name"] and isinstance(r["value"], float)
+
+
+def test_ingest_torn_lines(tmp_path):
+    sess = tmp_path / "s.jsonl"
+    sess.write_text(
+        json.dumps(_rec()) + "\n"
+        + "{'not json\n"                      # foreign garbage
+        + json.dumps(_rec(value=2500.0)) + "\n"
+        + json.dumps(_rec())[: 40] + "\n",    # torn mid-append
+        encoding="utf8",
+    )
+    rows, skipped = rl.ingest_session(sess)
+    assert [r["value"] for r in rows] == [2600.0, 2500.0]
+    assert skipped == 2
+
+
+def test_ingest_missing_file_raises(tmp_path):
+    with pytest.raises(rl.LedgerError):
+        rl.ingest_session(tmp_path / "absent.jsonl")
+
+
+# ----------------------------------------------------------------------
+# keys + trust arithmetic
+# ----------------------------------------------------------------------
+
+
+def test_row_key_separates_arms():
+    base = rl.normalize_record(_rec())
+    other_codec = rl.normalize_record(_rec(grad_compression="int8"))
+    other_shape = rl.normalize_record(_rec(B=512))
+    other_platform = rl.normalize_record(_rec(platform="tpu"))
+    twin = rl.normalize_record(_rec(value=1234.0))
+    assert rl.row_key(base) == rl.row_key(twin)
+    assert rl.row_key(base) != rl.row_key(other_codec)
+    assert rl.row_key(base) != rl.row_key(other_shape)
+    assert rl.row_key(base) != rl.row_key(other_platform)
+
+
+def test_is_clean_and_noise_band():
+    clean = rl.normalize_record(_rec())
+    assert rl.is_clean(clean)
+    assert not rl.is_clean(rl.normalize_record(_rec(contended=True)))
+    assert not rl.is_clean(
+        rl.normalize_record(_rec(peak_reprobe_ratio=0.90))
+    )
+    # unstamped (no reprobe machinery on that spec) counts as clean
+    assert rl.is_clean(rl.normalize_record(_rec(peak_reprobe_ratio=None)))
+    # dispersion: (2626-2574)/2600 = 2%
+    assert rl.dispersion(clean) == pytest.approx(0.02)
+    # band = max(floor 5%, both disps 2%, both slacks 3%) = floor
+    assert rl.noise_band(clean, clean) == pytest.approx(rl.NOISE_FLOOR)
+    # a depressed-reprobe record widens the band to its slack
+    dirty = rl.normalize_record(_rec(peak_reprobe_ratio=0.88))
+    assert rl.noise_band(clean, dirty) == pytest.approx(0.12)
+
+
+# ----------------------------------------------------------------------
+# diff: the refusal matrix
+# ----------------------------------------------------------------------
+
+
+def test_diff_refuses_cross_platform():
+    a = rl.normalize_record(_rec(platform="cpu"))
+    b = rl.normalize_record(_rec(platform="tpu"))
+    with pytest.raises(rl.LedgerError, match="cross-platform"):
+        rl.diff_rows(a, b)
+
+
+def test_diff_warns_on_key_mismatch_and_contended_arm():
+    a = rl.normalize_record(_rec())
+    b = rl.normalize_record(
+        _rec(grad_compression="int8", contended=True, value=2000.0)
+    )
+    d = rl.diff_rows(a, b)
+    text = " ".join(d["warnings"])
+    assert "keys differ" in text
+    assert "CONTENDED" in text
+
+
+def test_diff_verdict_directions():
+    a = rl.normalize_record(_rec())
+    # higher-is-better (words/s): a 20% DROP regresses, a 20% gain improves
+    drop = rl.diff_rows(a, rl.normalize_record(_rec(value=2080.0)))
+    assert drop["verdict"] == "regressed"
+    assert drop["delta_pct"] == pytest.approx(-20.0)
+    gain = rl.diff_rows(a, rl.normalize_record(_rec(value=3120.0)))
+    assert gain["verdict"] == "improved"
+    noise = rl.diff_rows(a, rl.normalize_record(_rec(value=2522.0)))
+    assert noise["verdict"] == "within-noise"
+    # lower-is-better (seconds): a 20% RISE regresses
+    s_a = rl.normalize_record(
+        _rec(unit="seconds/update", value=0.5, wps_reps=None,
+             wps_min=None, wps_max=None)
+    )
+    s_b = rl.normalize_record(
+        _rec(unit="seconds/update", value=0.6, wps_reps=None,
+             wps_min=None, wps_max=None)
+    )
+    assert rl.diff_rows(s_a, s_b)["verdict"] == "regressed"
+    assert rl.diff_rows(s_b, s_a)["verdict"] == "improved"
+
+
+def test_latest_clean_baseline_skips_dirty_tail():
+    rows = [
+        rl.normalize_record(_rec(value=2600.0)),
+        rl.normalize_record(_rec(value=2550.0)),
+        rl.normalize_record(_rec(value=1900.0, contended=True)),
+    ]
+    base = rl.latest_clean_baseline(rows, rl.row_key(rows[0]))
+    assert base["value"] == 2550.0
+
+
+# ----------------------------------------------------------------------
+# regress: the sentry verdicts
+# ----------------------------------------------------------------------
+
+
+def test_regress_verdict_matrix():
+    history = [rl.normalize_record(_rec(value=2600.0))]
+    fresh_reg = rl.normalize_record(_rec(value=2080.0))       # -20%, clean
+    fresh_ok = rl.normalize_record(_rec(value=2522.0))        # -3%, noise
+    fresh_dirty = rl.normalize_record(
+        _rec(value=2080.0, contended=True, peak_reprobe_ratio=0.85)
+    )
+    fresh_new = rl.normalize_record(_rec(name="brand_new_spec"))
+    fresh_up = rl.normalize_record(_rec(value=3200.0))
+    verdicts = rl.regress(
+        [fresh_reg, fresh_ok, fresh_dirty, fresh_new, fresh_up], history
+    )
+    assert [v["verdict"] for v in verdicts] == [
+        "regression", "ok", "untrusted", "no-baseline", "improved"
+    ]
+    reg = verdicts[0]
+    assert reg["baseline_value"] == 2600.0
+    assert reg["delta_pct"] == pytest.approx(-20.0)
+    # only the regression verdict counts toward the CLI's exit 1
+    assert sum(1 for v in verdicts if v["verdict"] == "regression") == 1
+
+
+def test_regress_contended_fresh_never_confirms():
+    # even a 50% cliff is unconfirmable from a contended record
+    history = [rl.normalize_record(_rec(value=2600.0))]
+    fresh = rl.normalize_record(_rec(value=1300.0, contended=True))
+    (v,) = rl.regress([fresh], history)
+    assert v["verdict"] == "untrusted"
+    assert "contended" in v["reason"]
+
+
+# ----------------------------------------------------------------------
+# CLI: exit codes are the contract make bench-gate consumes
+# ----------------------------------------------------------------------
+
+
+def _cli(argv):
+    from spacy_ray_tpu.cli import telemetry_command
+
+    return telemetry_command(["ledger", *argv])
+
+
+def test_cli_regress_exit_codes(tmp_path, capsys):
+    sess = _write_session(
+        tmp_path / "session.jsonl",
+        [_rec(value=2580.0, recorded_at="2026-07-01T00:00:00Z"),
+         _rec(value=2600.0)],
+    )
+    # injected 20% regression on a clean fresh record -> exit 1
+    fresh_reg = _write_session(
+        tmp_path / "fresh_reg.jsonl", [_rec(value=2080.0)]
+    )
+    out_json = tmp_path / "verdict.json"
+    rc = _cli([
+        "regress", "--session", str(sess), "--record", str(fresh_reg),
+        "--json-out", str(out_json),
+    ])
+    assert rc == 1
+    assert "[REGRESSION]" in capsys.readouterr().out
+    payload = json.loads(out_json.read_text(encoding="utf8"))
+    assert payload["verdicts"][0]["verdict"] == "regression"
+    # reprobe-level noise (~3%) -> exit 0
+    fresh_ok = _write_session(
+        tmp_path / "fresh_ok.jsonl", [_rec(value=2522.0)]
+    )
+    assert _cli([
+        "regress", "--session", str(sess), "--record", str(fresh_ok),
+    ]) == 0
+    # contended fresh with the same cliff -> warn, exit 0
+    fresh_dirty = _write_session(
+        tmp_path / "fresh_dirty.jsonl", [_rec(value=2080.0, contended=True)]
+    )
+    assert _cli([
+        "regress", "--session", str(sess), "--record", str(fresh_dirty),
+    ]) == 0
+    assert "[UNTRUSTED]" in capsys.readouterr().out
+
+
+def test_cli_regress_self_judges_session_tail(tmp_path, capsys):
+    # without --record: each key's newest record judged against its own
+    # predecessors — the post-commit audit mode
+    sess = _write_session(
+        tmp_path / "session.jsonl",
+        [_rec(value=2600.0), _rec(value=2580.0), _rec(value=2000.0)],
+    )
+    assert _cli(["regress", "--session", str(sess)]) == 1
+    sess_ok = _write_session(
+        tmp_path / "ok.jsonl",
+        [_rec(value=2600.0), _rec(value=2580.0)],
+    )
+    assert _cli(["regress", "--session", str(sess_ok)]) == 0
+
+
+def test_cli_diff_refuses_cross_platform(tmp_path, capsys):
+    sess = _write_session(
+        tmp_path / "session.jsonl",
+        [_rec(platform="cpu"), _rec(name="tagger_tpu", platform="tpu")],
+    )
+    rc = _cli(["diff", "cnn_tagger", "tagger_tpu", "--session", str(sess)])
+    assert rc == 2
+    assert "cross-platform" in capsys.readouterr().err
+
+
+def test_cli_diff_and_selectors(tmp_path, capsys):
+    sess = _write_session(
+        tmp_path / "session.jsonl",
+        [_rec(value=2600.0), _rec(value=2650.0)],
+    )
+    rc = _cli([
+        "diff", "cnn_tagger@0", "cnn_tagger@-1", "--session", str(sess)
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "within-noise" in out
+    # a records-file selector takes that file's last row
+    fresh = _write_session(tmp_path / "f.jsonl", [_rec(value=2080.0)])
+    rc = _cli(["diff", "cnn_tagger@-1", str(fresh), "--session", str(sess)])
+    assert rc == 0
+    assert "regressed" in capsys.readouterr().out
+
+
+def test_cli_unknown_selector_and_missing_session(tmp_path, capsys):
+    sess = _write_session(tmp_path / "s.jsonl", [_rec()])
+    assert _cli(["show", "nope", "--session", str(sess)]) == 0  # renders "no rows"
+    assert _cli([
+        "diff", "nope@0", "cnn_tagger", "--session", str(sess)
+    ]) == 2
+    assert _cli(["list", "--session", str(tmp_path / "absent.jsonl")]) == 2
+
+
+def test_cli_list_over_committed_session(capsys):
+    assert _cli(["list", "--session", str(COMMITTED_SESSION)]) == 0
+    out = capsys.readouterr().out
+    assert "run ledger:" in out
+    assert "cnn_tagger" in out

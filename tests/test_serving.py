@@ -553,6 +553,11 @@ def test_disabled_telemetry_makes_zero_calls(serve_nlp, monkeypatch):
 
     monkeypatch.setattr(alerting_mod.AlertEngine, "__init__", _boom)
     monkeypatch.setattr(incidents_mod.FlightRecorder, "__init__", _boom)
+    # PR 18: no facade = no host sampler, no /proc reads, and the
+    # /metrics reply carries no srt_process_* family
+    from spacy_ray_tpu.training import hoststats as hoststats_mod
+
+    monkeypatch.setattr(hoststats_mod.ProcessSampler, "__init__", _boom)
     engine = InferenceEngine(
         serve_nlp, max_batch_docs=4, max_wait_s=0.01, max_doc_len=32
     )

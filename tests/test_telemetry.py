@@ -397,9 +397,13 @@ def test_disabled_telemetry_constructs_nothing(
     # zero incident I/O — any construction raises
     from spacy_ray_tpu import alerting as alerting_mod
     from spacy_ray_tpu import incidents as incidents_mod
+    from spacy_ray_tpu.training import hoststats as hoststats_mod
 
     monkeypatch.setattr(alerting_mod.AlertEngine, "__init__", _boom)
     monkeypatch.setattr(incidents_mod.FlightRecorder, "__init__", _boom)
+    # PR 18: the host sampler lives inside the facade — disabled
+    # telemetry must read /proc exactly never
+    monkeypatch.setattr(hoststats_mod.ProcessSampler, "__init__", _boom)
     cfg = _config(tagger_config_text, data_dir, **{"training.max_steps": 2})
     _, result = train(cfg, n_workers=1, stdout_log=False)
     assert result.final_step == 2

@@ -1013,6 +1013,10 @@ def test_fleet_obs_acceptance_zero_telemetry_guard(
     )
     monkeypatch.setattr(alerting_mod.AlertEngine, "__init__", _boom)
     monkeypatch.setattr(incidents_mod.FlightRecorder, "__init__", _boom)
+    # PR 18: the host sampler obeys the same contract
+    from spacy_ray_tpu.training import hoststats as hoststats_mod
+
+    monkeypatch.setattr(hoststats_mod.ProcessSampler, "__init__", _boom)
     cfg = _config(
         tagger_config_text, data_dir,
         **{"training.max_steps": 3, "training.eval_frequency": 3},
