@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry observability serving fleet live train-fleet train-fleet-obs train-fleet-chaos bench bench-gate baseline profile step-perf serve-perf update-shard dryrun
+.PHONY: test test-fast test-slow resilience telemetry observability serving fleet multi-model live train-fleet train-fleet-obs train-fleet-chaos bench bench-gate baseline profile step-perf serve-perf update-shard dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -46,6 +46,21 @@ serving:
 # crash-recovery and bench-record variants are slow-marked and excluded
 fleet:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -m "not slow"
+
+# multi-tenant multi-model suite (docs/SERVING.md "Multi-model fleet",
+# docs/TUNING.md §23): manifest registry + path/header routing matrix,
+# WFQ weight-ratio convergence + per-class expiry, token-bucket quotas
+# under a fake clock + the typed-429 matrix, residency LRU (pinned
+# default, leader-election cold load, zero post-load compiles),
+# placement hysteresis, per-model cache/merge/top surfaces, the
+# zero-telemetry guard, and the 2-model HTTP end-to-end — then the
+# isolation bench: a saturating quota-metered burst on model alpha must
+# not move model beta's gold-class window p99 past target (zero 5xx;
+# the committed record names per-model p99 / cache hit rate / quota
+# rejects / residency swaps)
+multi-model:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_multimodel.py -q -m "not slow"
+	JAX_PLATFORMS=cpu python bench.py --serving --multi-model
 
 # live continuous-learning suite (docs/SERVING.md "Continuous learning"):
 # Checkpoints reader API + writer-protocol contract, watcher torn-skip,

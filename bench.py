@@ -1691,7 +1691,14 @@ class _ParseSession:
         self._lock = threading.Lock()
         self._idle: List[Any] = []
 
-    def post(self, texts: List[str]) -> Tuple[int, float]:
+    def post(
+        self,
+        texts: List[str],
+        *,
+        path: str = "/v1/parse",
+        extra_headers: Optional[Dict[str, str]] = None,
+        return_error_code: bool = False,
+    ) -> Tuple[int, float]:
         import http.client
 
         body = json.dumps({"texts": texts}).encode("utf8")
@@ -1700,6 +1707,8 @@ class _ParseSession:
             "Content-Type": "application/json",
             self._id_header: request_id,
         }
+        if extra_headers:
+            headers.update(extra_headers)
         t0 = time.perf_counter()
         with self._lock:
             conn = self._idle.pop() if self._idle else None
@@ -1710,9 +1719,9 @@ class _ParseSession:
                     self.host, self.port, timeout=self.timeout_s
                 )
             try:
-                conn.request("POST", "/v1/parse", body, headers)
+                conn.request("POST", path, body, headers)
                 resp = conn.getresponse()
-                resp.read()
+                resp_body = resp.read()
             except (OSError, http.client.HTTPException) as e:
                 conn.close()
                 if not fresh:
@@ -1729,7 +1738,18 @@ class _ParseSession:
             if resp.getheader(self._id_header) != request_id:
                 with self._lock:
                     _ParseSession.echo_failures += 1
-            return resp.status, time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            if not return_error_code:
+                return resp.status, dt
+            # the multi-model spec tallies rejects BY TYPED CODE (a
+            # quota 429 and a queue-full 429 are different stories)
+            code = None
+            if resp.status >= 400:
+                try:
+                    code = json.loads(resp_body).get("error")
+                except (ValueError, AttributeError):
+                    code = None
+            return resp.status, dt, code
 
     def close(self) -> None:
         with self._lock:
@@ -2952,6 +2972,328 @@ def run_serving_zipfian(
     return rec
 
 
+def _drive_open_mm(
+    host: str, port: int, duration_s: float, rate: float,
+    bodies: List[List[str]], path: str, tenant: Optional[str],
+) -> List[Tuple[int, float, Optional[str]]]:
+    """Open-loop stream against one model path with one tenant header;
+    returns [(status, latency_s, typed_error_code), ...]."""
+    import threading
+
+    from spacy_ray_tpu.serving.multimodel import TENANT_HEADER
+
+    interval = 1.0 / rate
+    n_requests = max(int(duration_s * rate), 1)
+    extra = {TENANT_HEADER: tenant} if tenant else None
+    session = _ParseSession(host, port)
+    lock = threading.Lock()
+    shots: List[Tuple[int, float, Optional[str]]] = []
+
+    def one_shot(i: int) -> None:
+        texts = bodies[i % len(bodies)]
+        try:
+            status, dt, code = session.post(
+                texts, path=path, extra_headers=extra,
+                return_error_code=True,
+            )
+        except OSError:
+            status, dt, code = -1, 0.0, None
+        with lock:
+            shots.append((status, dt, code))
+
+    t0 = time.perf_counter()
+    workers: List[Any] = []
+    for i in range(n_requests):
+        target = t0 + i * interval
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        th = threading.Thread(target=one_shot, args=(i,), daemon=True)
+        th.start()
+        workers.append(th)
+    for th in workers:
+        th.join(timeout=35.0)
+    session.close()
+    return shots
+
+
+def _mm_stream_stats(
+    shots: List[Tuple[int, float, Optional[str]]],
+) -> Dict[str, Any]:
+    ok = [dt for st, dt, _ in shots if st == 200]
+    out = _latency_stats(ok)
+    out.update({
+        "requests_ok": len(ok),
+        "rejected_quota": sum(
+            1 for st, _, c in shots if st == 429 and c == "quota_exceeded"
+        ),
+        "rejected_queue_full": sum(
+            1 for st, _, c in shots if st == 429 and c == "queue_full"
+        ),
+        "rejected_other": sum(
+            1 for st, _, c in shots
+            if 400 <= st < 500 and c not in ("quota_exceeded", "queue_full")
+        ),
+        "http_5xx": sum(1 for st, _, _ in shots if st >= 500),
+        "failed": sum(1 for st, _, _ in shots if st < 0),
+    })
+    return out
+
+
+def run_serving_multimodel(
+    platform: str,
+    *,
+    replicas: int = 1,
+    duration_s: float = 8.0,
+    burst_rate: Optional[float] = None,
+    steady_rate: Optional[float] = None,
+    max_batch: int = 16,
+    max_wait_ms: float = 2.0,
+    texts_per_request: int = 2,
+    gold_p99_target_ms: float = 2000.0,
+) -> Dict[str, Any]:
+    """``--serving --multi-model``: the two-model ISOLATION spec through
+    the real fleet (router + replicas, manifest-armed). Model ``alpha``
+    takes a saturating open-loop burst from a quota-metered bulk-class
+    tenant; model ``beta`` takes a steady gold-class stream with a
+    declared window-p99 target. The committed contract: the burst on
+    alpha must NOT push beta's per-model window p99 past the gold
+    target, and the whole run serves zero 5xx — alpha's excess sheds as
+    typed 429s (quota first, queue-full second), never as server
+    errors. The record names per-model window p99, per-model cache hit
+    rate, quota rejects by typed code, and residency swaps (beta is
+    placed via the same POST /admin/models/load the placement policy
+    uses, so the measured run never pays a cold load)."""
+    import tempfile
+    import threading
+
+    from spacy_ray_tpu.serving.fleet import Fleet, FleetConfig
+
+    nlp = _serving_nlp()
+    tmpdir = tempfile.mkdtemp(prefix="srt_mm_bench_")
+    dirs: Dict[str, Path] = {}
+    for name in ("alpha", "beta"):
+        d = Path(tmpdir) / name
+        nlp.to_disk(d)
+        dirs[name] = d
+    del nlp
+
+    base = _committed_session_value(
+        "serving_fleet_open", platform=platform, replicas=replicas,
+        max_batch_docs=max_batch, texts_per_request=texts_per_request,
+    )
+    base, base_source = base or (15.0, "fallback:15rps")
+    burst = float(burst_rate) if burst_rate else 3.0 * base
+    steady = float(steady_rate) if steady_rate else max(base / 3.0, 4.0)
+    # the bursty tenant's quota: half its offered doc rate, so the
+    # bucket sheds a visible share BEFORE the queue even sees it
+    quota_docs = max(burst * texts_per_request / 2.0, 1.0)
+    manifest_path = Path(tmpdir) / "manifest.json"
+    manifest_path.write_text(json.dumps({
+        "default_model": "alpha",
+        "models": {n: {"path": str(d)} for n, d in dirs.items()},
+        "classes": {
+            "gold": {"weight": 4, "p99_target_ms": gold_p99_target_ms},
+            "bulk": {"weight": 1, "p99_target_ms": 30_000},
+        },
+        "tenants": {
+            "goldco": {"class": "gold"},
+            "bursty": {"class": "bulk", "quota_docs_per_s": quota_docs,
+                       "quota_burst": 2 * quota_docs},
+        },
+    }), encoding="utf-8")
+
+    device = "cpu" if platform == "cpu" else platform
+    cpu_cores: Optional[List[str]] = None
+    if device == "cpu":
+        cpu_cores = [str(c) for c in sorted(os.sched_getaffinity(0))]
+    config = FleetConfig(
+        model_path=str(dirs["alpha"]),
+        host="127.0.0.1",
+        port=0,
+        device=device,
+        replicas=replicas,
+        min_replicas=replicas,
+        max_replicas=replicas,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        # a tight queue bounds the worst admitted wait well under the
+        # 30s request timeout: alpha's overload story must be typed
+        # 429s, never deadline 504s
+        queue_size=max(4 * max_batch, 64),
+        timeout_ms=30_000.0,
+        max_doc_len=64,
+        cpu_cores=cpu_cores,
+        autoscale=False,
+        telemetry=True,
+        model_manifest=str(manifest_path),
+        resident_models=2,
+    )
+
+    # the two streams: alpha burst replays DISTINCT bodies (pure queue
+    # pressure, no cache relief); beta replays a small pool, so the
+    # per-model cache ledger shows real hits for the record
+    n_burst = max(int(duration_s * burst), 1)
+    burst_bodies = [_serving_texts(texts_per_request, seed=10_000 + i)
+                    for i in range(n_burst)]
+    steady_pool = [_serving_texts(texts_per_request, seed=20_000 + i)
+                   for i in range(max(int(duration_s * steady) // 2, 2))]
+
+    fleet = Fleet(config)
+    try:
+        t0 = time.perf_counter()
+        host, port = fleet.start()
+        if not fleet.wait_ready(replicas, timeout_s=600.0):
+            ready = len(fleet.router.ready_handles())
+            print(f"# multi-model bench: only {ready}/{replicas} replicas "
+                  "ready — recording a skip", flush=True)
+            _append_session(
+                {"name": "serving_multimodel_isolation", "skipped": True,
+                 "reason": f"{ready}/{replicas} replicas ready in 600s"},
+                platform,
+            )
+            return {}
+        # place beta on every replica through the SAME admin surface the
+        # placement policy drives — the run measures steady state, not
+        # beta's one-time cold load
+        for h in fleet.router.ready_handles():
+            fleet.router.load_model(h.replica_id, "beta", timeout_s=600.0)
+        fleet.router.probe_once()  # learn the new resident sets
+        ready_seconds = time.perf_counter() - t0
+        print(f"# multi-model bench: {replicas} replica(s) ready in "
+              f"{ready_seconds:.1f}s; alpha burst {burst:.1f} req/s "
+              f"(quota {quota_docs:.0f} docs/s), beta steady "
+              f"{steady:.1f} req/s (gold target {gold_p99_target_ms:.0f}ms)",
+              flush=True)
+        streams: Dict[str, List[Tuple[int, float, Optional[str]]]] = {}
+
+        def _run_stream(key, rate, bodies, path, tenant):
+            streams[key] = _drive_open_mm(
+                host, port, duration_s, rate, bodies, path, tenant,
+            )
+
+        threads = [
+            threading.Thread(target=_run_stream, args=(
+                "alpha", burst, burst_bodies,
+                "/v1/models/alpha/parse", "bursty",
+            )),
+            threading.Thread(target=_run_stream, args=(
+                "beta", steady, steady_pool,
+                "/v1/models/beta/parse", "goldco",
+            )),
+        ]
+        wall_t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - wall_t0
+        try:
+            status, metrics = _get_json(host, port, "/metrics")
+        except OSError:
+            status, metrics = 0, {}
+        prom_lines = _prometheus_scrape_lines(host, port)
+        # residency truth straight from the replicas (loads/evictions
+        # live in each replica's /metrics, not in the merged fleet view)
+        residency_swaps = 0
+        for snap in fleet.router.scrape_replica_metrics():
+            res = snap.get("residency") if isinstance(snap, dict) else None
+            if isinstance(res, dict):
+                residency_swaps += int(res.get("residency_swaps") or 0)
+    finally:
+        fleet.request_shutdown()
+        fleet.wait()
+
+    fleet_block = (metrics or {}).get("fleet") or {}
+    by_model = fleet_block.get("by_model") or {}
+    cache_by_model = ((metrics or {}).get("cache") or {}).get(
+        "by_model"
+    ) or {}
+    ms = lambda v: round(v * 1e3, 2) if isinstance(v, (int, float)) else None  # noqa: E731
+
+    def _model_block(name: str) -> Dict[str, Any]:
+        sub = by_model.get(name) or {}
+        win = sub.get("slo_window") or {}
+        ledger = cache_by_model.get(name) or {}
+        hits = int(ledger.get("hits") or 0)
+        misses = int(ledger.get("misses") or 0)
+        return {
+            "window_p99_ms": ms(win.get("request_latency_p99")),
+            "window_p50_ms": ms(win.get("request_latency_p50")),
+            "window_samples": win.get("samples"),
+            "requests": (sub.get("counters") or {}).get("requests"),
+            "cache_hits": hits,
+            "cache_misses": misses,
+            "cache_hit_rate": (
+                round(hits / (hits + misses), 4) if hits + misses else None
+            ),
+        }
+
+    alpha = _mm_stream_stats(streams.get("alpha") or [])
+    beta = _mm_stream_stats(streams.get("beta") or [])
+    alpha_model = _model_block("alpha")
+    beta_model = _model_block("beta")
+    http_5xx = alpha["http_5xx"] + beta["http_5xx"]
+    failed = alpha["failed"] + beta["failed"]
+    beta_p99 = beta_model["window_p99_ms"]
+    rec = {
+        "name": "serving_multimodel_isolation",
+        "metric": (
+            f"beta_window_p99_under_alpha_burst (alpha {burst:.0f} req/s "
+            f"burst vs beta {steady:.0f} req/s gold, target "
+            f"{gold_p99_target_ms:.0f}ms, {replicas} replica(s), "
+            "2 resident models, HTTP)"
+        ),
+        "value": beta_p99,
+        "unit": "ms window p99 (beta, replica-side)",
+        "platform": platform,
+        "mode": "open",
+        "replicas": replicas,
+        "resident_models": 2,
+        "duration_s": round(wall, 2),
+        "burst_rps": round(burst, 1),
+        "steady_rps": round(steady, 1),
+        "rate_source": base_source,
+        "quota_docs_per_s": round(quota_docs, 1),
+        "gold_p99_target_ms": gold_p99_target_ms,
+        "texts_per_request": texts_per_request,
+        "max_batch_docs": max_batch,
+        "http_5xx": http_5xx,
+        "failed": failed,
+        "residency_swaps": residency_swaps,
+        "model_alpha": {**alpha_model, "client": alpha},
+        "model_beta": {**beta_model, "client": beta},
+        "quota_rejects": alpha["rejected_quota"] + beta["rejected_quota"],
+        "prometheus_scrape_lines": prom_lines,
+        "ready_seconds": round(ready_seconds, 1),
+        "cpu_cores": cpu_cores,
+    }
+    problems = []
+    if http_5xx or failed:
+        problems.append(f"{http_5xx} 5xx + {failed} transport failures "
+                        "(the record requires zero)")
+    if beta["rejected_quota"] or beta["rejected_queue_full"]:
+        problems.append(
+            f"beta (gold, in-quota) was shed "
+            f"{beta['rejected_quota']}+{beta['rejected_queue_full']} times"
+        )
+    if beta_p99 is None:
+        problems.append("no beta window p99 in the fleet by_model view")
+    elif beta_p99 > gold_p99_target_ms:
+        problems.append(
+            f"beta window p99 {beta_p99:.0f}ms breached the gold target "
+            f"{gold_p99_target_ms:.0f}ms under alpha's burst"
+        )
+    if problems:
+        rec["skipped"] = True
+        rec["reason"] = "isolation contract violated: " + "; ".join(problems)
+        print(f"# multi-model bench: {rec['reason']}; recording a skip",
+              flush=True)
+    print(json.dumps(rec), flush=True)
+    _append_session(rec, platform)
+    return rec
+
+
 def _accelerator_reachable(timeout: float = 180.0) -> bool:
     """Probe the default (accelerator) backend in a THROWAWAY subprocess.
 
@@ -3656,6 +3998,21 @@ def main() -> None:
         "space",
     )
     parser.add_argument(
+        "--multi-model", action="store_true",
+        help="--serving: run the two-model isolation spec instead — a "
+        "manifest-armed fleet hosting models alpha+beta, a saturating "
+        "quota-metered burst on alpha and a steady gold-class stream on "
+        "beta; the record commits beta's per-model window p99 against "
+        "its class target (plus per-model cache hit rate, typed quota "
+        "rejects, residency swaps) and requires zero 5xx; lands in "
+        "BENCH_SESSION.jsonl",
+    )
+    parser.add_argument(
+        "--mm-gold-target-ms", type=float, default=2000.0,
+        help="--serving --multi-model: the gold class's declared window "
+        "p99 target (the isolation contract bound)",
+    )
+    parser.add_argument(
         "--swap", action="store_true",
         help="--serving: run the live hot-swap spec instead — open-loop "
         "load at the committed offered rate while forcing --swap-count "
@@ -3791,6 +4148,17 @@ def main() -> None:
                 duration_s=max(float(args.serving_duration), 4.0),
                 swaps=int(args.swap_count),
                 open_rate=float(args.serving_rate) or None,
+            )
+        elif args.multi_model:
+            counts = [
+                int(c) for c in args.replicas.split(",") if c.strip()
+            ] or [1]
+            run_serving_multimodel(
+                jax.default_backend(),
+                replicas=counts[0],
+                duration_s=max(float(args.serving_duration), 6.0),
+                burst_rate=float(args.serving_rate) or None,
+                gold_p99_target_ms=float(args.mm_gold_target_ms),
             )
         elif args.zipfian:
             counts = [

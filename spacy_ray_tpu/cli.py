@@ -2074,6 +2074,19 @@ def serve_command(argv: List[str]) -> int:
     parser.add_argument("--no-warmup", action="store_true",
                         help="skip the bucket compile sweep (first requests "
                         "then pay compiles — testing only)")
+    parser.add_argument("--model-manifest", type=Path, default=None,
+                        help="multi-model serving (docs/SERVING.md "
+                        "'Multi-model fleet'): a JSON manifest of model "
+                        "name -> pipeline dir (plus SLO classes and tenant "
+                        "quotas). Requests route by /v1/models/<name>/parse "
+                        "or the X-SRT-Model header; /v1/parse keeps serving "
+                        "the manifest's default model. The positional "
+                        "model_path is ignored — the manifest's default "
+                        "model path is authoritative")
+    parser.add_argument("--resident-models", type=int, default=2,
+                        help="multi-model only: how many warmed engines "
+                        "this replica keeps resident at once (LRU eviction "
+                        "past this; the default model is pinned)")
     parser.add_argument("--no-telemetry", action="store_true",
                         help="disable the SLO metrics/trace surface "
                         "entirely (zero telemetry calls; /metrics reports "
@@ -2116,21 +2129,75 @@ def serve_command(argv: List[str]) -> int:
     from .serving.engine import InferenceEngine, ServingTelemetry
     from .serving.server import Server
 
-    nlp = Pipeline.from_disk(args.model_path)
-    tel = None if args.no_telemetry else ServingTelemetry()
-    engine = InferenceEngine(
-        nlp,
-        max_batch_docs=args.max_batch,
-        max_wait_s=max(args.max_wait_ms, 0.0) / 1e3,
-        max_queue_docs=args.queue_size,
-        timeout_s=max(args.timeout_ms, 1.0) / 1e3,
-        max_doc_len=args.max_doc_len,
-        batching=args.batching,
-        precision=args.precision,
-        telemetry=tel,
+    # multi-model serving: registry + admission from the manifest; the
+    # residency manager owns every engine beyond the pinned default
+    registry = None
+    residency = None
+    admission = None
+    if args.model_manifest is not None:
+        from .serving.multimodel import (
+            AdmissionController,
+            ModelRegistry,
+            ResidencyManager,
+        )
+
+        registry = ModelRegistry.from_manifest(args.model_manifest)
+        admission = AdmissionController(registry)
+
+    class_weights = (
+        registry.class_weights() if registry is not None else None
     )
+
+    def _build_engine(path: Path, mtel) -> "InferenceEngine":
+        return InferenceEngine(
+            Pipeline.from_disk(path),
+            max_batch_docs=args.max_batch,
+            max_wait_s=max(args.max_wait_ms, 0.0) / 1e3,
+            max_queue_docs=args.queue_size,
+            timeout_s=max(args.timeout_ms, 1.0) / 1e3,
+            max_doc_len=args.max_doc_len,
+            batching=args.batching,
+            precision=args.precision,
+            telemetry=mtel,
+            class_weights=class_weights,
+        )
+
+    default_path = args.model_path
+    if registry is not None:
+        default_path = Path(registry.spec(registry.default_model).path)
+    tel = None if args.no_telemetry else ServingTelemetry()
+    engine = _build_engine(default_path, tel)
+    if registry is not None:
+
+        def _engine_factory(spec) -> "InferenceEngine":
+            # each resident model gets its OWN telemetry (per-model
+            # /metrics blocks) and its own warmed bucket programs —
+            # loads happen on a request thread, never the dispatch one
+            mtel = None if args.no_telemetry else ServingTelemetry()
+            e = _build_engine(Path(spec.path), mtel)
+            if not args.no_warmup:
+                e.warmup()
+            e.start()
+            return e
+
+        residency = ResidencyManager(
+            registry,
+            _engine_factory,
+            capacity=max(args.resident_models, 1),
+            evict_drain_s=min(args.drain_timeout_s, 10.0),
+            pinned={registry.default_model},
+        )
+        # the default engine is adopted, not factory-loaded: the server
+        # lifecycle warms and starts it (listener-first banner intact)
+        residency.adopt(registry.default_model, engine)
     print(f"serving batching={engine.batching} "
-          f"precision={engine.overlay.label}", flush=True)
+          f"precision={engine.overlay.label}"
+          + (
+              f" models={','.join(registry.names())} "
+              f"default={registry.default_model}"
+              if registry is not None else ""
+          ),
+          flush=True)
     watcher = None
     if args.watch is not None:
         from .serving.live import CheckpointWatcher
@@ -2181,6 +2248,7 @@ def serve_command(argv: List[str]) -> int:
         watcher=watcher, swap_dirs=[str(d) for d in args.swap_dirs],
         alerts=alerts, recorder=recorder,
         observe_interval_s=args.observe_interval_s,
+        registry=registry, residency=residency, admission=admission,
     )
     # listener-first: the banner (and thus the bound port) appears before
     # the warmup sweep, so a fleet supervisor can probe /healthz — which
@@ -2286,6 +2354,19 @@ def serve_fleet_command(argv: List[str]) -> int:
                         help="replica serving precision overlay (None = "
                         "the serve default, auto — bf16 on accelerators, "
                         "f32 on CPU)")
+    parser.add_argument("--model-manifest", type=Path, default=None,
+                        help="multi-model fleet (docs/SERVING.md "
+                        "'Multi-model fleet'): every replica serves the "
+                        "models in this JSON manifest (name -> pipeline "
+                        "dir, SLO classes, tenant quotas); the router "
+                        "resolves /v1/models/<name>/parse and X-SRT-Model "
+                        "and routes within the replicas hosting the model. "
+                        "The positional model_path is ignored by replicas "
+                        "— the manifest is authoritative")
+    parser.add_argument("--resident-models", type=int, default=None,
+                        help="multi-model only: per-replica warmed-engine "
+                        "hot-set size (LRU eviction past it; the default "
+                        "model is pinned)")
     # router knobs
     parser.add_argument("--cache-mb", type=float, default=32.0,
                         help="router response cache budget in MB, keyed by "
@@ -2404,6 +2485,11 @@ def serve_fleet_command(argv: List[str]) -> int:
         max_doc_len=args.max_doc_len,
         batching=args.batching,
         precision=args.precision,
+        model_manifest=(
+            str(args.model_manifest)
+            if args.model_manifest is not None else None
+        ),
+        resident_models=args.resident_models,
         base_port=args.base_port,
         visible_devices=(
             [m.strip() for m in args.visible_devices.split(",") if m.strip()]

@@ -58,6 +58,8 @@ __all__ = [
     "DeadlineExceeded",
     "RequestTooLarge",
     "SwapFailed",
+    "QuotaExceeded",
+    "UnknownModel",
     "ServeRequest",
     "DynamicBatcher",
     "REQUEST_ID_HEADER",
@@ -156,6 +158,28 @@ class SwapFailed(ServingError):
     code = "swap_failed"
 
 
+class QuotaExceeded(ServingError):
+    """A tenant's token bucket is empty: the request exceeds the quota
+    the manifest grants that tenant, independent of queue occupancy —
+    a distinct 429 from QueueFull so a client can tell "the server is
+    saturated" (back off briefly) from "YOU are over quota" (back off
+    until the bucket refills). Shedding here, before the queue, is what
+    keeps one tenant's burst from starving another's SLO class."""
+
+    http_status = 429
+    code = "quota_exceeded"
+
+
+class UnknownModel(ServingError):
+    """The request named a model the registry does not know (bad path
+    segment or ``X-SRT-Model`` header) — a typed 404, not a routing
+    fallback: silently serving the default model under the wrong name
+    would poison the per-model cache and per-model SLO accounting."""
+
+    http_status = 404
+    code = "unknown_model"
+
+
 class ServeRequest:
     """One admitted request: a list of tokenized docs plus completion
     plumbing. The HTTP handler thread blocks on ``wait``; the dispatch
@@ -165,7 +189,7 @@ class ServeRequest:
     __slots__ = (
         "docs", "deadline", "enqueued_at", "started_at", "dispatched_at",
         "_done", "error", "batch_info", "request_id", "latency_s",
-        "device_s",
+        "device_s", "klass",
     )
 
     def __init__(
@@ -174,10 +198,14 @@ class ServeRequest:
         deadline: float,
         enqueued_at: float,
         request_id: Optional[str] = None,
+        klass: str = "default",
     ):
         self.docs = docs
         self.deadline = float(deadline)
         self.enqueued_at = float(enqueued_at)
+        # SLO class (weighted-fair admission): which per-class queue this
+        # request rides in a class-aware batcher; plain batchers ignore it
+        self.klass = str(klass)
         # trace identity: minted at the edge (router or server) or
         # client-supplied; every span/exemplar/response header for this
         # request carries it
@@ -219,7 +247,16 @@ class DynamicBatcher:
     carry several docs, and occupancy accounting is in docs because that
     is what fills a padded device batch). ``mode`` picks the admission
     discipline — ``"window"`` size-or-deadline coalescing or
-    ``"continuous"`` slot-based immediate admission (module docstring)."""
+    ``"continuous"`` slot-based immediate admission (module docstring).
+
+    ``class_weights`` (multi-tenant serving, docs/SERVING.md
+    "Multi-model fleet") opts the batcher into weighted fair queuing:
+    one queue per SLO class, drained by deficit round robin so that
+    under saturation each class's share of dispatched DOCS converges to
+    its weight — a burst from one class fills its own queue, never the
+    others'. ``None`` (the default) keeps the original single FIFO
+    queue, bit-identical: the legacy single-tenant path never touches
+    the per-class machinery."""
 
     MODES = ("window", "continuous")
 
@@ -231,6 +268,7 @@ class DynamicBatcher:
         max_wait_s: float = 0.005,
         mode: str = "window",
         clock: Callable[[], float] = time.monotonic,
+        class_weights: Optional[Dict[str, float]] = None,
     ) -> None:
         if max_batch_docs < 1:
             raise ValueError("max_batch_docs must be >= 1")
@@ -258,6 +296,54 @@ class DynamicBatcher:
         self.rejected_full = 0
         self.rejected_draining = 0
         self.expired = 0
+        # -- weighted fair queuing (None = legacy single FIFO) ----------
+        self.class_weights: Optional[Dict[str, float]] = None
+        if class_weights is not None:
+            if not class_weights:
+                raise ValueError("class_weights must not be empty")
+            for k, w in class_weights.items():
+                if not (float(w) > 0):
+                    raise ValueError(
+                        f"class weight must be > 0, got {k}={w!r}"
+                    )
+            self.class_weights = {k: float(w) for k, w in class_weights.items()}
+            self._cqueues: Dict[str, Deque[ServeRequest]] = {
+                k: deque() for k in self.class_weights
+            }
+            self._corder: List[str] = list(self.class_weights)
+            self._deficit: Dict[str, float] = {k: 0.0 for k in self._corder}
+            self._rr_idx = 0
+            self._turn_open = False
+            self._recompute_quantum()
+        # per-class served-docs ledger (WFQ fairness is observable, not
+        # asserted): stays empty on the legacy path
+        self.served_docs_by_class: Dict[str, int] = {}
+
+    def _recompute_quantum(self) -> None:
+        # one turn's grant must afford the largest admissible request
+        # even for the lightest class, or a heavy head-of-line request
+        # could starve behind a deficit that never catches up
+        assert self.class_weights is not None
+        self._quantum = self.max_batch_docs / min(self.class_weights.values())
+
+    def _class_queue(self, klass: str) -> Deque[ServeRequest]:
+        """The queue for ``klass``, auto-registering unknown classes at
+        weight 1.0 (a tenant misconfigured into a class the batcher was
+        not built with still gets service, never a KeyError)."""
+        assert self.class_weights is not None
+        q = self._cqueues.get(klass)
+        if q is None:
+            self.class_weights[klass] = 1.0
+            q = self._cqueues[klass] = deque()
+            self._corder.append(klass)
+            self._deficit[klass] = 0.0
+            self._recompute_quantum()
+        return q
+
+    def _has_queued(self) -> bool:
+        if self.class_weights is None:
+            return bool(self._queue)
+        return any(self._cqueues.values())
 
     # -- producer side (HTTP handler threads) --------------------------
     def submit(self, request: ServeRequest) -> None:
@@ -277,7 +363,10 @@ class DynamicBatcher:
                     f"queue holds {self._queued_docs} docs "
                     f"(limit {self.max_queue_docs})"
                 )
-            self._queue.append(request)
+            if self.class_weights is None:
+                self._queue.append(request)
+            else:
+                self._class_queue(request.klass).append(request)
             self._queued_docs += n
             self._nonempty.notify()
 
@@ -291,6 +380,9 @@ class DynamicBatcher:
         completing already-expired ones with DeadlineExceeded (never
         spending device time on a response nobody is waiting for).
         Caller holds the lock."""
+        if self.class_weights is not None:
+            self._pop_ready_wfq(batch, now)
+            return
         have = sum(len(r.docs) for r in batch)
         while self._queue:
             head = self._queue[0]
@@ -314,6 +406,76 @@ class DynamicBatcher:
             batch.append(head)
             have += len(head.docs)
 
+    def _expire_head(self, q: Deque[ServeRequest], now: float) -> None:
+        """Complete already-expired requests at the head of ``q`` with
+        DeadlineExceeded (the per-class twin of the legacy loop's inline
+        expiry). Caller holds the lock."""
+        while q and q[0].deadline <= now:
+            head = q.popleft()
+            self._queued_docs -= len(head.docs)
+            self.expired += 1
+            head.complete(
+                DeadlineExceeded(
+                    f"deadline passed {now - head.deadline:.3f}s before "
+                    f"dispatch (queued {now - head.enqueued_at:.3f}s)"
+                )
+            )
+
+    def _pop_ready_wfq(self, batch: List[ServeRequest], now: float) -> None:
+        """Deficit round robin across the per-class queues: each class's
+        TURN grants it ``weight * quantum`` doc credits; it dispatches
+        whole requests while credits and batch room last, then the turn
+        passes. The round-robin pointer and deficits persist across
+        batch assemblies, so under saturation the dispatched-doc shares
+        converge to the weights even when one batch is too small to show
+        the ratio. An emptied queue forfeits its banked deficit (no
+        credit hoarding while idle — the standard DRR rule).
+        Caller holds the lock."""
+        have = sum(len(r.docs) for r in batch)
+        idle_turns = 0
+        while have < self.max_batch_docs and idle_turns < len(self._corder):
+            k = self._corder[self._rr_idx % len(self._corder)]
+            q = self._cqueues[k]
+            self._expire_head(q, now)
+            if not q:
+                self._deficit[k] = 0.0
+                self._rr_idx += 1
+                self._turn_open = False
+                idle_turns += 1
+                continue
+            if not self._turn_open:
+                self._deficit[k] += self.class_weights[k] * self._quantum
+                self._turn_open = True
+            served = False
+            while q:
+                self._expire_head(q, now)
+                if not q:
+                    break
+                cost = len(q[0].docs)
+                if have + cost > self.max_batch_docs:
+                    # batch room exhausted; the turn stays open so the
+                    # next assembly resumes exactly here
+                    return
+                if cost > self._deficit[k]:
+                    break
+                head = q.popleft()
+                self._queued_docs -= cost
+                head.started_at = now
+                batch.append(head)
+                have += cost
+                self._deficit[k] -= cost
+                self.served_docs_by_class[k] = (
+                    self.served_docs_by_class.get(k, 0) + cost
+                )
+                served = True
+            # queue drained or deficit exhausted: turn over (a drained
+            # queue also forfeits its remaining credits)
+            if not q:
+                self._deficit[k] = 0.0
+            self._rr_idx += 1
+            self._turn_open = False
+            idle_turns = 0 if served else idle_turns + 1
+
     def next_batch(self, poll_s: float = 0.05) -> Optional[List[ServeRequest]]:
         """Block for the next assembled batch. Returns None when the
         batcher is closed AND empty (the dispatch thread's exit signal);
@@ -324,7 +486,7 @@ class DynamicBatcher:
         drain) is never stuck inside a long real-time wait.
         """
         with self._lock:
-            while not self._queue:
+            while not self._has_queued():
                 if self._closed:
                     return None
                 self._nonempty.wait(timeout=poll_s)
@@ -393,9 +555,13 @@ class DynamicBatcher:
         blocked forever). Returns how many were failed."""
         with self._lock:
             n = 0
-            while self._queue:
-                req = self._queue.popleft()
-                self._queued_docs -= len(req.docs)
-                req.complete(error)
-                n += 1
+            queues: List[Deque[ServeRequest]] = [self._queue]
+            if self.class_weights is not None:
+                queues.extend(self._cqueues.values())
+            for q in queues:
+                while q:
+                    req = q.popleft()
+                    self._queued_docs -= len(req.docs)
+                    req.complete(error)
+                    n += 1
             return n

@@ -192,6 +192,7 @@ class ServingTelemetry:
         self._batches = self.registry.counter("batches")
         self._rej_full = self.registry.counter("rejected_queue_full")
         self._rej_drain = self.registry.counter("rejected_draining")
+        self._rej_quota = self.registry.counter("rejected_quota")
         self._deadline = self.registry.counter("deadline_exceeded")
         self._errors = self.registry.counter("errors")
         # hot-swap instruments (serving/live): how often the resident
@@ -240,6 +241,8 @@ class ServingTelemetry:
             self._deadline.inc()
         elif isinstance(error, ServingError) and error.code == "queue_full":
             self._rej_full.inc()
+        elif isinstance(error, ServingError) and error.code == "quota_exceeded":
+            self._rej_quota.inc()
         else:
             self._errors.inc()
         args = {"error": str(error)}
@@ -447,6 +450,7 @@ class InferenceEngine:
         precision: str = SERVING_DEFAULTS["precision"],
         telemetry: Optional[ServingTelemetry] = None,
         clock: Callable[[], float] = time.monotonic,
+        class_weights: Optional[Dict[str, float]] = None,
     ) -> None:
         if nlp.params is None:
             raise ValueError(
@@ -460,12 +464,15 @@ class InferenceEngine:
         self.tel = telemetry
         self.clock = clock
         self.batching = batching
+        # class_weights arms weighted fair queuing across SLO classes
+        # (multi-tenant serving); None keeps the legacy single FIFO
         self.batcher = DynamicBatcher(
             max_queue_docs=max_queue_docs,
             max_batch_docs=max_batch_docs,
             max_wait_s=max_wait_s,
             mode=batching,
             clock=clock,
+            class_weights=class_weights,
         )
         # precision overlay, applied ONCE at construction: every dispatch
         # (warmup sweep included, so warmed programs match live traffic's
@@ -543,15 +550,19 @@ class InferenceEngine:
         texts: Sequence[str],
         timeout_s: Optional[float] = None,
         request_id: Optional[str] = None,
+        klass: str = "default",
     ) -> ServeRequest:
         docs = [self.nlp.tokenizer(t) for t in texts]
-        return self.submit_docs(docs, timeout_s=timeout_s, request_id=request_id)
+        return self.submit_docs(
+            docs, timeout_s=timeout_s, request_id=request_id, klass=klass
+        )
 
     def submit_docs(
         self,
         docs: List[Any],
         timeout_s: Optional[float] = None,
         request_id: Optional[str] = None,
+        klass: str = "default",
     ) -> ServeRequest:
         timeout = self.timeout_s if timeout_s is None else float(timeout_s)
         too_long = [i for i, d in enumerate(docs) if len(d) > self.max_doc_len]
@@ -566,7 +577,7 @@ class InferenceEngine:
         now = self.clock()
         req = ServeRequest(
             docs, deadline=now + timeout, enqueued_at=now,
-            request_id=request_id,
+            request_id=request_id, klass=klass,
         )
         t0 = self.tel.now() if self.tel is not None else None
         try:

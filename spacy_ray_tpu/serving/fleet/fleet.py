@@ -70,6 +70,14 @@ class FleetConfig:
     # admission, precision "auto" — bf16 overlay on accelerators only)
     batching: Optional[str] = None
     precision: Optional[str] = None
+    # multi-model serving (docs/SERVING.md "Multi-model fleet"): a model
+    # manifest turns every replica into a multi-model host (registry +
+    # residency + admission built per replica from the same file) and
+    # teaches the router to resolve/route per model; resident_models
+    # caps each replica's LRU hot set. None = single-model, bit-identical
+    # to before the subsystem existed.
+    model_manifest: Optional[str] = None
+    resident_models: Optional[int] = None
     replica_drain_timeout_s: float = 30.0
     # replica port assignment: 0 = ephemeral (parsed from each banner);
     # nonzero = base_port + slot (fixed layouts for firewalls — slots
@@ -179,6 +187,8 @@ class FleetConfig:
                 self.observe_interval_s if incidents is not None else None
             ),
             no_telemetry=not self.telemetry,
+            model_manifest=self.model_manifest,
+            resident_models=self.resident_models,
             extra_args=self.extra_replica_args,
         )
 
@@ -263,6 +273,16 @@ class Fleet:
             grace_s=config.replica_drain_timeout_s + 15.0,
             on_crash=on_crash,
         )
+        # multi-model: one registry parse in the fleet process (each
+        # replica re-parses the same manifest itself) — the router's
+        # model resolution and the placement policy both read it
+        self.registry = None
+        if config.model_manifest:
+            from ..multimodel import ModelRegistry
+
+            self.registry = ModelRegistry.from_manifest(
+                config.model_manifest
+            )
         self.router = Router(
             self.supervisor.handles,
             telemetry=self.tel,
@@ -273,6 +293,7 @@ class Fleet:
             canary_fraction=(
                 config.canary_fraction if config.watch_dir else 0.0
             ),
+            registry=self.registry,
         )
         self.controller = None
         if config.watch_dir:
@@ -303,6 +324,28 @@ class Fleet:
                 down_consecutive=config.down_consecutive,
                 cooldown_s=config.cooldown_s,
             )
+        # placement-aware extension of the autoscaler: with a manifest
+        # AND autoscaling on, each tick also decides WHICH models need
+        # another host (per-model window p99 vs the tightest class
+        # target), applied via POST /admin/models/load and appended to
+        # the placement ledger (a CI failure artifact)
+        self.placement_policy = None
+        self._placement_ledger: Optional[str] = None
+        if self.registry is not None and config.autoscale:
+            from ..multimodel import PlacementPolicy
+
+            self.placement_policy = PlacementPolicy(
+                self.registry,
+                default_p99_target_ms=config.p99_target_ms,
+                breach_consecutive=config.up_consecutive,
+                cooldown_s=config.cooldown_s,
+            )
+            if config.incidents_dir:
+                from pathlib import Path
+
+                inc = Path(config.incidents_dir)
+                inc.mkdir(parents=True, exist_ok=True)
+                self._placement_ledger = str(inc / "placement.jsonl")
         self.router.alerts = self.alerts
         self.router.recorder = self.recorder
         self.httpd = RouterHTTPServer((config.host, config.port), self.router)
@@ -442,7 +485,70 @@ class Fleet:
                 )
                 self.tel.registry.counter("autoscale_decisions").inc()
             self.supervisor.scale_to(desired)
+        if self.placement_policy is not None:
+            self.placement_tick(snaps)
         return desired
+
+    def placement_tick(self, snaps: Optional[List[Dict[str, Any]]] = None):
+        """Placement half of the scaling loop: per-model window p99 from
+        the merged ``by_model`` view → which models need another host →
+        apply via ``/admin/models/load`` + append to the ledger. Returns
+        the decisions applied (callable directly by tests)."""
+        assert self.placement_policy is not None
+        from ...training.telemetry import merge_serving_snapshots
+
+        if snaps is None:
+            snaps = self.router.scrape_replica_metrics()
+        merged = merge_serving_snapshots(snaps)
+        by_model: Dict[str, Dict[str, Any]] = {}
+        for name, sub in (merged.get("by_model") or {}).items():
+            win = (sub or {}).get("slo_window") or {}
+            by_model[name] = {
+                "p99": win.get("request_latency_p99"),
+                "samples": win.get("samples"),
+            }
+        decisions = self.placement_policy.observe(
+            by_model,
+            self.router.placement(),
+            [h.replica_id for h in self.router.ready_handles()],
+        )
+        for d in decisions:
+            try:
+                status, _ = self.router.load_model(d.replica_id, d.model)
+            except Exception as exc:
+                status = None
+                logger.warning(
+                    "placement: load %r onto replica %d failed: %r",
+                    d.model, d.replica_id, exc,
+                )
+            log_event(
+                "placement-move",
+                f"model {d.model!r} -> replica {d.replica_id} "
+                f"(status {status}): {d.reason}",
+                level=logging.INFO,
+                model=d.model, replica=d.replica_id, status=status,
+            )
+            if self.tel is not None:
+                self.tel.trace.add_instant(
+                    "placement", cat="fleet",
+                    args={"model": d.model, "replica": d.replica_id},
+                )
+                self.tel.registry.counter("placement_decisions").inc()
+            if self._placement_ledger is not None:
+                import json
+
+                try:
+                    with open(self._placement_ledger, "a") as fh:
+                        fh.write(json.dumps({
+                            "unix_time": round(time.time(), 3),
+                            "model": d.model,
+                            "replica_id": d.replica_id,
+                            "status": status,
+                            "reason": d.reason,
+                        }) + "\n")
+                except OSError:
+                    logger.exception("placement ledger append failed")
+        return decisions
 
     # -- shutdown -------------------------------------------------------
     def request_shutdown(self, signum: Optional[int] = None) -> None:

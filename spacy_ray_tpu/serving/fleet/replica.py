@@ -82,6 +82,14 @@ class ReplicaHandle:
         # the live fleet controller key on these
         self.generation: Optional[int] = None
         self.swap_count = 0
+        # router-maintained from /healthz (multi-model serving only):
+        # which models this replica currently hosts — model name →
+        # {"generation", "swap_count", "warmed"} — and its configured
+        # default. The router's pick() routes a named model WITHIN the
+        # replicas hosting it, and the placement policy reads the same
+        # facts; the probe loop keeps both fresh for free.
+        self.resident_models: Dict[str, Dict[str, Any]] = {}
+        self.default_model: Optional[str] = None
         # router-maintained: requests currently forwarded to this replica
         self.outstanding = 0
         self.restarts = 0
@@ -149,6 +157,10 @@ class ReplicaHandle:
             # its generation identity is re-learned from /healthz
             self.generation = None
             self.swap_count = 0
+            # residency is re-learned too: the restarted process hosts
+            # only its pinned default until traffic/placement reloads
+            self.resident_models = {}
+            self.default_model = None
         self.close_conns()
 
     @property
@@ -176,6 +188,8 @@ class ReplicaHandle:
                 "restarts": self.restarts,
                 "generation": self.generation,
                 "swap_count": self.swap_count,
+                "resident_models": sorted(self.resident_models),
+                "default_model": self.default_model,
             }
 
 
@@ -515,6 +529,8 @@ def build_serve_cmd(
     blackbox: Optional[str] = None,
     observe_interval_s: Optional[float] = None,
     no_telemetry: bool = False,
+    model_manifest: Optional[str] = None,
+    resident_models: Optional[int] = None,
     extra_args: Sequence[str] = (),
 ) -> List[str]:
     """The canonical replica argv: one place building the ``serve`` line
@@ -554,6 +570,12 @@ def build_serve_cmd(
         cmd += ["--blackbox", str(blackbox)]
     if observe_interval_s is not None:
         cmd += ["--observe-interval-s", str(float(observe_interval_s))]
+    if model_manifest is not None:
+        # multi-model serving: the replica builds its own registry /
+        # residency / admission stack from the shared manifest
+        cmd += ["--model-manifest", str(model_manifest)]
+    if resident_models is not None:
+        cmd += ["--resident-models", str(int(resident_models))]
     if no_telemetry:
         cmd.append("--no-telemetry")
     cmd += list(extra_args)

@@ -48,9 +48,11 @@ from ..batcher import (
     Draining,
     REQUEST_ID_HEADER,
     ServingError,
+    UnknownModel,
     clean_request_id,
     mint_request_id,
 )
+from ..multimodel.registry import TENANT_HEADER
 from .replica import ReplicaHandle
 
 __all__ = [
@@ -117,20 +119,45 @@ class ResponseCache:
         self.evictions = 0
         self.stale_invalidations = 0
         self.flushes = 0
+        # per-model hit/miss ledger (multi-model serving): the model
+        # name is a key dimension, so two models' identical texts never
+        # collide, and the hit-rate story is attributable per model
+        self.by_model: Dict[str, Dict[str, int]] = {}
 
     @staticmethod
-    def key_for(texts: List[str]) -> bytes:
+    def key_for(texts: List[str], model: str = "") -> bytes:
         h = hashlib.sha256()
+        if model:
+            # model joins the key (distinct models annotate the same
+            # texts differently); \x01 keeps it unambiguous against the
+            # \x00-separated texts. Empty model = the single-model
+            # serving path — its keys are byte-identical to before the
+            # multi-model subsystem existed.
+            h.update(model.encode("utf8", "surrogatepass"))
+            h.update(b"\x01")
         for t in texts:
             h.update(t.encode("utf8", "surrogatepass"))
             h.update(b"\x00")  # unambiguous: ["ab"] != ["a","b"]
         return h.digest()
 
-    def get(self, key: bytes, generation: Any = None) -> Optional[bytes]:
+    def _tally(self, model: Optional[str], field: str) -> None:
+        """Caller holds ``_lock``."""
+        if model is None:
+            return
+        ledger = self.by_model.setdefault(
+            model, {"hits": 0, "misses": 0, "stale_invalidations": 0}
+        )
+        ledger[field] += 1
+
+    def get(
+        self, key: bytes, generation: Any = None,
+        model: Optional[str] = None,
+    ) -> Optional[bytes]:
         with self._lock:
             entry = self._entries.get(key)
             if entry is None:
                 self.misses += 1
+                self._tally(model, "misses")
                 return None
             stored_gen, body = entry
             if stored_gen != generation:
@@ -141,9 +168,12 @@ class ResponseCache:
                 self._nbytes -= len(body)
                 self.stale_invalidations += 1
                 self.misses += 1
+                self._tally(model, "stale_invalidations")
+                self._tally(model, "misses")
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
+            self._tally(model, "hits")
             return body
 
     def put(self, key: bytes, body: bytes, generation: Any = None) -> None:
@@ -176,9 +206,9 @@ class ResponseCache:
                 self.flushes += 1
         return n
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out: Dict[str, Any] = {
                 "cache_hits": self.hits,
                 "cache_misses": self.misses,
                 "cache_evictions": self.evictions,
@@ -187,6 +217,12 @@ class ResponseCache:
                 "cache_entries": len(self._entries),
                 "cache_bytes": self._nbytes,
             }
+            if self.by_model:
+                out["by_model"] = {
+                    m: dict(ledger)
+                    for m, ledger in sorted(self.by_model.items())
+                }
+            return out
 
     def __len__(self) -> int:
         with self._lock:
@@ -228,6 +264,11 @@ class RouterTelemetry:
         self._retries = self.registry.counter("retries")
         self._rej_no_replica = self.registry.counter("rejected_no_replica")
         self._rej_draining = self.registry.counter("rejected_draining")
+        # multi-model routing: requests naming a model the registry does
+        # not know (typed 404 at the edge, never forwarded)
+        self._rej_unknown_model = self.registry.counter(
+            "rejected_unknown_model"
+        )
         self._cache_hits = self.registry.counter("cache_hits")
         self._ready = self.registry.gauge("ready_replicas")
         self._replicas = self.registry.gauge("replicas")
@@ -287,6 +328,8 @@ class RouterTelemetry:
     ) -> None:
         if isinstance(error, Draining):
             self._rej_draining.inc()
+        elif isinstance(error, UnknownModel):
+            self._rej_unknown_model.inc()
         else:
             self._rej_no_replica.inc()
         args = {"error": str(error)}
@@ -338,9 +381,15 @@ class Router:
         probe_timeout_s: float = 5.0,
         forward_timeout_s: float = 60.0,
         canary_fraction: float = 0.0,
+        registry: Optional[Any] = None,
     ) -> None:
         self.replicas = replicas
         self.tel = telemetry
+        # multi-model serving (``--model-manifest``): a ModelRegistry
+        # lets the router resolve WHICH model a request names (path >
+        # header > default) and route within the replicas hosting it;
+        # None keeps the single-model path bit-identical
+        self.registry = registry
         self.cache = ResponseCache(cache_bytes) if cache_bytes > 0 else None
         self.probe_interval_s = float(probe_interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
@@ -427,10 +476,27 @@ class Router:
                 if isinstance(health, dict):
                     gen = health.get("generation")
                     swaps = health.get("swap_count")
+                    resident = health.get("resident_models")
+                    default_model = health.get("default_model")
                     with h.lock:
                         h.generation = gen if isinstance(gen, int) else None
                         if isinstance(swaps, int):
                             h.swap_count = swaps
+                        # residency advertisement (multi-model replicas
+                        # only): the probe loop IS the placement
+                        # discovery protocol — no registration RPC
+                        h.resident_models = (
+                            {
+                                str(m): (info if isinstance(info, dict)
+                                         else {})
+                                for m, info in resident.items()
+                            }
+                            if isinstance(resident, dict) else {}
+                        )
+                        h.default_model = (
+                            default_model
+                            if isinstance(default_model, str) else None
+                        )
                         # short health history: a crash postmortem's
                         # "what did the router last know about it"
                         h.health_history.append(
@@ -497,14 +563,33 @@ class Router:
             h.close_conns()
 
     # -- response cache generation discipline ---------------------------
-    def cache_generation(self) -> Any:
+    def cache_generation(self, model: Optional[str] = None) -> Any:
         """The generation a cache hit must match: the ONE generation
         every ready replica serves (learned from /healthz; None = the
         disk model is itself a valid generation). When ready replicas
         straddle generations — a canary rollout, a mid-promotion window,
         a crash-restarted straggler — returns :data:`GENERATION_MIXED`
         and the caller bypasses the cache: no single stamp could vouch
-        for which replica a forward would hit."""
+        for which replica a forward would hit.
+
+        With ``model`` (multi-model serving), the discipline applies to
+        the replicas HOSTING that model: their per-model generation from
+        the /healthz resident set. A model resident nowhere yet (first
+        request triggers the cold load) also yields the mixed sentinel —
+        nothing can vouch for a body before placement is known."""
+        if model is not None:
+            hosts = [
+                h for h in self.ready_handles()
+                if model in h.resident_models
+            ]
+            if not hosts:
+                return GENERATION_MIXED
+            gens = {
+                h.resident_models[model].get("generation") for h in hosts
+            }
+            if len(gens) == 1:
+                return next(iter(gens))
+            return GENERATION_MIXED
         gens = {h.generation for h in self.ready_handles()}
         if len(gens) == 1:
             return next(iter(gens))
@@ -514,7 +599,7 @@ class Router:
         with self._cache_bypass_lock:
             self.cache_mixed_bypasses += 1
 
-    def cache_stats(self) -> Optional[Dict[str, int]]:
+    def cache_stats(self) -> Optional[Dict[str, Any]]:
         """The cache's own counters plus the router-side mixed-generation
         bypass count — ONE ledger for every surface (JSON /metrics,
         the Prometheus ``srt_router_cache_*`` series, ``telemetry top``,
@@ -551,9 +636,17 @@ class Router:
             if h.ready and not h.stopping and h.address is not None
         ]
 
-    def pick(self) -> ReplicaHandle:
+    def pick(self, model: Optional[str] = None) -> ReplicaHandle:
         """Least-outstanding-requests over the ready set; ties broken by
         lowest id (deterministic, and it keeps warm caches warm).
+
+        With ``model`` (multi-model serving), least-outstanding runs
+        WITHIN the subset of ready replicas whose probe-learned resident
+        set includes that model — a request never pays another model's
+        cold load when a warm host exists. When NO ready replica hosts
+        it yet, the full ready set is the pool: the chosen replica's
+        residency manager cold-loads on arrival, and the next probe
+        teaches the router the new placement.
 
         With ``canary_fraction > 0`` and an ACTIVE rollout
         (``canary_generation`` set by the controller), the ready set
@@ -568,6 +661,10 @@ class Router:
             raise NoReplicaAvailable(
                 "no replica is ready (all warming, draining, or down)"
             )
+        if model is not None:
+            hosting = [h for h in ready if model in h.resident_models]
+            if hosting:
+                ready = hosting
         pool = ready
         target = self.canary_generation
         if self.canary_fraction > 0.0 and target is not None:
@@ -592,6 +689,10 @@ class Router:
         body: bytes,
         timeout_s: Optional[float] = None,
         request_id: Optional[str] = None,
+        *,
+        model: Optional[str] = None,
+        explicit_model: bool = False,
+        tenant: Optional[str] = None,
     ) -> Tuple[int, bytes, Optional[int]]:
         """Route one ``/v1/parse`` body: pick → forward → on socket
         failure mark the replica unready and retry on another. The retry
@@ -600,6 +701,14 @@ class Router:
         Returns ``(status, payload, replica_id)``; ``request_id`` (when
         given) is forwarded in the ``X-SRT-Request-Id`` header so the
         replica's spans and response carry the router's id.
+
+        ``model`` (multi-model serving) narrows ``pick`` to the replicas
+        hosting it; when the client NAMED the model (``explicit_model``,
+        via path or header) the forward goes to the normalized
+        ``/v1/models/<name>/parse`` path, while an implicit default stays
+        on the legacy ``/v1/parse`` wire shape. ``tenant`` is forwarded
+        in ``X-SRT-Tenant`` — quota enforcement lives at the replica's
+        admission edge, the router only carries the identity.
 
         Replica-level HTTP errors (429/504/...) are passed through
         verbatim — they are per-replica admission decisions the client
@@ -612,6 +721,11 @@ class Router:
         """
         if self.draining:
             raise Draining("fleet is draining; not admitting requests")
+        path = (
+            f"/v1/models/{model}/parse"
+            if model is not None and explicit_model else "/v1/parse"
+        )
+        extra_headers = {TENANT_HEADER: tenant} if tenant else None
         with self._inflight_lock:
             self._inflight += 1
         try:
@@ -620,7 +734,7 @@ class Router:
             last_err: Optional[Exception] = None
             while attempts < max_attempts:
                 attempts += 1
-                h = self.pick()  # raises NoReplicaAvailable when empty
+                h = self.pick(model)  # raises NoReplicaAvailable on empty
                 addr = h.address
                 if addr is None:
                     continue
@@ -628,9 +742,10 @@ class Router:
                     h.outstanding += 1
                 try:
                     status, payload = self._post(
-                        h, addr, "/v1/parse", body,
+                        h, addr, path, body,
                         timeout_s or self.forward_timeout_s,
                         request_id=request_id,
+                        extra_headers=extra_headers,
                     )
                     if status == 503 and self._replica_unavailable(payload):
                         # the replica itself says it can't take traffic
@@ -687,6 +802,7 @@ class Router:
     def _post(
         h: ReplicaHandle, addr: Tuple[str, int], path: str, body: bytes,
         timeout_s: float, request_id: Optional[str] = None,
+        extra_headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[int, bytes]:
         """POST over a pooled keep-alive connection to the replica.
 
@@ -702,6 +818,8 @@ class Router:
         headers = {"Content-Type": "application/json"}
         if request_id is not None:
             headers[REQUEST_ID_HEADER] = request_id
+        if extra_headers:
+            headers.update(extra_headers)
         conn = h.checkout_conn()
         while True:
             fresh = conn is None
@@ -726,6 +844,53 @@ class Router:
             else:
                 h.checkin_conn(conn)
             return resp.status, payload
+
+    # -- placement (multi-model) -----------------------------------------
+    def placement(self) -> Dict[int, List[str]]:
+        """Probe-learned placement: replica_id → resident model names
+        (every addressed replica, ready or not — the placement policy
+        filters by its own ready list)."""
+        return {
+            h.replica_id: sorted(h.resident_models)
+            for h in self.replicas()
+        }
+
+    def load_model(
+        self, replica_id: int, model: str, timeout_s: Optional[float] = None
+    ) -> Tuple[int, bytes]:
+        """Apply one placement decision: POST ``/admin/models/load`` to
+        the chosen replica (a fresh connection — admin traffic must not
+        touch the hot-path pool). Raises ``NoReplicaAvailable`` when the
+        replica has no address."""
+        handle = next(
+            (h for h in self.replicas() if h.replica_id == replica_id),
+            None,
+        )
+        addr = handle.address if handle is not None else None
+        if addr is None:
+            raise NoReplicaAvailable(
+                f"replica {replica_id} is not addressable"
+            )
+        body = json.dumps({"model": model}).encode("utf8")
+        conn = http.client.HTTPConnection(
+            addr[0], addr[1],
+            timeout=timeout_s or self.forward_timeout_s,
+        )
+        try:
+            conn.request(
+                "POST", "/admin/models/load", body,
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            payload = resp.read()
+        finally:
+            conn.close()
+        if resp.status == 200 and handle is not None:
+            # teach the router immediately (the next probe would too,
+            # but pick() should see the new host without the probe gap)
+            with handle.lock:
+                handle.resident_models.setdefault(model, {})
+        return resp.status, payload
 
     # -- fleet metrics ----------------------------------------------------
     def scrape_replica_metrics(self) -> List[Dict[str, Any]]:
@@ -839,6 +1004,15 @@ class Router:
         out: Dict[str, Any] = {"fleet": merged}
         out["replicas"] = [h.describe() for h in self.replicas()]
         out["scrape_failures"] = self.scrape_failure_stats()
+        if self.registry is not None:
+            # the placement view the policy (and `telemetry top`) reads:
+            # which replicas host which models, per the last probe pass
+            out["placement"] = {
+                str(rid): models
+                for rid, models in sorted(self.placement().items())
+            }
+            out["models"] = self.registry.names()
+            out["default_model"] = self.registry.default_model
         if self.tel is not None:
             out["router"] = self.tel.snapshot()
             # the router process's own host truth (each replica's rides
@@ -907,6 +1081,33 @@ class Router:
                         sub_win.get("request_latency_p99"),
                         {"generation": gen_key, "quantile": "0.99"},
                     )
+        by_model = merged.get("by_model")
+        if isinstance(by_model, dict):
+            # per-model fleet series (multi-model serving): counters sum
+            # exactly across replicas so the model-labeled snapshot walk
+            # is honest; window percentiles follow the same merge rule
+            # as the fleet-level gauges, labeled per model — the
+            # placement policy's breach signal and the per-class SLO
+            # story both read these
+            for model_name, sub in sorted(by_model.items()):
+                if not isinstance(sub, dict):
+                    continue
+                fam.add_snapshot(
+                    sub, prefix="srt_fleet_model",
+                    labels={"model": model_name},
+                )
+                sub_win = sub.get("slo_window")
+                if isinstance(sub_win, dict):
+                    for q in ("p50", "p95", "p99"):
+                        fam.add(
+                            "srt_fleet_model_request_latency_window_seconds",
+                            "gauge",
+                            sub_win.get(f"request_latency_{q}"),
+                            {
+                                "model": model_name,
+                                "quantile": q.replace("p", "0."),
+                            },
+                        )
         if self.tel is not None:
             tel_snap = self.tel.snapshot()
             if self.cache is not None:
@@ -945,6 +1146,17 @@ class Router:
                 )
             for key in ("cache_entries", "cache_bytes"):
                 fam.add(f"srt_router_{key}", "gauge", cache_stats.get(key))
+            # per-model cache ledger under its own family name — mixing
+            # model-labeled samples into the unlabeled totals above
+            # would double-count any sum() a scraper writes
+            for model_name, ledger in sorted(
+                (cache_stats.get("by_model") or {}).items()
+            ):
+                for key in ("hits", "misses", "stale_invalidations"):
+                    fam.add(
+                        f"srt_router_model_cache_{key}_total", "counter",
+                        ledger.get(key), {"model": model_name},
+                    )
         fam.add("srt_fleet_replicas", "gauge", merged.get("replicas"))
         return fam.render()
 
@@ -1108,7 +1320,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
             )
             return
         body = self.rfile.read(length)  # consume BEFORE any early reply
-        if self.path != "/v1/parse":
+        registry = router.registry
+        if self.path != "/v1/parse" and not (
+            registry is not None and self.path.startswith("/v1/models/")
+        ):
+            # without a registry, /v1/models/... keeps the legacy 404
+            # not_found — the typed unknown_model vocabulary only exists
+            # once multi-model serving is configured
             self._reply(404, {"error": "not_found", "message": self.path})
             return
         # the router MINTS the fleet-wide request id (honoring a valid
@@ -1121,6 +1339,23 @@ class _RouterHandler(BaseHTTPRequestHandler):
         ) or mint_request_id()
         if router.tel is not None:
             router.tel.request()
+        # multi-model resolution at the edge (path > X-SRT-Model header
+        # > manifest default): an unknown or malformed model name is a
+        # typed 404 BEFORE any forward — no replica pays for it
+        model_name: Optional[str] = None
+        explicit_model = False
+        tenant: Optional[str] = None
+        if registry is not None:
+            try:
+                model_name, explicit_model = registry.resolve_model(
+                    self.path, self.headers
+                )
+            except UnknownModel as e:
+                if router.tel is not None:
+                    router.tel.rejected(e, request_id)
+                self._reply_error(e, request_id)
+                return
+            tenant = self.headers.get(TENANT_HEADER)
         if router.draining:
             err = Draining("fleet is draining; not admitting requests")
             if router.tel is not None:
@@ -1137,7 +1372,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
         cache_key: Optional[bytes] = None
         cache_gen: Any = GENERATION_MIXED
         if router.cache is not None:
-            cache_gen = router.cache_generation()
+            # with a model resolved, the generation discipline runs per
+            # model over the replicas hosting it — each model's entries
+            # live under their own (model, generation, texts) key
+            cache_gen = router.cache_generation(model_name)
             # parsing happens on BOTH generation verdicts: the bypass
             # counter must only tally requests the cache would actually
             # have served (a texts-free/malformed body skips the cache
@@ -1161,8 +1399,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     if router.ready_handles():
                         router.count_cache_bypass()
                 else:
-                    cache_key = ResponseCache.key_for(texts)
-                    hit = router.cache.get(cache_key, cache_gen)
+                    cache_key = ResponseCache.key_for(
+                        texts, model=model_name or ""
+                    )
+                    hit = router.cache.get(
+                        cache_key, cache_gen, model=model_name
+                    )
                     if hit is not None:
                         if router.tel is not None:
                             router.tel.cache_hit()
@@ -1172,7 +1414,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
         span_t0 = router.tel.now() if router.tel is not None else None
         try:
             status, payload, replica_id = router.forward_parse(
-                body, request_id=request_id
+                body, request_id=request_id,
+                model=model_name, explicit_model=explicit_model,
+                tenant=tenant,
             )
         except ServingError as e:
             if router.tel is not None:
@@ -1197,14 +1441,24 @@ class _RouterHandler(BaseHTTPRequestHandler):
             # probe drops it, and the promotion flush clears any such
             # residue; it can never serve STALE (pre-promotion)
             # annotations, which is the contract that matters.
-            gen = next(
+            serving = next(
                 (
-                    h.generation
-                    for h in router.replicas()
+                    h for h in router.replicas()
                     if h.replica_id == replica_id
                 ),
-                cache_gen,
+                None,
             )
+            if serving is None:
+                gen = cache_gen
+            elif model_name is not None:
+                # per-model stamp: the serving replica's probe-learned
+                # generation FOR THIS MODEL (its fleet-level generation
+                # may belong to a different resident model's rollout)
+                gen = (
+                    serving.resident_models.get(model_name) or {}
+                ).get("generation")
+            else:
+                gen = serving.generation
             router.cache.put(cache_key, payload, gen)
         self._reply_bytes(status, payload, request_id)
 

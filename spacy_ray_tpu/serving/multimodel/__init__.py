@@ -1,0 +1,38 @@
+"""Multi-tenant, multi-model serving (docs/SERVING.md "Multi-model
+fleet"): model registry + request resolution, per-tenant token-bucket
+quotas + SLO-class weighted fair queuing, replica model residency with
+an LRU hot set, and placement-aware scaling.
+
+Everything here is OPT-IN via a manifest (``--model-manifest``): with
+no manifest configured, none of these objects is constructed and the
+single-model serving path is bit-identical to before this subsystem
+existed.
+"""
+
+from .admission import AdmissionController, TokenBucket
+from .placement import PlacementDecision, PlacementPolicy
+from .registry import (
+    MODEL_HEADER,
+    MODEL_PATH_RE,
+    TENANT_HEADER,
+    ClassSpec,
+    ModelRegistry,
+    ModelSpec,
+    TenantSpec,
+)
+from .residency import ResidencyManager
+
+__all__ = [
+    "MODEL_HEADER",
+    "TENANT_HEADER",
+    "MODEL_PATH_RE",
+    "ClassSpec",
+    "TenantSpec",
+    "ModelSpec",
+    "ModelRegistry",
+    "AdmissionController",
+    "TokenBucket",
+    "ResidencyManager",
+    "PlacementDecision",
+    "PlacementPolicy",
+]

@@ -1,0 +1,133 @@
+"""Tenant admission: token-bucket quotas in front of the class-aware
+batcher (docs/SERVING.md "Multi-model fleet").
+
+Division of labor, stated once:
+
+* **quota** (this module) answers "is THIS TENANT over its declared
+  rate" — a per-tenant token bucket metered in docs/s, shed with the
+  typed ``QuotaExceeded(429)`` BEFORE the request touches the queue, so
+  an over-quota burst costs the fleet nothing but the reject;
+* **fairness** (batcher.DynamicBatcher ``class_weights``) answers "of
+  the admitted work, who dispatches next" — deficit round robin across
+  SLO classes, so even two in-quota tenants cannot starve each other
+  past their class weights.
+
+Buckets are PER PROCESS: each replica meters the traffic it actually
+receives, so a fleet's effective tenant ceiling is quota x replicas
+under perfect balance (docs/TUNING.md §23 covers sizing for that).
+The controller makes ZERO telemetry calls — rejects are counted by the
+serving telemetry at the HTTP layer, exactly like the other typed
+rejects, and with telemetry off nothing is counted anywhere.
+
+The clock is injectable; tests drive refill with a fake clock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional, TYPE_CHECKING
+
+from ..batcher import QuotaExceeded
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .registry import ModelRegistry, TenantSpec
+
+__all__ = ["TokenBucket", "AdmissionController"]
+
+
+class TokenBucket:
+    """The classic meter: ``rate`` tokens/s refill up to ``burst``;
+    ``try_acquire(n)`` atomically spends ``n`` or spends nothing.
+    Refill is computed lazily from elapsed clock time — no timer
+    thread, safe under concurrent handler threads."""
+
+    def __init__(
+        self,
+        rate: float,
+        burst: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if not (rate > 0):
+            raise ValueError(f"rate must be > 0, got {rate!r}")
+        self.rate = float(rate)
+        # default burst = one second of rate: a tenant can always spend
+        # its steady-state second in one instant, nothing more
+        self.burst = float(burst) if burst is not None else float(rate)
+        if not (self.burst > 0):
+            raise ValueError(f"burst must be > 0, got {burst!r}")
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._tokens = self.burst
+        self._last = clock()
+
+    def _refill(self, now: float) -> None:
+        elapsed = now - self._last
+        if elapsed > 0:
+            self._tokens = min(self.burst, self._tokens + elapsed * self.rate)
+        self._last = now
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._lock:
+            self._refill(self.clock())
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def available(self) -> float:
+        with self._lock:
+            self._refill(self.clock())
+            return self._tokens
+
+
+class AdmissionController:
+    """Per-tenant quota enforcement + tenant → class resolution, built
+    from a ``ModelRegistry``. One instance per serving process (replica
+    or single-model server); buckets exist only for tenants that
+    declare a quota — the anonymous tenant and unlimited tenants pay a
+    dict lookup and nothing else."""
+
+    def __init__(
+        self,
+        registry: "ModelRegistry",
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.registry = registry
+        self._buckets: Dict[str, TokenBucket] = {}
+        for name, spec in registry.tenants.items():
+            if spec.quota_docs_per_s is not None:
+                self._buckets[name] = TokenBucket(
+                    spec.quota_docs_per_s,
+                    burst=spec.quota_burst,
+                    clock=clock,
+                )
+        # shed ledger (plain ints, mirrored into telemetry by the HTTP
+        # layer — this module itself makes zero telemetry calls)
+        self.rejected_quota = 0
+        self._lock = threading.Lock()
+
+    def admit(self, tenant: Optional[str], n_docs: int = 1) -> str:
+        """Charge ``n_docs`` against ``tenant``'s bucket and return the
+        SLO class the request rides in. Raises ``QuotaExceeded`` (typed
+        429) when the bucket cannot cover the request; tenants without
+        a quota (including the anonymous default) always admit."""
+        spec = self.registry.tenant(tenant)
+        bucket = self._buckets.get(spec.name) if tenant is not None else None
+        if bucket is not None and not bucket.try_acquire(float(n_docs)):
+            with self._lock:
+                self.rejected_quota += 1
+            raise QuotaExceeded(
+                f"tenant {spec.name!r} is over quota "
+                f"({spec.quota_docs_per_s:g} docs/s, burst "
+                f"{bucket.burst:g}); retry after the bucket refills"
+            )
+        return spec.klass
+
+    def stats(self) -> Dict[str, float]:
+        """JSON-safe snapshot: remaining tokens per metered tenant plus
+        the shed count (the /metrics surface for quota pressure)."""
+        out: Dict[str, float] = {"rejected_quota": float(self.rejected_quota)}
+        for name, bucket in self._buckets.items():
+            out[f"tokens_{name}"] = bucket.available()
+        return out

@@ -1,0 +1,141 @@
+"""Placement-aware scaling: WHICH models replicas host, not just how
+many replicas exist (docs/SERVING.md "Multi-model fleet").
+
+The existing ``AutoscalerPolicy`` sizes the fleet from the merged
+window p99; this extension reads the PER-MODEL window p99 (the
+``by_model`` block the same Prometheus plumbing already merges) plus
+the placement the router's probe loop learned from /healthz resident
+sets, and decides per-model residency moves:
+
+* a model whose window p99 breaches its class target (or the fleet
+  default) on enough consecutive observations gets replicated onto the
+  ready replica with the fewest resident models that does not already
+  host it — spreading the hot model widens its least-outstanding
+  routing subset, which is the fleet-level pressure release;
+* models never breach → no decisions: replicas keep their organic
+  (traffic-driven, LRU) residency.
+
+Decisions are hysteresis-gated exactly like the replica-count policy
+(consecutive breaches + cooldown, injectable clock) so one noisy
+window never shuffles placement. The policy only DECIDES; the fleet
+applies a decision by POSTing ``/admin/models/load`` to the chosen
+replica and appends it to the placement ledger (``placement.jsonl``
+under the incidents dir — the CI failure artifact).
+
+Constructed only when a manifest is configured; makes zero telemetry
+calls itself.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+__all__ = ["PlacementDecision", "PlacementPolicy"]
+
+
+@dataclass(frozen=True)
+class PlacementDecision:
+    """One residency move: load ``model`` onto replica ``replica_id``."""
+
+    model: str
+    replica_id: int
+    reason: str
+
+
+@dataclass
+class _ModelState:
+    breach_streak: int = 0
+    last_move_at: float = field(default=float("-inf"))
+
+
+class PlacementPolicy:
+    def __init__(
+        self,
+        registry: Any,
+        *,
+        default_p99_target_ms: float = 500.0,
+        breach_consecutive: int = 3,
+        cooldown_s: float = 30.0,
+        min_window_samples: int = 20,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.registry = registry
+        self.default_p99_target_ms = float(default_p99_target_ms)
+        self.breach_consecutive = int(breach_consecutive)
+        self.cooldown_s = float(cooldown_s)
+        self.min_window_samples = int(min_window_samples)
+        self.clock = clock
+        self._state: Dict[str, _ModelState] = {}
+
+    def _target_s(self, model: str) -> float:
+        """The tightest class target any tenant could hold this model
+        to; without classes, the fleet default."""
+        targets = [
+            c.p99_target_ms
+            for c in getattr(self.registry, "classes", {}).values()
+            if c.p99_target_ms is not None
+        ]
+        target_ms = min(targets) if targets else self.default_p99_target_ms
+        return target_ms / 1e3
+
+    def observe(
+        self,
+        by_model: Mapping[str, Mapping[str, Any]],
+        placement: Mapping[int, List[str]],
+        ready_replicas: List[int],
+    ) -> List[PlacementDecision]:
+        """One observe-decide cycle.
+
+        ``by_model``: model → ``{"p99": seconds, "samples": int}`` (the
+        fleet /metrics ``by_model`` slo_window, already merged);
+        ``placement``: replica_id → resident model names (probe-learned);
+        ``ready_replicas``: replica ids currently routable.
+        """
+        now = self.clock()
+        decisions: List[PlacementDecision] = []
+        for model in sorted(by_model):
+            obs = by_model[model]
+            p99 = obs.get("p99")
+            samples = int(obs.get("samples") or 0)
+            state = self._state.setdefault(model, _ModelState())
+            if (
+                not isinstance(p99, (int, float))
+                or samples < self.min_window_samples
+                or float(p99) <= self._target_s(model)
+            ):
+                state.breach_streak = 0
+                continue
+            state.breach_streak += 1
+            if state.breach_streak < self.breach_consecutive:
+                continue
+            if now - state.last_move_at < self.cooldown_s:
+                continue
+            hosts = {
+                rid for rid, models in placement.items() if model in models
+            }
+            candidates = [rid for rid in ready_replicas if rid not in hosts]
+            if not candidates:
+                # every ready replica already hosts it: placement is
+                # saturated — replica-COUNT scaling is the next lever,
+                # and that is the base autoscaler's job
+                state.breach_streak = 0
+                continue
+            target = min(
+                candidates, key=lambda rid: len(placement.get(rid, []))
+            )
+            decisions.append(
+                PlacementDecision(
+                    model=model,
+                    replica_id=target,
+                    reason=(
+                        f"window p99 {float(p99) * 1e3:.0f}ms > target "
+                        f"{self._target_s(model) * 1e3:.0f}ms for "
+                        f"{state.breach_streak} consecutive observations"
+                    ),
+                )
+            )
+            state.breach_streak = 0
+            state.last_move_at = now
+        return decisions

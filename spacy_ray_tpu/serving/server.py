@@ -43,6 +43,7 @@ from .batcher import (
     REQUEST_ID_HEADER,
     ServingError,
     SwapFailed,
+    UnknownModel,
     clean_request_id,
     mint_request_id,
 )
@@ -71,6 +72,15 @@ class ServingHTTPServer(ThreadingHTTPServer):
         super().__init__(addr, _Handler)
         self.engine = engine
         self.tel = telemetry
+        # multi-model serving (docs/SERVING.md "Multi-model fleet"),
+        # all three None unless serve --model-manifest wired them:
+        # registry resolves names, residency owns the per-model engine
+        # hot set, admission enforces tenant quotas + class mapping.
+        # With no manifest the request path below never touches them —
+        # the legacy single-model contract, bit-identical.
+        self.registry = None
+        self.residency = None
+        self.admission = None
         # optional diagnosis layer (docs/OBSERVABILITY.md "Alerting &
         # incidents"): the in-process AlertEngine whose states
         # /admin/alerts and the /metrics alerts block serve. None unless
@@ -193,6 +203,19 @@ class _Handler(BaseHTTPRequestHandler):
                     "generation": self.server.engine.serving_generation,
                     "swap_count": self.server.engine.swap_count,
                 }
+                if self.server.residency is not None:
+                    # multi-model placement advertisement: the router's
+                    # probe loop learns which models live here (and each
+                    # one's generation) from this block — placement
+                    # discovery costs zero extra requests
+                    payload["resident_models"] = (
+                        self.server.residency.resident_info()
+                    )
+                    payload["residency"] = self.server.residency.stats()
+                    if self.server.registry is not None:
+                        payload["default_model"] = (
+                            self.server.registry.default_model
+                        )
                 if self.server.tel is not None:
                     # monotonic-clock anchor for the cross-process trace
                     # collector (docs/OBSERVABILITY.md "Distributed
@@ -237,6 +260,24 @@ class _Handler(BaseHTTPRequestHandler):
         # percentiles splittable by generation
         snap["generation"] = engine.serving_generation
         snap["swap_count"] = engine.swap_count
+        residency = self.server.residency
+        if residency is not None:
+            # per-model sub-snapshots (each resident engine carries its
+            # own telemetry): merge_serving_snapshots groups these into
+            # the fleet's by_model block, and the Prometheus branch
+            # below emits them as model-labeled series
+            models: Dict[str, Any] = {}
+            for name, eng in sorted(residency.engines().items()):
+                if eng.tel is None:
+                    continue
+                msnap = eng.tel.snapshot()
+                msnap["model"] = name
+                msnap["generation"] = eng.serving_generation
+                msnap["swap_count"] = eng.swap_count
+                models[name] = msnap
+            if models:
+                snap["models"] = models
+            snap["residency"] = residency.stats()
         if self.server.alerts is not None:
             # the compact alert block `telemetry top` renders; full
             # per-rule states live on /admin/alerts
@@ -276,6 +317,30 @@ class _Handler(BaseHTTPRequestHandler):
                             "window_s": int(win.get("window_s") or 0),
                         },
                     )
+            if isinstance(snap.get("models"), dict):
+                # model-labeled twins of the srt_serving_* families: one
+                # series set per resident model, so per-model p99 is
+                # scrapeable without parsing the JSON surface
+                for name, msnap in sorted(snap["models"].items()):
+                    fam.add_snapshot(
+                        msnap, prefix="srt_serving",
+                        labels={"model": name},
+                    )
+                    mwin = msnap.get("slo_window")
+                    if isinstance(mwin, dict):
+                        for q in ("p50", "p95", "p99"):
+                            fam.add(
+                                "srt_serving_request_latency_window_seconds",
+                                "gauge",
+                                mwin.get(f"request_latency_{q}"),
+                                {
+                                    "model": name,
+                                    "quantile": q.replace("p", "0."),
+                                    "window_s": int(
+                                        mwin.get("window_s") or 0
+                                    ),
+                                },
+                            )
             if self.server.alerts is not None:
                 # srt_alert_state{alert,severity} 0/1/2 + fired totals —
                 # the scraper-side view of the in-process state machine
@@ -328,7 +393,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path in ("/admin/swap", "/admin/rollback"):
             self._handle_admin(body)
             return
-        if self.path != "/v1/parse":
+        if self.path == "/admin/models/load":
+            self._handle_model_load(body)
+            return
+        if self.path != "/v1/parse" and not (
+            self.server.registry is not None
+            and self.path.startswith("/v1/models/")
+        ):
             self._reply(404, {"error": "not_found", "message": self.path})
             return
         # trace identity: honor a client/router-supplied id, mint one
@@ -346,6 +417,21 @@ class _Handler(BaseHTTPRequestHandler):
                 request_id,
             )
             return
+        # multi-model resolution (no manifest → registry is None and
+        # this whole block is skipped; the legacy path is untouched):
+        # path wins over the X-SRT-Model header wins over the default —
+        # an unknown name is the typed 404, never a silent fallback
+        model_name: Optional[str] = None
+        if self.server.registry is not None:
+            try:
+                model_name, _ = self.server.registry.resolve_model(
+                    self.path, self.headers
+                )
+            except UnknownModel as e:
+                if self.server.tel is not None:
+                    self.server.tel.request_rejected(e, request_id)
+                self._reply_error(e, request_id)
+                return
         try:
             payload = json.loads(body or b"{}")
         except ValueError:
@@ -375,9 +461,37 @@ class _Handler(BaseHTTPRequestHandler):
             timeout_s = max(float(payload["timeout_ms"]) / 1000.0, 1e-3)
         from ..training.corpus import _doc_to_json
 
+        # tenant admission (quota BEFORE the queue, metered in docs) +
+        # SLO-class resolution for the batcher's weighted fair queue
+        klass = "default"
+        if self.server.admission is not None:
+            from .multimodel.registry import TENANT_HEADER
+
+            try:
+                klass = self.server.admission.admit(
+                    self.headers.get(TENANT_HEADER), n_docs=len(texts)
+                )
+            except ServingError as e:
+                if self.server.tel is not None:
+                    self.server.tel.request_rejected(e, request_id)
+                self._reply_error(e, request_id)
+                return
+        # resolve the engine: the residency hot set for a named model
+        # (loading it on first use, LRU-evicting past capacity), the
+        # server's single engine otherwise
+        engine = self.server.engine
+        if self.server.residency is not None and model_name is not None:
+            try:
+                engine = self.server.residency.engine_for(model_name)
+            except ServingError as e:
+                if self.server.tel is not None:
+                    self.server.tel.request_rejected(e, request_id)
+                self._reply_error(e, request_id)
+                return
         try:
-            req = self.server.engine.submit_texts(
-                texts, timeout_s=timeout_s, request_id=request_id
+            req = engine.submit_texts(
+                texts, timeout_s=timeout_s, request_id=request_id,
+                klass=klass,
             )
         except ServingError as e:
             self._reply_error(e, request_id)
@@ -385,7 +499,9 @@ class _Handler(BaseHTTPRequestHandler):
         t_ser = time.perf_counter()
         docs_json = [_doc_to_json(d) for d in req.docs]
         serialize_s = time.perf_counter() - t_ser
-        tel = self.server.tel
+        # exemplars ride the tel of the engine that served the request,
+        # so a per-model engine's p99 threshold judges its own traffic
+        tel = engine.tel
         if tel is not None and req.latency_s is not None:
             # slow-request exemplar: the per-stage breakdown that turns
             # "p99 regressed" into "this request waited HERE"
@@ -416,6 +532,55 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
 
+    def _handle_model_load(self, body: bytes) -> None:
+        """Placement control (docs/SERVING.md "Multi-model fleet"):
+        ``{"model": <name>}`` pulls a MANIFEST model into this replica's
+        hot set (load + warmup on this handler thread; resident traffic
+        keeps dispatching). Unlike /admin/swap this needs no directory
+        allowlist — the loadable set is exactly the operator-provided
+        manifest, never a client-supplied path."""
+        if self.server.residency is None:
+            self._reply(
+                403,
+                {
+                    "error": "forbidden",
+                    "message": "multi-model serving is not configured "
+                    "(serve --model-manifest)",
+                },
+            )
+            return
+        if self.server.draining:
+            self._reply_error(Draining("server is draining; no loads"))
+            return
+        try:
+            payload = json.loads(body or b"{}")
+        except ValueError:
+            self._reply(
+                400, {"error": "bad_request", "message": "body is not JSON"}
+            )
+            return
+        name = payload.get("model") if isinstance(payload, dict) else None
+        if not isinstance(name, str) or not name:
+            self._reply(
+                400,
+                {"error": "bad_request", "message": 'body must be {"model": '
+                 "<manifest model name>}"},
+            )
+            return
+        try:
+            self.server.residency.engine_for(name)
+        except ServingError as e:
+            self._reply_error(e)
+            return
+        self._reply(
+            200,
+            {
+                "model": name,
+                "resident": self.server.residency.resident(),
+                "residency": self.server.residency.stats(),
+            },
+        )
+
     # -- admin: live hot-swap control (docs/SERVING.md "Continuous
     # learning"). These run on the LISTENER, not a side channel, so the
     # fleet controller reaches replicas over the address it already
@@ -427,6 +592,34 @@ class _Handler(BaseHTTPRequestHandler):
         if self.server.draining:
             self._reply_error(Draining("server is draining; no swaps"))
             return
+        # optional per-model target (multi-model serving): swap/rollback
+        # the named RESIDENT engine instead of the default — hot-swap
+        # works per model, and swapping a model that is not resident is
+        # a typed refusal, not a surprise cold load
+        model = None
+        if body:
+            try:
+                parsed = json.loads(body)
+                if isinstance(parsed, dict):
+                    model = parsed.get("model")
+            except ValueError:
+                pass  # the swap path below replies 400 for non-JSON
+        if isinstance(model, str) and model:
+            if self.server.residency is None:
+                self._reply(
+                    403,
+                    {
+                        "error": "forbidden",
+                        "message": "per-model swap needs multi-model "
+                        "serving (serve --model-manifest)",
+                    },
+                )
+                return
+            try:
+                engine = self.server.residency.engine_for(model, load=False)
+            except ServingError as e:
+                self._reply_error(e)
+                return
         if not self.server.allowed_swap_dirs:
             # the WHOLE admin surface keys off the swap-dir config —
             # rollback included: an ungated rollback on an open port
@@ -554,9 +747,16 @@ class Server:
         alerts: Optional[Any] = None,
         recorder: Optional[Any] = None,
         observe_interval_s: float = 2.0,
+        registry: Optional[Any] = None,
+        residency: Optional[Any] = None,
+        admission: Optional[Any] = None,
     ) -> None:
         self.engine = engine
         self.tel = telemetry
+        # multi-model serving (all None without --model-manifest)
+        self.registry = registry
+        self.residency = residency
+        self.admission = admission
         # the diagnosis layer (docs/OBSERVABILITY.md "Alerting &
         # incidents"): an AlertEngine and/or FlightRecorder, both fed by
         # one observer ticker off the hot path. Only ever constructed by
@@ -575,6 +775,9 @@ class Server:
         self.watcher = watcher
         self.httpd = ServingHTTPServer((host, port), engine, telemetry)
         self.httpd.alerts = alerts
+        self.httpd.registry = registry
+        self.httpd.residency = residency
+        self.httpd.admission = admission
         # /admin/swap allowlist: the watched dir plus any explicit
         # --swap-dir entries; empty = admin swaps 403 (see
         # ServingHTTPServer.allowed_swap_dirs)
@@ -653,6 +856,8 @@ class Server:
         if self.watcher is not None:
             self.watcher.stop()
         self.engine.batcher.begin_drain()
+        if self.residency is not None:
+            self.residency.begin_drain()
         log_event(
             "serve-drain",
             "shutdown requested — draining "
@@ -666,6 +871,12 @@ class Server:
                 f"drain exceeded {self.drain_timeout_s:.1f}s — hard stop",
             )
             self.engine.stop()
+        if self.residency is not None:
+            # every resident engine gets the same graceful drain the
+            # default engine got (the default is in the hot set too —
+            # its second drain is an idempotent no-op)
+            if not self.residency.stop_all(self.drain_timeout_s):
+                clean = False
         self.httpd.shutdown()
         self.httpd.server_close()
         return 0 if clean else 1

@@ -147,6 +147,16 @@ class TopModel:
             router = payload.get("router") or {}
             for k, v in (router.get("counters") or {}).items():
                 counters[f"router.{k}"] = v
+            # per-model counters (multi-model fleets) join the same
+            # delta arithmetic under a "model.<name>." prefix, so each
+            # model's req/s and quota-reject/s come for free
+            by_model = fleet.get("by_model") or {}
+            for mname, sub in by_model.items():
+                if not isinstance(sub, dict):
+                    continue
+                for k, v in (sub.get("counters") or {}).items():
+                    if isinstance(v, (int, float)):
+                        counters[f"model.{mname}.{k}"] = v
             # the edge cache's ledger (router /metrics "cache" block —
             # the same surface the Zipfian bench record reads): lifetime
             # hit rate over hits+misses; None when the cache is off
@@ -161,6 +171,37 @@ class TopModel:
                     cache_hit_rate = 0.0
             rates = self._rates(url, counters, now)
             replicas = payload.get("replicas") or []
+            # per-model rows: window p99 from the merged by_model view,
+            # cache hit % from the per-model cache ledger, and the
+            # resident-replica count from the probe-learned placement
+            placement = payload.get("placement") or {}
+            cache_by_model = (
+                cache.get("by_model") if isinstance(cache, dict) else None
+            ) or {}
+            models: List[Dict[str, Any]] = []
+            for mname in sorted(by_model):
+                sub = by_model[mname] if isinstance(
+                    by_model[mname], dict
+                ) else {}
+                ledger = cache_by_model.get(mname) or {}
+                m_hits = ledger.get("hits") or 0
+                m_misses = ledger.get("misses") or 0
+                models.append({
+                    "name": mname,
+                    "req_s": rates.get(f"model.{mname}.requests"),
+                    "p99": _get(sub, "slo_window", "request_latency_p99"),
+                    "cache_hit_rate": (
+                        m_hits / (m_hits + m_misses)
+                        if (m_hits + m_misses) > 0 else None
+                    ),
+                    "hosts": sum(
+                        1 for ms in placement.values()
+                        if mname in (ms or [])
+                    ),
+                    "quota_s": rates.get(
+                        f"model.{mname}.rejected_quota"
+                    ),
+                })
             return {
                 "url": url,
                 "kind": kind,
@@ -199,6 +240,8 @@ class TopModel:
                     cache.get("cache_mixed_generation_bypasses")
                     if isinstance(cache, dict) else None
                 ),
+                "quota_s": rates.get("rejected_quota"),
+                "models": models,
                 "alerts": payload.get("alerts"),
                 **_process_cols(payload),
             }
@@ -276,8 +319,30 @@ class TopModel:
                 "wire_ratio": wire_ratio,
                 **_process_cols(payload),
             }
-        counters = payload.get("counters") or {}
+        counters = dict(payload.get("counters") or {})
+        # a multi-model replica's /metrics carries per-engine snapshots
+        # under "models": same prefix trick as the router view
+        replica_models = payload.get("models") or {}
+        for mname, msnap in replica_models.items():
+            if not isinstance(msnap, dict):
+                continue
+            for k, v in (msnap.get("counters") or {}).items():
+                if isinstance(v, (int, float)):
+                    counters[f"model.{mname}.{k}"] = v
         rates = self._rates(url, counters, now)
+        models = []
+        for mname in sorted(replica_models):
+            msnap = replica_models[mname] if isinstance(
+                replica_models[mname], dict
+            ) else {}
+            models.append({
+                "name": mname,
+                "req_s": rates.get(f"model.{mname}.requests"),
+                "p99": _get(msnap, "slo_window", "request_latency_p99"),
+                "cache_hit_rate": None,
+                "hosts": None,
+                "quota_s": rates.get(f"model.{mname}.rejected_quota"),
+            })
         return {
             "url": url,
             "kind": kind,
@@ -294,6 +359,8 @@ class TopModel:
                 + (rates.get("deadline_exceeded") or 0.0)
             ) if rates else None,
             "exemplars": counters.get("slow_exemplars"),
+            "quota_s": rates.get("rejected_quota"),
+            "models": models,
             "alerts": payload.get("alerts"),
             **_process_cols(payload),
         }
@@ -315,6 +382,24 @@ def _fmt_alerts(block: Any) -> str:
     if pending:
         return f"pending {pending}"
     return "ok"
+
+
+def _model_lines(row: Dict[str, Any], lines: List[str]) -> None:
+    """Per-model sub-rows (multi-model serving): req/s, window p99,
+    cache hit %, resident-replica count, quota-reject/s."""
+    for m in row.get("models") or []:
+        hr = m.get("cache_hit_rate")
+        cache_s = f"{hr * 100:.0f}%" if isinstance(hr, float) else "-"
+        hosts = m.get("hosts")
+        hosts_s = _fmt_int(hosts) if hosts is not None else "-"
+        lines.append(
+            f"    model {m.get('name')}  "
+            f"req {_fmt_rate(m.get('req_s'))}  "
+            f"p99 {_fmt_ms(m.get('p99'))}  "
+            f"cache {cache_s}  "
+            f"hosts {hosts_s}  "
+            f"429-quota {_fmt_rate(m.get('quota_s'))}"
+        )
 
 
 def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
@@ -350,11 +435,13 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 f"occ p50 {_fmt_int(row.get('occupancy'))}  "
                 f"gen [{gens}]  swaps {_fmt_int(row.get('swaps'))}  "
                 f"rej {_fmt_rate(row.get('reject_s'))}  "
+                f"429-quota {_fmt_rate(row.get('quota_s'))}  "
                 f"cache {cache_s}  "
                 f"scrape-fail {_fmt_int(row.get('scrape_failures'))}  "
                 f"{_fmt_host(row)}  "
                 f"alerts {_fmt_alerts(row.get('alerts'))}"
             )
+            _model_lines(row, lines)
         elif kind == "trainer":
             worker = row.get("worker")
             tag = (
@@ -412,10 +499,12 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 f"queue {_fmt_int(row.get('queue_depth'))}  "
                 f"occ {_fmt_int(row.get('occupancy'))}  "
                 f"rej {_fmt_rate(row.get('reject_s'))}  "
+                f"429-quota {_fmt_rate(row.get('quota_s'))}  "
                 f"slow-exemplars {_fmt_int(row.get('exemplars'))}  "
                 f"{_fmt_host(row)}  "
                 f"alerts {_fmt_alerts(row.get('alerts'))}"
             )
+            _model_lines(row, lines)
     return "\n".join(lines) + "\n"
 
 

@@ -219,6 +219,12 @@ def merge_serving_snapshots(
       generation — the canary guard's entire signal. Replicas serving
       the model as loaded from disk (generation null) group under
       ``"none"``.
+    * **models** — when any snapshot carries a ``models`` block
+      (multi-model serving: model name → that engine's own snapshot,
+      stamped per model by the replica), the merged view adds
+      ``by_model``: the SAME merge re-run over each model's per-engine
+      snapshots gathered across replicas — the per-model window p99 the
+      placement policy and the per-class SLO story read.
     """
     merged: Dict[str, Any] = {
         "replicas": len(snaps),
@@ -352,6 +358,23 @@ def merge_serving_snapshots(
                 sub["generation"] = g
                 by_gen["none" if g is None else str(g)] = sub
             merged["by_generation"] = by_gen
+        model_groups: Dict[str, List[Dict[str, Any]]] = {}
+        for snap in snaps:
+            models = snap.get("models")
+            if not isinstance(models, dict):
+                continue
+            for name, msnap in models.items():
+                if isinstance(msnap, dict):
+                    model_groups.setdefault(str(name), []).append(msnap)
+        if model_groups:
+            by_model: Dict[str, Any] = {}
+            for name in sorted(model_groups):
+                sub = merge_serving_snapshots(
+                    model_groups[name], _tag_generations=False
+                )
+                sub["model"] = name
+                by_model[name] = sub
+            merged["by_model"] = by_model
     return merged
 
 
