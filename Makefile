@@ -1,6 +1,6 @@
 # Convenience targets; the canonical commands live in README.md / PERF.md.
 
-.PHONY: test test-fast test-slow resilience telemetry observability serving fleet multi-model live train-fleet train-fleet-obs train-fleet-chaos bench bench-gate baseline profile step-perf serve-perf update-shard dryrun
+.PHONY: test test-fast test-slow resilience telemetry observability serving fleet multi-model live train-fleet train-fleet-obs train-fleet-chaos bench bench-gate baseline profile step-perf serve-perf serve-perf3 update-shard dryrun
 
 test:
 	python -m pytest tests/ -q
@@ -161,6 +161,22 @@ serve-perf:
 	JAX_PLATFORMS=cpu python bench.py --serving-ab
 	JAX_PLATFORMS=cpu python bench.py --serving
 	JAX_PLATFORMS=cpu python bench.py --serving --zipfian
+
+# serving data plane (PR 20, docs/SERVING.md "Data plane"): the fast-tier
+# data-plane tests (conditional 304s + ETag/generation interaction,
+# length-affinity policy, pooled-connection stale-retry), then the
+# length-routing A/B through the real 2-replica fleet (pad share must
+# strictly drop), the Zipfian spec whose conditional arm commits 304
+# share + bytes saved, and the router-ceiling spec (pooled vs fresh-dial
+# arms against stub replicas, naming which side bounds the fleet)
+serve-perf3:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -m 'not slow' \
+		-k "conditional or suppressed or passthrough or length_ or stale_pooled or aux_conns"
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q -m 'not slow' \
+		-k "etag or conditional or pad or batch_span"
+	JAX_PLATFORMS=cpu python bench.py --serving --length-mix
+	JAX_PLATFORMS=cpu python bench.py --serving --zipfian
+	JAX_PLATFORMS=cpu python bench.py --serving --router-ceiling
 
 # cross-replica update sharding (PERF.md "Update sharding (round 11)"):
 # the full==replicated equality suite + v2 owner-shard checkpoint format +

@@ -1698,6 +1698,8 @@ class _ParseSession:
         path: str = "/v1/parse",
         extra_headers: Optional[Dict[str, str]] = None,
         return_error_code: bool = False,
+        if_none_match: Optional[str] = None,
+        return_meta: bool = False,
     ) -> Tuple[int, float]:
         import http.client
 
@@ -1707,6 +1709,8 @@ class _ParseSession:
             "Content-Type": "application/json",
             self._id_header: request_id,
         }
+        if if_none_match:
+            headers["If-None-Match"] = if_none_match
         if extra_headers:
             headers.update(extra_headers)
         t0 = time.perf_counter()
@@ -1739,6 +1743,12 @@ class _ParseSession:
                 with self._lock:
                     _ParseSession.echo_failures += 1
             dt = time.perf_counter() - t0
+            if return_meta:
+                # the conditional-response arm needs the validator and
+                # the wire size: a 304 saves exactly the body bytes the
+                # key's 200 carried
+                return (resp.status, dt, resp.getheader("ETag"),
+                        len(resp_body))
             if not return_error_code:
                 return resp.status, dt
             # the multi-model spec tallies rejects BY TYPED CODE (a
@@ -2779,6 +2789,64 @@ def zipf_ranks(
     return rng.choices(range(n_keys), weights=weights, k=n_samples)
 
 
+def _drive_open_conditional(
+    host: str, port: int, rate: float,
+    texts_seq: List[List[str]], ranks: List[int],
+) -> Tuple[float, List[Tuple[int, float]], int, int]:
+    """Open-loop replay where repeat visitors revalidate: each key's
+    first 200 teaches the driver its ETag (and body size), and every
+    repeat of that key sends If-None-Match — the conditional-response
+    data plane under Zipfian traffic. Returns (wall, [(status,
+    latency_s)], conditional_sent, bytes_saved): a 304 saves exactly
+    the body bytes that key's 200 carried."""
+    import threading
+
+    interval = 1.0 / rate
+    lock = threading.Lock()
+    shots: List[Tuple[int, float]] = []
+    etags: Dict[int, str] = {}
+    body_bytes: Dict[int, int] = {}
+    tally = {"conditional": 0, "saved": 0}
+    session = _ParseSession(host, port)
+
+    def one_shot(i: int) -> None:
+        key = ranks[i % len(ranks)]
+        with lock:
+            inm = etags.get(key)
+        try:
+            status, dt, etag, blen = session.post(
+                texts_seq[i % len(texts_seq)], if_none_match=inm,
+                return_meta=True,
+            )
+        except OSError:
+            status, dt, etag, blen = -1, 0.0, None, 0
+        with lock:
+            shots.append((status, dt))
+            if inm is not None:
+                tally["conditional"] += 1
+            if status == 200 and etag:
+                etags[key] = etag
+                body_bytes[key] = blen
+            elif status == 304:
+                tally["saved"] += body_bytes.get(key, 0)
+
+    t0 = time.perf_counter()
+    workers: List[Any] = []
+    for i in range(len(ranks)):
+        target = t0 + i * interval
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        th = threading.Thread(target=one_shot, args=(i,), daemon=True)
+        th.start()
+        workers.append(th)
+    for th in workers:
+        th.join(timeout=35.0)
+    session.close()
+    wall = time.perf_counter() - t0
+    return wall, shots, tally["conditional"], tally["saved"]
+
+
 def run_serving_zipfian(
     platform: str,
     *,
@@ -2894,6 +2962,16 @@ def run_serving_zipfian(
         cache_stats = (metrics or {}).get("cache") or {}
         win = ((metrics or {}).get("fleet") or {}).get("slo_window") or {}
         prom_lines = _prometheus_scrape_lines(host, port)
+        # conditional-response arm: the SAME Zipfian sequence, but
+        # clients that repeat a key revalidate with If-None-Match — the
+        # 304 ledger delta below isolates this phase
+        wall_c, shots_c, conditional_sent, bytes_saved = \
+            _drive_open_conditional(host, port, rate, texts_seq, ranks)
+        try:
+            _, metrics2 = _get_json(host, port, "/metrics")
+        except OSError:
+            metrics2 = {}
+        cache_after = (metrics2 or {}).get("cache") or {}
     finally:
         fleet.request_shutdown()
         fleet.wait()
@@ -2941,6 +3019,9 @@ def run_serving_zipfian(
         "cache_mixed_generation_bypasses": int(
             cache_stats.get("cache_mixed_generation_bypasses") or 0
         ),
+        "cache_not_modified": int(
+            cache_stats.get("cache_not_modified") or 0
+        ),
         "cache_entries": int(cache_stats.get("cache_entries") or 0),
         "cache_bytes": int(cache_stats.get("cache_bytes") or 0),
         # replica-side sliding-window percentiles: misses only (a hit
@@ -2967,6 +3048,426 @@ def run_serving_zipfian(
         )
         print(f"# zipfian bench: {rec['reason']}; recording a skip",
               flush=True)
+    print(json.dumps(rec), flush=True)
+    _append_session(rec, platform)
+
+    # the conditional-response arm's record: repeat clients revalidate,
+    # the headline is what share of responses were body-less 304s and
+    # how many response bytes never crossed the wire
+    ok_c = sum(1 for st, _ in shots_c if st == 200)
+    n_304 = sum(1 for st, _ in shots_c if st == 304)
+    rejected_c = sum(1 for st, _ in shots_c if st == 429)
+    http_5xx_c = sum(1 for st, _ in shots_c if 500 <= st)
+    failed_c = sum(1 for st, _ in shots_c if st < 0)
+    total_c = len(shots_c)
+    share_304 = round(n_304 / total_c, 4) if total_c else None
+    ledger_304 = (int(cache_after.get("cache_not_modified") or 0)
+                  - int(cache_stats.get("cache_not_modified") or 0))
+    rec_c = {
+        "name": "serving_zipfian_conditional",
+        "metric": (
+            f"conditional_304_share (fixed {rate:.0f} req/s offered, "
+            f"zipf s={zipf_s} over {n_keys} keys, repeat clients send "
+            f"If-None-Match, {replicas} replica(s), HTTP)"
+        ),
+        "value": share_304,
+        "unit": "304 share",
+        "platform": platform,
+        "mode": "open",
+        "replicas": replicas,
+        "offered_rps": round(rate, 1),
+        "offered_rate_source": rate_source,
+        "duration_s": round(wall_c, 2),
+        "requests_ok": ok_c,
+        "responses_304": n_304,
+        "conditional_sent": conditional_sent,
+        "bytes_saved": bytes_saved,
+        "rejected": rejected_c,
+        "failed": failed_c,
+        "http_5xx": http_5xx_c,
+        "zipf_s": zipf_s,
+        "zipf_keys": n_keys,
+        "cache_not_modified_delta": ledger_304,
+        **_latency_stats([dt for st, dt in shots_c if st in (200, 304)]),
+    }
+    bad_c = rejected_c + http_5xx_c + failed_c
+    if bad_c or not n_304:
+        rec_c["skipped"] = True
+        rec_c["reason"] = (
+            f"contract violated: {rejected_c} reject(s), {http_5xx_c} "
+            f"5xx, {failed_c} failure(s), {n_304} 304(s) — the "
+            "conditional record requires zero of the former and a "
+            "non-zero 304 share"
+        )
+        print(f"# zipfian bench: {rec_c['reason']}; recording a skip",
+              flush=True)
+    print(json.dumps(rec_c), flush=True)
+    _append_session(rec_c, platform)
+    return rec
+
+
+def _bimodal_bodies(
+    n: int, texts_per_request: int, seed: int = 0
+) -> List[List[str]]:
+    """Request bodies with a BIMODAL length mixture — half short docs
+    (6-10 words, the 16-token bucket) and half long (88-108 words, the
+    128-token bucket), shuffled deterministically so length-blind
+    routing interleaves them on every replica."""
+    import random
+
+    rng = random.Random(seed)
+    vocab = ("the quick brown fox jumps over a lazy dog near riverbank "
+             "while birds sing loudly in early morning light today").split()
+
+    def body(lo: int, hi: int) -> List[str]:
+        return [
+            " ".join(rng.choice(vocab) for _ in range(rng.randint(lo, hi)))
+            for _ in range(texts_per_request)
+        ]
+
+    bodies = [body(6, 10) for _ in range(n // 2)]
+    bodies += [body(88, 108) for _ in range(n - n // 2)]
+    rng.shuffle(bodies)
+    return bodies
+
+
+def _fleet_counters(host: str, port: int, *names: str) -> List[float]:
+    """Current values of fleet-merged counters via the router's
+    aggregated /metrics (0.0 when absent or unreachable)."""
+    try:
+        status, payload = _get_json(host, port, "/metrics")
+    except OSError:
+        return [0.0] * len(names)
+    if status != 200:
+        return [0.0] * len(names)
+    counters = ((payload or {}).get("fleet") or {}).get("counters") or {}
+    return [float(counters.get(n) or 0) for n in names]
+
+
+def run_serving_length_mix(
+    platform: str,
+    *,
+    replicas: int = 2,
+    duration_s: float = 4.0,
+    clients: int = 8,
+    max_batch: int = 16,
+    max_wait_ms: float = 2.0,
+    texts_per_request: int = 2,
+) -> Optional[Dict[str, Any]]:
+    """``--serving --length-mix``: the length-aware-routing A/B — a
+    bimodal doc-length mixture driven closed-loop through the REAL
+    2-replica fleet twice, once length-blind and once with
+    ``length_routing`` armed, same bodies, same topology. The committed
+    record carries both arms' padded-token share (from the fleet-merged
+    srt_serving pad counters, measured at the batcher's dispatch
+    assembly) and client p99; the contract is that the affinity arm's
+    pad share strictly drops — shorter docs stop padding to the longest
+    straggler in mixed batches. The edge cache is disabled for this
+    spec: pad accounting happens on the replicas, so every request must
+    reach one."""
+    import tempfile
+
+    from spacy_ray_tpu.serving.fleet import Fleet, FleetConfig
+
+    nlp = _serving_nlp()
+    tmpdir = tempfile.mkdtemp(prefix="srt_lenmix_bench_")
+    model_dir = Path(tmpdir) / "model"
+    nlp.to_disk(model_dir)
+    del nlp
+
+    device = "cpu" if platform == "cpu" else platform
+    cpu_cores: Optional[List[str]] = None
+    if device == "cpu":
+        cpu_cores = [str(c) for c in sorted(os.sched_getaffinity(0))]
+    bodies = _bimodal_bodies(256, texts_per_request)
+    arms: Dict[str, Dict[str, Any]] = {}
+
+    for arm, length_routing in (("blind", False), ("affinity", True)):
+        config = FleetConfig(
+            model_path=str(model_dir),
+            host="127.0.0.1",
+            port=0,
+            device=device,
+            replicas=replicas,
+            min_replicas=replicas,
+            max_replicas=replicas,
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            queue_size=max(8 * max_batch, 128),
+            timeout_ms=30_000.0,
+            max_doc_len=128,  # the long mode lives in the 128 bucket
+            cpu_cores=cpu_cores,
+            autoscale=False,
+            telemetry=True,
+            cache_mb=0.0,  # every request must REACH a replica (pad
+            # accounting happens at the batcher's dispatch assembly)
+            length_routing=length_routing,
+        )
+        fleet = Fleet(config)
+        try:
+            t0 = time.perf_counter()
+            host, port = fleet.start()
+            if not fleet.wait_ready(replicas, timeout_s=600.0):
+                ready = len(fleet.router.ready_handles())
+                print(f"# length-mix bench: only {ready}/{replicas} "
+                      "replicas ready — recording a skip", flush=True)
+                _append_session(
+                    {"name": "serving_length_mix_ab", "skipped": True,
+                     "reason": f"{ready}/{replicas} replicas ready "
+                     f"within 600s ({arm} arm)"},
+                    platform,
+                )
+                return None
+            ready_seconds = time.perf_counter() - t0
+            print(f"# length-mix bench [{arm}]: {replicas} replicas "
+                  f"ready in {ready_seconds:.1f}s", flush=True)
+            pad0, real0 = _fleet_counters(
+                host, port, "pad_tokens", "real_tokens"
+            )
+            wall, counts, latencies = _drive_closed(
+                host, port, duration_s, clients, bodies
+            )
+            pad1, real1 = _fleet_counters(
+                host, port, "pad_tokens", "real_tokens"
+            )
+            try:
+                _, metrics = _get_json(host, port, "/metrics")
+            except OSError:
+                metrics = {}
+            rc = ((metrics or {}).get("router") or {}).get("counters") or {}
+        finally:
+            fleet.request_shutdown()
+            fleet.wait()
+        pad, real = pad1 - pad0, real1 - real0
+        arms[arm] = {
+            "rps": round(counts["ok"] / wall, 1),
+            "requests_ok": counts["ok"],
+            "rejected": counts["rejected"],
+            "failed": counts["failed"],
+            "pad_tokens": int(pad),
+            "real_tokens": int(real),
+            "pad_share": (
+                round(pad / (pad + real), 4) if pad + real > 0 else None
+            ),
+            "affinity_picks": int(rc.get("length_affinity_picks") or 0),
+            "affinity_spills": int(rc.get("length_affinity_spills") or 0),
+            **_latency_stats(latencies),
+        }
+
+    blind, affine = arms["blind"], arms["affinity"]
+    rec = {
+        "name": "serving_length_mix_ab",
+        "metric": (
+            f"pad_share_blind_vs_length_routed (closed loop, {clients} "
+            f"clients, bimodal 6-10/88-108 word docs, {replicas} replicas"
+            + (", 1 core/replica" if cpu_cores else "")
+            + ", edge cache off, HTTP)"
+        ),
+        "value": affine["pad_share"],
+        "unit": "pad share",
+        "platform": platform,
+        "mode": "closed",
+        "replicas": replicas,
+        "clients": clients,
+        "duration_s": duration_s,
+        "texts_per_request": texts_per_request,
+        "max_batch_docs": max_batch,
+        "cpu_cores": cpu_cores,
+        "pad_share_blind": blind["pad_share"],
+        "pad_share_affinity": affine["pad_share"],
+        "rps_blind": blind["rps"],
+        "rps_affinity": affine["rps"],
+        "p99_ms_blind": blind["latency_ms_p99"],
+        "p99_ms_affinity": affine["latency_ms_p99"],
+        "affinity_picks": affine["affinity_picks"],
+        "affinity_spills": affine["affinity_spills"],
+        "arms": arms,
+    }
+    bad = sum(a["rejected"] + a["failed"] for a in arms.values())
+    improved = (
+        blind["pad_share"] is not None
+        and affine["pad_share"] is not None
+        and affine["pad_share"] < blind["pad_share"]
+    )
+    if bad or not improved:
+        rec["skipped"] = True
+        rec["reason"] = (
+            f"contract violated: pad share {blind['pad_share']} -> "
+            f"{affine['pad_share']} (must strictly drop), "
+            f"{bad} reject(s)/failure(s)"
+        )
+        print(f"# length-mix bench: {rec['reason']}; recording a skip",
+              flush=True)
+    print(json.dumps(rec), flush=True)
+    _append_session(rec, platform)
+    return rec
+
+
+def run_serving_router_ceiling(
+    platform: str,
+    *,
+    replica_counts: Optional[List[int]] = None,
+    duration_s: float = 2.0,
+    clients: int = 8,
+    texts_per_request: int = 2,
+) -> Dict[str, Any]:
+    """``--serving --router-ceiling``: how many forwards per second the
+    ROUTER data plane itself sustains, isolated from model compute —
+    in-process stub replicas answer /v1/parse with a canned body at
+    ~zero cost, so the closed-loop rate through the real
+    RouterHTTPServer measures the edge path (parse headers, pick,
+    pooled forward, stream back) and nothing else. Each replica count
+    runs TWO arms: the pooled data plane as shipped, and a fresh-dial
+    arm with connection pooling disabled — the A/B that names what the
+    pool is worth. The verdict per count compares the pooled ceiling
+    against the latest committed real-fleet closed-loop rate at the
+    same count: a fleet well below the ceiling is replica-bound (scale
+    replicas), a fleet near it is router-bound (shard the edge)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from spacy_ray_tpu.serving.fleet import (
+        ReplicaHandle,
+        Router,
+        RouterHTTPServer,
+        RouterTelemetry,
+    )
+    import spacy_ray_tpu.serving.fleet.replica as replica_mod
+
+    canned = json.dumps({
+        "docs": [
+            {"tokens": ["stub"] * 8, "tags": ["X"] * 8}
+            for _ in range(texts_per_request)
+        ],
+        "batch": {"occupancy": 1},
+    }).encode("utf8")
+
+    class _StubSrv(ThreadingHTTPServer):
+        daemon_threads = True
+
+    class _Stub(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        # keep-alive + Nagle + delayed ACK stalls ~40ms between the
+        # header and body writes (the real servers disable it too)
+        disable_nagle_algorithm = True
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _send(self, status, body):
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802
+            self._send(200, b'{"status": "ok"}')
+
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            self._send(200, canned)
+
+    counts = replica_counts or [1, 2, 4, 8]
+    texts_pool = [_serving_texts(texts_per_request, seed=i)
+                  for i in range(64)]
+    points: List[Dict[str, Any]] = []
+
+    for n in counts:
+        stubs = [_StubSrv(("127.0.0.1", 0), _Stub) for _ in range(n)]
+        threads = [
+            threading.Thread(target=s.serve_forever,
+                             kwargs={"poll_interval": 0.05}, daemon=True)
+            for s in stubs
+        ]
+        for t in threads:
+            t.start()
+        handles = []
+        for i, s in enumerate(stubs):
+            h = ReplicaHandle(i)
+            h.set_address("127.0.0.1", s.server_address[1])
+            h.ready = True
+            handles.append(h)
+        router = Router(lambda: handles, telemetry=RouterTelemetry())
+        httpd = RouterHTTPServer(("127.0.0.1", 0), router)
+        threading.Thread(
+            target=httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        ).start()
+        host, port = httpd.server_address[:2]
+        try:
+            wall, c, lat = _drive_closed(
+                str(host), int(port), duration_s, clients, texts_pool
+            )
+            pooled_rps = c["ok"] / wall
+            # fresh-dial arm: pooling off — every forward pays the TCP
+            # dial + replica handler-thread spawn this PR removed
+            orig_out = replica_mod.ReplicaHandle.checkout_conn
+            orig_in = replica_mod.ReplicaHandle.checkin_conn
+            replica_mod.ReplicaHandle.checkout_conn = lambda self: None
+            replica_mod.ReplicaHandle.checkin_conn = (
+                lambda self, conn: conn.close()
+            )
+            try:
+                wall_f, c_f, _ = _drive_closed(
+                    str(host), int(port), duration_s, clients, texts_pool
+                )
+            finally:
+                replica_mod.ReplicaHandle.checkout_conn = orig_out
+                replica_mod.ReplicaHandle.checkin_conn = orig_in
+            fresh_rps = c_f["ok"] / wall_f
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            for h in handles:
+                h.close_conns()
+            for s in stubs:
+                s.shutdown()
+                s.server_close()
+        committed = _committed_session_value(
+            "serving_fleet_closed", field="value",
+            platform=platform, replicas=n,
+        )
+        fleet_rps = committed[0] if committed else None
+        if fleet_rps is None:
+            bound = "unknown (no committed fleet record at this count)"
+        elif fleet_rps < 0.7 * pooled_rps:
+            bound = "replicas"
+        else:
+            bound = "router"
+        point = {
+            "replicas": n,
+            "router_ceiling_rps": round(pooled_rps, 1),
+            "router_fresh_dial_rps": round(fresh_rps, 1),
+            "pool_speedup": (
+                round(pooled_rps / fresh_rps, 2) if fresh_rps else None
+            ),
+            "fleet_rps_committed": fleet_rps,
+            "bound": bound,
+            "failed": c["failed"] + c_f["failed"],
+            "latency_ms_p99": _latency_stats(lat)["latency_ms_p99"],
+        }
+        points.append(point)
+        print(f"# router ceiling n={n}: pooled {pooled_rps:.0f} req/s, "
+              f"fresh-dial {fresh_rps:.0f} req/s, bound: {bound}",
+              flush=True)
+
+    rec = {
+        "name": "serving_router_ceiling",
+        "metric": (
+            f"router_forward_ceiling (closed loop, {clients} clients, "
+            "stub replicas at ~zero model cost, pooled vs fresh-dial "
+            "arms, HTTP)"
+        ),
+        "value": points[-1]["router_ceiling_rps"] if points else None,
+        "unit": "req/s",
+        "platform": platform,
+        "mode": "closed",
+        "clients": clients,
+        "duration_s": duration_s,
+        "texts_per_request": texts_per_request,
+        "points": points,
+    }
     print(json.dumps(rec), flush=True)
     _append_session(rec, platform)
     return rec
@@ -3998,6 +4499,25 @@ def main() -> None:
         "space",
     )
     parser.add_argument(
+        "--length-mix", action="store_true",
+        help="--serving: run the length-aware-routing A/B instead — a "
+        "bimodal doc-length mixture closed-loop through the real "
+        "2-replica fleet, one length-blind arm and one with "
+        "--length-routing armed; the record commits both arms' "
+        "padded-token share (srt_serving pad counters) and p99 and "
+        "requires the affinity arm's pad share to strictly drop; lands "
+        "in BENCH_SESSION.jsonl",
+    )
+    parser.add_argument(
+        "--router-ceiling", action="store_true",
+        help="--serving: measure the router data plane's forward "
+        "ceiling instead — closed-loop through the real router against "
+        "in-process stub replicas (~zero model cost) at each --replicas "
+        "count, pooled vs fresh-dial arms; the record names whether the "
+        "router or the replica pool bounds the committed fleet rate; "
+        "lands in BENCH_SESSION.jsonl",
+    )
+    parser.add_argument(
         "--multi-model", action="store_true",
         help="--serving: run the two-model isolation spec instead — a "
         "manifest-armed fleet hosting models alpha+beta, a saturating "
@@ -4159,6 +4679,26 @@ def main() -> None:
                 duration_s=max(float(args.serving_duration), 6.0),
                 burst_rate=float(args.serving_rate) or None,
                 gold_p99_target_ms=float(args.mm_gold_target_ms),
+            )
+        elif args.length_mix:
+            counts = [
+                int(c) for c in args.replicas.split(",") if c.strip()
+            ] or [2]
+            run_serving_length_mix(
+                jax.default_backend(),
+                replicas=max(counts[0], 2),  # affinity needs a pool
+                duration_s=max(float(args.serving_duration), 4.0),
+                clients=int(args.serving_clients),
+            )
+        elif args.router_ceiling:
+            counts = [
+                int(c) for c in args.replicas.split(",") if c.strip()
+            ] or None
+            run_serving_router_ceiling(
+                jax.default_backend(),
+                replica_counts=counts,
+                duration_s=max(float(args.serving_duration) / 2.0, 2.0),
+                clients=int(args.serving_clients),
             )
         elif args.zipfian:
             counts = [

@@ -2377,6 +2377,16 @@ def serve_fleet_command(argv: List[str]) -> int:
     parser.add_argument("--probe-interval-s", type=float, default=0.5,
                         help="how often the router re-probes each "
                         "replica's /healthz")
+    parser.add_argument("--length-routing", action="store_true",
+                        help="length-bucket affinity routing: steer "
+                        "similar doc lengths to the same replica (within "
+                        "the least-outstanding/model-hosting candidates) "
+                        "so device batches fill their bucket instead of "
+                        "padding to the longest straggler; pays on skewed "
+                        "length mixtures with >1 replica (TUNING.md §24); "
+                        "pad share lands in /metrics as "
+                        "srt_serving_pad_tokens_total / "
+                        "srt_serving_real_tokens_total")
     # live continuous learning (docs/SERVING.md "Continuous learning",
     # TUNING.md §14)
     parser.add_argument("--watch", type=Path, default=None,
@@ -2499,6 +2509,7 @@ def serve_fleet_command(argv: List[str]) -> int:
         cpu_cores=cpu_cores,
         cache_mb=args.cache_mb,
         probe_interval_s=args.probe_interval_s,
+        length_routing=args.length_routing,
         watch_dir=str(args.watch) if args.watch is not None else None,
         watch_interval_s=args.watch_interval_s,
         canary_fraction=args.canary_fraction,

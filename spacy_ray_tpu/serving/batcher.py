@@ -43,6 +43,7 @@ Contract:
 
 from __future__ import annotations
 
+import hashlib
 import re
 import threading
 import time
@@ -65,6 +66,9 @@ __all__ = [
     "REQUEST_ID_HEADER",
     "mint_request_id",
     "clean_request_id",
+    "cache_key_for",
+    "etag_for",
+    "if_none_match_hit",
 ]
 
 
@@ -82,6 +86,63 @@ REQUEST_ID_HEADER = "X-SRT-Request-Id"
 _REQUEST_ID_RE = re.compile(r"\A[A-Za-z0-9._:-]{1,128}\Z")  # \Z, not $:
 # $ would also match before a trailing newline, letting "id\n" echo into
 # a response header
+
+
+# Conditional responses (docs/SERVING.md "Data plane"): a /v1/parse
+# response is a pure function of (texts, model, serving generation) —
+# same inputs against the same weights annotate identically, byte for
+# byte. That makes a STRONG ETag computable at admission, before any
+# inference: the input digest (the response cache's key, so router cache
+# and ETag can never disagree about identity) plus the generation. A
+# hot-swap promotion changes the generation and therefore every ETag,
+# invalidating clients' cached bodies exactly when the annotations
+# could differ.
+
+
+def cache_key_for(texts: List[str], model: str = "") -> bytes:
+    """Digest identifying a /v1/parse input. Shared by the router's
+    response cache and the ETag so the two can never disagree."""
+    h = hashlib.sha256()
+    if model:
+        # model joins the key (distinct models annotate the same texts
+        # differently); \x01 keeps it unambiguous against the
+        # \x00-separated texts. Empty model = the single-model serving
+        # path — its keys are byte-identical to before the multi-model
+        # subsystem existed.
+        h.update(model.encode("utf8", "surrogatepass"))
+        h.update(b"\x01")
+    for t in texts:
+        h.update(t.encode("utf8", "surrogatepass"))
+        h.update(b"\x00")  # unambiguous: ["ab"] != ["a","b"]
+    return h.digest()
+
+
+def etag_for(
+    texts: List[str], model: str = "", generation: Optional[int] = None
+) -> str:
+    """Strong ETag (quoted, per RFC 9110) for a /v1/parse response."""
+    h = hashlib.sha256(cache_key_for(texts, model))
+    h.update(b"\x02")
+    h.update(repr(generation).encode("utf8"))
+    return '"' + h.hexdigest()[:32] + '"'
+
+
+def if_none_match_hit(header: Optional[str], etag: str) -> bool:
+    """Does an If-None-Match header match ``etag``? Handles comma lists
+    and ``*``; weak-comparison (a ``W/`` prefix on a listed tag still
+    matches) because 304 is a cache-freshness decision, not a storage
+    precondition."""
+    if not header:
+        return False
+    for candidate in header.split(","):
+        candidate = candidate.strip()
+        if candidate == "*":
+            return True
+        if candidate.startswith("W/"):
+            candidate = candidate[2:].strip()
+        if candidate == etag:
+            return True
+    return False
 
 
 def mint_request_id() -> str:

@@ -190,6 +190,16 @@ class ServingTelemetry:
         self._requests = self.registry.counter("requests")
         self._docs = self.registry.counter("docs")
         self._batches = self.registry.counter("batches")
+        # padding tax (data plane, docs/SERVING.md): tokens the device
+        # actually computed vs tokens the bucket shape forced it to pad
+        # to — pad share = pad / (pad + real) is the number length-aware
+        # routing exists to reduce, so it must be measured where the
+        # shape is chosen (dispatch assembly), not estimated downstream
+        self._pad_tokens = self.registry.counter("pad_tokens")
+        self._real_tokens = self.registry.counter("real_tokens")
+        # conditional responses: requests answered 304 from the
+        # ETag/If-None-Match check — inference AND serialization skipped
+        self._not_modified = self.registry.counter("not_modified")
         self._rej_full = self.registry.counter("rejected_queue_full")
         self._rej_drain = self.registry.counter("rejected_draining")
         self._rej_quota = self.registry.counter("rejected_quota")
@@ -282,16 +292,24 @@ class ServingTelemetry:
                 args=args,
             )
 
+    def conditional_hit(self) -> None:
+        self._not_modified.inc()
+
     def batch_span(
         self,
         occupancy: int,
         B: int,
         T: int,
         request_ids: Optional[List[str]] = None,
+        real_tokens: Optional[int] = None,
     ):
         self._batches.inc()
         self._occupancy.observe(occupancy)
         self._last_occ.set(occupancy)
+        if real_tokens is not None:
+            # B*T is what the device computes; real is what was asked for
+            self._real_tokens.inc(real_tokens)
+            self._pad_tokens.inc(max(B * T - real_tokens, 0))
         kwargs: Dict[str, Any] = {"occupancy": occupancy, "B": B, "T": T}
         if request_ids:
             # a batch holds at most max_batch_docs requests — small
@@ -663,10 +681,13 @@ class InferenceEngine:
             r.dispatched_at = dispatched_at
         request_ids = [r.request_id for r in requests]
         info = {"occupancy": n, "B": B, "T": T, "generation": generation}
+        real_tokens = sum(len(d) for d in docs)
         t_dev = self.clock()
         try:
             if self.tel is not None:
-                with self.tel.batch_span(n, B, T, request_ids):
+                with self.tel.batch_span(
+                    n, B, T, request_ids, real_tokens=real_tokens
+                ):
                     self.nlp.predict_docs(
                         docs, params=serve_params,
                         batch_size=n, pad_batch_to=B, pad_len_to=T,

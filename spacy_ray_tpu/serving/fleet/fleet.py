@@ -102,6 +102,12 @@ class FleetConfig:
     # the hit-rate x p99 win on skewed traffic; 0 = off)
     cache_mb: float = 32.0
     probe_interval_s: float = 0.5
+    # length-bucket affinity routing (docs/SERVING.md "Data plane"):
+    # steer similar doc lengths to the same replica so device batches
+    # fill one bucket shape instead of padding to the longest straggler.
+    # Off by default — it pays on skewed length mixtures with >1
+    # replica (docs/TUNING.md §24), and is a no-op otherwise.
+    length_routing: bool = False
     # live continuous learning (docs/SERVING.md "Continuous learning"):
     # watch_dir = a TrainCheckpoint directory a training run writes into;
     # new intact generations are canaried onto canary_fraction of the
@@ -288,6 +294,7 @@ class Fleet:
             telemetry=self.tel,
             cache_bytes=int(config.cache_mb * 1024 * 1024),
             probe_interval_s=config.probe_interval_s,
+            length_routing=config.length_routing,
             # the split only activates while ready replicas actually
             # straddle two generations, i.e. during a controller rollout
             canary_fraction=(

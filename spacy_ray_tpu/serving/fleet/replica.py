@@ -109,6 +109,14 @@ class ReplicaHandle:
         self._pool_lock = threading.Lock()
         self._pool: List[http.client.HTTPConnection] = []
         self.pool_cap = 16
+        # control-plane pool (health probes, metrics/exemplar scrapes):
+        # SEPARATE from the hot-path pool because the two dial with
+        # different timeouts — a probe reusing a forward's 60s-timeout
+        # socket would take 60s to notice a hung replica, and a forward
+        # reusing a probe's 5s socket would time out long parses. Small
+        # cap: one prober + a couple of concurrent scrape passes.
+        self._aux_pool: List[http.client.HTTPConnection] = []
+        self.aux_pool_cap = 4
 
     def checkout_conn(self) -> Optional[http.client.HTTPConnection]:
         """Pop an idle keep-alive connection, or None (caller dials)."""
@@ -126,13 +134,29 @@ class ReplicaHandle:
                 return
         conn.close()
 
+    def checkout_aux_conn(self) -> Optional[http.client.HTTPConnection]:
+        """Pop an idle control-plane connection, or None (caller dials)."""
+        with self._pool_lock:
+            if self._aux_pool:
+                return self._aux_pool.pop()
+        return None
+
+    def checkin_aux_conn(self, conn: http.client.HTTPConnection) -> None:
+        with self._pool_lock:
+            if not self.stopping and len(self._aux_pool) < self.aux_pool_cap:
+                self._aux_pool.append(conn)
+                return
+        conn.close()
+
     def close_conns(self) -> None:
-        """Drop every pooled connection (replica died, left rotation, or
-        the fleet is draining — the replica-side handler threads see EOF
-        instead of waiting on an idle socket)."""
+        """Drop every pooled connection — hot path and control plane
+        (replica died, left rotation, or the fleet is draining — the
+        replica-side handler threads see EOF instead of waiting on an
+        idle socket)."""
         with self._pool_lock:
             pool, self._pool = self._pool, []
-        for conn in pool:
+            aux, self._aux_pool = self._aux_pool, []
+        for conn in pool + aux:
             try:
                 conn.close()
             except OSError:

@@ -32,7 +32,6 @@ for the whole fleet instead of N.
 
 from __future__ import annotations
 
-import hashlib
 import http.client
 import json
 import logging
@@ -49,7 +48,10 @@ from ..batcher import (
     REQUEST_ID_HEADER,
     ServingError,
     UnknownModel,
+    cache_key_for,
     clean_request_id,
+    etag_for,
+    if_none_match_hit,
     mint_request_id,
 )
 from ..multimodel.registry import TENANT_HEADER
@@ -82,6 +84,22 @@ class NoReplicaAvailable(ServingError):
 # mid-promotion): no single generation can vouch for a cached body, so
 # the cache is bypassed entirely until the fleet converges
 GENERATION_MIXED = object()
+
+
+def _length_bucket_hint(texts: List[str]) -> int:
+    """Coarse length-bucket index for affinity routing. The router does
+    not tokenize; a whitespace word count approximates token count well
+    enough to BUCKET — the buckets are powers of two, so a near-boundary
+    miss lands one bucket off, which only weakens affinity, never
+    correctness. Keyed on the MAX text (the shape the device batch pads
+    to), same rule as the engine's dispatch assembly."""
+    from ...training.batcher import DEFAULT_LENGTH_BUCKETS
+
+    est = max(len(t.split()) for t in texts)
+    for i, bucket in enumerate(DEFAULT_LENGTH_BUCKETS):
+        if est <= bucket:
+            return i
+    return len(DEFAULT_LENGTH_BUCKETS) - 1
 
 
 class ResponseCache:
@@ -119,26 +137,19 @@ class ResponseCache:
         self.evictions = 0
         self.stale_invalidations = 0
         self.flushes = 0
+        # conditional responses answered body-less (304): the client
+        # already held the exact body this cache (or a replica) would
+        # have sent — hit-adjacent, but zero bytes moved
+        self.not_modified = 0
         # per-model hit/miss ledger (multi-model serving): the model
         # name is a key dimension, so two models' identical texts never
         # collide, and the hit-rate story is attributable per model
         self.by_model: Dict[str, Dict[str, int]] = {}
 
-    @staticmethod
-    def key_for(texts: List[str], model: str = "") -> bytes:
-        h = hashlib.sha256()
-        if model:
-            # model joins the key (distinct models annotate the same
-            # texts differently); \x01 keeps it unambiguous against the
-            # \x00-separated texts. Empty model = the single-model
-            # serving path — its keys are byte-identical to before the
-            # multi-model subsystem existed.
-            h.update(model.encode("utf8", "surrogatepass"))
-            h.update(b"\x01")
-        for t in texts:
-            h.update(t.encode("utf8", "surrogatepass"))
-            h.update(b"\x00")  # unambiguous: ["ab"] != ["a","b"]
-        return h.digest()
+    # the digest lives in batcher.cache_key_for so the replica's ETag
+    # and the router's cache key can never disagree about identity —
+    # the ETag is that key plus the generation (docs/SERVING.md)
+    key_for = staticmethod(cache_key_for)
 
     def _tally(self, model: Optional[str], field: str) -> None:
         """Caller holds ``_lock``."""
@@ -147,7 +158,10 @@ class ResponseCache:
         ledger = self.by_model.setdefault(
             model, {"hits": 0, "misses": 0, "stale_invalidations": 0}
         )
-        ledger[field] += 1
+        # not_modified joins a ledger lazily (first 304 for that model)
+        # so the legacy ledger shape is unchanged for models that never
+        # see a conditional request
+        ledger[field] = ledger.get(field, 0) + 1
 
     def get(
         self, key: bytes, generation: Any = None,
@@ -194,6 +208,11 @@ class ResponseCache:
                 self._nbytes -= len(evicted)
                 self.evictions += 1
 
+    def count_not_modified(self, model: Optional[str] = None) -> None:
+        with self._lock:
+            self.not_modified += 1
+            self._tally(model, "not_modified")
+
     def flush(self) -> int:
         """Drop every entry; returns how many. Called on promotion —
         the old generation's bodies can never hit again (their stamp no
@@ -214,6 +233,7 @@ class ResponseCache:
                 "cache_evictions": self.evictions,
                 "cache_stale_invalidations": self.stale_invalidations,
                 "cache_flushes": self.flushes,
+                "cache_not_modified": self.not_modified,
                 "cache_entries": len(self._entries),
                 "cache_bytes": self._nbytes,
             }
@@ -282,6 +302,14 @@ class RouterTelemetry:
         # ratio the deterministic accumulator promises is auditable here
         self._canary_picks = self.registry.counter("routed_canary")
         self._baseline_picks = self.registry.counter("routed_baseline")
+        # length-affinity accounting (data plane): how often the policy
+        # placed a request on its bucket's replica vs spilled to
+        # least-outstanding because that replica was already loaded —
+        # a high spill share means the mixture defeats the affinity map
+        self._affinity_picks = self.registry.counter("length_affinity_picks")
+        self._affinity_spills = self.registry.counter(
+            "length_affinity_spills"
+        )
 
     def now(self) -> float:
         return self.trace.now()
@@ -348,6 +376,9 @@ class RouterTelemetry:
     def split_pick(self, canary: bool) -> None:
         (self._canary_picks if canary else self._baseline_picks).inc()
 
+    def affinity_pick(self, *, spilled: bool) -> None:
+        (self._affinity_spills if spilled else self._affinity_picks).inc()
+
     def replica_counts(self, ready: int, total: int) -> None:
         self._ready.set(ready)
         self._replicas.set(total)
@@ -382,9 +413,17 @@ class Router:
         forward_timeout_s: float = 60.0,
         canary_fraction: float = 0.0,
         registry: Optional[Any] = None,
+        length_routing: bool = False,
+        affinity_slack: int = 2,
     ) -> None:
         self.replicas = replicas
         self.tel = telemetry
+        # length-bucket affinity (docs/SERVING.md "Data plane"): off by
+        # default — the pad-share win only exists with >1 replica and a
+        # skewed length mixture, and the policy costs a texts parse on
+        # the otherwise byte-proxy hot path
+        self.length_routing = bool(length_routing)
+        self.affinity_slack = int(affinity_slack)
         # multi-model serving (``--model-manifest``): a ModelRegistry
         # lets the router resolve WHICH model a request names (path >
         # header > default) and route within the replicas hosting it;
@@ -451,16 +490,10 @@ class Router:
                 self._mark_unready(h, "no address" if addr is None else "down")
                 continue
             try:
-                conn = http.client.HTTPConnection(
-                    addr[0], addr[1], timeout=self.probe_timeout_s
+                status, raw = self._get_aux(
+                    h, addr, "/healthz", self.probe_timeout_s
                 )
-                try:
-                    conn.request("GET", "/healthz")
-                    resp = conn.getresponse()
-                    raw = resp.read()
-                    ok = resp.status == 200
-                finally:
-                    conn.close()
+                ok = status == 200
             except OSError:
                 ok = False
                 raw = b""
@@ -636,9 +669,26 @@ class Router:
             if h.ready and not h.stopping and h.address is not None
         ]
 
-    def pick(self, model: Optional[str] = None) -> ReplicaHandle:
+    def pick(
+        self,
+        model: Optional[str] = None,
+        length_bucket: Optional[int] = None,
+    ) -> ReplicaHandle:
         """Least-outstanding-requests over the ready set; ties broken by
         lowest id (deterministic, and it keeps warm caches warm).
+
+        With ``length_routing`` armed and a ``length_bucket`` hint
+        (docs/SERVING.md "Data plane"), a deterministic bucket→replica
+        affinity runs WITHIN the final candidate pool — after model
+        narrowing and the canary split, never instead of them — so
+        similar doc lengths land on the same replica and its device
+        batches fill one bucket shape instead of padding to the longest
+        straggler. Affinity is advisory: when the affinity replica is
+        already ``affinity_slack`` requests above the pool's
+        least-loaded, the pick spills to least-outstanding — a skewed
+        length mixture must never starve or overload a replica. With
+        the flag off, a single-replica pool, or no hint, the pick is
+        bit-identical to plain least-outstanding.
 
         With ``model`` (multi-model serving), least-outstanding runs
         WITHIN the subset of ready replicas whose probe-learned resident
@@ -679,6 +729,20 @@ class Router:
                 pool = canary if take_canary else baseline
                 if self.tel is not None:
                     self.tel.split_pick(take_canary)
+        if (
+            self.length_routing
+            and length_bucket is not None
+            and len(pool) > 1
+        ):
+            ordered = sorted(pool, key=lambda h: h.replica_id)
+            target = ordered[length_bucket % len(ordered)]
+            floor = min(h.outstanding for h in pool)
+            if target.outstanding <= floor + self.affinity_slack:
+                if self.tel is not None:
+                    self.tel.affinity_pick(spilled=False)
+                return target
+            if self.tel is not None:
+                self.tel.affinity_pick(spilled=True)
         return min(
             pool, key=lambda h: (h.outstanding, h.replica_id)
         )
@@ -693,14 +757,21 @@ class Router:
         model: Optional[str] = None,
         explicit_model: bool = False,
         tenant: Optional[str] = None,
-    ) -> Tuple[int, bytes, Optional[int]]:
+        length_bucket: Optional[int] = None,
+        if_none_match: Optional[str] = None,
+    ) -> Tuple[int, bytes, Optional[int], Optional[str]]:
         """Route one ``/v1/parse`` body: pick → forward → on socket
         failure mark the replica unready and retry on another. The retry
         budget is one attempt per distinct ready replica (+1): a body
         that fails everywhere means the fleet is down, not the request.
-        Returns ``(status, payload, replica_id)``; ``request_id`` (when
-        given) is forwarded in the ``X-SRT-Request-Id`` header so the
-        replica's spans and response carry the router's id.
+        Returns ``(status, payload, replica_id, etag)`` — ``etag`` is
+        the replica's ``ETag`` response header (None when absent);
+        ``request_id`` (when given) is forwarded in the
+        ``X-SRT-Request-Id`` header so the replica's spans and response
+        carry the router's id. ``length_bucket`` is the affinity hint
+        ``pick`` consumes; ``if_none_match`` rides through to the
+        replica so ITS conditional check can answer a body-less 304
+        even when the router's own cache could not.
 
         ``model`` (multi-model serving) narrows ``pick`` to the replicas
         hosting it; when the client NAMED the model (``explicit_model``,
@@ -725,7 +796,13 @@ class Router:
             f"/v1/models/{model}/parse"
             if model is not None and explicit_model else "/v1/parse"
         )
-        extra_headers = {TENANT_HEADER: tenant} if tenant else None
+        extra_headers: Optional[Dict[str, str]] = None
+        if tenant or if_none_match:
+            extra_headers = {}
+            if tenant:
+                extra_headers[TENANT_HEADER] = tenant
+            if if_none_match:
+                extra_headers["If-None-Match"] = if_none_match
         with self._inflight_lock:
             self._inflight += 1
         try:
@@ -734,14 +811,15 @@ class Router:
             last_err: Optional[Exception] = None
             while attempts < max_attempts:
                 attempts += 1
-                h = self.pick(model)  # raises NoReplicaAvailable on empty
+                # raises NoReplicaAvailable on empty ready set
+                h = self.pick(model, length_bucket=length_bucket)
                 addr = h.address
                 if addr is None:
                     continue
                 with h.lock:
                     h.outstanding += 1
                 try:
-                    status, payload = self._post(
+                    status, payload, etag = self._post(
                         h, addr, path, body,
                         timeout_s or self.forward_timeout_s,
                         request_id=request_id,
@@ -761,7 +839,7 @@ class Router:
                                 h.replica_id, "Replica503", request_id
                             )
                         continue
-                    return status, payload, h.replica_id
+                    return status, payload, h.replica_id, etag
                 except OSError as e:
                     # crashed or restarting mid-request: out of rotation
                     # NOW; the prober re-adds it when /healthz recovers
@@ -809,11 +887,18 @@ class Router:
         A fresh TCP dial + replica-side handler-thread spawn per forward
         costs more than a small parse itself, so idle connections are
         pooled per handle. A pooled connection can have gone stale (the
-        replica restarted, or closed it while idle): that failure gets
-        ONE retry on a freshly dialed connection before the error
+        replica restarted, or closed it while idle) — and when one is,
+        usually ALL of them are: a restart severs the whole pool at
+        once. A stale failure therefore retries on the NEXT pooled
+        connection (draining the severed pool one checkout at a time)
+        and finally on a freshly dialed connection before the error
         propagates — safe to resend because ``/v1/parse`` is pure.
         Failures on a fresh dial surface as OSError (the contract
         ``forward_parse``'s replica-level retry loop keys on).
+
+        Returns ``(status, payload, etag)`` — the replica's ``ETag``
+        response header rides along so the edge can propagate it to the
+        client without parsing the body.
         """
         headers = {"Content-Type": "application/json"}
         if request_id is not None:
@@ -834,7 +919,8 @@ class Router:
             except (OSError, http.client.HTTPException) as e:
                 conn.close()
                 if not fresh:
-                    conn = None
+                    # try the next pooled conn; None → one fresh dial
+                    conn = h.checkout_conn()
                     continue
                 if not isinstance(e, OSError):
                     raise OSError(f"replica HTTP protocol error: {e!r}")
@@ -843,6 +929,42 @@ class Router:
                 conn.close()
             else:
                 h.checkin_conn(conn)
+            return resp.status, payload, resp.getheader("ETag")
+
+    @staticmethod
+    def _get_aux(
+        h: ReplicaHandle, addr: Tuple[str, int], path: str, timeout_s: float
+    ) -> Tuple[int, bytes]:
+        """GET over a pooled control-plane connection. Probes and
+        scrapes repeat every ``probe_interval_s`` forever — dialing
+        fresh each pass adds up to more control-plane TCP churn than
+        the data plane's, for sockets to the very same replicas. Same
+        stale discipline as ``_post``: a stale pooled socket retries on
+        the next pooled one, then one fresh dial; failures surface as
+        OSError (what every caller already treats as "unhealthy")."""
+        conn = h.checkout_aux_conn()
+        while True:
+            fresh = conn is None
+            if fresh:
+                conn = http.client.HTTPConnection(
+                    addr[0], addr[1], timeout=timeout_s
+                )
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                payload = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                if not fresh:
+                    conn = h.checkout_aux_conn()
+                    continue
+                if not isinstance(e, OSError):
+                    raise OSError(f"replica HTTP protocol error: {e!r}")
+                raise
+            if resp.will_close:
+                conn.close()
+            else:
+                h.checkin_aux_conn(conn)
             return resp.status, payload
 
     # -- placement (multi-model) -----------------------------------------
@@ -910,16 +1032,10 @@ class Router:
             if addr is None:
                 return
             try:
-                conn = http.client.HTTPConnection(
-                    addr[0], addr[1], timeout=self.probe_timeout_s
+                status, raw = self._get_aux(
+                    h, addr, "/metrics", self.probe_timeout_s
                 )
-                try:
-                    conn.request("GET", "/metrics")
-                    resp = conn.getresponse()
-                    raw = resp.read()
-                finally:
-                    conn.close()
-                if resp.status == 200:
+                if status == 200:
                     snap = json.loads(raw)
                     if isinstance(snap, dict):
                         snap["replica_id"] = h.replica_id
@@ -975,16 +1091,10 @@ class Router:
             if addr is None:
                 continue
             try:
-                conn = http.client.HTTPConnection(
-                    addr[0], addr[1], timeout=self.probe_timeout_s
+                status, raw = self._get_aux(
+                    h, addr, "/admin/exemplars", self.probe_timeout_s
                 )
-                try:
-                    conn.request("GET", "/admin/exemplars")
-                    resp = conn.getresponse()
-                    raw = resp.read()
-                finally:
-                    conn.close()
-                if resp.status == 200:
+                if status == 200:
                     payload = json.loads(raw)
                     if isinstance(payload, dict):
                         payload["replica_id"] = h.replica_id
@@ -1139,6 +1249,7 @@ class Router:
                 "cache_hits", "cache_misses", "cache_evictions",
                 "cache_stale_invalidations", "cache_flushes",
                 "cache_mixed_generation_bypasses",
+                "cache_not_modified",
             ):
                 fam.add(
                     f"srt_router_{key}_total", "counter",
@@ -1152,7 +1263,10 @@ class Router:
             for model_name, ledger in sorted(
                 (cache_stats.get("by_model") or {}).items()
             ):
-                for key in ("hits", "misses", "stale_invalidations"):
+                for key in (
+                    "hits", "misses", "stale_invalidations",
+                    "not_modified",
+                ):
                     fam.add(
                         f"srt_router_model_cache_{key}_total", "counter",
                         ledger.get(key), {"model": model_name},
@@ -1206,14 +1320,31 @@ class _RouterHandler(BaseHTTPRequestHandler):
         body: bytes,
         request_id: Optional[str] = None,
         content_type: str = "application/json",
+        etag: Optional[str] = None,
     ) -> None:
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         if request_id is not None:
             self.send_header(REQUEST_ID_HEADER, request_id)
+        if etag is not None:
+            self.send_header("ETag", etag)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
-        self.wfile.write(body)
+        if body:
+            self.wfile.write(body)
+
+    def _reply_not_modified(
+        self, etag: Optional[str], request_id: Optional[str] = None
+    ) -> None:
+        """Body-less 304 from the edge: the client's cached body is
+        still exact for the fleet's converged generation."""
+        self.send_response(304)
+        if etag:
+            self.send_header("ETag", etag)
+        if request_id is not None:
+            self.send_header(REQUEST_ID_HEADER, request_id)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
 
     def _reply(
         self,
@@ -1369,6 +1500,17 @@ class _RouterHandler(BaseHTTPRequestHandler):
         # generations (rollout/promotion in flight) the cache is
         # bypassed entirely — a stale cached annotation must never
         # outlive a promotion
+        # texts are parsed ONLY when a policy needs them (the response
+        # cache, the length-affinity hint, or a conditional request to
+        # validate) — otherwise the router stays a pure byte proxy
+        inm = self.headers.get("If-None-Match")
+        texts: Optional[List[str]] = None
+        if router.cache is not None or router.length_routing:
+            texts = self._texts_from(body)
+        length_bucket = (
+            _length_bucket_hint(texts)
+            if router.length_routing and texts is not None else None
+        )
         cache_key: Optional[bytes] = None
         cache_gen: Any = GENERATION_MIXED
         if router.cache is not None:
@@ -1382,7 +1524,6 @@ class _RouterHandler(BaseHTTPRequestHandler):
             # on the converged path too, so it is not a "bypass"), and
             # the parse cost during a rollout window equals what the
             # converged path already pays per cacheable request
-            texts = self._texts_from(body)
             if texts is not None:
                 if cache_gen is GENERATION_MIXED:
                     # the bypass the generation discipline mandates —
@@ -1395,10 +1536,28 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     # about to be rejected no_replica — tallying it as
                     # a "rollout window" would inflate the counter
                     # during startup and outages with bypasses that
-                    # never happened.
+                    # never happened. The conditional check is bypassed
+                    # on exactly the same verdict: no single generation
+                    # can vouch for a client's cached body either, so
+                    # If-None-Match is neither answered here nor
+                    # forwarded (satellite of the PR 11 discipline).
                     if router.ready_handles():
                         router.count_cache_bypass()
+                        inm = None
                 else:
+                    # converged fleet: the ETag is a pure function of
+                    # (texts, model, generation), all known HERE — a
+                    # matching If-None-Match is a body-less 304 with no
+                    # forward at all, even when the cache never stored
+                    # this body (the CLIENT holds it; the tag alone
+                    # vouches for its freshness)
+                    edge_etag = etag_for(
+                        texts, model_name or "", cache_gen
+                    )
+                    if if_none_match_hit(inm, edge_etag):
+                        router.cache.count_not_modified(model_name)
+                        self._reply_not_modified(edge_etag, request_id)
+                        return
                     cache_key = ResponseCache.key_for(
                         texts, model=model_name or ""
                     )
@@ -1408,15 +1567,19 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     if hit is not None:
                         if router.tel is not None:
                             router.tel.cache_hit()
-                        self._reply_bytes(200, hit, request_id)
+                        self._reply_bytes(
+                            200, hit, request_id, etag=edge_etag
+                        )
                         return
         t0 = time.perf_counter()
         span_t0 = router.tel.now() if router.tel is not None else None
         try:
-            status, payload, replica_id = router.forward_parse(
+            status, payload, replica_id, fwd_etag = router.forward_parse(
                 body, request_id=request_id,
                 model=model_name, explicit_model=explicit_model,
                 tenant=tenant,
+                length_bucket=length_bucket,
+                if_none_match=inm,
             )
         except ServingError as e:
             if router.tel is not None:
@@ -1460,7 +1623,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
             else:
                 gen = serving.generation
             router.cache.put(cache_key, payload, gen)
-        self._reply_bytes(status, payload, request_id)
+        if status == 304:
+            # a replica's own conditional check fired (the cache-off or
+            # registry-less edge still honors If-None-Match end to end);
+            # counted in the cache ledger when one exists — the 304
+            # share must be one number however it was answered
+            if router.cache is not None:
+                router.cache.count_not_modified(model_name)
+            self._reply_not_modified(fwd_etag, request_id)
+            return
+        self._reply_bytes(status, payload, request_id, etag=fwd_etag)
 
     @staticmethod
     def _texts_from(body: bytes) -> Optional[List[str]]:

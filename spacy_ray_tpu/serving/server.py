@@ -45,6 +45,8 @@ from .batcher import (
     SwapFailed,
     UnknownModel,
     clean_request_id,
+    etag_for,
+    if_none_match_hit,
     mint_request_id,
 )
 from .engine import InferenceEngine, ServingTelemetry
@@ -112,6 +114,7 @@ class _Handler(BaseHTTPRequestHandler):
         status: int,
         payload: Dict[str, Any],
         request_id: Optional[str] = None,
+        etag: Optional[str] = None,
     ) -> None:
         body = json.dumps(payload).encode("utf8")
         self.send_response(status)
@@ -120,9 +123,24 @@ class _Handler(BaseHTTPRequestHandler):
             # the trace identity rides the response on EVERY outcome —
             # a 504 is exactly the response whose id gets looked up
             self.send_header(REQUEST_ID_HEADER, request_id)
+        if etag is not None:
+            self.send_header("ETag", etag)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _reply_not_modified(
+        self, etag: str, request_id: Optional[str] = None
+    ) -> None:
+        """Body-less 304: the client's cached body is still exact. A 304
+        carries no body by definition, but Content-Length: 0 is stamped
+        anyway so naive keep-alive clients can't desync the stream."""
+        self.send_response(304)
+        self.send_header("ETag", etag)
+        if request_id is not None:
+            self.send_header(REQUEST_ID_HEADER, request_id)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
 
     def _reply_text(self, status: int, text: str, content_type: str) -> None:
         body = text.encode("utf8")
@@ -488,6 +506,22 @@ class _Handler(BaseHTTPRequestHandler):
                     self.server.tel.request_rejected(e, request_id)
                 self._reply_error(e, request_id)
                 return
+        # conditional response (docs/SERVING.md "Data plane"): the ETag
+        # is a pure function of (texts, model, generation), so it is
+        # known HERE, before any inference — a matching If-None-Match
+        # skips the queue, the device, and serialization entirely. The
+        # check validates against the CURRENT generation: post-swap, the
+        # tag differs and the request falls through to a full parse.
+        admission_etag = etag_for(
+            texts, model_name or "", engine.serving_generation
+        )
+        if if_none_match_hit(
+            self.headers.get("If-None-Match"), admission_etag
+        ):
+            if engine.tel is not None:
+                engine.tel.conditional_hit()
+            self._reply_not_modified(admission_etag, request_id)
+            return
         try:
             req = engine.submit_texts(
                 texts, timeout_s=timeout_s, request_id=request_id,
@@ -525,10 +559,16 @@ class _Handler(BaseHTTPRequestHandler):
                 T=req.batch_info.get("T"),
                 generation=req.batch_info.get("generation"),
             )
+        # the stamped ETag uses the generation the batch ACTUALLY ran on
+        # (a swap can land between admission and dispatch) — the tag must
+        # identify the body it rides, not the body admission expected
         self._reply(
             200,
             {"docs": docs_json, "batch": req.batch_info},
             request_id,
+            etag=etag_for(
+                texts, model_name or "", req.batch_info.get("generation")
+            ),
         )
 
 

@@ -240,6 +240,14 @@ class TopModel:
                     cache.get("cache_mixed_generation_bypasses")
                     if isinstance(cache, dict) else None
                 ),
+                # data plane (PR 20): fleet-wide padded-token share
+                # from the engines' dispatch assembly, and conditional
+                # (304) responses from the cache ledger
+                "pad_share": _pad_share(counters),
+                "not_modified": (
+                    cache.get("cache_not_modified")
+                    if isinstance(cache, dict) else None
+                ),
                 "quota_s": rates.get("rejected_quota"),
                 "models": models,
                 "alerts": payload.get("alerts"),
@@ -359,11 +367,26 @@ class TopModel:
                 + (rates.get("deadline_exceeded") or 0.0)
             ) if rates else None,
             "exemplars": counters.get("slow_exemplars"),
+            "pad_share": _pad_share(counters),
             "quota_s": rates.get("rejected_quota"),
             "models": models,
             "alerts": payload.get("alerts"),
             **_process_cols(payload),
         }
+
+
+def _pad_share(counters: Dict[str, Any]) -> Optional[float]:
+    """Lifetime padded-token share from the pad/real counter pair the
+    engine's dispatch assembly exports; None before any batch ran (or
+    against an older endpoint without the counters)."""
+    pad = counters.get("pad_tokens")
+    real = counters.get("real_tokens")
+    if not isinstance(pad, (int, float)) or not isinstance(
+        real, (int, float)
+    ):
+        return None
+    total = pad + real
+    return (pad / total) if total > 0 else None
 
 
 def _fmt_alerts(block: Any) -> str:
@@ -430,6 +453,8 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
             )
             hr = row.get("cache_hit_rate")
             cache_s = f"{hr * 100:.0f}%" if isinstance(hr, float) else "-"
+            ps = row.get("pad_share")
+            pad_s = f"{ps * 100:.0f}%" if isinstance(ps, float) else "-"
             lines.append(
                 f"    queue {_fmt_int(row.get('queue_depth'))}  "
                 f"occ p50 {_fmt_int(row.get('occupancy'))}  "
@@ -437,6 +462,8 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 f"rej {_fmt_rate(row.get('reject_s'))}  "
                 f"429-quota {_fmt_rate(row.get('quota_s'))}  "
                 f"cache {cache_s}  "
+                f"pad {pad_s}  "
+                f"304 {_fmt_int(row.get('not_modified'))}  "
                 f"scrape-fail {_fmt_int(row.get('scrape_failures'))}  "
                 f"{_fmt_host(row)}  "
                 f"alerts {_fmt_alerts(row.get('alerts'))}"
@@ -492,6 +519,8 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 f"gen {row.get('generation') if row.get('generation') is not None else '-'}"
                 f"  swaps {_fmt_int(row.get('swaps'))}"
             )
+            ps = row.get("pad_share")
+            pad_s = f"{ps * 100:.0f}%" if isinstance(ps, float) else "-"
             lines.append(
                 f"    req {_fmt_rate(row.get('req_s'))}  "
                 f"win p50 {_fmt_ms(row.get('p50'))}  "
@@ -500,6 +529,7 @@ def render(rows: List[Dict[str, Any]], *, now_label: str = "") -> str:
                 f"occ {_fmt_int(row.get('occupancy'))}  "
                 f"rej {_fmt_rate(row.get('reject_s'))}  "
                 f"429-quota {_fmt_rate(row.get('quota_s'))}  "
+                f"pad {pad_s}  "
                 f"slow-exemplars {_fmt_int(row.get('exemplars'))}  "
                 f"{_fmt_host(row)}  "
                 f"alerts {_fmt_alerts(row.get('alerts'))}"

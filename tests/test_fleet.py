@@ -50,14 +50,19 @@ class _StubServer(ThreadingHTTPServer):
 
 class _StubHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # keep-alive + Nagle + delayed ACK stalls ~40ms between the header
+    # and body writes (the real servers disable it too)
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):  # quiet
         pass
 
-    def _reply(self, status, payload):
+    def _reply(self, status, payload, etag=None):
         body = json.dumps(payload).encode("utf8")
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
+        if etag:
+            self.send_header("ETag", etag)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -89,6 +94,16 @@ class _StubHandler(BaseHTTPRequestHandler):
             self._reply(503, {"error": "draining",
                               "message": "draining; not admitting"})
             return
+        if stub.etag is not None:
+            # mimic the real replica's conditional-response path: a
+            # matching If-None-Match validator gets a body-less 304
+            inm = self.headers.get("If-None-Match")
+            if inm is not None and inm in (stub.etag, "*"):
+                self.send_response(304)
+                self.send_header("ETag", stub.etag)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
         if stub.latency_s:
             time.sleep(stub.latency_s)
         batch = {"occupancy": 1}
@@ -98,7 +113,8 @@ class _StubHandler(BaseHTTPRequestHandler):
             batch["generation"] = stub.generation
         self._reply(
             200, {"docs": [{"stub": stub.tag, "gen": stub.generation}],
-                  "batch": batch}
+                  "batch": batch},
+            etag=stub.etag,
         )
 
 
@@ -107,11 +123,12 @@ class StubReplica:
     (``warming`` flips readiness, ``close()`` simulates a crash)."""
 
     def __init__(self, *, warming=False, latency_s=0.0, snapshot=None,
-                 tag="stub", generation=None):
+                 tag="stub", generation=None, etag=None):
         self.warming = warming
         self.draining = False
         self.latency_s = latency_s
         self.generation = generation
+        self.etag = etag
         self.swap_count = 0
         self.snapshot = snapshot or {"counters": {}, "gauges": {},
                                      "histograms": {}, "slo": {}}
@@ -147,6 +164,22 @@ def _post(host, port, payload, timeout=30.0, path="/v1/parse"):
                      {"Content-Type": "application/json"})
         resp = conn.getresponse()
         return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _post_raw(host, port, payload, headers=None, timeout=30.0,
+              path="/v1/parse"):
+    """Like _post but returns (status, body_bytes, response_headers)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        body = json.dumps(payload).encode("utf8")
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        conn.request("POST", path, body, hdrs)
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
     finally:
         conn.close()
 
@@ -524,6 +557,325 @@ def test_router_cache_bypassed_while_generations_mixed():
         s2.close()
 
 
+# ----------------------------------------------------------------------
+# Data plane (PR 20): conditional responses, length affinity, conn pools
+# ----------------------------------------------------------------------
+
+
+def test_router_edge_conditional_304_and_promotion_invalidates():
+    """Tentpole (c): the edge answers a matching If-None-Match with a
+    body-less 304 without forwarding; a generation promotion changes the
+    tag, so held validators go stale exactly when the cache does."""
+    from spacy_ray_tpu.serving.batcher import etag_for
+
+    texts = ["the cat runs"]
+    stub = StubReplica(tag="origin", generation=1,
+                       etag=etag_for(texts, "", 1))
+    handle = make_handle(0, stub)
+    router = Router(lambda: [handle], cache_bytes=1 << 20)
+    httpd, host, port = serve_router(router)
+    try:
+        router.probe_once()  # learn generation 1
+        body = {"texts": texts}
+        status, raw, headers = _post_raw(host, port, body)
+        assert status == 200
+        tag1 = headers["ETag"]
+        assert tag1 == etag_for(texts, "", 1)
+
+        # conditional revalidation: 304, no body, no forward, counted
+        status, raw, headers = _post_raw(
+            host, port, body, headers={"If-None-Match": tag1}
+        )
+        assert status == 304 and raw == b""
+        assert headers["ETag"] == tag1
+        assert stub.parse_calls == 1
+        assert router.cache.stats()["cache_not_modified"] == 1
+        # the 304 check runs BEFORE the cache lookup: hit stats clean
+        assert router.cache.stats()["cache_hits"] == 0
+
+        # an unconditional repeat is a cache hit and carries the tag
+        status, raw, headers = _post_raw(host, port, body)
+        assert status == 200 and headers["ETag"] == tag1
+        assert stub.parse_calls == 1
+        assert router.cache.stats()["cache_hits"] == 1
+
+        # promotion: generation 2 invalidates every held validator
+        stub.generation = 2
+        stub.etag = etag_for(texts, "", 2)
+        router.probe_once()
+        status, raw, headers = _post_raw(
+            host, port, body, headers={"If-None-Match": tag1}
+        )
+        assert status == 200, "stale validator must get the full body"
+        tag2 = headers["ETag"]
+        assert tag2 == etag_for(texts, "", 2) and tag2 != tag1
+        assert stub.parse_calls == 2  # forwarded, not answered stale
+        # ...and the NEW validator revalidates again
+        status, raw, _ = _post_raw(
+            host, port, body, headers={"If-None-Match": tag2}
+        )
+        assert status == 304 and stub.parse_calls == 2
+        assert router.cache.stats()["cache_not_modified"] == 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        stub.close()
+
+
+def test_router_304_suppressed_while_generations_mixed():
+    """Mid-rollout no single generation can vouch for a validator, so
+    If-None-Match is neither answered at the edge nor forwarded — the
+    client gets the full body, exactly like the cache bypass."""
+    s1 = StubReplica(tag="old", generation=1, etag='"x"')
+    s2 = StubReplica(tag="new", generation=2, etag='"x"')
+    h1, h2 = make_handle(0, s1), make_handle(1, s2)
+    router = Router(lambda: [h1, h2], cache_bytes=1 << 20)
+    httpd, host, port = serve_router(router)
+    try:
+        router.probe_once()
+        # "*" matches ANY tag — if the edge consulted it, or forwarded
+        # it to the etag-honoring stub, this would come back 304
+        status, raw, _ = _post_raw(
+            host, port, {"texts": ["x"]}, headers={"If-None-Match": "*"}
+        )
+        assert status == 200
+        assert json.loads(raw)["docs"]
+        assert router.cache.stats().get("cache_not_modified", 0) == 0
+        assert router.cache_stats()["cache_mixed_generation_bypasses"] == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        s1.close()
+        s2.close()
+
+
+def test_router_replica_304_passthrough():
+    """A replica-side 304 (cache off at the edge, or edge tag mismatch)
+    passes through as a body-less 304 with the replica's ETag."""
+    stub = StubReplica(tag="origin", etag='"abc"')
+    handle = make_handle(0, stub)
+    router = Router(lambda: [handle])  # no cache armed
+    httpd, host, port = serve_router(router)
+    try:
+        status, raw, headers = _post_raw(
+            host, port, {"texts": ["x"]}, headers={"If-None-Match": '"abc"'}
+        )
+        assert status == 304 and raw == b""
+        assert headers["ETag"] == '"abc"'
+        assert stub.parse_calls == 1  # the replica answered, cheaply
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        stub.close()
+
+
+def test_router_replica_304_passthrough_counted_with_cache_armed():
+    stub = StubReplica(tag="origin", generation=1, etag='"abc"')
+    handle = make_handle(0, stub)
+    router = Router(lambda: [handle], cache_bytes=1 << 20)
+    httpd, host, port = serve_router(router)
+    try:
+        router.probe_once()
+        # '"abc"' is not the edge tag for these texts, so the edge
+        # forwards the validator; the stub replies 304
+        status, raw, headers = _post_raw(
+            host, port, {"texts": ["x"]}, headers={"If-None-Match": '"abc"'}
+        )
+        assert status == 304 and raw == b""
+        assert router.cache.stats()["cache_not_modified"] == 1
+        assert router.cache.stats()["cache_misses"] == 1
+        assert len(router.cache) == 0  # a 304 has no body to cache
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        stub.close()
+
+
+def _mk_handle(replica_id, port=19000):
+    h = ReplicaHandle(replica_id)
+    h.set_address("127.0.0.1", port + replica_id)
+    h.ready = True
+    return h
+
+
+def test_length_routing_degenerate_cases_match_least_outstanding():
+    """Satellite: flag off, no hint, single replica, or a model hosted
+    by one replica — the pick is bit-identical to least-outstanding."""
+    handles = [_mk_handle(i) for i in range(3)]
+    handles[0].outstanding = 2
+    handles[1].outstanding = 0
+    handles[2].outstanding = 1
+
+    off = Router(lambda: handles, length_routing=False)
+    assert off.pick(length_bucket=3) is handles[1]  # flag off: hint inert
+
+    tel = RouterTelemetry()
+    on = Router(lambda: handles, length_routing=True, telemetry=tel)
+    assert on.pick() is handles[1]  # no hint: plain least-outstanding
+    single = [_mk_handle(0, port=19100)]
+    on_single = Router(lambda: single, length_routing=True, telemetry=tel)
+    assert on_single.pick(length_bucket=5) is single[0]
+    # model narrowing to a single host: affinity never reroutes it
+    handles[2].resident_models = {"m": {}}
+    assert on.pick(model="m", length_bucket=0) is handles[2]
+    counters = tel.snapshot()["counters"]
+    assert counters["length_affinity_picks"] == 0
+    assert counters["length_affinity_spills"] == 0
+
+
+def test_length_affinity_bucket_mapping_and_spill():
+    tel = RouterTelemetry()
+    handles = [_mk_handle(i) for i in range(2)]
+    router = Router(lambda: handles, length_routing=True, telemetry=tel)
+    # equal load: bucket index maps deterministically over sorted ids
+    assert router.pick(length_bucket=0) is handles[0]
+    assert router.pick(length_bucket=1) is handles[1]
+    assert router.pick(length_bucket=2) is handles[0]
+    assert router.pick(length_bucket=3) is handles[1]
+    assert tel.snapshot()["counters"]["length_affinity_picks"] == 4
+    # the affinity target more than affinity_slack above the floor:
+    # spill to least-outstanding — affinity is advisory, never a queue
+    handles[0].outstanding = 3
+    assert router.pick(length_bucket=0) is handles[1]
+    counters = tel.snapshot()["counters"]
+    assert counters["length_affinity_spills"] == 1
+
+
+def test_length_affinity_skewed_mixture_no_starvation():
+    """A single-bucket (fully skewed) stream must keep spilling to the
+    other replica: load imbalance stays bounded by the slack."""
+    tel = RouterTelemetry()
+    handles = [_mk_handle(i) for i in range(2)]
+    router = Router(lambda: handles, length_routing=True, telemetry=tel)
+    picked = []
+    for _ in range(12):  # every request hints the same bucket
+        h = router.pick(length_bucket=1)
+        h.outstanding += 1
+        picked.append(h.replica_id)
+    assert set(picked) == {0, 1}, "skewed mixture starved a replica"
+    assert abs(handles[0].outstanding - handles[1].outstanding) <= \
+        router.affinity_slack + 1
+    counters = tel.snapshot()["counters"]
+    assert counters["length_affinity_spills"] >= 1
+    assert counters["length_affinity_picks"] >= 1
+
+
+def _pad_for(lengths, batch=4):
+    """Padded-token cost of dispatching `lengths` in arrival order in
+    fixed chunks, each padded to its bucketed max — the same bucket
+    table the serving engine pads to."""
+    from spacy_ray_tpu.training.batcher import DEFAULT_LENGTH_BUCKETS
+
+    pad = 0
+    for i in range(0, len(lengths), batch):
+        chunk = lengths[i:i + batch]
+        t = next(
+            (b for b in DEFAULT_LENGTH_BUCKETS if b >= max(chunk)),
+            max(chunk),
+        )
+        pad += len(chunk) * t - sum(chunk)
+    return pad
+
+
+def test_length_affinity_cuts_pad_on_bimodal_mix():
+    """Satellite: on a bimodal length mixture, bucket affinity segregates
+    short from long docs per replica, and the padded-token cost of the
+    resulting dispatch order is strictly below length-blind routing."""
+    from spacy_ray_tpu.serving.fleet.router import _length_bucket_hint
+
+    # 64 docs, half 5 words (bucket 16) and half 100 words (bucket 128),
+    # interleaved so blind least-outstanding mixes them on both replicas
+    pattern = [5, 5, 100, 5, 100, 100, 5, 100] * 8
+
+    def route(use_affinity):
+        handles = [_mk_handle(i, port=19200) for i in range(2)]
+        router = Router(
+            lambda: handles, length_routing=use_affinity,
+            telemetry=RouterTelemetry(),
+        )
+        assigned = {0: [], 1: []}
+        for n_words in pattern:
+            hint = _length_bucket_hint(["w " * n_words]) \
+                if use_affinity else None
+            h = router.pick(length_bucket=hint)
+            assigned[h.replica_id].append(n_words)
+            h.outstanding += 1  # steady accumulation under load
+        return assigned
+
+    blind = route(False)
+    affine = route(True)
+    # no starvation: both replicas carry a fair share either way
+    assert min(len(v) for v in affine.values()) >= len(pattern) // 4
+    # segregation: each replica's stream is length-homogeneous
+    assert all(len(set(v)) == 1 for v in affine.values())
+    pad_blind = _pad_for(blind[0]) + _pad_for(blind[1])
+    pad_affine = _pad_for(affine[0]) + _pad_for(affine[1])
+    assert pad_affine < pad_blind, (
+        f"affinity did not cut pad: {pad_affine} >= {pad_blind}"
+    )
+
+
+def test_stale_pooled_conns_drained_then_fresh_dial_no_5xx():
+    """Satellite: a replica restart severs every pooled socket at once.
+    The forward path must drain the stale pool — retrying each pooled
+    conn — and land on a fresh dial, never surfacing a client 5xx."""
+    live = StubReplica(tag="live")
+    gone = StubReplica(tag="gone")
+    gone.close()  # the old incarnation's port: dials now refused
+    try:
+        h = make_handle(0, live)
+        for _ in range(3):  # the severed pool a restart leaves behind
+            h.checkin_conn(
+                http.client.HTTPConnection("127.0.0.1", gone.port,
+                                           timeout=5.0)
+            )
+        router = Router(lambda: [h])
+        httpd, host, port = serve_router(router)
+        try:
+            for _ in range(4):
+                status, payload = _post(host, port, {"texts": ["x"]})
+                assert status == 200
+                assert payload["docs"][0]["stub"] == "live"
+            assert live.parse_calls == 4
+            assert h.ready  # the stale drain never marked it unhealthy
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+    finally:
+        live.close()
+
+
+def test_probe_and_scrape_survive_stale_aux_conns():
+    """Control-plane pooling has the same stale discipline: a poisoned
+    aux pool never fails a probe or a scrape against a live replica."""
+    live = StubReplica(
+        snapshot={"counters": {"requests": 7}, "gauges": {},
+                  "histograms": {}, "slo": {}},
+    )
+    gone = StubReplica()
+    gone.close()
+    try:
+        h = make_handle(0, live, ready=False)
+
+        def poison():
+            for _ in range(2):
+                h.checkin_aux_conn(
+                    http.client.HTTPConnection("127.0.0.1", gone.port,
+                                               timeout=5.0)
+                )
+
+        router = Router(lambda: [h])
+        poison()
+        assert router.probe_once() == 1
+        assert h.ready
+        poison()
+        snaps = router.scrape_replica_metrics()
+        assert len(snaps) == 1
+        assert snaps[0]["counters"]["requests"] == 7
+    finally:
+        live.close()
+
+
 def test_controller_finish_flushes_cache_on_promote(tmp_path):
     """The live controller's promotion hook: a promote (generation
     change fleet-wide) flushes the response cache eagerly."""
@@ -610,8 +962,12 @@ def test_router_metrics_endpoint_aggregates_replicas():
         assert fleet["slo"]["request_latency_p99_worst"] == 0.3
         assert {r["id"] for r in metrics["replicas"]} == {0, 1}
         assert "router" in metrics  # the router's own counters ride along
-        # an unreachable replica is skipped, not fatal
+        # an unreachable replica is skipped, not fatal. close() only
+        # stops the stub's LISTENER (its keep-alive handler threads live
+        # on), so sever the router's pooled control-plane conns too —
+        # that is what a real process death does to every socket
         stubs[0].close()
+        handles[0].close_conns()
         handles[0].ready = True  # stale — scrape must tolerate it
         status, metrics = _get(host, port, "/metrics")
         assert status == 200 and metrics["fleet"]["replicas"] == 1

@@ -33,6 +33,11 @@ from spacy_ray_tpu.serving import (
     ServingTelemetry,
     warmup_buckets,
 )
+from spacy_ray_tpu.serving.batcher import (
+    cache_key_for,
+    etag_for,
+    if_none_match_hit,
+)
 from spacy_ray_tpu.util import synth_corpus
 
 SERVE_CFG = """
@@ -460,6 +465,104 @@ def test_too_long_doc_rejected_413(served):
     )
     assert status == 413
     assert payload["error"] == "request_too_large"
+
+
+# ----------------------------------------------------------------------
+# Conditional responses (ETag / If-None-Match) and pad accounting
+# ----------------------------------------------------------------------
+
+
+def _post_raw(host, port, payload, headers=None, timeout=30.0):
+    """Like _post but returns (status, body_bytes, response_headers)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        body = json.dumps(payload).encode("utf8")
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        conn.request("POST", "/v1/parse", body, hdrs)
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def test_etag_helpers_are_model_and_generation_sensitive():
+    texts = ["the cat runs fast today"]
+    base = etag_for(texts, "", 0)
+    assert base.startswith('"') and base.endswith('"')
+    # same inputs -> same tag; any axis change -> different tag
+    assert etag_for(texts, "", 0) == base
+    assert etag_for(texts, "alpha", 0) != base
+    assert etag_for(texts, "", 1) != base
+    assert etag_for(["other text"], "", 0) != base
+    # the text digest is the shared response-cache key
+    assert cache_key_for(texts, "alpha") != cache_key_for(texts, "beta")
+    # If-None-Match grammar: exact, list, weak validator, wildcard
+    assert if_none_match_hit(base, base)
+    assert if_none_match_hit(f'"nope", {base}', base)
+    assert if_none_match_hit(f"W/{base}", base)
+    assert if_none_match_hit("*", base)
+    assert not if_none_match_hit(None, base)
+    assert not if_none_match_hit('"nope"', base)
+
+
+def test_replica_etag_and_conditional_304(served):
+    """A replica stamps a strong ETag on every 200; a matching
+    If-None-Match is answered 304 with no body at admission (before the
+    queue), counted as not_modified; a stale tag gets the full 200."""
+    engine, tel, host, port = served
+    texts = [TEXTS[0]]
+    status, body, headers = _post_raw(host, port, {"texts": texts})
+    assert status == 200
+    etag = headers["ETag"]
+    assert etag == etag_for(texts, "", engine.serving_generation)
+
+    before = tel.snapshot()["counters"].get("not_modified", 0)
+    status, body, headers = _post_raw(
+        host, port, {"texts": texts}, headers={"If-None-Match": etag}
+    )
+    assert status == 304
+    assert body == b""
+    assert headers["ETag"] == etag
+    assert tel.snapshot()["counters"]["not_modified"] == before + 1
+
+    # a non-matching validator is ignored: full response, no 304 count
+    status, body, _ = _post_raw(
+        host, port, {"texts": texts}, headers={"If-None-Match": '"stale"'}
+    )
+    assert status == 200
+    assert json.loads(body)["docs"]
+    assert tel.snapshot()["counters"]["not_modified"] == before + 1
+
+
+def test_pad_and_real_token_counters_on_dispatch(served):
+    """Every dispatched batch contributes real_tokens (sum of doc lens)
+    and pad_tokens (B*T - real) to the serving counters."""
+    engine, tel, host, port = served
+    before = tel.snapshot()["counters"]
+    status, _ = _post(host, port, {"texts": ["a short doc"]})
+    assert status == 200
+    after = tel.snapshot()["counters"]
+    assert after["real_tokens"] > before.get("real_tokens", 0)
+    # a 3-token doc in a padded bucket always pads something
+    assert after["pad_tokens"] > before.get("pad_tokens", 0)
+
+
+def test_batch_span_pad_accounting_unit():
+    tel = ServingTelemetry()
+    with tel.batch_span(2, 4, 32, real_tokens=50):
+        pass
+    counters = tel.snapshot()["counters"]
+    assert counters["real_tokens"] == 50
+    assert counters["pad_tokens"] == 4 * 32 - 50
+    # real_tokens omitted -> pad counters stay at zero
+    tel2 = ServingTelemetry()
+    with tel2.batch_span(1, 1, 16):
+        pass
+    c2 = tel2.snapshot()["counters"]
+    assert c2["real_tokens"] == 0
+    assert c2["pad_tokens"] == 0
 
 
 def test_request_deadline_maps_to_504(serve_nlp):
